@@ -1,0 +1,28 @@
+"""brpc_tpu — a TPU-native RPC framework with the capability surface of apache/brpc.
+
+Layering mirrors the reference's strict 4-library stack (see SURVEY.md §1 and
+reference CMakeLists.txt:428-433) re-imagined for TPU hosts:
+
+  utils/    ≙ src/butil   — IOBuf, pools, EndPoint (incl. tpu://), flags, logging
+  metrics/  ≙ src/bvar    — lock-minimal metrics: Adder/Window/LatencyRecorder/...
+  fiber/    ≙ src/bthread — M:N fiber scheduler (native C++ core under native/)
+  rpc/      ≙ src/brpc    — Server, Channel, Controller, protocols
+  cluster/  ≙ src/brpc/policy — naming services, load balancers, circuit breaker,
+              health checking, concurrency limiters
+  parallel/ — combo channels (ParallelChannel/PartitionChannel/SelectiveChannel,
+              reference parallel_channel.h:185) lowered to XLA collectives over a
+              jax.sharding.Mesh when sub-channels form a mesh axis
+  streaming/ — streaming RPC (reference stream.h:102) + tensor streams
+  builtin/  ≙ src/brpc/builtin — HTTP debug portal (/status /vars /flags /health ...)
+  models/, ops/ — flagship workloads (parameter-server ResNet-50) and pallas kernels
+
+The hot data path is C++ (native/), reached via ctypes; the TPU data plane is
+jax/XLA (typed array transfers + collectives), with the control plane on bytes —
+the split the reference's RDMA endpoint already makes (rdma/rdma_endpoint.h:95).
+"""
+
+__version__ = "0.1.0"
+
+from brpc_tpu.utils.endpoint import EndPoint  # noqa: F401
+from brpc_tpu.utils import flags  # noqa: F401
+from brpc_tpu.metrics import bvar  # noqa: F401

@@ -1,0 +1,1 @@
+"""metrics — lock-minimal metrics (≙ reference src/bvar, SURVEY.md §2.2)."""

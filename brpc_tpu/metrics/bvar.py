@@ -1,0 +1,741 @@
+"""bvar — write-side per-thread, read-side merge-on-demand metrics.
+
+Capability map to the reference (src/bvar):
+  Variable registry + expose/dump  — variable.h:102,133 ``expose_as``,
+                                     ``dump_exposed`` with filters
+  Adder/Maxer/Miner                — reducer.h:69,224,258,308 over per-thread
+                                     agents (detail/combiner.h): each writer
+                                     thread mutates only its own agent; reads
+                                     merge all agents
+  Window / PerSecond               — window.h over per-second sampled series
+                                     (detail/sampler.cpp: one global sampler
+                                     thread ticks every second)
+  IntRecorder + Percentile         — average + reservoir percentile estimator
+                                     (detail/percentile.h:134)
+  LatencyRecorder                  — composite per-method server metric
+                                     (latency_recorder.h:32-75)
+  PassiveStatus / Status           — value computed on read / settable value
+  MultiDimension                   — labeled metrics for Prometheus export
+                                     (multi_dimension.h:35)
+  GFlag bridge                     — flags mirrored as variables (bvar/gflag.cpp)
+
+Python writers on the hot path touch only their own thread's agent (a plain
+attribute store), so there is no cross-thread contention; the native C++ core
+mirrors this design for its internal counters and publishes them through the
+same registry (see native/src/metrics.h).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import random
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from brpc_tpu.utils import flags as _flags
+
+# ---------------------------------------------------------------------------
+# Registry
+
+
+class _Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._vars: Dict[str, "Variable"] = {}
+
+    def expose(self, name: str, var: "Variable") -> bool:
+        with self._lock:
+            if name in self._vars:
+                return False
+            self._vars[name] = var
+            return True
+
+    def hide(self, name: str) -> bool:
+        with self._lock:
+            return self._vars.pop(name, None) is not None
+
+    def get(self, name: str) -> Optional["Variable"]:
+        with self._lock:
+            return self._vars.get(name)
+
+    def items(self) -> List[Tuple[str, "Variable"]]:
+        with self._lock:
+            return sorted(self._vars.items())
+
+
+_registry = _Registry()
+
+
+def describe_exposed(name: str) -> Optional[str]:
+    v = _registry.get(name)
+    return None if v is None else v.describe()
+
+
+def dump_exposed(filter_fn: Optional[Callable[[str], bool]] = None
+                 ) -> List[Tuple[str, str]]:
+    """≙ Variable::dump_exposed (variable.h:153)."""
+    out = []
+    for name, var in _registry.items():
+        if filter_fn is None or filter_fn(name):
+            out.append((name, var.describe()))
+    return out
+
+
+class Variable:
+    """Base of everything exposable (≙ bvar::Variable, variable.h:102)."""
+
+    def __init__(self):
+        self._name: Optional[str] = None
+
+    def get_value(self) -> Any:
+        raise NotImplementedError
+
+    def describe(self) -> str:
+        v = self.get_value()
+        if isinstance(v, float):
+            return f"{v:.6g}"
+        return str(v)
+
+    def expose(self, name: str) -> bool:
+        name = name.strip().replace(" ", "_")
+        if self._name is not None:
+            _registry.hide(self._name)
+        ok = _registry.expose(name, self)
+        if ok:
+            self._name = name
+        return ok
+
+    def expose_as(self, prefix: str, name: str) -> bool:
+        return self.expose(f"{prefix}_{name}" if prefix else name)
+
+    def hide(self) -> bool:
+        if self._name is None:
+            return False
+        ok = _registry.hide(self._name)
+        self._name = None
+        return ok
+
+    @property
+    def name(self) -> Optional[str]:
+        return self._name
+
+
+# ---------------------------------------------------------------------------
+# Reducers over per-thread agents
+
+
+class _Agent:
+    __slots__ = ("value", "last")
+
+    def __init__(self, identity):
+        self.value = identity
+        self.last = None  # sampler-thread-private cumulative snapshot
+
+
+class _Reducer(Variable):
+    """Per-thread-agent combiner (≙ detail::AgentCombiner, detail/combiner.h)."""
+
+    def __init__(self, identity, op: Callable[[Any, Any], Any]):
+        super().__init__()
+        self._identity = identity
+        self._op = op
+        self._agents_lock = threading.Lock()
+        self._agents: List[_Agent] = []
+        self._tls = threading.local()
+        self._window_sampler: Optional["_WindowSampler"] = None
+
+    def _shared_window_sampler(self) -> "_WindowSampler":
+        """All Windows over one reducer share one sampler — a second
+        independent sampler would also call reset() and the two would split
+        the per-second deltas between them."""
+        with self._agents_lock:
+            if self._window_sampler is None:
+                self._window_sampler = _WindowSampler(self, _MAX_WINDOW, True)
+            self._window_sampler.refs += 1
+            return self._window_sampler
+
+    def _release_window_sampler(self):
+        with self._agents_lock:
+            s = self._window_sampler
+            if s is not None:
+                s.refs -= 1
+                if s.refs <= 0:
+                    s.destroy()
+                    self._window_sampler = None
+
+    def _my_agent(self) -> _Agent:
+        a = getattr(self._tls, "agent", None)
+        if a is None:
+            a = _Agent(self._identity)
+            with self._agents_lock:
+                self._agents.append(a)
+            self._tls.agent = a
+        return a
+
+    def get_value(self):
+        with self._agents_lock:
+            agents = list(self._agents)
+        v = self._identity
+        for a in agents:
+            v = self._op(v, a.value)
+        return v
+
+    # Sampling. Adder/IntRecorder sample per-tick *deltas* without writing to
+    # agents at all (writers do non-atomic read-modify-write under the GIL, so
+    # a sampler store could double-count an in-flight increment; tracking the
+    # last-seen cumulative value per agent is race-free because only the
+    # single sampler thread reads/writes `last`). Maxer/Miner have no delta
+    # form, so their sample resets the agent (a max racing the reset may slip
+    # into the adjacent second — same tolerance as the reference's
+    # agent-exchange).
+    _samples_as_delta = False
+
+    def reset(self):
+        """Take one per-interval sample (called by the sampler thread only)."""
+        with self._agents_lock:
+            agents = list(self._agents)
+        v = self._identity
+        if self._samples_as_delta:
+            for a in agents:
+                cur = a.value
+                last = getattr(a, "last", None)
+                if last is None:
+                    delta = cur
+                else:
+                    delta = self._sub(cur, last)
+                a.last = cur
+                v = self._op(v, delta)
+        else:
+            for a in agents:
+                v = self._op(v, a.value)
+                a.value = self._identity
+        return v
+
+    @staticmethod
+    def _sub(cur, last):
+        return cur - last
+
+    def _unsampled_remainder(self):
+        """Value accumulated since the last sampler tick (read-only)."""
+        with self._agents_lock:
+            agents = list(self._agents)
+        v = self._identity
+        if self._samples_as_delta:
+            for a in agents:
+                cur, last = a.value, a.last
+                v = self._op(v, cur if last is None else self._sub(cur, last))
+        else:
+            for a in agents:
+                v = self._op(v, a.value)
+        return v
+
+
+class Adder(_Reducer):
+    """≙ bvar::Adder (reducer.h:224)."""
+
+    _samples_as_delta = True
+
+    def __init__(self, name: Optional[str] = None):
+        super().__init__(0, lambda a, b: a + b)
+        if name:
+            self.expose(name)
+
+    def add(self, v=1):
+        self._my_agent().value += v
+
+    def __lshift__(self, v):
+        self.add(v)
+        return self
+
+
+class Maxer(_Reducer):
+    """≙ bvar::Maxer (reducer.h:258)."""
+
+    def __init__(self, name: Optional[str] = None):
+        super().__init__(None, lambda a, b: b if a is None else
+                         (a if b is None else max(a, b)))
+        if name:
+            self.expose(name)
+
+    def update(self, v):
+        a = self._my_agent()
+        if a.value is None or v > a.value:
+            a.value = v
+
+    __lshift__ = lambda self, v: (self.update(v), self)[1]
+
+    def get_value(self):
+        v = super().get_value()
+        return 0 if v is None else v
+
+
+class Miner(_Reducer):
+    """≙ bvar::Miner (reducer.h:308)."""
+
+    def __init__(self, name: Optional[str] = None):
+        super().__init__(None, lambda a, b: b if a is None else
+                         (a if b is None else min(a, b)))
+        if name:
+            self.expose(name)
+
+    def update(self, v):
+        a = self._my_agent()
+        if a.value is None or v < a.value:
+            a.value = v
+
+    __lshift__ = lambda self, v: (self.update(v), self)[1]
+
+    def get_value(self):
+        v = super().get_value()
+        return 0 if v is None else v
+
+
+# ---------------------------------------------------------------------------
+# Status / PassiveStatus
+
+
+class Status(Variable):
+    """Settable value (≙ bvar::Status, status.h)."""
+
+    def __init__(self, name: Optional[str] = None, value: Any = 0):
+        super().__init__()
+        self._value = value
+        if name:
+            self.expose(name)
+
+    def set_value(self, v):
+        self._value = v
+
+    def get_value(self):
+        return self._value
+
+
+class PassiveStatus(Variable):
+    """Value computed on read (≙ bvar::PassiveStatus, status.h; used for
+    worker_usage / run-queue sizes, reference task_control.h:123-129)."""
+
+    def __init__(self, fn: Callable[[], Any], name: Optional[str] = None):
+        super().__init__()
+        self._fn = fn
+        if name:
+            self.expose(name)
+
+    def get_value(self):
+        return self._fn()
+
+
+class GFlag(PassiveStatus):
+    """Flag mirrored as a variable (≙ bvar::GFlag, bvar/gflag.cpp)."""
+
+    def __init__(self, flag_name: str, expose_name: Optional[str] = None):
+        super().__init__(lambda: _flags.get_flag(flag_name),
+                         expose_name or flag_name)
+
+
+# ---------------------------------------------------------------------------
+# Sampler thread + Window / PerSecond
+
+_MAX_WINDOW = 600
+
+
+class _SamplerCollector(threading.Thread):
+    """One global thread sampling every second
+    (≙ detail::SamplerCollector, detail/sampler.cpp)."""
+
+    _instance = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self):
+        super().__init__(name="bvar_sampler", daemon=True)
+        self._lock = threading.Lock()
+        self._samplers: List["_WindowSampler"] = []
+        self._stop = threading.Event()
+
+    @classmethod
+    def instance(cls) -> "_SamplerCollector":
+        with cls._instance_lock:
+            if cls._instance is None or not cls._instance.is_alive():
+                cls._instance = cls()
+                cls._instance.start()
+            return cls._instance
+
+    def schedule(self, s: "_WindowSampler"):
+        with self._lock:
+            self._samplers.append(s)
+
+    def unschedule(self, s: "_WindowSampler"):
+        with self._lock:
+            try:
+                self._samplers.remove(s)
+            except ValueError:
+                pass
+
+    def run(self):
+        while not self._stop.wait(1.0):
+            with self._lock:
+                samplers = list(self._samplers)
+            for s in samplers:
+                try:
+                    s.take_sample()
+                except Exception:
+                    pass
+
+
+class _WindowSampler:
+    """Keeps last N per-second samples of a reducer."""
+
+    def __init__(self, owner, window_size: int, reset_each_second: bool):
+        self.owner = owner
+        self.window_size = min(window_size, _MAX_WINDOW)
+        self.reset_each_second = reset_each_second
+        self.refs = 0
+        self._lock = threading.Lock()
+        self._q: deque = deque(maxlen=self.window_size + 1)
+        _SamplerCollector.instance().schedule(self)
+
+    def take_sample(self):
+        if self.reset_each_second:
+            v = self.owner.reset()
+        else:
+            v = self.owner.get_value()
+        with self._lock:
+            self._q.append((time.monotonic(), v))
+
+    def samples(self) -> List[Tuple[float, Any]]:
+        with self._lock:
+            return list(self._q)
+
+    def destroy(self):
+        """Stop sampling (≙ reference samplers destroyed with their Variable —
+        without this every Window/Percentile ever created leaks into the
+        collector and its reducer is pinned forever)."""
+        _SamplerCollector.instance().unschedule(self)
+
+
+class Window(Variable):
+    """Value of a reducer over the last ``window_size`` seconds
+    (≙ bvar::Window, window.h).
+
+    Every sample is a *per-second* value (Adder/IntRecorder: the delta
+    accumulated that second; Maxer/Miner: the extremum seen that second,
+    agents reset per tick as the reference's Window<Maxer> does); the window
+    value folds the samples with the reducer's own op, plus the live partial
+    second, so a spike ages out of the window instead of sticking forever.
+    """
+
+    def __init__(self, reducer: _Reducer, window_size: int = 10,
+                 name: Optional[str] = None):
+        super().__init__()
+        self._reducer = reducer
+        self._window = window_size
+        self._sampler = reducer._shared_window_sampler()
+        if name:
+            self.expose(name)
+
+    def get_value(self):
+        op = self._reducer._op
+        samples = self._sampler.samples()[-self._window:]
+        acc = self._reducer._identity
+        for _, v in samples:
+            acc = op(acc, v)
+        # include the not-yet-sampled partial second. For delta reducers the
+        # live value is (current - last-sampled) per agent; approximating with
+        # get_value() would re-count already-sampled history, so compute the
+        # unsampled remainder explicitly.
+        acc = op(acc, self._reducer._unsampled_remainder())
+        if acc is None:  # Maxer/Miner identity with no data
+            return 0
+        return acc
+
+    def close(self):
+        self._reducer._release_window_sampler()
+        self.hide()
+
+
+class PerSecond(Variable):
+    """Windowed rate (≙ bvar::PerSecond, window.h)."""
+
+    def __init__(self, adder: Adder, window_size: int = 10,
+                 name: Optional[str] = None):
+        super().__init__()
+        self._win = Window(adder, window_size)
+        self._window_size = window_size
+        if name:
+            self.expose(name)
+
+    def get_value(self):
+        samples = self._win._sampler.samples()
+        if len(samples) < 2:
+            return 0
+        # each sample is the delta accumulated over one 1s sampler tick; rate
+        # is their mean over the window (the live partial second is excluded —
+        # including it would overcount the denominator's whole seconds)
+        use = samples[-min(self._window_size, len(samples)):]
+        total = 0
+        for _, v in use:
+            total += v
+        return total / len(use)
+
+    def close(self):
+        self._win.close()
+        self.hide()
+
+
+# ---------------------------------------------------------------------------
+# IntRecorder + Percentile + LatencyRecorder
+
+
+class IntRecorder(_Reducer):
+    """Average recorder: per-thread (sum, count) agents
+    (≙ bvar::IntRecorder, recorder.h)."""
+
+    _samples_as_delta = True
+
+    def __init__(self, name: Optional[str] = None):
+        super().__init__((0, 0), lambda a, b: (a[0] + b[0], a[1] + b[1]))
+        if name:
+            self.expose(name)
+
+    @staticmethod
+    def _sub(cur, last):
+        return (cur[0] - last[0], cur[1] - last[1])
+
+    def record(self, v: int):
+        a = self._my_agent()
+        s, c = a.value
+        a.value = (s + v, c + 1)
+
+    __lshift__ = lambda self, v: (self.record(v), self)[1]
+
+    def average(self) -> float:
+        s, c = self.get_value()
+        return (s / c) if c else 0.0
+
+    def describe(self) -> str:
+        return f"{self.average():.6g}"
+
+
+_RESERVOIR = 254  # samples kept per interval (≙ percentile.h SAMPLE_SIZE)
+
+
+class _PercentileInterval:
+    __slots__ = ("samples", "count")
+
+    def __init__(self):
+        self.samples: List[int] = []
+        self.count = 0
+
+    def add(self, v: int):
+        self.count += 1
+        if len(self.samples) < _RESERVOIR:
+            self.samples.append(v)
+        else:
+            # reservoir sampling keeps the kept set uniform over all added
+            i = random.randrange(self.count)
+            if i < _RESERVOIR:
+                self.samples[i] = v
+
+
+class Percentile:
+    """Randomized-reservoir percentile estimator over a sliding window
+    (≙ bvar::detail::Percentile, detail/percentile.h:134)."""
+
+    def __init__(self, window_size: int = 10):
+        self._lock = threading.Lock()
+        self._window = window_size
+        self._current = _PercentileInterval()
+        self._q: deque = deque(maxlen=window_size)
+        self._sampler = _WindowSampler(self, window_size, True)
+
+    # duck-typed reducer API for the sampler
+    def reset(self):
+        with self._lock:
+            iv = self._current
+            self._current = _PercentileInterval()
+            self._q.append(iv)
+        return iv
+
+    def get_value(self):
+        return None
+
+    def record(self, v: int):
+        with self._lock:
+            self._current.add(v)
+
+    def get_number(self, ratio: float) -> int:
+        with self._lock:
+            intervals = list(self._q) + [self._current]
+        merged: List[int] = []
+        for iv in intervals:
+            merged.extend(iv.samples)
+        if not merged:
+            return 0
+        merged.sort()
+        idx = min(len(merged) - 1, int(ratio * len(merged)))
+        return merged[idx]
+
+    def close(self):
+        self._sampler.destroy()
+
+
+class LatencyRecorder(Variable):
+    """Composite latency/qps metric: avg, p50/p90/p99/p999/p9999, max, qps,
+    count (≙ bvar::LatencyRecorder, latency_recorder.h:32-75).
+
+    ``expose(prefix)`` publishes the same sub-variable names the reference
+    does: <prefix>_latency, _max_latency, _qps, _count, _latency_percentiles.
+    """
+
+    def __init__(self, window_size: int = 10):
+        super().__init__()
+        self._latency = IntRecorder()
+        self._latency_window = Window(self._latency, window_size)
+        self._max = Maxer()
+        self._max_window = Window(self._max, window_size)
+        self._count = Adder()
+        self._qps = PerSecond(self._count, window_size)
+        self._percentile = Percentile(window_size)
+
+    def record(self, latency_us: int):
+        self._latency.record(latency_us)
+        self._max.update(latency_us)
+        self._count.add(1)
+        self._percentile.record(latency_us)
+
+    __lshift__ = lambda self, v: (self.record(v), self)[1]
+
+    def latency(self) -> float:
+        v = self._latency_window.get_value()
+        if isinstance(v, tuple):
+            s, c = v
+            return s / c if c else 0.0
+        return 0.0
+
+    def latency_percentile(self, ratio: float) -> int:
+        return self._percentile.get_number(ratio)
+
+    def max_latency(self) -> int:
+        return self._max_window.get_value() or 0
+
+    def qps(self) -> float:
+        return self._qps.get_value()
+
+    def count(self) -> int:
+        return self._count.get_value()
+
+    def get_value(self):
+        return self.latency()
+
+    def expose(self, prefix: str) -> bool:  # type: ignore[override]
+        self.hide()
+        self._name = prefix
+        self._sub_vars = [
+            PassiveStatus(self.latency, f"{prefix}_latency"),
+            PassiveStatus(self.max_latency, f"{prefix}_max_latency"),
+            PassiveStatus(self.qps, f"{prefix}_qps"),
+            PassiveStatus(self.count, f"{prefix}_count"),
+        ]
+        for p, nm in ((0.5, "50"), (0.9, "90"), (0.99, "99"),
+                      (0.999, "999"), (0.9999, "9999")):
+            self._sub_vars.append(
+                PassiveStatus(lambda p=p: self.latency_percentile(p),
+                              f"{prefix}_latency_{nm}"))
+        return True
+
+    def hide(self) -> bool:  # type: ignore[override]
+        for v in getattr(self, "_sub_vars", []):
+            v.hide()
+        self._sub_vars = []
+        self._name = None
+        return True
+
+    def close(self):
+        """Unregister and stop all samplers (call when the method/connection
+        this recorder instruments goes away)."""
+        self.hide()
+        self._latency_window.close()
+        self._max_window.close()
+        self._qps._win.close()
+        self._percentile.close()
+
+
+# ---------------------------------------------------------------------------
+# MultiDimension (labeled metrics)
+
+
+class MultiDimension(Variable):
+    """Labeled family of variables (≙ bvar::MultiDimension, multi_dimension.h:35);
+    exported with labels by the Prometheus dumper."""
+
+    def __init__(self, name: str, labels: Sequence[str],
+                 factory: Callable[[], Variable] = Adder):
+        super().__init__()
+        self._labels = tuple(labels)
+        self._factory = factory
+        self._lock = threading.Lock()
+        self._stats: Dict[Tuple[str, ...], Variable] = {}
+        self.expose(name)
+
+    @property
+    def labels(self) -> Tuple[str, ...]:
+        return self._labels
+
+    def get_stats(self, label_values: Sequence[str]) -> Variable:
+        key = tuple(str(v) for v in label_values)
+        if len(key) != len(self._labels):
+            raise ValueError(f"expected {len(self._labels)} label values")
+        with self._lock:
+            v = self._stats.get(key)
+            if v is None:
+                v = self._factory()
+                self._stats[key] = v
+            return v
+
+    def items(self) -> List[Tuple[Tuple[str, ...], Variable]]:
+        with self._lock:
+            return list(self._stats.items())
+
+    def count_stats(self) -> int:
+        with self._lock:
+            return len(self._stats)
+
+    def get_value(self):
+        return self.count_stats()
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text export (≙ builtin/prometheus_metrics_service.cpp)
+
+
+def _prom_name(name: str) -> str:
+    return "".join(c if (c.isalnum() or c == "_") else "_" for c in name)
+
+
+def _prom_label_value(v: str) -> str:
+    """Escape per the Prometheus text exposition format."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def dump_prometheus() -> str:
+    lines: List[str] = []
+    for name, var in _registry.items():
+        pname = _prom_name(name)
+        if isinstance(var, MultiDimension):
+            lines.append(f"# TYPE {pname} gauge")
+            for key, sub in var.items():
+                lbl = ",".join(f'{k}="{_prom_label_value(v)}"'
+                               for k, v in zip(var.labels, key))
+                val = sub.get_value()
+                if isinstance(val, tuple):
+                    val = (val[0] / val[1]) if val[1] else 0
+                if isinstance(val, (int, float)):
+                    lines.append(f"{pname}{{{lbl}}} {val}")
+            continue
+        val = var.get_value()
+        if isinstance(val, tuple):
+            val = (val[0] / val[1]) if val[1] else 0
+        if isinstance(val, (int, float)):
+            lines.append(f"# TYPE {pname} gauge")
+            lines.append(f"{pname} {val}")
+    return "\n".join(lines) + "\n"

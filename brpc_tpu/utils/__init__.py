@@ -1,0 +1,1 @@
+"""utils — base library (≙ reference src/butil, SURVEY.md §2.1)."""

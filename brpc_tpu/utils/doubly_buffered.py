@@ -1,0 +1,83 @@
+"""DoublyBufferedData — read-mostly RCU-like container.
+
+The reference (butil/containers/doubly_buffered_data.h) keeps two copies of the
+data; readers grab the foreground copy through a thread-local reference with no
+contended atomics, the writer modifies the background copy, atomically flips,
+waits out readers of the old foreground, then applies the same modification to
+the (now background) old copy.  It is the backbone of load-balancer server
+lists (reference load_balancer.h:72) and the client SocketMap.
+
+The Python flip keeps the same reader guarantee (a reader never observes a
+torn copy, and never blocks the writer's first modification) using a
+per-reader epoch ticket instead of thread-local mutexes; CPython reference
+assignment is atomic, so readers take a snapshot of the foreground index
+without locking.  The native C++ core has the faithful wait-free reader
+(native/src/doubly_buffered.h) for hot paths.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Generic, List, TypeVar
+
+T = TypeVar("T")
+
+
+class DoublyBufferedData(Generic[T]):
+    def __init__(self, factory: Callable[[], T]):
+        self._data: List[T] = [factory(), factory()]
+        self._fg = 0  # index of foreground copy; assignment is atomic in CPython
+        self._write_lock = threading.Lock()
+        # per-copy reader counters guarded by a lock each; readers touch only
+        # the counter of their snapshot copy (cheap, uncontended with writer
+        # except during a flip)
+        self._ref_locks = [threading.Lock(), threading.Lock()]
+        self._refs = [0, 0]
+        self._no_readers = [threading.Condition(self._ref_locks[0]),
+                            threading.Condition(self._ref_locks[1])]
+
+    class ScopedPtr(Generic[T]):
+        """Reader handle (≙ DoublyBufferedData<T>::ScopedPtr)."""
+
+        __slots__ = ("_dbd", "_idx", "data")
+
+        def __init__(self, dbd: "DoublyBufferedData[T]"):
+            self._dbd = dbd
+            while True:
+                idx = dbd._fg
+                with dbd._ref_locks[idx]:
+                    if idx == dbd._fg:  # not flipped between snapshot and lock
+                        dbd._refs[idx] += 1
+                        self._idx = idx
+                        self.data = dbd._data[idx]
+                        return
+
+        def __enter__(self) -> T:
+            return self.data
+
+        def __exit__(self, *exc) -> None:
+            self.release()
+
+        def release(self) -> None:
+            dbd, idx = self._dbd, self._idx
+            with dbd._ref_locks[idx]:
+                dbd._refs[idx] -= 1
+                if dbd._refs[idx] == 0:
+                    dbd._no_readers[idx].notify_all()
+
+    def read(self) -> "DoublyBufferedData.ScopedPtr[T]":
+        return DoublyBufferedData.ScopedPtr(self)
+
+    def modify(self, fn: Callable[[T], bool]) -> bool:
+        """Apply ``fn`` to both copies, flipping in between (≙ Modify())."""
+        with self._write_lock:
+            bg = 1 - self._fg
+            if not fn(self._data[bg]):
+                return False
+            self._fg = bg  # flip: new readers go to the modified copy
+            old = 1 - bg
+            with self._ref_locks[old]:
+                while self._refs[old] != 0:
+                    self._no_readers[old].wait()
+            fn(self._data[old])
+            return True

@@ -1,0 +1,112 @@
+"""EndPoint — address value type, extended with tpu:// device endpoints.
+
+The reference's EndPoint (butil/endpoint.h:87-147) is an ip:port value type with
+parsing/resolving helpers and unix-socket support.  The TPU build extends the
+grammar with device endpoints (BASELINE.json north star: a Channel can dial
+``tpu://slice/chip``):
+
+    "127.0.0.1:8000"          host TCP endpoint
+    "unix:/tmp/s.sock"        unix domain socket
+    "tpu://0/3"               slice 0, chip 3 (data plane rides ICI/PJRT;
+                              control plane rides DCN/TCP — the RDMA split,
+                              reference rdma/rdma_endpoint.h:95)
+    "tpu://0/3@10.0.0.2:9000" device endpoint with explicit control address
+"""
+
+from __future__ import annotations
+
+import re
+import socket as _socket
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+
+class EndPointError(ValueError):
+    pass
+
+
+@dataclass(frozen=True)
+class EndPoint:
+    """ip:port | unix path | tpu device coordinate (immutable value type)."""
+
+    ip: str = ""
+    port: int = 0
+    # "tcp" | "unix" | "tpu"
+    scheme: str = "tcp"
+    # tpu:// coordinates (scheme == "tpu")
+    slice_id: int = -1
+    chip_id: int = -1
+
+    def __str__(self) -> str:
+        if self.scheme == "unix":
+            return f"unix:{self.ip}"
+        if self.scheme == "tpu":
+            base = f"tpu://{self.slice_id}/{self.chip_id}"
+            if self.ip:
+                return f"{base}@{self.ip}:{self.port}"
+            return base
+        return f"{self.ip}:{self.port}"
+
+    @property
+    def is_device(self) -> bool:
+        return self.scheme == "tpu"
+
+    def control_address(self) -> Tuple[str, int]:
+        """Host address carrying the control plane (handshake/meta)."""
+        if self.scheme == "tpu" and not self.ip:
+            raise EndPointError(f"{self} has no control address attached")
+        return (self.ip, self.port)
+
+
+_TPU_RE = re.compile(r"^tpu://(\d+)/(\d+)(?:@([^:]+):(\d+))?$")
+
+
+def str2endpoint(s: str) -> EndPoint:
+    """Parse any endpoint grammar (≙ butil::str2endpoint, endpoint.h:107)."""
+    s = s.strip()
+    if s.startswith("unix:"):
+        path = s[len("unix:"):]
+        if not path:
+            raise EndPointError(f"empty unix path in {s!r}")
+        return EndPoint(ip=path, port=0, scheme="unix")
+    m = _TPU_RE.match(s)
+    if m:
+        slice_id, chip_id = int(m.group(1)), int(m.group(2))
+        ip = m.group(3) or ""
+        port = int(m.group(4)) if m.group(4) else 0
+        return EndPoint(ip=ip, port=port, scheme="tpu",
+                        slice_id=slice_id, chip_id=chip_id)
+    if s.startswith("tpu://"):
+        raise EndPointError(f"malformed tpu endpoint {s!r}")
+    # ip:port  (allow [v6]:port)
+    if s.startswith("["):
+        host, _, rest = s[1:].partition("]")
+        if not rest.startswith(":"):
+            raise EndPointError(f"malformed endpoint {s!r}")
+        return EndPoint(ip=host, port=_parse_port(rest[1:], s), scheme="tcp")
+    host, sep, port = s.rpartition(":")
+    if not sep:
+        raise EndPointError(f"missing port in {s!r}")
+    return EndPoint(ip=host, port=_parse_port(port, s), scheme="tcp")
+
+
+def _parse_port(p: str, whole: str) -> int:
+    try:
+        v = int(p)
+    except ValueError:
+        raise EndPointError(f"bad port in {whole!r}") from None
+    if not (0 <= v <= 65535):
+        raise EndPointError(f"port out of range in {whole!r}")
+    return v
+
+
+def hostname2endpoint(host: str, port: Optional[int] = None) -> EndPoint:
+    """Resolve host[:port] via DNS (≙ butil::hostname2endpoint, endpoint.h:117)."""
+    if port is None:
+        name, sep, p = host.rpartition(":")
+        if not sep:
+            raise EndPointError(f"missing port in {host!r}")
+        port = _parse_port(p, host)
+        host = name
+    ip = _socket.gethostbyname(host)
+    return EndPoint(ip=ip, port=port, scheme="tcp")

@@ -1,0 +1,131 @@
+"""Runtime-reloadable flags — capability of gflags + reloadable_flags.
+
+The reference's config system is pure gflags: every tunable is a DEFINE_xxx next
+to its code, with validated hot reload (reference reloadable_flags.h:32-60) and
+live GET/SET through the builtin /flags HTTP service
+(reference builtin/flags_service.cpp).  This module reproduces that model:
+
+    FLAGS = define_int32("event_dispatcher_num", 1, "number of epoll threads")
+    ...
+    set_flag("event_dispatcher_num", 4)     # validated hot reload
+
+Flags are also mirrored into the metrics registry on demand (the reference
+mirrors gflags as bvars, bvar/gflag.cpp) — see metrics.bvar.GFlag.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Iterable, Optional
+
+
+class FlagError(Exception):
+    pass
+
+
+class Flag:
+    __slots__ = ("name", "default", "help", "type", "validator", "_value", "reloadable")
+
+    def __init__(self, name: str, default: Any, help: str, type_: type,
+                 validator: Optional[Callable[[Any], bool]] = None,
+                 reloadable: bool = True):
+        self.name = name
+        self.default = default
+        self.help = help
+        self.type = type_
+        self.validator = validator
+        self.reloadable = reloadable
+        self._value = default
+
+    @property
+    def value(self) -> Any:
+        return self._value
+
+    def set(self, value: Any) -> None:
+        try:
+            if self.type is bool and isinstance(value, str):
+                value = value.lower() in ("1", "true", "yes", "on")
+            else:
+                value = self.type(value)
+        except (TypeError, ValueError) as e:
+            raise FlagError(f"flag {self.name}: cannot convert {value!r} to "
+                            f"{self.type.__name__}") from e
+        if not self.reloadable and _registry.frozen:
+            raise FlagError(f"flag {self.name} is not reloadable")
+        if self.validator is not None and not self.validator(value):
+            raise FlagError(f"flag {self.name}: validator rejected {value!r}")
+        self._value = value
+
+
+class _Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._flags: Dict[str, Flag] = {}
+        self.frozen = False  # set once a Server starts; non-reloadable flags lock
+
+    def define(self, name: str, default: Any, help: str, type_: type,
+               validator=None, reloadable=True) -> Flag:
+        with self._lock:
+            if name in self._flags:
+                raise FlagError(f"flag {name} already defined")
+            f = Flag(name, default, help, type_, validator, reloadable)
+            self._flags[name] = f
+            return f
+
+    def get(self, name: str) -> Flag:
+        try:
+            return self._flags[name]
+        except KeyError:
+            raise FlagError(f"no such flag: {name}") from None
+
+    def set(self, name: str, value: Any) -> None:
+        self.get(name).set(value)
+
+    def all(self) -> Iterable[Flag]:
+        return list(self._flags.values())
+
+
+_registry = _Registry()
+
+
+def define_int32(name, default, help="", validator=None, reloadable=True) -> Flag:
+    return _registry.define(name, int(default), help, int, validator, reloadable)
+
+
+define_int64 = define_int32
+
+
+def define_bool(name, default, help="", validator=None, reloadable=True) -> Flag:
+    return _registry.define(name, bool(default), help, bool, validator, reloadable)
+
+
+def define_double(name, default, help="", validator=None, reloadable=True) -> Flag:
+    return _registry.define(name, float(default), help, float, validator, reloadable)
+
+
+def define_string(name, default, help="", validator=None, reloadable=True) -> Flag:
+    return _registry.define(name, str(default), help, str, validator, reloadable)
+
+
+def get_flag(name: str) -> Any:
+    return _registry.get(name).value
+
+
+def set_flag(name: str, value: Any) -> None:
+    _registry.set(name, value)
+
+
+def flag_exists(name: str) -> bool:
+    try:
+        _registry.get(name)
+        return True
+    except FlagError:
+        return False
+
+
+def all_flags():
+    return _registry.all()
+
+
+def freeze_nonreloadable():
+    _registry.frozen = True

@@ -1,0 +1,98 @@
+"""Chromium-style logging facade (≙ butil/logging.h).
+
+Capabilities kept from the reference: leveled LOG streams, CHECK macros,
+VLOG with per-module runtime-adjustable verbosity (surfaced by the builtin
+/vlog service, reference builtin/vlog_service.cpp), and a pluggable LogSink.
+Implemented over the stdlib logging module so users can interpose handlers.
+"""
+
+from __future__ import annotations
+
+import logging as _pylog
+import sys
+import threading
+from typing import Dict, Optional
+
+_logger = _pylog.getLogger("brpc_tpu")
+if not _logger.handlers:
+    _h = _pylog.StreamHandler(sys.stderr)
+    _h.setFormatter(_pylog.Formatter(
+        "%(levelname).1s%(asctime)s %(threadName)s %(filename)s:%(lineno)d] %(message)s",
+        datefmt="%m%d %H:%M:%S"))
+    _logger.addHandler(_h)
+    _logger.setLevel(_pylog.INFO)
+    _logger.propagate = False
+
+LOG_INFO = _pylog.INFO
+LOG_WARNING = _pylog.WARNING
+LOG_ERROR = _pylog.ERROR
+LOG_FATAL = _pylog.CRITICAL
+
+
+class CheckError(AssertionError):
+    pass
+
+
+def LOG(level: int, msg: str, *args) -> None:
+    _logger.log(level, msg, *args, stacklevel=2)
+
+
+def LOG_IF(level: int, cond: bool, msg: str, *args) -> None:
+    if cond:
+        _logger.log(level, msg, *args, stacklevel=2)
+
+
+def CHECK(cond, msg: str = "", *args):
+    if not cond:
+        text = ("CHECK failed: " + (msg % args if args else msg)) if msg \
+            else "CHECK failed"
+        _logger.critical(text, stacklevel=2)
+        raise CheckError(text)
+    return cond
+
+
+def CHECK_EQ(a, b, msg: str = ""):
+    if a != b:
+        CHECK(False, f"{a!r} != {b!r} {msg}")
+
+
+# --- VLOG with per-module runtime levels (≙ /vlog service) -------------------
+
+_vlock = threading.Lock()
+_vmodule: Dict[str, int] = {}
+_global_v = 0
+
+
+def set_vlog_level(level: int, module: Optional[str] = None) -> None:
+    global _global_v
+    with _vlock:
+        if module is None:
+            _global_v = level
+        else:
+            _vmodule[module] = level
+
+
+def vlog_level(module: Optional[str] = None) -> int:
+    with _vlock:
+        if module is not None and module in _vmodule:
+            return _vmodule[module]
+        return _global_v
+
+
+def vlog_modules() -> Dict[str, int]:
+    with _vlock:
+        return dict(_vmodule)
+
+
+def VLOG(verbosity: int, msg: str, *args, module: Optional[str] = None) -> None:
+    if verbosity <= vlog_level(module):
+        _logger.info("[v%d] " + msg, verbosity, *args, stacklevel=2)
+
+
+def set_log_level(level: int) -> None:
+    _logger.setLevel(level)
+
+
+def add_sink(handler: _pylog.Handler) -> None:
+    """Pluggable LogSink (≙ logging::SetLogSink)."""
+    _logger.addHandler(handler)

@@ -1,0 +1,18 @@
+"""Test env: force a virtual 8-device CPU platform before jax initializes.
+
+Mirrors the reference's "multi-node without a cluster" strategy
+(SURVEY.md §4): tests exercise real in-process transports and a real
+multi-device mesh, no mocks — loopback TCP stands in for the network and
+8 virtual CPU devices stand in for a TPU slice.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,164 @@
+"""metrics tests (≙ reference test/bvar_reducer_unittest.cpp,
+bvar_window_unittest.cpp, bvar_percentile_unittest.cpp,
+bvar_latency_recorder_unittest.cpp, bvar_mvariable_unittest.cpp)."""
+
+import threading
+
+from brpc_tpu.metrics import bvar
+from brpc_tpu.utils import flags
+
+
+class TestReducers:
+    def test_adder(self):
+        a = bvar.Adder()
+        a.add(3)
+        a << 4 << 5
+        assert a.get_value() == 12
+
+    def test_adder_multithread(self):
+        a = bvar.Adder()
+
+        def work():
+            for _ in range(10000):
+                a.add(1)
+
+        ts = [threading.Thread(target=work) for _ in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert a.get_value() == 80000
+
+    def test_maxer_miner(self):
+        m = bvar.Maxer()
+        n = bvar.Miner()
+        for v in [5, 1, 9, 3]:
+            m.update(v)
+            n.update(v)
+        assert m.get_value() == 9
+        assert n.get_value() == 1
+
+    def test_int_recorder(self):
+        r = bvar.IntRecorder()
+        for v in [10, 20, 30]:
+            r.record(v)
+        assert r.average() == 20
+
+
+class TestRegistry:
+    def test_expose_dump(self):
+        a = bvar.Adder("t_reg_adder")
+        a.add(42)
+        dumped = dict(bvar.dump_exposed(lambda n: n.startswith("t_reg_")))
+        assert dumped["t_reg_adder"] == "42"
+        assert a.hide()
+        assert "t_reg_adder" not in dict(bvar.dump_exposed())
+
+    def test_passive_status(self):
+        box = {"v": 7}
+        p = bvar.PassiveStatus(lambda: box["v"], "t_passive")
+        assert p.get_value() == 7
+        box["v"] = 8
+        assert bvar.describe_exposed("t_passive") == "8"
+        p.hide()
+
+    def test_gflag_bridge(self):
+        flags.define_int32("t_bvar_flag", 11)
+        g = bvar.GFlag("t_bvar_flag")
+        assert g.get_value() == 11
+        flags.set_flag("t_bvar_flag", 13)
+        assert g.get_value() == 13
+        g.hide()
+
+
+class TestPercentileLatency:
+    def test_percentile(self):
+        p = bvar.Percentile()
+        for v in range(1, 1001):
+            p.record(v)
+        p50 = p.get_number(0.5)
+        p99 = p.get_number(0.99)
+        assert 350 <= p50 <= 650
+        assert p99 >= 900
+
+    def test_latency_recorder(self):
+        lr = bvar.LatencyRecorder()
+        for v in [100, 200, 300, 400]:
+            lr.record(v)
+        assert lr.count() == 4
+        assert lr.max_latency() >= 400 or lr._max.get_value() == 400
+        assert lr.latency_percentile(0.5) in (100, 200, 300, 400)
+
+    def test_latency_recorder_expose(self):
+        lr = bvar.LatencyRecorder()
+        lr.expose("t_method")
+        lr.record(150)
+        names = [n for n, _ in bvar.dump_exposed(lambda n: n.startswith("t_method"))]
+        assert "t_method_qps" in names
+        assert "t_method_latency_99" in names
+
+
+class TestMultiDimension:
+    def test_labels(self):
+        md = bvar.MultiDimension("t_md_counter", ["method", "code"])
+        md.get_stats(["echo", "0"]).add(3)
+        md.get_stats(["echo", "1"]).add(1)
+        md.get_stats(["ping", "0"]).add(2)
+        assert md.count_stats() == 3
+        text = bvar.dump_prometheus()
+        assert 't_md_counter{method="echo",code="0"} 3' in text
+        md.hide()
+
+
+class TestWindowRegression:
+    def test_windowed_max_decays(self):
+        import time
+        m = bvar.Maxer()
+        w = bvar.Window(m, 2)
+        m.update(5000)
+        time.sleep(3.5)  # spike ages out of the 2s window
+        m.update(10)
+        assert w.get_value() == 10
+        w.close()
+
+    def test_two_windows_share_sampler(self):
+        import time
+        a = bvar.Adder()
+        w1 = bvar.Window(a, 10)
+        w2 = bvar.Window(a, 10)
+        a.add(7)
+        time.sleep(1.5)
+        assert w1.get_value() == 7
+        assert w2.get_value() == 7
+        w1.close()
+        w2.close()
+
+    def test_close_unschedules_sampler(self):
+        from brpc_tpu.metrics.bvar import _SamplerCollector
+        a = bvar.Adder()
+        w = bvar.Window(a, 5)
+        n0 = len(_SamplerCollector.instance()._samplers)
+        w.close()
+        assert len(_SamplerCollector.instance()._samplers) == n0 - 1
+
+    def test_prometheus_label_escaping(self):
+        md = bvar.MultiDimension("t_esc", ["path"])
+        md.get_stats(['say "hi"\\x']).add(1)
+        text = bvar.dump_prometheus()
+        assert 't_esc{path="say \\"hi\\"\\\\x"} 1' in text
+        md.hide()
+
+
+class TestWindow:
+    def test_window_includes_live_partial_second(self):
+        a = bvar.Adder()
+        w = bvar.Window(a, 10)
+        a.add(5)
+        # no sampler tick needed: live partial second counts
+        assert w.get_value() == 5
+
+    def test_per_second_zero_before_samples(self):
+        a = bvar.Adder()
+        ps = bvar.PerSecond(a, 10)
+        a.add(100)
+        assert ps.get_value() == 0

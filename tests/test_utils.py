@@ -1,0 +1,103 @@
+"""utils tests (≙ reference test/endpoint_unittest.cpp and gflags usage)."""
+
+import threading
+
+import pytest
+
+from brpc_tpu.utils import flags
+from brpc_tpu.utils.endpoint import EndPoint, EndPointError, str2endpoint
+from brpc_tpu.utils.doubly_buffered import DoublyBufferedData
+
+
+class TestEndPoint:
+    def test_tcp(self):
+        ep = str2endpoint("127.0.0.1:8000")
+        assert ep.ip == "127.0.0.1" and ep.port == 8000 and ep.scheme == "tcp"
+        assert str(ep) == "127.0.0.1:8000"
+
+    def test_unix(self):
+        ep = str2endpoint("unix:/tmp/x.sock")
+        assert ep.scheme == "unix" and ep.ip == "/tmp/x.sock"
+        assert str(ep) == "unix:/tmp/x.sock"
+
+    def test_tpu(self):
+        ep = str2endpoint("tpu://0/3")
+        assert ep.is_device and ep.slice_id == 0 and ep.chip_id == 3
+        with pytest.raises(EndPointError):
+            ep.control_address()
+
+    def test_tpu_with_control(self):
+        ep = str2endpoint("tpu://1/7@10.0.0.2:9000")
+        assert ep.slice_id == 1 and ep.chip_id == 7
+        assert ep.control_address() == ("10.0.0.2", 9000)
+        assert str(ep) == "tpu://1/7@10.0.0.2:9000"
+
+    def test_bad(self):
+        for s in ["nocolon", "1.2.3.4:99999", "tpu://x/y"]:
+            with pytest.raises(EndPointError):
+                str2endpoint(s)
+
+    def test_value_semantics(self):
+        assert str2endpoint("1.2.3.4:5") == EndPoint(ip="1.2.3.4", port=5)
+        assert hash(str2endpoint("1.2.3.4:5")) == hash(EndPoint(ip="1.2.3.4", port=5))
+
+
+class TestFlags:
+    def test_define_get_set(self):
+        flags.define_int32("t_flag_a", 3, "doc")
+        assert flags.get_flag("t_flag_a") == 3
+        flags.set_flag("t_flag_a", "7")
+        assert flags.get_flag("t_flag_a") == 7
+
+    def test_validator(self):
+        flags.define_int32("t_flag_v", 1, validator=lambda v: v > 0)
+        with pytest.raises(flags.FlagError):
+            flags.set_flag("t_flag_v", -1)
+        assert flags.get_flag("t_flag_v") == 1
+
+    def test_duplicate(self):
+        flags.define_bool("t_flag_d", True)
+        with pytest.raises(flags.FlagError):
+            flags.define_bool("t_flag_d", False)
+
+
+class TestDoublyBuffered:
+    def test_read_modify(self):
+        dbd = DoublyBufferedData(list)
+        with dbd.read() as data:
+            assert data == []
+        assert dbd.modify(lambda lst: (lst.append(1), True)[1])
+        with dbd.read() as data:
+            assert data == [1]
+
+    def test_concurrent_readers_see_consistent_copy(self):
+        dbd = DoublyBufferedData(list)
+        stop = threading.Event()
+        errors = []
+
+        def reader():
+            try:
+                while not stop.is_set():
+                    with dbd.read() as data:
+                        snapshot = list(data)
+                        # each copy is only ever mutated by modify(); a torn
+                        # read would show a non-prefix sequence
+                        if snapshot != sorted(snapshot):
+                            errors.append(snapshot)
+                            return
+            except Exception as e:  # surface thread failures to the test
+                errors.append(e)
+
+        ts = [threading.Thread(target=reader) for _ in range(4)]
+        for t in ts:
+            t.start()
+        try:
+            for i in range(200):
+                dbd.modify(lambda lst, i=i: (lst.append(i), True)[1])
+        finally:
+            stop.set()
+            for t in ts:
+                t.join()
+        assert not errors
+        with dbd.read() as data:
+            assert data == list(range(200))
