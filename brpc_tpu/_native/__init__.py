@@ -1,0 +1,76 @@
+"""ctypes loader for the native core (libbrpc_tpu_core.so).
+
+Builds the library on first import if it is missing (cmake+ninja via
+native/build.sh).  The native core provides the hot paths: IOBuf, the M:N
+fiber scheduler with butex (≙ reference src/bthread), and — as later layers
+land — sockets, the TRPC wire protocol, and the in-process bench loops.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(os.path.dirname(_HERE))
+_SO = os.path.join(_HERE, "libbrpc_tpu_core.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+FIBER_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+
+
+def _build() -> None:
+    script = os.path.join(_REPO, "native", "build.sh")
+    subprocess.run(["bash", script], check=True, capture_output=True)
+
+
+def lib() -> ctypes.CDLL:
+    """The loaded native library (building it if needed)."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO):
+            _build()
+        L = ctypes.CDLL(_SO)
+        _declare(L)
+        _lib = L
+        return L
+
+
+def _declare(L: ctypes.CDLL) -> None:
+    c = ctypes
+    L.trpc_init.argtypes = [c.c_int]
+    L.trpc_init.restype = c.c_int
+    L.trpc_workers.restype = c.c_int
+    L.trpc_runtime_stats.argtypes = [c.POINTER(c.c_uint64)]
+    L.trpc_runtime_stats.restype = None
+
+    L.trpc_fiber_start.argtypes = [c.POINTER(c.c_uint64), FIBER_FN, c.c_void_p]
+    L.trpc_fiber_start.restype = c.c_int
+    L.trpc_fiber_join.argtypes = [c.c_uint64]
+    L.trpc_fiber_join.restype = c.c_int
+    L.trpc_fiber_yield.restype = None
+    L.trpc_fiber_usleep.argtypes = [c.c_int64]
+    L.trpc_fiber_usleep.restype = None
+    L.trpc_in_fiber.restype = c.c_int
+
+    L.trpc_butex_create.restype = c.c_void_p
+    L.trpc_butex_destroy.argtypes = [c.c_void_p]
+    L.trpc_butex_load.argtypes = [c.c_void_p]
+    L.trpc_butex_load.restype = c.c_int32
+    L.trpc_butex_store.argtypes = [c.c_void_p, c.c_int32]
+    L.trpc_butex_add.argtypes = [c.c_void_p, c.c_int32]
+    L.trpc_butex_add.restype = c.c_int32
+    L.trpc_butex_wait.argtypes = [c.c_void_p, c.c_int32, c.c_int64]
+    L.trpc_butex_wait.restype = c.c_int
+    L.trpc_butex_wake.argtypes = [c.c_void_p]
+    L.trpc_butex_wake.restype = c.c_int
+    L.trpc_butex_wake_all.argtypes = [c.c_void_p]
+    L.trpc_butex_wake_all.restype = c.c_int

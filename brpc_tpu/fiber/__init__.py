@@ -1,0 +1,124 @@
+"""fiber — Python facade over the native M:N fiber runtime
+(≙ reference src/bthread, SURVEY.md §2.3; implementation native/src/fiber.cc).
+
+Python-side usage is control-plane only (starting the runtime, introspecting
+stats, waiting on butexes from host threads or PJRT completion callbacks);
+the scheduler and all hot-path fibers live in C++.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Callable, Dict, Optional
+
+from brpc_tpu._native import FIBER_FN, lib
+from brpc_tpu.metrics import bvar
+
+_started = False
+_stats_vars = []
+
+
+def init(num_workers: int = 0) -> int:
+    """Start worker pthreads (idempotent, ≙ bthread concurrency setup)."""
+    global _started
+    n = lib().trpc_init(num_workers)
+    if not _started:
+        _started = True
+        _expose_stats()
+    return n
+
+
+def workers() -> int:
+    return lib().trpc_workers()
+
+
+def _raw_stats():
+    buf = (ctypes.c_uint64 * 5)()
+    lib().trpc_runtime_stats(buf)
+    return {
+        "fibers_created": buf[0],
+        "context_switches": buf[1],
+        "steals": buf[2],
+        "parks": buf[3],
+        "workers": buf[4],
+    }
+
+
+def stats() -> Dict[str, int]:
+    return _raw_stats()
+
+
+def _expose_stats() -> None:
+    # ≙ bthread's bvars: worker_usage, switch_per_second (task_control.h:120)
+    for key in ("fibers_created", "context_switches", "steals", "parks"):
+        _stats_vars.append(
+            bvar.PassiveStatus(lambda k=key: _raw_stats()[k], f"fiber_{key}"))
+
+
+# live references so ctypes callbacks outlive their fibers
+_live_callbacks: Dict[int, object] = {}
+_cb_seq = [0]
+
+
+def start(fn: Callable[[], None]) -> int:
+    """Run fn() on a fiber.  For tests/tools — handlers on the RPC hot path
+    are dispatched natively, not through here."""
+    init()
+    key = _cb_seq[0] = _cb_seq[0] + 1
+
+    def tramp(_arg):
+        try:
+            fn()
+        finally:
+            _live_callbacks.pop(key, None)
+
+    cfn = FIBER_FN(tramp)
+    _live_callbacks[key] = cfn
+    fid = ctypes.c_uint64()
+    rc = lib().trpc_fiber_start(ctypes.byref(fid), cfn, None)
+    if rc != 0:
+        _live_callbacks.pop(key, None)
+        raise OSError(rc, "fiber_start failed")
+    return fid.value
+
+
+def join(fid: int) -> None:
+    lib().trpc_fiber_join(fid)
+
+
+class Butex:
+    """32-bit wait/wake word shared between fibers and pthreads
+    (≙ bthread butex, reference butex.h:36-72).  The TPU hook: a jax host
+    callback on transfer completion calls wake_all() to resume fibers
+    awaiting device data (BASELINE.json north star)."""
+
+    def __init__(self):
+        init()
+        self._b = lib().trpc_butex_create()
+
+    def close(self):
+        if self._b:
+            lib().trpc_butex_destroy(self._b)
+            self._b = None
+
+    @property
+    def value(self) -> int:
+        return lib().trpc_butex_load(self._b)
+
+    @value.setter
+    def value(self, v: int) -> None:
+        lib().trpc_butex_store(self._b, v)
+
+    def add(self, v: int = 1) -> int:
+        return lib().trpc_butex_add(self._b, v)
+
+    def wait(self, expected: int, timeout_us: Optional[int] = None) -> int:
+        """0 = woken; -EWOULDBLOCK value differs; -ETIMEDOUT on timeout."""
+        t = -1 if timeout_us is None else timeout_us
+        return lib().trpc_butex_wait(self._b, expected, t)
+
+    def wake(self) -> int:
+        return lib().trpc_butex_wake(self._b)
+
+    def wake_all(self) -> int:
+        return lib().trpc_butex_wake_all(self._b)

@@ -29,11 +29,13 @@ from __future__ import annotations
 
 import threading
 import time
+import traceback as _traceback
 import random
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from brpc_tpu.utils import flags as _flags
+from brpc_tpu.utils import logging as _log
 
 # ---------------------------------------------------------------------------
 # Registry
@@ -99,10 +101,12 @@ class Variable:
 
     def expose(self, name: str) -> bool:
         name = name.strip().replace(" ", "_")
-        if self._name is not None:
-            _registry.hide(self._name)
+        # register the new name first: a failed re-expose must not
+        # unregister the old name
         ok = _registry.expose(name, self)
         if ok:
+            if self._name is not None and self._name != name:
+                _registry.hide(self._name)
             self._name = name
         return ok
 
@@ -133,6 +137,23 @@ class _Agent:
         self.last = None  # sampler-thread-private cumulative snapshot
 
 
+class _AgentHolder:
+    """Lives in a thread's TLS dict; its collection (at thread exit) folds
+    the agent's contribution into the reducer's residuals."""
+
+    __slots__ = ("reducer", "agent")
+
+    def __init__(self, reducer, agent):
+        self.reducer = reducer
+        self.agent = agent
+
+    def __del__(self):
+        try:
+            self.reducer._on_agent_death(self.agent)
+        except Exception:
+            pass
+
+
 class _Reducer(Variable):
     """Per-thread-agent combiner (≙ detail::AgentCombiner, detail/combiner.h)."""
 
@@ -144,6 +165,12 @@ class _Reducer(Variable):
         self._agents: List[_Agent] = []
         self._tls = threading.local()
         self._window_sampler: Optional["_WindowSampler"] = None
+        # contributions of exited threads (≙ the reference combiner merging
+        # dead agents into a global residual so _agents stays bounded by
+        # *live* threads): _residual feeds lifetime reads, _residual_unsampled
+        # holds the dead agents' not-yet-sampled remainder for the next tick.
+        self._residual = identity
+        self._residual_unsampled = identity
 
     def _shared_window_sampler(self) -> "_WindowSampler":
         """All Windows over one reducer share one sampler — a second
@@ -165,18 +192,36 @@ class _Reducer(Variable):
                     self._window_sampler = None
 
     def _my_agent(self) -> _Agent:
-        a = getattr(self._tls, "agent", None)
-        if a is None:
+        holder = getattr(self._tls, "holder", None)
+        if holder is None:
             a = _Agent(self._identity)
             with self._agents_lock:
                 self._agents.append(a)
-            self._tls.agent = a
-        return a
+            # when the thread dies, its TLS dict drops the holder and the
+            # finalizer folds the agent into the residuals
+            self._tls.holder = _AgentHolder(self, a)
+            return a
+        return holder.agent
+
+    def _on_agent_death(self, a: _Agent) -> None:
+        with self._agents_lock:
+            try:
+                self._agents.remove(a)
+            except ValueError:
+                return
+            cur = a.value
+            self._residual = self._op(self._residual, cur)
+            last = a.last
+            d = cur if last is None else self._sub_or_whole(cur, last)
+            self._residual_unsampled = self._op(self._residual_unsampled, d)
+
+    def _sub_or_whole(self, cur, last):
+        return self._sub(cur, last) if self._samples_as_delta else cur
 
     def get_value(self):
         with self._agents_lock:
             agents = list(self._agents)
-        v = self._identity
+            v = self._residual
         for a in agents:
             v = self._op(v, a.value)
         return v
@@ -195,11 +240,12 @@ class _Reducer(Variable):
         """Take one per-interval sample (called by the sampler thread only)."""
         with self._agents_lock:
             agents = list(self._agents)
-        v = self._identity
+            v = self._residual_unsampled
+            self._residual_unsampled = self._identity
         if self._samples_as_delta:
             for a in agents:
                 cur = a.value
-                last = getattr(a, "last", None)
+                last = a.last
                 if last is None:
                     delta = cur
                 else:
@@ -220,7 +266,7 @@ class _Reducer(Variable):
         """Value accumulated since the last sampler tick (read-only)."""
         with self._agents_lock:
             agents = list(self._agents)
-        v = self._identity
+            v = self._residual_unsampled
         if self._samples_as_delta:
             for a in agents:
                 cur, last = a.value, a.last
@@ -329,6 +375,7 @@ class GFlag(PassiveStatus):
     """Flag mirrored as a variable (≙ bvar::GFlag, bvar/gflag.cpp)."""
 
     def __init__(self, flag_name: str, expose_name: Optional[str] = None):
+        _flags.get_flag(flag_name)  # fail at definition site, not at dump time
         super().__init__(lambda: _flags.get_flag(flag_name),
                          expose_name or flag_name)
 
@@ -379,7 +426,10 @@ class _SamplerCollector(threading.Thread):
                 try:
                     s.take_sample()
                 except Exception:
-                    pass
+                    _log.LOG(_log.LOG_ERROR,
+                             "bvar sampler failed on %r: %s",
+                             getattr(s.owner, "name", s.owner),
+                             _traceback.format_exc())
 
 
 class _WindowSampler:
@@ -434,6 +484,8 @@ class Window(Variable):
             self.expose(name)
 
     def get_value(self):
+        if self._sampler is None:
+            return 0  # closed
         op = self._reducer._op
         samples = self._sampler.samples()[-self._window:]
         acc = self._reducer._identity
@@ -449,6 +501,9 @@ class Window(Variable):
         return acc
 
     def close(self):
+        if self._sampler is None:
+            return  # double-close must not drop a sibling Window's sampler
+        self._sampler = None
         self._reducer._release_window_sampler()
         self.hide()
 
@@ -656,7 +711,7 @@ class LatencyRecorder(Variable):
         self.hide()
         self._latency_window.close()
         self._max_window.close()
-        self._qps._win.close()
+        self._qps.close()
         self._percentile.close()
 
 

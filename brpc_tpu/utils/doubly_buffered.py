@@ -20,6 +20,8 @@ from __future__ import annotations
 import threading
 from typing import Callable, Generic, List, TypeVar
 
+from brpc_tpu.utils import logging as _log
+
 T = TypeVar("T")
 
 
@@ -69,7 +71,13 @@ class DoublyBufferedData(Generic[T]):
         return DoublyBufferedData.ScopedPtr(self)
 
     def modify(self, fn: Callable[[T], bool]) -> bool:
-        """Apply ``fn`` to both copies, flipping in between (≙ Modify())."""
+        """Apply ``fn`` to both copies, flipping in between (≙ Modify()).
+
+        ``fn`` must be deterministic given the copy's state: it is applied
+        twice (once per copy).  If the second application fails the copies
+        have diverged — that is a caller bug; it is logged CRITICAL and
+        re-raised rather than silently ignored.
+        """
         with self._write_lock:
             bg = 1 - self._fg
             if not fn(self._data[bg]):
@@ -79,5 +87,15 @@ class DoublyBufferedData(Generic[T]):
             with self._ref_locks[old]:
                 while self._refs[old] != 0:
                     self._no_readers[old].wait()
-            fn(self._data[old])
+            try:
+                ok = fn(self._data[old])
+            except Exception:
+                _log.LOG(_log.LOG_FATAL,
+                         "DoublyBufferedData.modify: fn failed on the second "
+                         "copy after the flip; copies have diverged")
+                raise
+            if not ok:
+                _log.LOG(_log.LOG_ERROR,
+                         "DoublyBufferedData.modify: fn returned False on the "
+                         "second copy; copies have diverged")
             return True

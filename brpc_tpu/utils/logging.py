@@ -86,7 +86,11 @@ def vlog_modules() -> Dict[str, int]:
 
 def VLOG(verbosity: int, msg: str, *args, module: Optional[str] = None) -> None:
     if verbosity <= vlog_level(module):
-        _logger.info("[v%d] " + msg, verbosity, *args, stacklevel=2)
+        if args:
+            text = msg % args
+        else:
+            text = msg  # no args: treat literally (may contain raw '%')
+        _logger.info("[v%d] %s", verbosity, text, stacklevel=2)
 
 
 def set_log_level(level: int) -> None:

@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Build the native core and install the shared library into the Python
+# package (brpc_tpu/_native/). Run from anywhere.
+set -euo pipefail
+cd "$(dirname "$0")"
+mkdir -p build
+cmake -S . -B build -G Ninja -DCMAKE_BUILD_TYPE=Release >/dev/null
+ninja -C build
+cp build/libbrpc_tpu_core.so ../brpc_tpu/_native/
+if [[ "${1:-}" == "--test" ]]; then
+  ./build/test_core
+fi
+echo "native core built -> brpc_tpu/_native/libbrpc_tpu_core.so"

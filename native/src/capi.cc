@@ -1,0 +1,66 @@
+// capi.cc — C API consumed by the Python package through ctypes
+// (brpc_tpu/_native/__init__.py).  The reference has no language bindings
+// (SURVEY.md §2: java/python are TBD placeholders); this surface is new
+// design for the TPU build: Python is the control plane, C++ the data plane.
+#include <cerrno>
+#include <cstring>
+
+#include "fiber.h"
+#include "iobuf.h"
+
+using namespace trpc;
+
+extern "C" {
+
+// --- runtime ---------------------------------------------------------------
+
+int trpc_init(int num_workers) { return fiber_runtime_init(num_workers); }
+int trpc_workers() { return fiber_runtime_workers(); }
+
+void trpc_runtime_stats(uint64_t out[5]) {
+  FiberRuntimeStats s = fiber_runtime_stats();
+  out[0] = s.fibers_created;
+  out[1] = s.context_switches;
+  out[2] = s.steals;
+  out[3] = s.parks;
+  out[4] = (uint64_t)s.workers;
+}
+
+// --- fibers ----------------------------------------------------------------
+
+typedef void (*trpc_fiber_fn)(void* arg);
+
+int trpc_fiber_start(uint64_t* out, trpc_fiber_fn fn, void* arg) {
+  return fiber_start((fiber_t*)out, fn, arg);
+}
+
+int trpc_fiber_join(uint64_t f) { return fiber_join(f); }
+void trpc_fiber_yield() { fiber_yield(); }
+void trpc_fiber_usleep(int64_t us) { fiber_usleep(us); }
+int trpc_in_fiber() { return in_fiber() ? 1 : 0; }
+
+// --- butex (device-event wake hook: PJRT host callbacks call
+// trpc_butex_wake_all to resume fibers awaiting a transfer) ----------------
+
+void* trpc_butex_create() { return butex_create(); }
+void trpc_butex_destroy(void* b) { butex_destroy((Butex*)b); }
+int32_t trpc_butex_load(void* b) {
+  return butex_value((Butex*)b).load(std::memory_order_acquire);
+}
+void trpc_butex_store(void* b, int32_t v) {
+  butex_value((Butex*)b).store(v, std::memory_order_release);
+}
+int32_t trpc_butex_add(void* b, int32_t v) {
+  return butex_value((Butex*)b).fetch_add(v, std::memory_order_acq_rel) + v;
+}
+int trpc_butex_wait(void* b, int32_t expected, int64_t timeout_us) {
+  int rc = butex_wait((Butex*)b, expected, timeout_us);
+  if (rc != 0) {
+    return -errno;
+  }
+  return 0;
+}
+int trpc_butex_wake(void* b) { return butex_wake((Butex*)b); }
+int trpc_butex_wake_all(void* b) { return butex_wake_all((Butex*)b); }
+
+}  // extern "C"

@@ -1,0 +1,58 @@
+// common.h — shared basics for the brpc_tpu native core.
+// The native core is the TPU-host equivalent of the reference's
+// butil+bthread+brpc hot paths (SURVEY.md §2.1/§2.3/§2.4), written fresh
+// for this framework: C++17, Linux/x86_64, no external deps.
+#pragma once
+
+#include <time.h>
+
+#include <atomic>
+#include <cstddef>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+#define TRPC_LIKELY(x) __builtin_expect(!!(x), 1)
+#define TRPC_UNLIKELY(x) __builtin_expect(!!(x), 0)
+
+#define TRPC_DISALLOW_COPY(T) \
+  T(const T&) = delete;       \
+  T& operator=(const T&) = delete
+
+namespace trpc {
+
+inline int64_t monotonic_ns() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec * 1000000000LL + ts.tv_nsec;
+}
+
+inline int64_t monotonic_us() { return monotonic_ns() / 1000; }
+
+// Error codes shared with the Python layer (see brpc_tpu/rpc/errors.py).
+enum ErrorCode {
+  TRPC_OK = 0,
+  TRPC_ERPCTIMEDOUT = 1008,   // RPC deadline exceeded (≙ brpc ERPCTIMEDOUT)
+  TRPC_EFAILEDSOCKET = 1009,  // the connection was broken
+  TRPC_EBACKUPREQUEST = 1010, // backup-request timer fired (internal)
+  TRPC_EREQUEST = 1011,       // bad request bytes
+  TRPC_ENOSERVICE = 1001,     // no such service
+  TRPC_ENOMETHOD = 1002,      // no such method
+  TRPC_ESTOP = 1012,          // server is stopping
+  TRPC_EINTERNAL = 2001,      // server-side user exception
+  TRPC_EOVERCROWDED = 2004,   // too many buffered writes (≙ brpc EOVERCROWDED)
+  TRPC_ELIMIT = 2005,         // concurrency limiter rejected (≙ brpc ELIMIT)
+};
+
+// xorshift per-thread fast random (≙ butil fast_rand).
+inline uint64_t fast_rand() {
+  static thread_local uint64_t s = 0x9e3779b97f4a7c15ULL ^
+      (uint64_t)(uintptr_t)&s ^ (uint64_t)monotonic_ns();
+  s ^= s << 13;
+  s ^= s >> 7;
+  s ^= s << 17;
+  return s;
+}
+
+}  // namespace trpc

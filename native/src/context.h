@@ -1,0 +1,52 @@
+// context.h — minimal x86_64 SysV stackful-context switch for the fiber
+// runtime (role of the reference's fcontext assembly, bthread/context.cpp:
+// 812 lines for 4 arches; this build targets linux/x86_64 TPU hosts only).
+//
+// Model: a context is just a saved stack pointer.  tctx_jump saves the
+// callee-saved register frame on the current stack, stores the resulting sp
+// through `from`, switches to `to`, restores, and returns `arg` to the
+// resumed side.  tctx_make builds an initial frame that enters
+// `entry(arg)` through a trampoline (the trampoline realigns the stack, so
+// the frame layout does not need to be alignment-perfect).
+#pragma once
+
+#include <cstdint>
+
+extern "C" {
+// Defined in context.S.
+//   from: where to store the suspended context's sp
+//   to:   sp of the context to resume
+//   arg:  value returned by the matching tctx_jump on the resumed side
+void* tctx_jump(void** from, void* to, void* arg);
+
+// Entry trampoline (context.S): moves the jump arg into %rdi, aligns the
+// stack and calls the function stored in %r15.  The entry function must
+// never return (it must tctx_jump away); the trampoline traps if it does.
+void tctx_entry(void);
+}
+
+namespace trpc {
+
+typedef void (*ContextEntry)(void*);
+
+// Build an initial context on [stack_base, stack_base+size).
+// Frame layout must mirror the pop sequence in tctx_jump (context.S):
+//   [sp+0]  mxcsr/x87cw save area (8 bytes)
+//   [sp+8]  r15  <- entry function (read by tctx_entry)
+//   [sp+16] r14, [sp+24] r13, [sp+32] r12, [sp+40] rbx, [sp+48] rbp
+//   [sp+56] return address = tctx_entry
+inline void* tctx_make(void* stack_base, size_t size, ContextEntry entry) {
+  uintptr_t top = ((uintptr_t)stack_base + size) & ~(uintptr_t)15;
+  uint64_t* sp = (uint64_t*)top;
+  sp -= 8;  // 8 slots: mxcsr/fcw, r15, r14, r13, r12, rbx, rbp, retaddr
+  uint32_t mxcsr;
+  uint16_t fcw;
+  __asm__ volatile("stmxcsr %0\n\tfnstcw %1" : "=m"(mxcsr), "=m"(fcw));
+  sp[0] = (uint64_t)mxcsr | ((uint64_t)fcw << 32);
+  sp[1] = (uint64_t)(uintptr_t)entry;  // -> r15
+  sp[2] = sp[3] = sp[4] = sp[5] = sp[6] = 0;
+  sp[7] = (uint64_t)(uintptr_t)&tctx_entry;  // return address
+  return (void*)sp;
+}
+
+}  // namespace trpc

@@ -1,0 +1,580 @@
+#include "fiber.h"
+
+#include <errno.h>
+#include <linux/futex.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include "context.h"
+#include "object_pool.h"
+#include "timer_thread.h"
+#include "work_stealing_queue.h"
+
+namespace trpc {
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Stacks: mmap'd with a PROT_NONE guard page, recycled through a pool
+// (≙ bthread/stack.cpp).
+
+constexpr size_t kStackSize = 256 * 1024;
+constexpr size_t kGuard = 4096;
+
+struct StackMem {
+  char* base = nullptr;  // usable base (above the guard page)
+
+  StackMem() {
+    char* m = (char*)mmap(nullptr, kStackSize + kGuard,
+                          PROT_READ | PROT_WRITE,
+                          MAP_PRIVATE | MAP_ANONYMOUS | MAP_STACK, -1, 0);
+    if (m == MAP_FAILED) {
+      abort();
+    }
+    mprotect(m, kGuard, PROT_NONE);
+    base = m + kGuard;
+  }
+  ~StackMem() { munmap(base - kGuard, kStackSize + kGuard); }
+};
+
+// ---------------------------------------------------------------------------
+// TaskMeta
+
+struct TaskGroup;
+
+struct TaskMeta {
+  FiberFn fn = nullptr;
+  void* arg = nullptr;
+  void* sp = nullptr;
+  StackMem* stack = nullptr;
+  uint32_t slot = 0;
+  std::atomic<uint32_t> version{1};  // bumped on exit; join key
+  Butex* join_butex = nullptr;       // value mirrors version
+  Butex* sleep_butex = nullptr;      // private, for usleep
+
+  fiber_t tid() const {
+    return ((uint64_t)version.load(std::memory_order_relaxed) << 32) | slot;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// ParkingLot (≙ bthread/parking_lot.h): futex sleep for idle workers.
+
+int sys_futex(std::atomic<int32_t>* addr, int op, int val,
+              const timespec* timeout) {
+  return (int)syscall(SYS_futex, (int32_t*)addr, op, val, timeout, nullptr, 0);
+}
+
+class ParkingLot {
+ public:
+  int32_t GetState() { return pending_.load(std::memory_order_seq_cst); }
+
+  void Wait(int32_t expected) {
+    nwaiters_.fetch_add(1, std::memory_order_seq_cst);
+    sys_futex(&pending_, FUTEX_WAIT_PRIVATE, expected, nullptr);
+    nwaiters_.fetch_sub(1, std::memory_order_seq_cst);
+  }
+
+  void Signal(int n) {
+    pending_.fetch_add(1, std::memory_order_seq_cst);
+    if (nwaiters_.load(std::memory_order_seq_cst) > 0) {
+      sys_futex(&pending_, FUTEX_WAKE_PRIVATE, n, nullptr);
+    }
+  }
+
+ private:
+  std::atomic<int32_t> pending_{0};
+  std::atomic<int32_t> nwaiters_{0};
+};
+
+// ---------------------------------------------------------------------------
+// TaskGroup / TaskControl (≙ bthread/task_group.h, task_control.h)
+
+struct RemainedCb {
+  void (*fn)(void*) = nullptr;
+  void* arg = nullptr;
+};
+
+struct TaskGroup {
+  WorkStealingQueue<fiber_t> rq{4096};
+  std::mutex remote_mu;
+  std::deque<fiber_t> remote_rq;
+  void* main_sp = nullptr;
+  TaskMeta* cur = nullptr;
+  RemainedCb remained;
+  int index = 0;
+  uint64_t nswitch = 0;
+
+  void set_remained(void (*fn)(void*), void* arg) {
+    remained.fn = fn;
+    remained.arg = arg;
+  }
+};
+
+struct TaskControl {
+  std::vector<TaskGroup*> groups;
+  std::vector<std::thread> workers;
+  ParkingLot pl;
+  std::atomic<bool> started{false};
+  std::atomic<uint64_t> nfibers{0};
+  std::atomic<uint64_t> nsteals{0};
+  std::atomic<uint64_t> nparks{0};
+};
+
+// leaked on purpose: workers scan control().groups forever
+TaskControl& control() {
+  static TaskControl* c = new TaskControl();
+  return *c;
+}
+#define g_control control()
+thread_local TaskGroup* tls_group = nullptr;
+
+void worker_main(TaskGroup* g);
+
+// steal one task from any other group (random probing, ≙ steal_task).
+bool steal_task(TaskGroup* self, fiber_t* out) {
+  size_t n = g_control.groups.size();
+  if (n <= 1) {
+    return false;
+  }
+  uint64_t seed = fast_rand();
+  for (size_t i = 0; i < 2 * n; ++i) {
+    TaskGroup* victim = g_control.groups[(seed + i) % n];
+    if (victim == self) {
+      continue;
+    }
+    if (victim->rq.Steal(out)) {
+      g_control.nsteals.fetch_add(1, std::memory_order_relaxed);
+      return true;
+    }
+  }
+  // remote queues
+  for (size_t i = 0; i < n; ++i) {
+    TaskGroup* victim = g_control.groups[(seed + i) % n];
+    std::lock_guard<std::mutex> lk(victim->remote_mu);
+    if (!victim->remote_rq.empty()) {
+      *out = victim->remote_rq.front();
+      victim->remote_rq.pop_front();
+      return true;
+    }
+  }
+  return false;
+}
+
+bool next_task(TaskGroup* g, fiber_t* out) {
+  if (g->rq.Pop(out)) {
+    return true;
+  }
+  {
+    std::lock_guard<std::mutex> lk(g->remote_mu);
+    if (!g->remote_rq.empty()) {
+      *out = g->remote_rq.front();
+      g->remote_rq.pop_front();
+      return true;
+    }
+  }
+  return steal_task(g, out);
+}
+
+// Push a runnable fiber; called from workers, foreign pthreads, timer
+// callbacks, and (via the C API) PJRT host callbacks.
+void ready_to_run(TaskMeta* m) {
+  TaskGroup* g = tls_group;
+  if (g != nullptr) {
+    if (TRPC_UNLIKELY(!g->rq.Push(m->tid()))) {
+      std::lock_guard<std::mutex> lk(g->remote_mu);
+      g->remote_rq.push_back(m->tid());
+    }
+  } else {
+    TaskGroup* target =
+        g_control.groups[fast_rand() % g_control.groups.size()];
+    std::lock_guard<std::mutex> lk(target->remote_mu);
+    target->remote_rq.push_back(m->tid());
+  }
+  g_control.pl.Signal(1);
+}
+
+// Runs on the worker (main) stack right after a fiber switches out
+// (≙ TaskGroup "remained" callbacks, task_group.h:112-116): the only safe
+// point to unlock the lock that protected the fiber's wait registration, or
+// to recycle the dead fiber's stack.
+void run_remained(TaskGroup* g) {
+  if (g->remained.fn != nullptr) {
+    auto fn = g->remained.fn;
+    auto arg = g->remained.arg;
+    g->remained.fn = nullptr;
+    fn(arg);
+  }
+}
+
+void cb_ready_to_run(void* p) { ready_to_run((TaskMeta*)p); }
+
+void cb_finish_fiber(void* p) {
+  TaskMeta* m = (TaskMeta*)p;
+  ObjectPool<StackMem>::Return(m->stack);
+  m->stack = nullptr;
+  uint32_t newver = m->version.load(std::memory_order_relaxed) + 1;
+  // order: publish the new version, then wake joiners
+  butex_value(m->join_butex).store((int32_t)newver, std::memory_order_release);
+  m->version.store(newver, std::memory_order_release);
+  butex_wake_all(m->join_butex);
+  ResourcePool<TaskMeta>::Return(m->slot);
+}
+
+// First frame of every fiber.
+void fiber_entry(void* p) {
+  TaskMeta* m = (TaskMeta*)p;
+  {
+    TaskGroup* g = tls_group;
+    run_remained(g);  // remained set by the context that jumped to us
+  }
+  m->fn(m->arg);
+  // exit: recycle on the worker stack after we've switched off this one
+  TaskGroup* g = tls_group;  // may differ from entry group
+  g->set_remained(cb_finish_fiber, m);
+  tctx_jump(&m->sp, g->main_sp, nullptr);
+  __builtin_unreachable();
+}
+
+void run_fiber(TaskGroup* g, fiber_t tid) {
+  uint32_t slot = (uint32_t)tid;
+  uint32_t ver = (uint32_t)(tid >> 32);
+  TaskMeta* m = ResourcePool<TaskMeta>::Address(slot);
+  if (m == nullptr || m->version.load(std::memory_order_acquire) != ver) {
+    return;  // already finished (stale tid)
+  }
+  g->cur = m;
+  ++g->nswitch;
+  tctx_jump(&g->main_sp, m->sp, m);
+  g->cur = nullptr;
+  run_remained(g);
+}
+
+void worker_main(TaskGroup* g) {
+  char name[16];
+  snprintf(name, sizeof(name), "trpc_w%d", g->index);
+  pthread_setname_np(pthread_self(), name);
+  tls_group = g;
+  while (true) {
+    fiber_t tid;
+    if (next_task(g, &tid)) {
+      run_fiber(g, tid);
+      continue;
+    }
+    int32_t st = g_control.pl.GetState();
+    if (next_task(g, &tid)) {  // recheck after snapshotting lot state
+      run_fiber(g, tid);
+      continue;
+    }
+    g_control.nparks.fetch_add(1, std::memory_order_relaxed);
+    g_control.pl.Wait(st);
+  }
+}
+
+// Called on the fiber stack to give up the CPU; resumes when re-run.
+void sched_away(TaskMeta* m) {
+  TaskGroup* g = tls_group;
+  tctx_jump(&m->sp, g->main_sp, nullptr);
+  // resumed, possibly on a different worker: nothing to do — callers must
+  // re-read tls_group themselves.
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// Butex
+
+struct ButexWaiter {
+  enum Kind { FIBER, PTHREAD } kind = FIBER;
+  TaskMeta* meta = nullptr;          // FIBER
+  std::condition_variable cv;        // PTHREAD
+  bool signaled = false;             // PTHREAD
+  int result = 0;                    // 0 woken; ETIMEDOUT
+  ButexWaiter* next = nullptr;
+  ButexWaiter* prev = nullptr;
+  bool linked = false;
+  Butex* owner = nullptr;
+};
+
+struct Butex {
+  std::atomic<int32_t> value{0};
+  std::mutex mu;
+  ButexWaiter head;  // sentinel of doubly-linked ring
+
+  Butex() { head.next = head.prev = &head; }
+
+  void link(ButexWaiter* w) {
+    w->prev = head.prev;
+    w->next = &head;
+    head.prev->next = w;
+    head.prev = w;
+    w->linked = true;
+    w->owner = this;
+  }
+  static void unlink(ButexWaiter* w) {
+    w->prev->next = w->next;
+    w->next->prev = w->prev;
+    w->linked = false;
+  }
+  ButexWaiter* first() { return head.next == &head ? nullptr : head.next; }
+};
+
+Butex* butex_create() { return ObjectPool<Butex>::Get(); }
+
+void butex_destroy(Butex* b) { ObjectPool<Butex>::Return(b); }
+
+std::atomic<int32_t>& butex_value(Butex* b) { return b->value; }
+
+namespace {
+
+struct WaitUnlockArg {
+  std::mutex* mu;
+};
+
+void cb_unlock_mutex(void* p) { ((std::mutex*)p)->unlock(); }
+
+void butex_timeout_cb(void* p) {
+  ButexWaiter* w = (ButexWaiter*)p;
+  Butex* b = w->owner;
+  std::unique_lock<std::mutex> lk(b->mu);
+  if (!w->linked) {
+    return;  // already woken normally
+  }
+  Butex::unlink(w);
+  w->result = ETIMEDOUT;
+  TaskMeta* m = w->meta;
+  lk.unlock();
+  ready_to_run(m);
+}
+
+int butex_wait_pthread(Butex* b, int32_t expected, int64_t timeout_us) {
+  std::unique_lock<std::mutex> lk(b->mu);
+  if (b->value.load(std::memory_order_acquire) != expected) {
+    errno = EWOULDBLOCK;
+    return -1;
+  }
+  ButexWaiter w;
+  w.kind = ButexWaiter::PTHREAD;
+  b->link(&w);
+  bool timed_out = false;
+  if (timeout_us < 0) {
+    w.cv.wait(lk, [&] { return w.signaled; });
+  } else {
+    timed_out = !w.cv.wait_for(lk, std::chrono::microseconds(timeout_us),
+                               [&] { return w.signaled; });
+  }
+  if (timed_out) {
+    if (w.linked) {
+      Butex::unlink(&w);
+    }
+    errno = ETIMEDOUT;
+    return -1;
+  }
+  return 0;
+}
+
+}  // namespace
+
+int butex_wait(Butex* b, int32_t expected, int64_t timeout_us) {
+  TaskGroup* g = tls_group;
+  if (g == nullptr || g->cur == nullptr) {
+    return butex_wait_pthread(b, expected, timeout_us);
+  }
+  TaskMeta* m = g->cur;
+  b->mu.lock();
+  if (b->value.load(std::memory_order_acquire) != expected) {
+    b->mu.unlock();
+    errno = EWOULDBLOCK;
+    return -1;
+  }
+  ButexWaiter w;
+  w.kind = ButexWaiter::FIBER;
+  w.meta = m;
+  b->link(&w);
+  TimerTask* tt = nullptr;
+  if (timeout_us >= 0) {
+    // The callback may fire before we switch out; it will block on b->mu,
+    // which is released only by the remained callback after the switch
+    // completes — so it can never see a half-switched fiber.
+    tt = timer_add(monotonic_us() + timeout_us, butex_timeout_cb, &w);
+  }
+  g->set_remained(cb_unlock_mutex, &b->mu);
+  sched_away(m);
+  // Resumed: the waker (or the timeout) unlinked us before ready_to_run.
+  if (tt != nullptr) {
+    timer_cancel_and_free(tt);  // waits out a concurrently-running callback
+  }
+  if (w.result == ETIMEDOUT) {
+    errno = ETIMEDOUT;
+    return -1;
+  }
+  return 0;
+}
+
+namespace {
+int butex_wake_some(Butex* b, int limit) {
+  int woken = 0;
+  TaskMeta* to_run[16];
+  int nrun = 0;
+  {
+    std::lock_guard<std::mutex> lk(b->mu);
+    while (woken < limit) {
+      ButexWaiter* w = b->first();
+      if (w == nullptr) {
+        break;
+      }
+      Butex::unlink(w);
+      w->result = 0;
+      if (w->kind == ButexWaiter::PTHREAD) {
+        w->signaled = true;
+        w->cv.notify_one();  // under mu: &w stays valid while linked-or-locked
+      } else if (nrun < 16) {
+        to_run[nrun++] = w->meta;
+      } else {
+        ready_to_run(w->meta);  // overflow: enqueue under lock (rare)
+      }
+      ++woken;
+    }
+  }
+  for (int i = 0; i < nrun; ++i) {
+    ready_to_run(to_run[i]);
+  }
+  return woken;
+}
+}  // namespace
+
+int butex_wake(Butex* b) { return butex_wake_some(b, 1); }
+int butex_wake_all(Butex* b) { return butex_wake_some(b, INT32_MAX); }
+
+// ---------------------------------------------------------------------------
+// Public fiber API
+
+int fiber_runtime_init(int num_workers) {
+  bool expected = false;
+  if (!g_control.started.compare_exchange_strong(expected, true)) {
+    return 0;
+  }
+  timer_thread_start();
+  if (num_workers <= 0) {
+    num_workers = (int)std::thread::hardware_concurrency();
+    if (num_workers <= 0) {
+      num_workers = 4;
+    }
+  }
+  for (int i = 0; i < num_workers; ++i) {
+    TaskGroup* g = new TaskGroup();
+    g->index = i;
+    g_control.groups.push_back(g);
+  }
+  for (int i = 0; i < num_workers; ++i) {
+    g_control.workers.emplace_back(worker_main, g_control.groups[i]);
+    g_control.workers.back().detach();
+  }
+  return num_workers;
+}
+
+int fiber_runtime_workers() { return (int)g_control.groups.size(); }
+bool fiber_runtime_started() {
+  return g_control.started.load(std::memory_order_acquire);
+}
+
+int fiber_start(fiber_t* out, FiberFn fn, void* arg) {
+  if (TRPC_UNLIKELY(!fiber_runtime_started())) {
+    fiber_runtime_init(0);
+  }
+  TaskMeta* m = nullptr;
+  uint32_t slot = ResourcePool<TaskMeta>::Get(&m);
+  if (m == nullptr) {
+    return ENOMEM;
+  }
+  m->slot = slot;
+  if (m->join_butex == nullptr) {
+    m->join_butex = butex_create();
+    m->sleep_butex = butex_create();
+  }
+  m->fn = fn;
+  m->arg = arg;
+  m->stack = ObjectPool<StackMem>::Get();
+  m->sp = tctx_make(m->stack->base, kStackSize, fiber_entry);
+  butex_value(m->join_butex)
+      .store((int32_t)m->version.load(std::memory_order_relaxed),
+             std::memory_order_release);
+  g_control.nfibers.fetch_add(1, std::memory_order_relaxed);
+  if (out != nullptr) {
+    *out = m->tid();
+  }
+  ready_to_run(m);
+  return 0;
+}
+
+int fiber_join(fiber_t f) {
+  uint32_t slot = (uint32_t)f;
+  uint32_t ver = (uint32_t)(f >> 32);
+  TaskMeta* m = ResourcePool<TaskMeta>::Address(slot);
+  if (m == nullptr) {
+    return EINVAL;
+  }
+  while (m->version.load(std::memory_order_acquire) == ver) {
+    if (butex_wait(m->join_butex, (int32_t)ver, -1) != 0 &&
+        errno == EWOULDBLOCK) {
+      break;  // version already bumped
+    }
+  }
+  return 0;
+}
+
+void fiber_yield() {
+  TaskGroup* g = tls_group;
+  if (g == nullptr || g->cur == nullptr) {
+    std::this_thread::yield();
+    return;
+  }
+  TaskMeta* m = g->cur;
+  g->set_remained(cb_ready_to_run, m);
+  sched_away(m);
+}
+
+void fiber_usleep(int64_t us) {
+  TaskGroup* g = tls_group;
+  if (g == nullptr || g->cur == nullptr) {
+    ::usleep((useconds_t)us);
+    return;
+  }
+  TaskMeta* m = g->cur;
+  // sleep_butex value never changes: the wait can only end by timeout.
+  butex_wait(m->sleep_butex, butex_value(m->sleep_butex).load(), us);
+}
+
+fiber_t fiber_self() {
+  TaskGroup* g = tls_group;
+  return (g != nullptr && g->cur != nullptr) ? g->cur->tid() : INVALID_FIBER;
+}
+
+bool in_fiber() {
+  TaskGroup* g = tls_group;
+  return g != nullptr && g->cur != nullptr;
+}
+
+FiberRuntimeStats fiber_runtime_stats() {
+  FiberRuntimeStats s{};
+  s.fibers_created = g_control.nfibers.load(std::memory_order_relaxed);
+  uint64_t sw = 0;
+  for (auto* g : g_control.groups) {
+    sw += g->nswitch;
+  }
+  s.context_switches = sw;
+  s.steals = g_control.nsteals.load(std::memory_order_relaxed);
+  s.parks = g_control.nparks.load(std::memory_order_relaxed);
+  s.workers = (int)g_control.groups.size();
+  return s;
+}
+
+}  // namespace trpc

@@ -1,0 +1,65 @@
+// fiber.h — M:N fiber runtime (capability of the reference src/bthread,
+// SURVEY.md §2.3): N worker pthreads run fibers from per-worker
+// work-stealing deques, with remote queues for external submitters, a
+// futex-based ParkingLot for idle workers, and butex as the universal
+// blocking primitive for fibers AND pthreads on the same word
+// (reference butex.h:36-72).
+//
+// TPU twist (BASELINE.json north star): a butex can be woken from any
+// foreign thread — including a PJRT host callback on transfer completion —
+// so a fiber awaiting a device event costs no thread (see
+// brpc_tpu/parallel/device_iobuf.py for the Python-side hookup).
+#pragma once
+
+#include <cstdint>
+
+#include "common.h"
+
+namespace trpc {
+
+// (version << 32) | pool slot — ABA-safe handle (≙ bthread_t).
+typedef uint64_t fiber_t;
+constexpr fiber_t INVALID_FIBER = 0;
+
+typedef void (*FiberFn)(void*);
+
+// Start workers (idempotent).  num_workers <= 0 => hardware concurrency.
+int fiber_runtime_init(int num_workers);
+int fiber_runtime_workers();
+bool fiber_runtime_started();
+
+// Start a fiber; runnable on any worker (≙ bthread_start_background).
+int fiber_start(fiber_t* out, FiberFn fn, void* arg);
+// Wait until fiber finishes (callable from fibers and plain pthreads).
+int fiber_join(fiber_t f);
+void fiber_yield();
+void fiber_usleep(int64_t us);
+fiber_t fiber_self();
+bool in_fiber();
+
+// --- butex (≙ bthread/butex.h) --------------------------------------------
+// A butex is a 32-bit value supporting futex-style wait/wake for both
+// fibers and pthreads.
+struct Butex;
+Butex* butex_create();
+void butex_destroy(Butex* b);
+std::atomic<int32_t>& butex_value(Butex* b);
+// Wait while *value == expected.  timeout_us < 0 => infinite.
+// Returns 0 when woken; -1 with errno EWOULDBLOCK (value differed) or
+// ETIMEDOUT.
+int butex_wait(Butex* b, int32_t expected, int64_t timeout_us);
+// Wake up to one / all waiters.  Returns number woken.
+int butex_wake(Butex* b);
+int butex_wake_all(Butex* b);
+
+// Runtime introspection (feeds PassiveStatus bvars on the Python side).
+struct FiberRuntimeStats {
+  uint64_t fibers_created;
+  uint64_t context_switches;
+  uint64_t steals;
+  uint64_t parks;
+  int workers;
+};
+FiberRuntimeStats fiber_runtime_stats();
+
+}  // namespace trpc

@@ -1,0 +1,292 @@
+#include "iobuf.h"
+
+#include <errno.h>
+#include <stdlib.h>
+#include <unistd.h>
+
+namespace trpc {
+
+// ---------------------------------------------------------------------------
+// IOBlock
+
+IOBlock* IOBlock::New(uint32_t payload) {
+  char* mem = (char*)malloc(sizeof(IOBlock) + payload);
+  IOBlock* b = new (mem) IOBlock();
+  b->cap = payload;
+  b->data = mem + sizeof(IOBlock);
+  return b;
+}
+
+IOBlock* IOBlock::NewUser(void* data, uint32_t len, UserBlockDeleter d,
+                          void* meta) {
+  IOBlock* b = (IOBlock*)malloc(sizeof(IOBlock));
+  new (b) IOBlock();
+  b->cap = len;
+  b->size = len;
+  b->data = (char*)data;
+  b->deleter = d;
+  b->meta = meta;
+  return b;
+}
+
+void IOBlock::Unref() {
+  if (nshared.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+    if (deleter != nullptr) {
+      deleter(data, meta);
+    }
+    this->~IOBlock();
+    free(this);
+  }
+}
+
+// Per-thread active tail block.
+static thread_local IOBlock* g_tls_block = nullptr;
+
+IOBlock* tls_acquire_block() {
+  IOBlock* b = g_tls_block;
+  if (b == nullptr || b->spare() == 0) {
+    if (b != nullptr) {
+      b->Unref();
+    }
+    b = IOBlock::New();
+    g_tls_block = b;
+  }
+  return b;
+}
+
+void tls_release_block() {
+  if (g_tls_block != nullptr) {
+    g_tls_block->Unref();
+    g_tls_block = nullptr;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// IOBuf
+
+void IOBuf::clear() {
+  for (auto& r : refs_) {
+    r.block->Unref();
+  }
+  refs_.clear();
+  length_ = 0;
+}
+
+void IOBuf::push_ref(const BlockRef& r) {
+  if (!refs_.empty()) {
+    BlockRef& last = refs_.back();
+    if (last.block == r.block && last.offset + last.length == r.offset) {
+      last.length += r.length;  // merge contiguous refs of the same block
+      length_ += r.length;
+      r.block->Unref();  // merged: drop the extra reference
+      return;
+    }
+  }
+  refs_.push_back(r);
+  length_ += r.length;
+}
+
+void IOBuf::append(const void* data, size_t n) {
+  const char* p = (const char*)data;
+  while (n > 0) {
+    IOBlock* b = tls_acquire_block();
+    uint32_t copy = b->spare() < n ? b->spare() : (uint32_t)n;
+    memcpy(b->data + b->size, p, copy);
+    BlockRef r{b, b->size, copy};
+    b->Ref();
+    b->size += copy;
+    push_ref(r);
+    p += copy;
+    n -= copy;
+  }
+}
+
+void IOBuf::append(const IOBuf& other) {
+  for (const auto& r : other.refs_) {
+    r.block->Ref();
+    push_ref(r);
+  }
+}
+
+void IOBuf::append(IOBuf&& other) {
+  if (refs_.empty()) {
+    refs_ = std::move(other.refs_);
+    length_ = other.length_;
+  } else {
+    for (const auto& r : other.refs_) {
+      refs_.push_back(r);  // transfer refs without re-counting
+    }
+    length_ += other.length_;
+  }
+  other.refs_.clear();
+  other.length_ = 0;
+}
+
+void IOBuf::append_user_data(void* data, size_t n, UserBlockDeleter d,
+                             void* meta) {
+  IOBlock* b = IOBlock::NewUser(data, (uint32_t)n, d, meta);
+  BlockRef r{b, 0, (uint32_t)n};
+  push_ref(r);  // b starts with refcount 1 owned by this buf
+}
+
+size_t IOBuf::cutn(IOBuf* out, size_t n) {
+  size_t cut = 0;
+  size_t i = 0;
+  while (i < refs_.size() && cut < n) {
+    BlockRef& r = refs_[i];
+    if (r.length <= n - cut) {
+      out->push_ref(r);  // transfer whole ref (ownership moves)
+      cut += r.length;
+      ++i;
+    } else {
+      uint32_t take = (uint32_t)(n - cut);
+      BlockRef part{r.block, r.offset, take};
+      r.block->Ref();
+      out->push_ref(part);
+      r.offset += take;
+      r.length -= take;
+      cut += take;
+      break;
+    }
+  }
+  refs_.erase(refs_.begin(), refs_.begin() + i);
+  length_ -= cut;
+  return cut;
+}
+
+size_t IOBuf::pop_front(size_t n) {
+  size_t popped = 0;
+  size_t i = 0;
+  while (i < refs_.size() && popped < n) {
+    BlockRef& r = refs_[i];
+    if (r.length <= n - popped) {
+      popped += r.length;
+      r.block->Unref();
+      ++i;
+    } else {
+      uint32_t take = (uint32_t)(n - popped);
+      r.offset += take;
+      r.length -= take;
+      popped += take;
+      break;
+    }
+  }
+  refs_.erase(refs_.begin(), refs_.begin() + i);
+  length_ -= popped;
+  return popped;
+}
+
+size_t IOBuf::copy_to(void* dst, size_t n, size_t from) const {
+  char* out = (char*)dst;
+  size_t copied = 0;
+  size_t pos = 0;
+  for (const auto& r : refs_) {
+    if (copied >= n) {
+      break;
+    }
+    if (pos + r.length <= from) {
+      pos += r.length;
+      continue;
+    }
+    uint32_t off = (uint32_t)(from > pos ? from - pos : 0);
+    uint32_t avail = r.length - off;
+    uint32_t copy = (uint32_t)(n - copied < avail ? n - copied : avail);
+    memcpy(out + copied, r.block->data + r.offset + off, copy);
+    copied += copy;
+    pos += r.length;
+  }
+  return copied;
+}
+
+std::string IOBuf::to_string() const {
+  std::string s;
+  s.resize(length_);
+  copy_to(&s[0], length_);
+  return s;
+}
+
+// Unused fresh block kept per thread so append_from_fd does not pay a
+// malloc/free round-trip per short read.
+static thread_local IOBlock* g_tls_spare = nullptr;
+
+ssize_t IOBuf::append_from_fd(int fd, size_t max) {
+  size_t total = 0;
+  while (total < max) {
+    IOBlock* tail = tls_acquire_block();
+    iovec vec[2];
+    vec[0].iov_base = tail->data + tail->size;
+    vec[0].iov_len = tail->spare();
+    // a second fresh block so big bursts need fewer syscalls
+    IOBlock* extra = g_tls_spare != nullptr ? g_tls_spare : IOBlock::New();
+    g_tls_spare = nullptr;
+    vec[1].iov_base = extra->data;
+    vec[1].iov_len = extra->cap;
+    ssize_t n = readv(fd, vec, 2);
+    if (n < 0) {
+      g_tls_spare = extra;
+      if (errno == EINTR) {
+        continue;
+      }
+      if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        return (ssize_t)total;
+      }
+      return total > 0 ? (ssize_t)total : -1;
+    }
+    if (n == 0) {
+      g_tls_spare = extra;
+      return (ssize_t)total;  // EOF; caller distinguishes via total==0
+    }
+    size_t left = (size_t)n;
+    uint32_t into_tail =
+        left < vec[0].iov_len ? (uint32_t)left : (uint32_t)vec[0].iov_len;
+    if (into_tail > 0) {
+      BlockRef r{tail, tail->size, into_tail};
+      tail->Ref();
+      tail->size += into_tail;
+      push_ref(r);
+      left -= into_tail;
+    }
+    if (left > 0) {
+      extra->size = (uint32_t)left;
+      BlockRef r{extra, 0, (uint32_t)left};
+      push_ref(r);  // extra's initial ref transfers to this buf
+    } else {
+      g_tls_spare = extra;
+    }
+    total += (size_t)n;
+    if ((size_t)n < vec[0].iov_len + vec[1].iov_len) {
+      return (ssize_t)total;  // short read: kernel buffer drained
+    }
+  }
+  return (ssize_t)total;
+}
+
+ssize_t IOBuf::cut_into_fd(int fd, size_t max) {
+  if (refs_.empty()) {
+    return 0;
+  }
+  iovec vec[64];
+  int nvec = 0;
+  size_t queued = 0;
+  for (const auto& r : refs_) {
+    if (nvec == 64 || queued >= max) {
+      break;
+    }
+    size_t len = r.length;
+    if (queued + len > max) {
+      len = max - queued;
+    }
+    vec[nvec].iov_base = r.block->data + r.offset;
+    vec[nvec].iov_len = len;
+    queued += len;
+    ++nvec;
+  }
+  ssize_t n = writev(fd, vec, nvec);
+  if (n < 0) {
+    return -1;
+  }
+  pop_front((size_t)n);
+  return n;
+}
+
+}  // namespace trpc

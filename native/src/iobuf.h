@@ -1,0 +1,116 @@
+// iobuf.h — zero-copy chained buffer, the data currency of the framework
+// (capability of the reference butil/iobuf.h:64: refcounted blocks,
+// BlockRef{offset,len,block}, cut/append without memcpy, fd IO, and
+// append_user_data with a deleter+meta — the hook that lets blocks wrap
+// externally-owned memory such as PJRT device buffers, iobuf.h:259-263).
+#pragma once
+
+#include <sys/uio.h>
+
+#include <string>
+#include <vector>
+
+#include "common.h"
+
+namespace trpc {
+
+struct IOBlock;
+
+// deleter(data, meta) runs when the last reference to a user block dies.
+typedef void (*UserBlockDeleter)(void* data, void* meta);
+
+struct IOBlock {
+  std::atomic<int32_t> nshared{1};
+  uint32_t size = 0;  // bytes filled (append cursor for pooled blocks)
+  uint32_t cap = 0;
+  char* data = nullptr;
+  UserBlockDeleter deleter = nullptr;  // non-null => user-owned memory
+  void* meta = nullptr;                // opaque owner handle (device buffer)
+
+  static constexpr uint32_t kDefaultPayload = 8192 - 64;  // ≙ 8KB blocks
+
+  static IOBlock* New(uint32_t payload = kDefaultPayload);
+  static IOBlock* NewUser(void* data, uint32_t len, UserBlockDeleter d,
+                          void* meta);
+  void Ref() { nshared.fetch_add(1, std::memory_order_relaxed); }
+  void Unref();
+  uint32_t spare() const { return cap - size; }
+};
+
+struct BlockRef {
+  IOBlock* block = nullptr;
+  uint32_t offset = 0;
+  uint32_t length = 0;
+};
+
+class IOBuf {
+ public:
+  IOBuf() = default;
+  ~IOBuf() { clear(); }
+  IOBuf(const IOBuf& o) { append(o); }
+  IOBuf& operator=(const IOBuf& o) {
+    if (this != &o) {
+      clear();
+      append(o);
+    }
+    return *this;
+  }
+  IOBuf(IOBuf&& o) noexcept
+      : refs_(std::move(o.refs_)), length_(o.length_) {
+    o.refs_.clear();
+    o.length_ = 0;
+  }
+  IOBuf& operator=(IOBuf&& o) noexcept {
+    if (this != &o) {
+      clear();
+      refs_ = std::move(o.refs_);
+      length_ = o.length_;
+      o.refs_.clear();
+      o.length_ = 0;
+    }
+    return *this;
+  }
+
+  size_t size() const { return length_; }
+  bool empty() const { return length_ == 0; }
+  void clear();
+
+  void append(const void* data, size_t n);
+  void append(const std::string& s) { append(s.data(), s.size()); }
+  // Zero-copy: share the other buffer's blocks.
+  void append(const IOBuf& other);
+  void append(IOBuf&& other);
+  // Zero-copy external memory (device-buffer hook).
+  void append_user_data(void* data, size_t n, UserBlockDeleter d, void* meta);
+
+  // Move the first n bytes into *out (zero-copy ref transfer).
+  size_t cutn(IOBuf* out, size_t n);
+  // Drop the first n bytes.
+  size_t pop_front(size_t n);
+  // Copy out [from, from+n) without consuming.  Returns bytes copied.
+  size_t copy_to(void* dst, size_t n, size_t from = 0) const;
+  std::string to_string() const;
+
+  // Read from fd until EAGAIN or max bytes; appends to this buffer.
+  // Returns total read, 0 on EOF, -1 on error (errno set).  On EAGAIN with
+  // some data already read, returns that count.
+  ssize_t append_from_fd(int fd, size_t max = (size_t)-1);
+  // writev the first refs to fd; pops what was written.  Returns bytes
+  // written or -1 (errno set).
+  ssize_t cut_into_fd(int fd, size_t max = (size_t)-1);
+
+  size_t block_count() const { return refs_.size(); }
+  const BlockRef& ref_at(size_t i) const { return refs_[i]; }
+
+ private:
+  void push_ref(const BlockRef& r);
+  std::vector<BlockRef> refs_;
+  size_t length_ = 0;
+};
+
+// Thread-local appender state: the shared tail block current thread writes
+// into (≙ butil per-thread block sharing, iobuf.cpp tls_block).
+IOBlock* tls_acquire_block();
+void tls_release_block();
+
+}  // namespace trpc

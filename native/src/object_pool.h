@@ -1,0 +1,176 @@
+// object_pool.h / resource_pool semantics — lock-minimal slab allocators
+// (capability of the reference butil/object_pool.h + resource_pool.h:
+// thread-local free chunks merged to a global list; ResourcePool returns
+// stable ids usable as versioned handles for sockets/fibers).
+#pragma once
+
+#include <mutex>
+#include <vector>
+
+#include "common.h"
+
+namespace trpc {
+
+// ObjectPool<T>: recycles T* with thread-local caches.
+template <typename T>
+class ObjectPool {
+ public:
+  static constexpr size_t kTransferChunk = 64;
+  static constexpr size_t kTlsMax = 192;
+
+  static T* Get() {
+    auto& tls = tls_cache();
+    if (TRPC_UNLIKELY(tls.empty())) {
+      Refill(tls);
+    }
+    if (!tls.empty()) {
+      T* p = tls.back();
+      tls.pop_back();
+      return p;
+    }
+    return new T();
+  }
+
+  static void Return(T* p) {
+    auto& tls = tls_cache();
+    tls.push_back(p);
+    if (TRPC_UNLIKELY(tls.size() > kTlsMax)) {
+      Spill(tls);
+    }
+  }
+
+ private:
+  static std::vector<T*>& tls_cache() {
+    static thread_local std::vector<T*> c;
+    return c;
+  }
+  // leaked on purpose: runtime threads outlive static destruction
+  static std::mutex& mu() {
+    static std::mutex* m = new std::mutex();
+    return *m;
+  }
+  static std::vector<T*>& global() {
+    static std::vector<T*>* g = new std::vector<T*>();
+    return *g;
+  }
+  static void Refill(std::vector<T*>& tls) {
+    std::lock_guard<std::mutex> lk(mu());
+    auto& g = global();
+    size_t n = g.size() < kTransferChunk ? g.size() : kTransferChunk;
+    for (size_t i = 0; i < n; ++i) {
+      tls.push_back(g.back());
+      g.pop_back();
+    }
+  }
+  static void Spill(std::vector<T*>& tls) {
+    std::lock_guard<std::mutex> lk(mu());
+    auto& g = global();
+    for (size_t i = 0; i < kTransferChunk; ++i) {
+      g.push_back(tls.back());
+      tls.pop_back();
+    }
+  }
+};
+
+// ResourcePool<T>: id-addressed slabs with stable addresses — the backbone
+// of ABA-safe handles (fiber ids, socket ids).  Slots are never freed; ids
+// are recycled through free lists.  address() is wait-free.
+template <typename T>
+class ResourcePool {
+ public:
+  static constexpr uint32_t kSlabBits = 8;  // 256 items per slab
+  static constexpr uint32_t kSlabSize = 1u << kSlabBits;
+  static constexpr uint32_t kMaxSlabs = 1u << 16;  // 16M items max
+
+  // Returns a slot id and its address.
+  static uint32_t Get(T** out) {
+    auto& tls = tls_free();
+    if (TRPC_UNLIKELY(tls.empty())) {
+      Refill(tls);
+    }
+    if (!tls.empty()) {
+      uint32_t id = tls.back();
+      tls.pop_back();
+      *out = Address(id);
+      return id;
+    }
+    return Grow(out);
+  }
+
+  static void Return(uint32_t id) {
+    auto& tls = tls_free();
+    tls.push_back(id);
+    if (TRPC_UNLIKELY(tls.size() > kTlsMax)) {
+      std::lock_guard<std::mutex> lk(mu());
+      auto& g = global_free();
+      for (size_t i = 0; i < kTransferChunk; ++i) {
+        g.push_back(tls.back());
+        tls.pop_back();
+      }
+    }
+  }
+
+  static T* Address(uint32_t id) {
+    T* slab = slabs()[id >> kSlabBits].load(std::memory_order_acquire);
+    return TRPC_LIKELY(slab != nullptr) ? slab + (id & (kSlabSize - 1))
+                                        : nullptr;
+  }
+
+ private:
+  static constexpr size_t kTransferChunk = 32;
+  static constexpr size_t kTlsMax = 96;
+
+  static std::atomic<T*>* slabs() {
+    static std::atomic<T*> s[kMaxSlabs] = {};
+    return s;
+  }
+  // leaked on purpose (see ObjectPool::mu)
+  static std::mutex& mu() {
+    static std::mutex* m = new std::mutex();
+    return *m;
+  }
+  static std::vector<uint32_t>& global_free() {
+    static std::vector<uint32_t>* g = new std::vector<uint32_t>();
+    return *g;
+  }
+  static std::vector<uint32_t>& tls_free() {
+    static thread_local std::vector<uint32_t> c;
+    return c;
+  }
+  static uint32_t& nslab() {
+    static uint32_t n = 0;
+    return n;
+  }
+
+  static void Refill(std::vector<uint32_t>& tls) {
+    std::lock_guard<std::mutex> lk(mu());
+    auto& g = global_free();
+    size_t n = g.size() < kTransferChunk ? g.size() : kTransferChunk;
+    for (size_t i = 0; i < n; ++i) {
+      tls.push_back(g.back());
+      g.pop_back();
+    }
+  }
+
+  static uint32_t Grow(T** out) {
+    std::lock_guard<std::mutex> lk(mu());
+    uint32_t slab_idx = nslab();
+    if (slab_idx >= kMaxSlabs) {
+      *out = nullptr;
+      return UINT32_MAX;
+    }
+    T* slab = new T[kSlabSize];
+    slabs()[slab_idx].store(slab, std::memory_order_release);
+    nslab() = slab_idx + 1;
+    uint32_t base = slab_idx << kSlabBits;
+    auto& g = global_free();
+    // hand out slot 0, free the rest
+    for (uint32_t i = kSlabSize - 1; i >= 1; --i) {
+      g.push_back(base + i);
+    }
+    *out = slab;
+    return base;
+  }
+};
+
+}  // namespace trpc

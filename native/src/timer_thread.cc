@@ -1,0 +1,133 @@
+#include "timer_thread.h"
+
+#include <pthread.h>
+
+#include <condition_variable>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <vector>
+
+#include "object_pool.h"
+
+namespace trpc {
+
+enum TimerState : int {
+  TIMER_PENDING = 0,
+  TIMER_RUNNING = 1,
+  TIMER_DONE = 2,
+  TIMER_CANCELLED = 3,
+};
+
+struct TimerTask {
+  int64_t run_time_us = 0;
+  TimerFn fn = nullptr;
+  void* arg = nullptr;
+  std::atomic<int> state{TIMER_PENDING};
+};
+
+namespace {
+
+struct Later {
+  bool operator()(const TimerTask* a, const TimerTask* b) const {
+    return a->run_time_us > b->run_time_us;
+  }
+};
+
+class TimerThread {
+ public:
+  static TimerThread& Instance() {
+    // leaked on purpose: the detached timer thread uses mu_/cv_ forever
+    static TimerThread* t = new TimerThread();
+    return *t;
+  }
+
+  TimerTask* Add(int64_t abstime_us, TimerFn fn, void* arg) {
+    TimerTask* t = ObjectPool<TimerTask>::Get();
+    t->run_time_us = abstime_us;
+    t->fn = fn;
+    t->arg = arg;
+    t->state.store(TIMER_PENDING, std::memory_order_relaxed);
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      heap_.push(t);
+      if (heap_.top() == t) {
+        cv_.notify_one();  // new earliest deadline
+      }
+    }
+    return t;
+  }
+
+  void Run() {
+    std::unique_lock<std::mutex> lk(mu_);
+    while (true) {
+      if (heap_.empty()) {
+        cv_.wait(lk);
+        continue;
+      }
+      TimerTask* t = heap_.top();
+      int st = t->state.load(std::memory_order_acquire);
+      if (st == TIMER_CANCELLED) {
+        heap_.pop();
+        ObjectPool<TimerTask>::Return(t);
+        continue;
+      }
+      int64_t now = monotonic_us();
+      if (t->run_time_us > now) {
+        cv_.wait_for(lk, std::chrono::microseconds(t->run_time_us - now));
+        continue;
+      }
+      heap_.pop();
+      int expected = TIMER_PENDING;
+      if (t->state.compare_exchange_strong(expected, TIMER_RUNNING,
+                                           std::memory_order_acq_rel)) {
+        lk.unlock();
+        t->fn(t->arg);
+        t->state.store(TIMER_DONE, std::memory_order_release);
+        lk.lock();
+      } else {
+        // cancelled between peek and pop
+        ObjectPool<TimerTask>::Return(t);
+      }
+    }
+  }
+
+ private:
+  TimerThread() {
+    std::thread th([this] {
+      pthread_setname_np(pthread_self(), "trpc_timer");
+      Run();
+    });
+    th.detach();
+  }
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::priority_queue<TimerTask*, std::vector<TimerTask*>, Later> heap_;
+};
+
+}  // namespace
+
+TimerTask* timer_add(int64_t abstime_us, TimerFn fn, void* arg) {
+  return TimerThread::Instance().Add(abstime_us, fn, arg);
+}
+
+int timer_cancel_and_free(TimerTask* t) {
+  int expected = TIMER_PENDING;
+  if (t->state.compare_exchange_strong(expected, TIMER_CANCELLED,
+                                       std::memory_order_acq_rel)) {
+    return 1;  // timer thread frees it on lazy pop
+  }
+  // fired (or firing): wait out the callback, then free.
+  while (t->state.load(std::memory_order_acquire) == TIMER_RUNNING) {
+#if defined(__x86_64__)
+    __builtin_ia32_pause();
+#endif
+  }
+  ObjectPool<TimerTask>::Return(t);
+  return 0;
+}
+
+void timer_thread_start() { (void)TimerThread::Instance(); }
+
+}  // namespace trpc

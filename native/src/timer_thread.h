@@ -1,0 +1,31 @@
+// timer_thread.h — dedicated timer pthread driving all RPC timeouts and
+// timed waits (capability of the reference bthread/timer_thread.h:53; the
+// reference uses O(1) hashed buckets, this build starts with a binary heap —
+// the schedule/unschedule rate is bounded by in-flight RPCs).
+//
+// Ownership protocol: every timer_add() must be paired with exactly one
+// timer_cancel_and_free(), even after the timer fired.  CANCELLED-while-
+// pending tasks are freed by the timer thread on lazy pop; all other states
+// are freed by the canceller.
+#pragma once
+
+#include <cstdint>
+
+#include "common.h"
+
+namespace trpc {
+
+struct TimerTask;
+typedef void (*TimerFn)(void* arg);
+
+// Schedule fn(arg) at abstime_us (CLOCK_MONOTONIC microseconds).
+TimerTask* timer_add(int64_t abstime_us, TimerFn fn, void* arg);
+
+// Cancel if still pending; if the callback is running, waits for it to
+// finish.  Returns 1 if the callback was prevented from running, 0 if it ran
+// (or is done).  Always releases the caller's ownership of `t`.
+int timer_cancel_and_free(TimerTask* t);
+
+void timer_thread_start();  // idempotent
+
+}  // namespace trpc

@@ -1,0 +1,96 @@
+// work_stealing_queue.h — Chase-Lev style bounded work-stealing deque
+// (capability of the reference bthread/work_stealing_queue.h:32: owner
+// pushes/pops at the bottom without contention, thieves CAS at the top).
+#pragma once
+
+#include "common.h"
+
+namespace trpc {
+
+template <typename T>
+class WorkStealingQueue {
+ public:
+  TRPC_DISALLOW_COPY(WorkStealingQueue);
+
+  explicit WorkStealingQueue(size_t capacity = 4096)
+      : cap_(capacity), mask_(capacity - 1), buf_(new T[capacity]) {
+    // capacity must be a power of two
+    bottom_.store(1, std::memory_order_relaxed);
+    top_.store(1, std::memory_order_relaxed);
+  }
+  ~WorkStealingQueue() { delete[] buf_; }
+
+  // Owner only.  Returns false when full.
+  bool Push(const T& v) {
+    uint64_t b = bottom_.load(std::memory_order_relaxed);
+    uint64_t t = top_.load(std::memory_order_acquire);
+    if (TRPC_UNLIKELY(b >= t + cap_)) {
+      return false;
+    }
+    buf_[b & mask_] = v;
+    bottom_.store(b + 1, std::memory_order_release);
+    return true;
+  }
+
+  // Owner only.  LIFO pop from the bottom.
+  bool Pop(T* out) {
+    uint64_t b = bottom_.load(std::memory_order_relaxed);
+    uint64_t t = top_.load(std::memory_order_relaxed);
+    if (t >= b) {
+      return false;
+    }
+    --b;
+    bottom_.store(b, std::memory_order_relaxed);
+    std::atomic_thread_fence(std::memory_order_seq_cst);
+    t = top_.load(std::memory_order_relaxed);
+    if (t > b) {
+      // lost the race with a thief on the last element
+      bottom_.store(b + 1, std::memory_order_relaxed);
+      return false;
+    }
+    *out = buf_[b & mask_];
+    if (t == b) {
+      // last element: race thieves via CAS on top
+      if (!top_.compare_exchange_strong(t, t + 1, std::memory_order_seq_cst,
+                                        std::memory_order_relaxed)) {
+        bottom_.store(b + 1, std::memory_order_relaxed);
+        return false;
+      }
+      bottom_.store(b + 1, std::memory_order_relaxed);
+    }
+    return true;
+  }
+
+  // Any thread.  FIFO steal from the top.
+  bool Steal(T* out) {
+    uint64_t t = top_.load(std::memory_order_acquire);
+    uint64_t b = bottom_.load(std::memory_order_acquire);
+    while (t < b) {
+      T v = buf_[t & mask_];
+      if (top_.compare_exchange_strong(t, t + 1, std::memory_order_seq_cst,
+                                       std::memory_order_relaxed)) {
+        *out = v;
+        return true;
+      }
+      b = bottom_.load(std::memory_order_acquire);
+    }
+    return false;
+  }
+
+  size_t volatile_size() const {
+    uint64_t b = bottom_.load(std::memory_order_relaxed);
+    uint64_t t = top_.load(std::memory_order_relaxed);
+    return b > t ? (size_t)(b - t) : 0;
+  }
+
+  size_t capacity() const { return cap_; }
+
+ private:
+  const size_t cap_;
+  const size_t mask_;
+  T* buf_;
+  alignas(64) std::atomic<uint64_t> bottom_;
+  alignas(64) std::atomic<uint64_t> top_;
+};
+
+}  // namespace trpc

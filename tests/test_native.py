@@ -1,0 +1,91 @@
+"""Native core tests: run the C++ smoke binary, then exercise the ctypes
+surface (≙ reference bthread unittests driven from the public API)."""
+
+import os
+import subprocess
+import threading
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_cpp_smoke_binary():
+    """Builds (if needed) and runs the native test binary — covers iobuf,
+    fiber start/join, butex timeout/pingpong, pthread butex, yield storm."""
+    from brpc_tpu._native import lib
+    lib()  # ensure built
+    exe = os.path.join(REPO, "native", "build", "test_core")
+    out = subprocess.run([exe], capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "ALL NATIVE CORE TESTS PASSED" in out.stdout
+
+
+class TestFiberPython:
+    def test_init_and_stats(self):
+        from brpc_tpu import fiber
+        n = fiber.init(2)
+        assert fiber.workers() >= 2 or n == 0  # 0 if already started wider
+        s = fiber.stats()
+        assert s["workers"] >= 2
+
+    def test_start_join(self):
+        from brpc_tpu import fiber
+        hits = []
+        fid = fiber.start(lambda: hits.append(1))
+        fiber.join(fid)
+        assert hits == [1]
+
+    def test_many_fibers(self):
+        from brpc_tpu import fiber
+        counter = {"n": 0}
+        lock = threading.Lock()
+
+        def work():
+            with lock:
+                counter["n"] += 1
+
+        fids = [fiber.start(work) for _ in range(50)]
+        for f in fids:
+            fiber.join(f)
+        assert counter["n"] == 50
+
+    def test_butex_pthread_wait_wake(self):
+        from brpc_tpu import fiber
+        b = fiber.Butex()
+        b.value = 0
+
+        def waker():
+            time.sleep(0.05)
+            b.value = 1
+            b.wake_all()
+
+        t = threading.Thread(target=waker)
+        t.start()
+        rc = b.wait(0, timeout_us=2_000_000)
+        t.join()
+        assert rc == 0
+        b.close()
+
+    def test_butex_timeout(self):
+        from brpc_tpu import fiber
+        import errno
+        b = fiber.Butex()
+        b.value = 5
+        t0 = time.monotonic()
+        rc = b.wait(5, timeout_us=50_000)
+        dt = time.monotonic() - t0
+        assert rc == -errno.ETIMEDOUT
+        assert 0.04 <= dt < 1.0
+        # mismatched expectation returns EWOULDBLOCK immediately
+        rc = b.wait(6, timeout_us=1_000_000)
+        assert rc == -errno.EWOULDBLOCK
+        b.close()
+
+    def test_fiber_bvars_exposed(self):
+        from brpc_tpu import fiber
+        from brpc_tpu.metrics import bvar
+        fiber.init()
+        names = [n for n, _ in bvar.dump_exposed(lambda n: n.startswith("fiber_"))]
+        assert "fiber_context_switches" in names
