@@ -74,3 +74,51 @@ def _declare(L: ctypes.CDLL) -> None:
     L.trpc_butex_wake.restype = c.c_int
     L.trpc_butex_wake_all.argtypes = [c.c_void_p]
     L.trpc_butex_wake_all.restype = c.c_int
+
+    # server
+    L.trpc_server_create.restype = c.c_void_p
+    L.trpc_server_add_echo.argtypes = [c.c_void_p]
+    L.trpc_server_add_echo.restype = c.c_int
+    L.trpc_server_add_service.argtypes = [c.c_void_p, c.c_char_p,
+                                          c.c_void_p, c.c_void_p]
+    L.trpc_server_add_service.restype = c.c_int
+    L.trpc_server_start.argtypes = [c.c_void_p, c.c_char_p, c.c_int]
+    L.trpc_server_start.restype = c.c_int
+    L.trpc_server_port.argtypes = [c.c_void_p]
+    L.trpc_server_port.restype = c.c_int
+    L.trpc_server_stop.argtypes = [c.c_void_p]
+    L.trpc_server_stop.restype = c.c_int
+    L.trpc_server_destroy.argtypes = [c.c_void_p]
+    L.trpc_server_destroy.restype = None
+    L.trpc_server_requests.argtypes = [c.c_void_p]
+    L.trpc_server_requests.restype = c.c_uint64
+    L.trpc_respond.argtypes = [c.c_uint64, c.c_int32, c.c_char_p,
+                               c.c_char_p, c.c_size_t, c.c_char_p,
+                               c.c_size_t]
+    L.trpc_respond.restype = c.c_int
+
+    # channel
+    L.trpc_channel_create.argtypes = [c.c_char_p, c.c_int]
+    L.trpc_channel_create.restype = c.c_void_p
+    L.trpc_channel_destroy.argtypes = [c.c_void_p]
+    L.trpc_channel_call.argtypes = [c.c_void_p, c.c_char_p, c.c_char_p,
+                                    c.c_size_t, c.c_char_p, c.c_size_t,
+                                    c.c_int64, c.POINTER(c.c_void_p)]
+    L.trpc_channel_call.restype = c.c_int
+    L.trpc_result_error_code.argtypes = [c.c_void_p]
+    L.trpc_result_error_code.restype = c.c_int32
+    L.trpc_result_error_text.argtypes = [c.c_void_p]
+    L.trpc_result_error_text.restype = c.c_char_p
+    L.trpc_result_data.argtypes = [c.c_void_p,
+                                   c.POINTER(c.POINTER(c.c_uint8))]
+    L.trpc_result_data.restype = c.c_size_t
+    L.trpc_result_attachment.argtypes = [c.c_void_p,
+                                         c.POINTER(c.POINTER(c.c_uint8))]
+    L.trpc_result_attachment.restype = c.c_size_t
+    L.trpc_result_destroy.argtypes = [c.c_void_p]
+
+    # bench
+    L.trpc_run_echo_bench.argtypes = [c.c_char_p, c.c_int, c.c_int, c.c_int,
+                                      c.c_int, c.c_int, c.c_double,
+                                      c.POINTER(c.c_double)]
+    L.trpc_run_echo_bench.restype = c.c_int

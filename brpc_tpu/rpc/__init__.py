@@ -1,0 +1,9 @@
+"""rpc — Server/Channel/Controller public API (≙ reference src/brpc core:
+server.h:343, channel.h:151, controller.h:110)."""
+
+from brpc_tpu.rpc.errors import (  # noqa: F401
+    RpcError, ERPCTIMEDOUT, EFAILEDSOCKET, ENOSERVICE, ENOMETHOD, EREQUEST,
+    EINTERNAL, ELIMIT, ESTOP, error_text)
+from brpc_tpu.rpc.controller import Controller  # noqa: F401
+from brpc_tpu.rpc.channel import Channel, ChannelOptions  # noqa: F401
+from brpc_tpu.rpc.server import Server, ServerOptions  # noqa: F401

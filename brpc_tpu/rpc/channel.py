@@ -1,0 +1,246 @@
+"""Channel — the client stub (≙ brpc::Channel, reference channel.cpp:407
+CallMethod is the whole client pipeline: serialize → pick server → pack →
+write → wait, with timeout/retry/backup orchestration from
+Controller::OnVersionedRPCReturned, controller.cpp:575-670).
+
+The per-connection data path (correlation ids, butex-woken pending calls,
+wait-free socket writes) is native (native/src/rpc.cc); this layer adds what
+sits above a single connection: retries with backoff, backup requests,
+naming+load-balancing (cluster layer), and circuit-breaker feedback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Tuple
+
+from brpc_tpu._native import lib
+from brpc_tpu.metrics import bvar
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.controller import Controller
+from brpc_tpu.utils.endpoint import EndPoint, str2endpoint
+
+
+@dataclass
+class ChannelOptions:
+    timeout_ms: float = 1000.0
+    max_retry: int = 3
+    backup_request_ms: Optional[float] = None
+    connect_timeout_ms: float = 500.0
+    # cluster mode (set via Channel(naming_url, load_balancer=...))
+    load_balancer: str = ""
+    retry_policy: Optional["RetryPolicy"] = None
+
+
+class RetryPolicy:
+    """≙ brpc::RetryPolicy (retry_policy.h): DoRetry decides, backoff_time_us
+    spaces the attempts."""
+
+    RETRIABLE = {errors.EFAILEDSOCKET, errors.EOVERCROWDED, errors.EINTERNAL}
+
+    def do_retry(self, cntl: Controller) -> bool:
+        return cntl.error_code in self.RETRIABLE
+
+    def backoff_us(self, attempt: int) -> int:
+        return 0  # no backoff by default (≙ reference default policy)
+
+
+class _NativeCall:
+    """One sync call against one native channel handle."""
+
+    __slots__ = ("handle",)
+
+    def __init__(self, handle):
+        self.handle = handle
+
+    def call(self, method: bytes, payload: bytes, attachment: bytes,
+             timeout_us: int) -> Tuple[int, str, bytes, bytes]:
+        L = lib()
+        result = ctypes.c_void_p()
+        rc = L.trpc_channel_call(
+            self.handle, method, payload, len(payload),
+            attachment if attachment else None, len(attachment),
+            timeout_us, ctypes.byref(result))
+        try:
+            code = L.trpc_result_error_code(result)
+            text = L.trpc_result_error_text(result).decode(
+                "utf-8", "replace") if code else ""
+            p = ctypes.POINTER(ctypes.c_uint8)()
+            n = L.trpc_result_data(result, ctypes.byref(p))
+            data = ctypes.string_at(p, n) if n else b""
+            n2 = L.trpc_result_attachment(result, ctypes.byref(p))
+            att = ctypes.string_at(p, n2) if n2 else b""
+            return (rc if rc else code), text, data, att
+        finally:
+            L.trpc_result_destroy(result)
+
+
+class SubChannel:
+    """A channel to a single server endpoint (native connection underneath).
+
+    ≙ the single-server brpc::Channel (SocketMap entry, channel.cpp:317).
+    """
+
+    def __init__(self, endpoint: EndPoint):
+        self.endpoint = endpoint
+        L = lib()
+        self._handle = L.trpc_channel_create(
+            endpoint.ip.encode(), endpoint.port)
+        self._native = _NativeCall(self._handle)
+        self._lock = threading.Lock()
+        self._closed = False
+
+    def call_once(self, method: bytes, payload: bytes, attachment: bytes,
+                  timeout_us: int):
+        return self._native.call(method, payload, attachment, timeout_us)
+
+    def close(self):
+        with self._lock:
+            if not self._closed:
+                self._closed = True
+                lib().trpc_channel_destroy(self._handle)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class Channel:
+    """Client stub.  ``Channel("127.0.0.1:8000")`` dials a single server;
+    ``Channel("list://h1:80,h2:80", load_balancer="rr")`` goes through the
+    cluster layer (naming service + LB + circuit breaker) — see
+    brpc_tpu/cluster/cluster_channel.py.
+    """
+
+    _latency = None  # class-wide client latency recorder, lazily exposed
+
+    def __init__(self, address: str,
+                 options: Optional[ChannelOptions] = None, **kw):
+        self.options = options or ChannelOptions(**kw)
+        self._cluster = None
+        if "://" in address and not address.startswith("tpu://"):
+            from brpc_tpu.cluster.cluster_channel import ClusterChannel
+            self._cluster = ClusterChannel(address, self.options)
+            self._sub = None
+        else:
+            ep = str2endpoint(address)
+            if ep.is_device:
+                # device endpoints carry the control plane on DCN/TCP
+                ep = EndPoint(ip=ep.ip, port=ep.port)
+            self._sub = SubChannel(ep)
+        if Channel._latency is None:
+            Channel._latency = bvar.LatencyRecorder()
+            Channel._latency.expose("rpc_client")
+
+    # -- the client pipeline (≙ Channel::CallMethod, channel.cpp:407) -------
+
+    def call(self, method: str, payload: bytes = b"",
+             attachment: bytes = b"",
+             cntl: Optional[Controller] = None) -> bytes:
+        """Synchronous call.  Raises RpcError on failure; returns response
+        payload (attachment lands on cntl.response_attachment)."""
+        cntl = cntl or Controller()
+        if cntl.timeout_ms is None:
+            cntl.timeout_ms = self.options.timeout_ms
+        cntl.reset()
+        mb = method.encode()
+        start = time.monotonic_ns()
+        deadline = start + int(cntl.timeout_ms * 1e6)
+        policy = self.options.retry_policy or _default_retry
+        max_retry = cntl.max_retry if cntl.max_retry is not None \
+            else self.options.max_retry
+        backup_ms = (cntl.backup_request_ms
+                     if cntl.backup_request_ms is not None
+                     else self.options.backup_request_ms)
+
+        attempt = 0
+        while True:
+            remaining_us = (deadline - time.monotonic_ns()) // 1000
+            if remaining_us <= 0:
+                cntl.set_failed(errors.ERPCTIMEDOUT)
+                break
+            code, text, data, att = self._call_attempt(
+                mb, payload, attachment, remaining_us, backup_ms, cntl)
+            cntl.error_code, cntl.error_text = code, text
+            if code == 0:
+                cntl.response_attachment = att
+                cntl.latency_us = (time.monotonic_ns() - start) // 1000
+                Channel._latency.record(cntl.latency_us)
+                return data
+            if attempt >= max_retry or not policy.do_retry(cntl):
+                break
+            attempt += 1
+            cntl.retried_count = attempt
+            backoff = policy.backoff_us(attempt)
+            if backoff > 0:
+                time.sleep(backoff / 1e6)
+        cntl.latency_us = (time.monotonic_ns() - start) // 1000
+        raise errors.RpcError(cntl.error_code, cntl.error_text)
+
+    def _call_attempt(self, method: bytes, payload: bytes, attachment: bytes,
+                      timeout_us: int, backup_ms: Optional[float],
+                      cntl: Controller):
+        if self._cluster is not None:
+            return self._cluster.call_once(method, payload, attachment,
+                                           timeout_us, cntl)
+        if backup_ms is None or timeout_us <= backup_ms * 1000:
+            return self._sub.call_once(method, payload, attachment,
+                                       timeout_us)
+        return self._backup_race(self._sub, method, payload, attachment,
+                                 timeout_us, backup_ms, cntl)
+
+    @staticmethod
+    def _backup_race(sub: SubChannel, method: bytes, payload: bytes,
+                     attachment: bytes, timeout_us: int, backup_ms: float,
+                     cntl: Controller):
+        """Backup request (≙ reference channel.cpp:551-560,
+        controller.cpp:601-634): if no response within backup_ms, race a
+        second attempt; first success wins."""
+        result = []
+        cond = threading.Condition()
+
+        def attempt(budget_us):
+            r = sub.call_once(method, payload, attachment, budget_us)
+            with cond:
+                result.append(r)
+                cond.notify_all()
+
+        t1 = threading.Thread(
+            target=attempt, args=(timeout_us,), daemon=True)
+        t1.start()
+        with cond:
+            cond.wait(backup_ms / 1000.0)
+            if not result:
+                cntl.backup_fired = True
+        if cntl.backup_fired:
+            remaining = timeout_us - int(backup_ms * 1000)
+            t2 = threading.Thread(
+                target=attempt, args=(remaining,), daemon=True)
+            t2.start()
+        with cond:
+            deadline = time.monotonic() + timeout_us / 1e6
+            while True:
+                for r in result:
+                    if r[0] == 0:
+                        return r
+                expected = 2 if cntl.backup_fired else 1
+                if len(result) >= expected:
+                    return result[0]
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return (errors.ERPCTIMEDOUT, "", b"", b"")
+                cond.wait(left)
+
+    def close(self):
+        if self._sub is not None:
+            self._sub.close()
+        if self._cluster is not None:
+            self._cluster.close()
+
+
+_default_retry = RetryPolicy()

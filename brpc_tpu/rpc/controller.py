@@ -1,0 +1,49 @@
+"""Controller — per-RPC context and result carrier
+(≙ brpc::Controller, reference controller.h:110: timeout/retry knobs on the
+client side; method/peer/attachment context on the server side)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class Controller:
+    """One RPC's mutable state.  Client side: set options before the call,
+    read results after.  Server side: passed to the handler with request
+    context; the handler sets response fields."""
+
+    def __init__(self):
+        # client options
+        self.timeout_ms: Optional[float] = 1000.0
+        self.max_retry: int = 3
+        self.backup_request_ms: Optional[float] = None
+        # shared state
+        self.error_code: int = 0
+        self.error_text: str = ""
+        self.request_attachment: bytes = b""
+        self.response_attachment: bytes = b""
+        # server-side context
+        self.method: str = ""
+        self.remote_side: str = ""
+        self.log_id: int = 0
+        # tracing (rpcz)
+        self.trace_id: int = 0
+        self.span_id: int = 0
+        # populated after a call
+        self.latency_us: int = 0
+        self.retried_count: int = 0
+        self.backup_fired: bool = False
+
+    def failed(self) -> bool:
+        return self.error_code != 0
+
+    def set_failed(self, code: int, text: str = "") -> None:
+        self.error_code = code
+        self.error_text = text
+
+    def reset(self) -> None:
+        self.error_code = 0
+        self.error_text = ""
+        self.latency_us = 0
+        self.retried_count = 0
+        self.backup_fired = False

@@ -1,0 +1,41 @@
+"""Error codes shared with the native core (native/src/common.h) —
+the capability of the reference's errno set (brpc/errno.proto)."""
+
+from __future__ import annotations
+
+OK = 0
+ENOSERVICE = 1001
+ENOMETHOD = 1002
+ERPCTIMEDOUT = 1008
+EFAILEDSOCKET = 1009
+EBACKUPREQUEST = 1010
+EREQUEST = 1011
+ESTOP = 1012
+EINTERNAL = 2001
+EOVERCROWDED = 2004
+ELIMIT = 2005
+
+_TEXT = {
+    OK: "OK",
+    ENOSERVICE: "no such service",
+    ENOMETHOD: "no such method",
+    ERPCTIMEDOUT: "rpc call timed out",
+    EFAILEDSOCKET: "the connection is broken",
+    EBACKUPREQUEST: "backup request fired",
+    EREQUEST: "bad request bytes",
+    ESTOP: "server is stopping",
+    EINTERNAL: "server-side exception",
+    EOVERCROWDED: "too many buffered writes",
+    ELIMIT: "rejected by concurrency limiter",
+}
+
+
+def error_text(code: int) -> str:
+    return _TEXT.get(code, f"error {code}")
+
+
+class RpcError(Exception):
+    def __init__(self, code: int, text: str = ""):
+        self.code = code
+        self.text = text or error_text(code)
+        super().__init__(f"[E{code}] {self.text}")

@@ -1,0 +1,217 @@
+"""Server — service registry + lifecycle (≙ brpc::Server, reference
+server.cpp:750 StartInternal: builds the acceptor, registers services and
+builtin debug services, binds per-method status).
+
+Data path is native: the acceptor, event dispatcher, frame parsing and the
+native echo service never touch Python.  Python handlers run on the native
+usercode pthread pool (≙ usercode_in_pthread,
+details/usercode_backup_pool.cpp) and respond through trpc_respond.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+import traceback
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional, Tuple, Union
+
+from brpc_tpu._native import lib
+from brpc_tpu.metrics import bvar
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.controller import Controller
+from brpc_tpu.utils import flags, logging as log
+
+flags.define_int32("usercode_workers", 4,
+                   "pthreads running Python handlers")
+
+_HANDLER_CB = ctypes.CFUNCTYPE(
+    None, ctypes.c_uint64, ctypes.c_char_p,
+    ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,
+    ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t, ctypes.c_void_p)
+
+# A handler returns bytes, (bytes, attachment_bytes), or None (then it must
+# have set cntl fields / called cntl.set_failed).
+Handler = Callable[[Controller, bytes], Union[bytes, Tuple[bytes, bytes], None]]
+
+
+@dataclass
+class ServerOptions:
+    num_workers: int = 0           # fiber workers (0 = ncpu)
+    max_concurrency: int = 0       # 0 = unlimited (limiters in cluster/)
+    enable_builtin_services: bool = True
+    builtin_port: Optional[int] = None  # HTTP debug portal port (None = off)
+
+
+class _MethodStatus:
+    """Per-method metrics (≙ details/method_status.h + MethodStatus):
+    a LatencyRecorder + error counter exposed as <service>_<method>_*."""
+
+    def __init__(self, name: str):
+        self.latency = bvar.LatencyRecorder()
+        self.latency.expose(f"rpc_server_{name}")
+        self.errors = bvar.Adder(f"rpc_server_{name}_errors")
+
+    def close(self):
+        self.latency.close()
+        self.errors.hide()
+
+
+class Server:
+    def __init__(self, options: Optional[ServerOptions] = None):
+        self.options = options or ServerOptions()
+        self._handle = lib().trpc_server_create()
+        self._services: Dict[str, Handler] = {}
+        self._method_status: Dict[str, _MethodStatus] = {}
+        self._cb_keepalive = []
+        self._started = False
+        self._port = 0
+        self._builtin = None
+        self._limiter = None  # cluster.ConcurrencyLimiter, set via option
+
+    # -- registration (≙ Server::AddService) --------------------------------
+
+    def add_echo_service(self) -> None:
+        """Native echo service: requests never enter Python (hot path for
+        benches, ≙ example/echo_c++)."""
+        lib().trpc_server_add_echo(self._handle)
+
+    def add_service(self, name: str, handler: Handler) -> None:
+        if self._started:
+            raise RuntimeError("add_service after start")
+        self._services[name] = handler
+        cb = _HANDLER_CB(self._make_dispatcher(name, handler))
+        self._cb_keepalive.append(cb)
+        lib().trpc_server_add_service(self._handle, name.encode(),
+                                      ctypes.cast(cb, ctypes.c_void_p), None)
+
+    def set_concurrency_limiter(self, limiter) -> None:
+        """Admission control hook (cluster layer: constant/auto/timeout,
+        ≙ ConcurrencyLimiter, concurrency_limiter.h:29)."""
+        self._limiter = limiter
+
+    def _make_dispatcher(self, name: str, handler: Handler):
+        status = self._method_status.get(name)
+        if status is None:
+            status = self._method_status[name] = _MethodStatus(name)
+        limiter_box = self  # read at call time so set_concurrency_limiter
+        # works after registration
+
+        def dispatch(token, method, req_p, req_len, att_p, att_len, _user):
+            import time
+            t0 = time.monotonic_ns()
+            L = lib()
+            limiter = limiter_box._limiter
+            if limiter is not None and not limiter.on_request():
+                L.trpc_respond(token, errors.ELIMIT,
+                               errors.error_text(errors.ELIMIT).encode(),
+                               None, 0, None, 0)
+                status.errors.add(1)
+                return
+            cntl = Controller()
+            cntl.method = method.decode() if method else name
+            req = ctypes.string_at(req_p, req_len) if req_len else b""
+            cntl.request_attachment = (
+                ctypes.string_at(att_p, att_len) if att_len else b"")
+            try:
+                out = handler(cntl, req)
+                resp, resp_att = b"", cntl.response_attachment
+                if isinstance(out, tuple):
+                    resp, resp_att = out
+                elif out is not None:
+                    resp = out
+                if cntl.failed():
+                    L.trpc_respond(token, cntl.error_code,
+                                   cntl.error_text.encode(), None, 0, None, 0)
+                    status.errors.add(1)
+                else:
+                    L.trpc_respond(token, 0, None, resp, len(resp),
+                                   resp_att if resp_att else None,
+                                   len(resp_att))
+            except errors.RpcError as e:
+                L.trpc_respond(token, e.code, e.text.encode(), None, 0,
+                               None, 0)
+                status.errors.add(1)
+            except Exception:
+                log.LOG(log.LOG_ERROR, "handler %s raised:\n%s", name,
+                        traceback.format_exc())
+                L.trpc_respond(token, errors.EINTERNAL,
+                               traceback.format_exc(limit=3).encode(),
+                               None, 0, None, 0)
+                status.errors.add(1)
+            finally:
+                if limiter is not None:
+                    limiter.on_response((time.monotonic_ns() - t0) // 1000)
+                status.latency.record((time.monotonic_ns() - t0) // 1000)
+
+        return dispatch
+
+    # -- lifecycle (≙ Server::Start/Stop/Join) ------------------------------
+
+    def start(self, address: str = "127.0.0.1:0") -> int:
+        from brpc_tpu import fiber
+        fiber.init(self.options.num_workers)
+        ip, _, port = address.rpartition(":")
+        rc = lib().trpc_server_start(self._handle, ip.encode(), int(port))
+        if rc != 0:
+            raise OSError(-rc, f"server start failed on {address}")
+        self._port = lib().trpc_server_port(self._handle)
+        self._started = True
+        flags.freeze_nonreloadable()
+        if (self.options.enable_builtin_services
+                and self.options.builtin_port is not None):
+            from brpc_tpu.builtin.portal import BuiltinPortal
+            self._builtin = BuiltinPortal(self)
+            self._builtin.start(self.options.builtin_port)
+        log.LOG(log.LOG_INFO, "Server started on %s:%d", ip or "0.0.0.0",
+                self._port)
+        return self._port
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    @property
+    def listen_address(self) -> str:
+        return f"127.0.0.1:{self._port}"
+
+    def request_count(self) -> int:
+        return lib().trpc_server_requests(self._handle)
+
+    def stop(self) -> None:
+        if self._started:
+            lib().trpc_server_stop(self._handle)
+            self._started = False
+        if self._builtin is not None:
+            self._builtin.stop()
+            self._builtin = None
+
+    def destroy(self) -> None:
+        """Stop, fail live connections, drain, and free the native server.
+        The Python object is unusable afterwards."""
+        if self._handle:
+            self.stop()
+            lib().trpc_server_destroy(self._handle)
+            self._handle = None
+        for st in self._method_status.values():
+            st.close()
+        self._method_status.clear()
+
+    def method_stats(self) -> Dict[str, dict]:
+        """/status data: per-method qps/latency/errors."""
+        out = {}
+        for name, st in self._method_status.items():
+            out[name] = {
+                "qps": st.latency.qps(),
+                "count": st.latency.count(),
+                "latency_us": st.latency.latency(),
+                "latency_99_us": st.latency.latency_percentile(0.99),
+                "errors": st.errors.get_value(),
+            }
+        return out
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()

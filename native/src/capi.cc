@@ -7,6 +7,7 @@
 
 #include "fiber.h"
 #include "iobuf.h"
+#include "rpc.h"
 
 using namespace trpc;
 
@@ -62,5 +63,92 @@ int trpc_butex_wait(void* b, int32_t expected, int64_t timeout_us) {
 }
 int trpc_butex_wake(void* b) { return butex_wake((Butex*)b); }
 int trpc_butex_wake_all(void* b) { return butex_wake_all((Butex*)b); }
+
+// --- server ----------------------------------------------------------------
+
+void* trpc_server_create() { return server_create(); }
+
+int trpc_server_add_echo(void* s) {
+  return server_add_service((Server*)s, "Echo", 0, nullptr, nullptr);
+}
+
+int trpc_server_add_service(void* s, const char* name, HandlerCb cb,
+                            void* user) {
+  return server_add_service((Server*)s, name, 1, cb, user);
+}
+
+int trpc_server_start(void* s, const char* ip, int port) {
+  return server_start((Server*)s, ip, port);
+}
+
+int trpc_server_port(void* s) { return server_port((Server*)s); }
+int trpc_server_stop(void* s) { return server_stop((Server*)s); }
+void trpc_server_destroy(void* s) { server_destroy((Server*)s); }
+uint64_t trpc_server_requests(void* s) { return server_requests((Server*)s); }
+
+int trpc_respond(uint64_t token, int32_t error_code, const char* error_text,
+                 const uint8_t* data, size_t len, const uint8_t* attach,
+                 size_t attach_len) {
+  return respond(token, error_code, error_text, data, len, attach,
+                 attach_len);
+}
+
+// --- channel ---------------------------------------------------------------
+
+void* trpc_channel_create(const char* ip, int port) {
+  return channel_create(ip, port);
+}
+
+void trpc_channel_destroy(void* c) { channel_destroy((Channel*)c); }
+
+// Synchronous call.  Response/attachment/error_text are returned through a
+// heap CallResult the caller must free with trpc_result_destroy.
+int trpc_channel_call(void* c, const char* method, const uint8_t* req,
+                      size_t req_len, const uint8_t* attach,
+                      size_t attach_len, int64_t timeout_us, void** result) {
+  CallResult* r = new CallResult();
+  int rc = channel_call((Channel*)c, method, req, req_len, attach, attach_len,
+                        timeout_us, r);
+  *result = r;
+  return rc;
+}
+
+int32_t trpc_result_error_code(void* r) {
+  return ((CallResult*)r)->error_code;
+}
+const char* trpc_result_error_text(void* r) {
+  return ((CallResult*)r)->error_text.c_str();
+}
+size_t trpc_result_data(void* r, const uint8_t** p) {
+  CallResult* cr = (CallResult*)r;
+  *p = (const uint8_t*)cr->response.data();
+  return cr->response.size();
+}
+size_t trpc_result_attachment(void* r, const uint8_t** p) {
+  CallResult* cr = (CallResult*)r;
+  *p = (const uint8_t*)cr->attachment.data();
+  return cr->attachment.size();
+}
+void trpc_result_destroy(void* r) { delete (CallResult*)r; }
+
+// --- bench -----------------------------------------------------------------
+
+int trpc_run_echo_bench(const char* ip, int port, int nconn, int concurrency,
+                        int payload_size, int attach_size, double seconds,
+                        double out[9]) {
+  BenchResult br;
+  int rc = run_echo_bench(ip, port, nconn, concurrency, payload_size,
+                          attach_size, seconds, &br);
+  out[0] = br.qps;
+  out[1] = br.p50_us;
+  out[2] = br.p90_us;
+  out[3] = br.p99_us;
+  out[4] = br.p999_us;
+  out[5] = br.max_us;
+  out[6] = (double)br.calls;
+  out[7] = (double)br.errors;
+  out[8] = br.gbps;
+  return rc;
+}
 
 }  // extern "C"

@@ -209,7 +209,10 @@ std::string IOBuf::to_string() const {
 // malloc/free round-trip per short read.
 static thread_local IOBlock* g_tls_spare = nullptr;
 
-ssize_t IOBuf::append_from_fd(int fd, size_t max) {
+ssize_t IOBuf::append_from_fd(int fd, size_t max, bool* eof) {
+  if (eof != nullptr) {
+    *eof = false;
+  }
   size_t total = 0;
   while (total < max) {
     IOBlock* tail = tls_acquire_block();
@@ -234,7 +237,10 @@ ssize_t IOBuf::append_from_fd(int fd, size_t max) {
     }
     if (n == 0) {
       g_tls_spare = extra;
-      return (ssize_t)total;  // EOF; caller distinguishes via total==0
+      if (eof != nullptr) {
+        *eof = true;
+      }
+      return (ssize_t)total;
     }
     size_t left = (size_t)n;
     uint32_t into_tail =

@@ -92,9 +92,9 @@ class IOBuf {
   std::string to_string() const;
 
   // Read from fd until EAGAIN or max bytes; appends to this buffer.
-  // Returns total read, 0 on EOF, -1 on error (errno set).  On EAGAIN with
-  // some data already read, returns that count.
-  ssize_t append_from_fd(int fd, size_t max = (size_t)-1);
+  // Returns total read or -1 on error (errno set).  *eof is set when the
+  // peer closed (readv returned 0).
+  ssize_t append_from_fd(int fd, size_t max = (size_t)-1, bool* eof = nullptr);
   // writev the first refs to fd; pops what was written.  Returns bytes
   // written or -1 (errno set).
   ssize_t cut_into_fd(int fd, size_t max = (size_t)-1);

@@ -1,0 +1,942 @@
+#include "rpc.h"
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "object_pool.h"
+#include "timer_thread.h"
+
+namespace trpc {
+
+// ---------------------------------------------------------------------------
+// TLV meta encode/decode
+
+namespace {
+
+constexpr uint32_t kMaxBodySize = 512u * 1024 * 1024;  // ≙ FLAGS_max_body_size
+
+void put_u32le(std::string* s, uint32_t v) {
+  s->append((const char*)&v, 4);
+}
+void put_u64le(std::string* s, uint64_t v) {
+  s->append((const char*)&v, 8);
+}
+void put_tlv(std::string* s, uint8_t tag, const void* data, uint32_t len) {
+  s->push_back((char)tag);
+  put_u32le(s, len);
+  s->append((const char*)data, len);
+}
+void put_tlv_u64(std::string* s, uint8_t tag, uint64_t v) {
+  put_tlv(s, tag, &v, 8);
+}
+void put_tlv_u32(std::string* s, uint8_t tag, uint32_t v) {
+  put_tlv(s, tag, &v, 4);
+}
+void put_tlv_u8(std::string* s, uint8_t tag, uint8_t v) {
+  put_tlv(s, tag, &v, 1);
+}
+
+std::string EncodeMeta(const RpcMeta& m) {
+  std::string s;
+  s.reserve(64 + m.method.size() + m.error_text.size());
+  if (!m.method.empty()) {
+    put_tlv(&s, 1, m.method.data(), (uint32_t)m.method.size());
+  }
+  put_tlv_u64(&s, 2, m.correlation_id);
+  if (m.error_code != 0) {
+    put_tlv_u32(&s, 3, (uint32_t)m.error_code);
+  }
+  if (!m.error_text.empty()) {
+    put_tlv(&s, 4, m.error_text.data(), (uint32_t)m.error_text.size());
+  }
+  if (m.attachment_size != 0) {
+    put_tlv_u32(&s, 5, m.attachment_size);
+  }
+  if (m.compress_type != 0) {
+    put_tlv_u8(&s, 6, m.compress_type);
+  }
+  if (m.trace_id != 0) {
+    put_tlv_u64(&s, 7, m.trace_id);
+  }
+  if (m.span_id != 0) {
+    put_tlv_u64(&s, 8, m.span_id);
+  }
+  if (m.flags != 0) {
+    put_tlv_u8(&s, 9, m.flags);
+  }
+  if (m.stream_id != 0) {
+    put_tlv_u64(&s, 10, m.stream_id);
+  }
+  if (m.stream_frame_type != 0) {
+    put_tlv_u8(&s, 11, m.stream_frame_type);
+  }
+  if (m.feedback_bytes != 0) {
+    put_tlv_u64(&s, 12, m.feedback_bytes);
+  }
+  return s;
+}
+
+bool DecodeMeta(const char* p, size_t n, RpcMeta* m) {
+  size_t i = 0;
+  while (i + 5 <= n) {
+    uint8_t tag = (uint8_t)p[i];
+    uint32_t len;
+    memcpy(&len, p + i + 1, 4);
+    i += 5;
+    if (i + len > n) {
+      return false;
+    }
+    const char* v = p + i;
+    switch (tag) {
+      case 1: m->method.assign(v, len); break;
+      case 2: if (len == 8) memcpy(&m->correlation_id, v, 8); break;
+      case 3: if (len == 4) memcpy(&m->error_code, v, 4); break;
+      case 4: m->error_text.assign(v, len); break;
+      case 5: if (len == 4) memcpy(&m->attachment_size, v, 4); break;
+      case 6: if (len == 1) m->compress_type = (uint8_t)v[0]; break;
+      case 7: if (len == 8) memcpy(&m->trace_id, v, 8); break;
+      case 8: if (len == 8) memcpy(&m->span_id, v, 8); break;
+      case 9: if (len == 1) m->flags = (uint8_t)v[0]; break;
+      case 10: if (len == 8) memcpy(&m->stream_id, v, 8); break;
+      case 11: if (len == 1) m->stream_frame_type = (uint8_t)v[0]; break;
+      case 12: if (len == 8) memcpy(&m->feedback_bytes, v, 8); break;
+      default: break;  // forward compatibility: skip unknown tags
+    }
+    i += len;
+  }
+  return i == n;
+}
+
+}  // namespace
+
+void PackFrame(IOBuf* out, const RpcMeta& meta, IOBuf&& payload,
+               IOBuf&& attachment) {
+  RpcMeta m2 = meta;
+  m2.attachment_size = (uint32_t)attachment.size();
+  std::string ms = EncodeMeta(m2);
+  uint32_t body = (uint32_t)(payload.size() + attachment.size());
+  char hdr[12];
+  memcpy(hdr, "TRPC", 4);
+  uint32_t mbe = htonl((uint32_t)ms.size());
+  uint32_t bbe = htonl(body);
+  memcpy(hdr + 4, &mbe, 4);
+  memcpy(hdr + 8, &bbe, 4);
+  out->append(hdr, 12);
+  out->append(ms.data(), ms.size());
+  out->append(std::move(payload));
+  out->append(std::move(attachment));
+}
+
+int ParseFrame(IOBuf* buf, RpcMeta* meta, IOBuf* payload, IOBuf* attachment) {
+  if (buf->size() < 12) {
+    return 0;
+  }
+  char hdr[12];
+  buf->copy_to(hdr, 12);
+  if (memcmp(hdr, "TRPC", 4) != 0) {
+    return -1;
+  }
+  uint32_t meta_size, body_size;
+  memcpy(&meta_size, hdr + 4, 4);
+  memcpy(&body_size, hdr + 8, 4);
+  meta_size = ntohl(meta_size);
+  body_size = ntohl(body_size);
+  if (meta_size > kMaxBodySize || body_size > kMaxBodySize) {
+    return -1;
+  }
+  size_t total = 12 + (size_t)meta_size + body_size;
+  if (buf->size() < total) {
+    return 0;
+  }
+  buf->pop_front(12);
+  std::string ms;
+  ms.resize(meta_size);
+  buf->copy_to(&ms[0], meta_size);
+  buf->pop_front(meta_size);
+  if (!DecodeMeta(ms.data(), ms.size(), meta)) {
+    return -1;
+  }
+  if (meta->attachment_size > body_size) {
+    return -1;
+  }
+  uint32_t payload_size = body_size - meta->attachment_size;
+  buf->cutn(payload, payload_size);
+  buf->cutn(attachment, meta->attachment_size);
+  return 1;
+}
+
+// ---------------------------------------------------------------------------
+// Usercode pthread pool (Python handlers run here, never on fiber stacks)
+
+namespace {
+
+struct CallCtx {
+  SocketId sock = INVALID_SOCKET_ID;
+  uint64_t correlation_id = 0;
+  std::string method;
+  std::string payload;
+  std::string attachment;
+  HandlerCb cb = nullptr;
+  void* user = nullptr;
+  uint32_t slot = 0;
+  std::atomic<uint32_t> version{1};
+
+  uint64_t token() const {
+    return ((uint64_t)version.load(std::memory_order_relaxed) << 32) | slot;
+  }
+};
+
+class UsercodePool {
+ public:
+  static UsercodePool& Instance() {
+    static UsercodePool* p = new UsercodePool();  // leaked on purpose
+    return *p;
+  }
+
+  void Submit(CallCtx* ctx) {
+    EnsureStarted();
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      q_.push_back(ctx);
+    }
+    cv_.notify_one();
+  }
+
+ private:
+  void EnsureStarted() {
+    bool expected = false;
+    if (!started_.compare_exchange_strong(expected, true)) {
+      return;
+    }
+    int n = 4;
+    for (int i = 0; i < n; ++i) {
+      std::thread t([this] {
+        pthread_setname_np(pthread_self(), "trpc_usercode");
+        Run();
+      });
+      t.detach();
+    }
+  }
+
+  void Run() {
+    std::unique_lock<std::mutex> lk(mu_);
+    while (true) {
+      cv_.wait(lk, [this] { return !q_.empty(); });
+      CallCtx* ctx = q_.front();
+      q_.pop_front();
+      lk.unlock();
+      ctx->cb(ctx->token(), ctx->method.c_str(),
+              (const uint8_t*)ctx->payload.data(), ctx->payload.size(),
+              (const uint8_t*)ctx->attachment.data(), ctx->attachment.size(),
+              ctx->user);
+      lk.lock();
+    }
+  }
+
+  std::atomic<bool> started_{false};
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<CallCtx*> q_;
+};
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// Server
+
+struct ServiceHandler {
+  int kind = 0;  // 0 native echo, 1 usercode callback
+  HandlerCb cb = nullptr;
+  void* user = nullptr;
+};
+
+class Server {
+ public:
+  std::unordered_map<std::string, ServiceHandler> services;
+  int listen_fd = -1;
+  SocketId listen_sock = INVALID_SOCKET_ID;
+  int port = 0;
+  std::atomic<bool> running{false};
+  std::atomic<uint64_t> nrequests{0};
+  // live accepted connections (for Stop to fail them and destroy to drain;
+  // ≙ the reference Server keeping its connection list via SocketMap)
+  std::mutex conns_mu;
+  std::unordered_map<SocketId, bool> conns;
+};
+
+namespace {
+
+void SendResponse(SocketId sock_id, uint64_t correlation_id,
+                  int32_t error_code, const char* error_text, IOBuf&& payload,
+                  IOBuf&& attachment) {
+  Socket* s = Socket::Address(sock_id);
+  if (s == nullptr) {
+    return;
+  }
+  RpcMeta meta;
+  meta.correlation_id = correlation_id;
+  meta.error_code = error_code;
+  if (error_text != nullptr) {
+    meta.error_text = error_text;
+  }
+  meta.flags = 1;  // response
+  IOBuf frame;
+  PackFrame(&frame, meta, std::move(payload), std::move(attachment));
+  s->Write(std::move(frame));
+  s->Dereference();
+}
+
+// edge_fn of server-side connection sockets: read + parse + dispatch
+// (≙ InputMessenger::OnNewMessages + ProcessRpcRequest).
+void ServerOnMessages(Socket* s) {
+  Server* srv = (Server*)s->user;
+  bool eof = false;
+  ssize_t n = s->ReadToBuf(&eof);
+  if (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
+    s->SetFailed(errno);
+    return;
+  }
+  while (true) {
+    RpcMeta meta;
+    IOBuf payload, attachment;
+    int rc = ParseFrame(&s->read_buf, &meta, &payload, &attachment);
+    if (rc == 0) {
+      break;
+    }
+    if (rc < 0) {
+      s->SetFailed(TRPC_EREQUEST);
+      return;
+    }
+    if (!srv->running.load(std::memory_order_acquire)) {
+      // stopping: refuse new requests (≙ ESTOP after Server::Stop)
+      SendResponse(s->id(), meta.correlation_id, TRPC_ESTOP,
+                   "server is stopping", IOBuf(), IOBuf());
+      continue;
+    }
+    srv->nrequests.fetch_add(1, std::memory_order_relaxed);
+    auto it = srv->services.find(meta.method);
+    if (it == srv->services.end()) {
+      // service-level fallback: "Service.Method" -> "Service"
+      size_t dot = meta.method.find('.');
+      if (dot != std::string::npos) {
+        it = srv->services.find(meta.method.substr(0, dot));
+      }
+    }
+    if (it == srv->services.end()) {
+      SendResponse(s->id(), meta.correlation_id, TRPC_ENOMETHOD,
+                   "no such method", IOBuf(), IOBuf());
+      continue;
+    }
+    const ServiceHandler& h = it->second;
+    if (h.kind == 0) {
+      // native echo: respond inline on this fiber (hot path)
+      SendResponse(s->id(), meta.correlation_id, 0, nullptr,
+                   std::move(payload), std::move(attachment));
+    } else {
+      CallCtx* ctx = nullptr;
+      uint32_t slot = ResourcePool<CallCtx>::Get(&ctx);
+      ctx->slot = slot;
+      ctx->sock = s->id();
+      ctx->correlation_id = meta.correlation_id;
+      ctx->method = std::move(meta.method);
+      ctx->payload = payload.to_string();
+      ctx->attachment = attachment.to_string();
+      ctx->cb = h.cb;
+      ctx->user = h.user;
+      UsercodePool::Instance().Submit(ctx);
+    }
+  }
+  if (eof) {
+    s->SetFailed(ECONNRESET);
+  }
+}
+
+void ServerConnFailed(Socket* s) {
+  Server* srv = (Server*)s->user;
+  std::lock_guard<std::mutex> lk(srv->conns_mu);
+  srv->conns.erase(s->id());
+}
+
+// edge_fn of the acceptor socket (≙ Acceptor::OnNewConnections,
+// acceptor.cpp:253): accept until EAGAIN, one connection Socket each.
+void OnNewConnections(Socket* listen_s) {
+  while (true) {
+    int fd = accept4(listen_s->fd, nullptr, nullptr,
+                     SOCK_NONBLOCK | SOCK_CLOEXEC);
+    if (fd < 0) {
+      return;  // EAGAIN or error: either way, wait for the next edge
+    }
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    SocketOptions opts;
+    opts.fd = fd;
+    opts.edge_fn = ServerOnMessages;
+    opts.user = listen_s->user;  // Server*
+    opts.on_failed = ServerConnFailed;
+    SocketId id;
+    if (Socket::Create(opts, &id) != 0) {
+      ::close(fd);
+      continue;
+    }
+    Server* srv = (Server*)listen_s->user;
+    {
+      std::lock_guard<std::mutex> lk(srv->conns_mu);
+      srv->conns[id] = true;
+    }
+    EventDispatcher::Instance().AddConsumer(id, fd);
+  }
+}
+
+}  // namespace
+
+Server* server_create() { return new Server(); }
+
+int server_add_service(Server* s, const char* name, int kind, HandlerCb cb,
+                       void* user) {
+  if (s->running.load()) {
+    return -EBUSY;
+  }
+  ServiceHandler h;
+  h.kind = kind;
+  h.cb = cb;
+  h.user = user;
+  s->services[name] = h;
+  return 0;
+}
+
+int server_start(Server* s, const char* ip, int port) {
+  fiber_runtime_init(0);
+  int fd = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
+  if (fd < 0) {
+    return -errno;
+  }
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  addr.sin_addr.s_addr = (ip == nullptr || ip[0] == '\0')
+                             ? htonl(INADDR_ANY)
+                             : inet_addr(ip);
+  if (bind(fd, (sockaddr*)&addr, sizeof(addr)) != 0 || listen(fd, 1024) != 0) {
+    int e = errno;
+    ::close(fd);
+    return -e;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(fd, (sockaddr*)&addr, &alen);
+  s->port = ntohs(addr.sin_port);
+  s->listen_fd = fd;
+  SocketOptions opts;
+  opts.fd = fd;
+  opts.edge_fn = OnNewConnections;
+  opts.user = s;
+  if (Socket::Create(opts, &s->listen_sock) != 0) {
+    ::close(fd);
+    return -ENOMEM;
+  }
+  EventDispatcher::Instance().AddConsumer(s->listen_sock, fd);
+  s->running.store(true);
+  return 0;
+}
+
+int server_port(Server* s) { return s->port; }
+
+int server_stop(Server* s) {
+  if (!s->running.exchange(false)) {
+    return 0;
+  }
+  Socket* ls = Socket::Address(s->listen_sock);
+  if (ls != nullptr) {
+    ls->SetFailed(TRPC_ESTOP);
+    ls->Dereference();
+  }
+  s->listen_fd = -1;
+  return 0;
+}
+
+void server_destroy(Server* s) {
+  server_stop(s);
+  // fail live connections and wait for their fibers to drain (they hold
+  // Server* through socket->user)
+  std::vector<SocketId> conns;
+  {
+    std::lock_guard<std::mutex> lk(s->conns_mu);
+    for (auto& kv : s->conns) {
+      conns.push_back(kv.first);
+    }
+  }
+  for (SocketId id : conns) {
+    Socket* cs = Socket::Address(id);
+    if (cs != nullptr) {
+      cs->SetFailed(TRPC_ESTOP);
+      cs->Dereference();
+    }
+  }
+  for (SocketId id : conns) {
+    while (true) {
+      Socket* cs = Socket::Address(id);
+      if (cs == nullptr) {
+        break;
+      }
+      cs->Dereference();
+      usleep(1000);
+    }
+  }
+  while (true) {
+    Socket* ls = Socket::Address(s->listen_sock);
+    if (ls == nullptr) {
+      break;
+    }
+    ls->Dereference();
+    usleep(1000);
+  }
+  delete s;
+}
+
+uint64_t server_requests(Server* s) {
+  return s->nrequests.load(std::memory_order_relaxed);
+}
+
+int respond(uint64_t token, int32_t error_code, const char* error_text,
+            const uint8_t* data, size_t len, const uint8_t* attach,
+            size_t attach_len) {
+  uint32_t slot = (uint32_t)token;
+  uint32_t ver = (uint32_t)(token >> 32);
+  CallCtx* ctx = ResourcePool<CallCtx>::Address(slot);
+  if (ctx == nullptr ||
+      ctx->version.load(std::memory_order_acquire) != ver) {
+    return -EINVAL;
+  }
+  IOBuf payload, attachment;
+  if (data != nullptr && len > 0) {
+    payload.append(data, len);
+  }
+  if (attach != nullptr && attach_len > 0) {
+    attachment.append(attach, attach_len);
+  }
+  SendResponse(ctx->sock, ctx->correlation_id, error_code, error_text,
+               std::move(payload), std::move(attachment));
+  ctx->version.fetch_add(1, std::memory_order_release);  // invalidate token
+  ctx->payload.clear();
+  ctx->attachment.clear();
+  ResourcePool<CallCtx>::Return(slot);
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Channel (client)
+
+namespace {
+
+struct PendingCall {
+  Butex* done = nullptr;  // value flips 0 -> 1 on completion
+  int32_t error_code = 0;
+  std::string error_text;
+  IOBuf response;
+  IOBuf attachment;
+};
+
+}  // namespace
+
+class Channel {
+ public:
+  std::string ip;
+  int port = 0;
+  std::atomic<uint64_t> next_corr{1};
+  std::mutex map_mu;
+  std::unordered_map<uint64_t, PendingCall*> pending;
+  std::mutex conn_mu;
+  SocketId sock = INVALID_SOCKET_ID;
+  bool connected = false;
+};
+
+namespace {
+
+// Fail every pending call of this channel (connection broke).
+void ChannelOnSocketFailed(Socket* s) {
+  Channel* c = (Channel*)s->user;
+  std::vector<std::pair<uint64_t, PendingCall*>> all;
+  {
+    std::lock_guard<std::mutex> lk(c->map_mu);
+    for (auto& kv : c->pending) {
+      all.push_back(kv);
+    }
+    c->pending.clear();
+  }
+  {
+    std::lock_guard<std::mutex> lk(c->conn_mu);
+    if (c->sock == s->id()) {
+      c->connected = false;
+    }
+  }
+  for (auto& kv : all) {
+    PendingCall* pc = kv.second;
+    pc->error_code = TRPC_EFAILEDSOCKET;
+    pc->error_text = "connection failed";
+    butex_value(pc->done).store(1, std::memory_order_release);
+    butex_wake_all(pc->done);
+  }
+}
+
+// edge_fn of client-side sockets: parse responses, wake callers
+// (≙ ProcessRpcResponse + bthread_id unlock/destroy).
+void ChannelOnMessages(Socket* s) {
+  Channel* c = (Channel*)s->user;
+  bool eof = false;
+  ssize_t n = s->ReadToBuf(&eof);
+  if (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
+    s->SetFailed(errno);
+    return;
+  }
+  while (true) {
+    RpcMeta meta;
+    IOBuf payload, attachment;
+    int rc = ParseFrame(&s->read_buf, &meta, &payload, &attachment);
+    if (rc == 0) {
+      break;
+    }
+    if (rc < 0) {
+      s->SetFailed(TRPC_EREQUEST);
+      return;
+    }
+    PendingCall* pc = nullptr;
+    {
+      std::lock_guard<std::mutex> lk(c->map_mu);
+      auto it = c->pending.find(meta.correlation_id);
+      if (it != c->pending.end()) {
+        pc = it->second;
+        c->pending.erase(it);
+      }
+    }
+    if (pc == nullptr) {
+      continue;  // late response after timeout: drop (≙ EREFUSED path)
+    }
+    pc->error_code = meta.error_code;
+    pc->error_text = std::move(meta.error_text);
+    pc->response = std::move(payload);
+    pc->attachment = std::move(attachment);
+    butex_value(pc->done).store(1, std::memory_order_release);
+    butex_wake_all(pc->done);
+  }
+  if (eof) {
+    s->SetFailed(ECONNRESET);
+  }
+}
+
+int EnsureConnected(Channel* c, SocketId* out) {
+  std::lock_guard<std::mutex> lk(c->conn_mu);
+  if (c->connected) {
+    Socket* s = Socket::Address(c->sock);
+    if (s != nullptr && !s->failed.load(std::memory_order_acquire)) {
+      s->Dereference();
+      *out = c->sock;
+      return 0;
+    }
+    if (s != nullptr) {
+      s->Dereference();
+    }
+    c->connected = false;
+  }
+  int fd = ::socket(AF_INET, SOCK_STREAM | SOCK_CLOEXEC, 0);
+  if (fd < 0) {
+    return -errno;
+  }
+  sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)c->port);
+  addr.sin_addr.s_addr = inet_addr(c->ip.c_str());
+  if (connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+    int e = errno;
+    ::close(fd);
+    return -e;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  // non-blocking after connect: reads/writes go through the dispatcher
+  int fl = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+  SocketOptions opts;
+  opts.fd = fd;
+  opts.edge_fn = ChannelOnMessages;
+  opts.user = c;
+  opts.on_failed = ChannelOnSocketFailed;
+  if (Socket::Create(opts, &c->sock) != 0) {
+    ::close(fd);
+    return -ENOMEM;
+  }
+  EventDispatcher::Instance().AddConsumer(c->sock, fd);
+  c->connected = true;
+  *out = c->sock;
+  return 0;
+}
+
+}  // namespace
+
+Channel* channel_create(const char* ip, int port) {
+  fiber_runtime_init(0);
+  Channel* c = new Channel();
+  c->ip = ip;
+  c->port = port;
+  return c;
+}
+
+void channel_destroy(Channel* c) {
+  SocketId sid = INVALID_SOCKET_ID;
+  {
+    std::lock_guard<std::mutex> lk(c->conn_mu);
+    if (c->connected) {
+      sid = c->sock;
+      c->connected = false;
+    }
+  }
+  // SetFailed outside conn_mu: its on_failed callback re-locks conn_mu
+  if (sid != INVALID_SOCKET_ID) {
+    Socket* s = Socket::Address(sid);
+    if (s != nullptr) {
+      s->SetFailed(TRPC_ESTOP);
+      s->Dereference();
+    }
+    // wait out in-flight dispatcher fibers that still reference this
+    // channel through the socket (Address succeeds until full recycle)
+    while (true) {
+      Socket* alive = Socket::Address(sid);
+      if (alive == nullptr) {
+        break;
+      }
+      alive->Dereference();
+      usleep(1000);
+    }
+  }
+  delete c;
+}
+
+int channel_call(Channel* c, const char* method, const uint8_t* req,
+                 size_t req_len, const uint8_t* attach, size_t attach_len,
+                 int64_t timeout_us, CallResult* out) {
+  SocketId sid;
+  int rc = EnsureConnected(c, &sid);
+  if (rc != 0) {
+    if (out != nullptr) {
+      out->error_code = TRPC_EFAILEDSOCKET;
+      out->error_text = "connect failed";
+    }
+    return TRPC_EFAILEDSOCKET;
+  }
+  Socket* s = Socket::Address(sid);
+  if (s == nullptr) {
+    return TRPC_EFAILEDSOCKET;
+  }
+  uint64_t corr = c->next_corr.fetch_add(1, std::memory_order_relaxed);
+  PendingCall* pc = ObjectPool<PendingCall>::Get();
+  if (pc->done == nullptr) {
+    pc->done = butex_create();
+  }
+  butex_value(pc->done).store(0, std::memory_order_release);
+  pc->error_code = 0;
+  pc->error_text.clear();
+  pc->response.clear();
+  pc->attachment.clear();
+  {
+    std::lock_guard<std::mutex> lk(c->map_mu);
+    c->pending[corr] = pc;
+  }
+  RpcMeta meta;
+  meta.method = method;
+  meta.correlation_id = corr;
+  IOBuf payload, attachment, frame;
+  if (req != nullptr && req_len > 0) {
+    payload.append(req, req_len);
+  }
+  if (attach != nullptr && attach_len > 0) {
+    attachment.append(attach, attach_len);
+  }
+  PackFrame(&frame, meta, std::move(payload), std::move(attachment));
+  rc = s->Write(std::move(frame));
+  s->Dereference();
+  int result;
+  if (rc != 0) {
+    bool still_pending;
+    {
+      std::lock_guard<std::mutex> lk(c->map_mu);
+      still_pending = c->pending.erase(corr) > 0;
+    }
+    if (still_pending) {
+      pc->error_code = TRPC_EFAILEDSOCKET;
+      pc->error_text = "write failed";
+    } else {
+      // ChannelOnSocketFailed already swept the map and may still be
+      // filling pc: wait for its completion flip before touching pc
+      while (butex_value(pc->done).load(std::memory_order_acquire) == 0) {
+        butex_wait(pc->done, 0, 1000);
+      }
+    }
+    result = pc->error_code;
+  } else {
+    // wait for completion or deadline (≙ Controller::IssueRPC + Join)
+    while (butex_value(pc->done).load(std::memory_order_acquire) == 0) {
+      if (butex_wait(pc->done, 0, timeout_us > 0 ? timeout_us : -1) != 0 &&
+          errno == ETIMEDOUT) {
+        bool still_pending;
+        {
+          std::lock_guard<std::mutex> lk(c->map_mu);
+          still_pending = c->pending.erase(corr) > 0;
+        }
+        if (still_pending) {
+          pc->error_code = TRPC_ERPCTIMEDOUT;
+          pc->error_text = "rpc timeout";
+          break;
+        }
+        // response raced the timeout: it is being delivered; wait for it
+        while (butex_value(pc->done).load(std::memory_order_acquire) == 0) {
+          butex_wait(pc->done, 0, 1000);
+        }
+        break;
+      }
+    }
+    result = pc->error_code;
+  }
+  if (out != nullptr) {
+    out->error_code = pc->error_code;
+    out->error_text = pc->error_text;
+    out->response = pc->response.to_string();
+    out->attachment = pc->attachment.to_string();
+  }
+  pc->response.clear();
+  pc->attachment.clear();
+  ObjectPool<PendingCall>::Return(pc);
+  return result;
+}
+
+// ---------------------------------------------------------------------------
+// In-process echo bench: all hot-path work on fibers, zero Python involved.
+
+namespace {
+
+struct BenchShared {
+  Channel** channels;
+  int nconn;
+  std::string payload;
+  std::string attach;
+  std::atomic<bool> stop{false};
+  std::atomic<uint64_t> calls{0};
+  std::atomic<uint64_t> errors{0};
+  std::mutex lat_mu;
+  std::vector<int64_t> latencies;  // merged on worker exit (sampled)
+};
+
+struct BenchWorkerArg {
+  BenchShared* sh;
+  int idx;
+};
+
+void BenchWorker(void* p) {
+  BenchWorkerArg* a = (BenchWorkerArg*)p;
+  BenchShared* sh = a->sh;
+  Channel* ch = sh->channels[a->idx % sh->nconn];
+  std::vector<int64_t> lat;
+  lat.reserve(1 << 16);
+  CallResult res;
+  while (!sh->stop.load(std::memory_order_acquire)) {
+    int64_t t0 = monotonic_ns();
+    int rc = channel_call(ch, "Echo.echo", (const uint8_t*)sh->payload.data(),
+                          sh->payload.size(),
+                          sh->attach.empty() ? nullptr
+                                             : (const uint8_t*)sh->attach.data(),
+                          sh->attach.size(), 5 * 1000 * 1000, &res);
+    int64_t dt = (monotonic_ns() - t0) / 1000;
+    if (rc == 0) {
+      sh->calls.fetch_add(1, std::memory_order_relaxed);
+      if (lat.size() < (1u << 20)) {
+        lat.push_back(dt);
+      }
+    } else {
+      sh->errors.fetch_add(1, std::memory_order_relaxed);
+    }
+  }
+  {
+    std::lock_guard<std::mutex> lk(sh->lat_mu);
+    sh->latencies.insert(sh->latencies.end(), lat.begin(), lat.end());
+  }
+  delete a;
+  // completion is observed via fiber_join: no shared state is touched
+  // after this point, so run_echo_bench can safely free BenchShared
+}
+
+}  // namespace
+
+int run_echo_bench(const char* ip, int port, int nconn, int concurrency,
+                   int payload_size, int attach_size, double seconds,
+                   BenchResult* out) {
+  fiber_runtime_init(0);
+  BenchShared sh;
+  sh.nconn = nconn;
+  std::vector<Channel*> chans(nconn);
+  for (int i = 0; i < nconn; ++i) {
+    chans[i] = channel_create(ip, port);
+  }
+  sh.channels = chans.data();
+  sh.payload.assign((size_t)payload_size, 'x');
+  sh.attach.assign((size_t)attach_size, 'a');
+
+  int64_t t0 = monotonic_ns();
+  std::vector<fiber_t> fids(concurrency);
+  for (int i = 0; i < concurrency; ++i) {
+    BenchWorkerArg* a = new BenchWorkerArg{&sh, i};
+    fiber_start(&fids[i], BenchWorker, a);
+  }
+  // run for the requested duration
+  int64_t deadline = t0 + (int64_t)(seconds * 1e9);
+  while (monotonic_ns() < deadline) {
+    usleep(10 * 1000);
+  }
+  sh.stop.store(true, std::memory_order_release);
+  for (fiber_t f : fids) {
+    fiber_join(f);  // workers fully exited: BenchShared safe to free
+  }
+  int64_t wall_ns = monotonic_ns() - t0;
+
+  for (int i = 0; i < nconn; ++i) {
+    channel_destroy(chans[i]);
+  }
+  uint64_t calls = sh.calls.load();
+  out->calls = calls;
+  out->errors = sh.errors.load();
+  out->qps = calls / (wall_ns / 1e9);
+  std::vector<int64_t>& lat = sh.latencies;
+  if (!lat.empty()) {
+    std::sort(lat.begin(), lat.end());
+    auto pct = [&](double p) {
+      size_t i = (size_t)(p * lat.size());
+      if (i >= lat.size()) i = lat.size() - 1;
+      return (double)lat[i];
+    };
+    out->p50_us = pct(0.50);
+    out->p90_us = pct(0.90);
+    out->p99_us = pct(0.99);
+    out->p999_us = pct(0.999);
+    out->max_us = (double)lat.back();
+  }
+  out->gbps = (double)calls * (payload_size + attach_size) * 2 /
+              (wall_ns / 1e9) / 1e9;
+  return 0;
+}
+
+}  // namespace trpc

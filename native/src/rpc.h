@@ -1,0 +1,123 @@
+// rpc.h — the TRPC binary protocol + native Server/Channel cores
+// (capability of the reference baidu_std protocol + Server + Channel:
+// policy/baidu_rpc_protocol.cpp, server.cpp, channel.cpp — re-designed, not
+// ported: the meta is a compact TLV instead of protobuf so the native core
+// has zero deps; correlation ids map to butex-woken pending calls the way
+// the reference maps them to bthread_id versions).
+//
+// Wire frame (≙ the 12-byte "PRPC" header, baidu_rpc_protocol.cpp:95):
+//   0..3   magic "TRPC"
+//   4..7   meta_size  (big-endian u32)
+//   8..11  body_size  (big-endian u32; body = payload + attachment,
+//                      excludes meta)
+// followed by meta TLVs then payload then attachment.
+//
+// Meta TLV: u8 tag, u32 length (LE), value.  Tags:
+//   1 method (bytes "Service.Method")   2 correlation_id (u64 LE)
+//   3 error_code (i32 LE)               4 error_text (bytes)
+//   5 attachment_size (u32 LE)          6 compress_type (u8)
+//   7 trace_id (u64 LE)                 8 span_id (u64 LE)
+//   9 flags (u8: bit0 = response)      10 stream_id (u64 LE)
+//  11 stream_frame_type (u8)           12 feedback_bytes (u64 LE)
+#pragma once
+
+#include <cstdint>
+
+#include "iobuf.h"
+#include "socket.h"
+
+namespace trpc {
+
+struct RpcMeta {
+  std::string method;
+  uint64_t correlation_id = 0;
+  int32_t error_code = 0;
+  std::string error_text;
+  uint32_t attachment_size = 0;
+  uint8_t compress_type = 0;
+  uint64_t trace_id = 0;
+  uint64_t span_id = 0;
+  uint8_t flags = 0;  // bit0: response
+  uint64_t stream_id = 0;
+  uint8_t stream_frame_type = 0;  // 0 none, 1 data, 2 close, 3 feedback
+  uint64_t feedback_bytes = 0;
+
+  bool is_response() const { return flags & 1; }
+};
+
+// Serialize header+meta+payload+attachment into out (payload/attachment
+// are moved, zero-copy).
+void PackFrame(IOBuf* out, const RpcMeta& meta, IOBuf&& payload,
+               IOBuf&& attachment);
+
+// Try parsing one frame from buf.  Returns:
+//   1 = parsed (meta/payload/attachment filled, frame consumed)
+//   0 = need more data
+//  -1 = protocol error
+int ParseFrame(IOBuf* buf, RpcMeta* meta, IOBuf* payload, IOBuf* attachment);
+
+// --- server ---------------------------------------------------------------
+
+// Python-side handler.  Called on a usercode pthread (≙ the reference's
+// usercode_in_pthread pool, details/usercode_backup_pool.cpp — here
+// mandatory for Python so the GIL and deep Python stacks never touch
+// worker fibers).  Responder must eventually call trpc_respond(token,...).
+typedef void (*HandlerCb)(uint64_t token, const char* method,
+                          const uint8_t* req, size_t req_len,
+                          const uint8_t* attach, size_t attach_len,
+                          void* user);
+
+class Server;
+
+Server* server_create();
+// kind: 0 = native echo (responds inline on the worker fiber);
+//       1 = callback on usercode pthread pool
+int server_add_service(Server* s, const char* name, int kind, HandlerCb cb,
+                       void* user);
+int server_start(Server* s, const char* ip, int port);
+int server_port(Server* s);
+int server_stop(Server* s);
+// Stop + fail live connections + drain + free.  The Server* is invalid
+// afterwards.
+void server_destroy(Server* s);
+// per-server counters
+uint64_t server_requests(Server* s);
+
+// Respond to a pending call token (thread-safe, any thread).
+int respond(uint64_t token, int32_t error_code, const char* error_text,
+            const uint8_t* data, size_t len, const uint8_t* attach,
+            size_t attach_len);
+
+// --- client ---------------------------------------------------------------
+
+class Channel;
+
+Channel* channel_create(const char* ip, int port);
+void channel_destroy(Channel* c);
+
+struct CallResult {
+  int32_t error_code = 0;
+  std::string error_text;
+  std::string response;
+  std::string attachment;
+};
+
+// Synchronous call (from fiber or pthread).  Returns 0 or error code.
+int channel_call(Channel* c, const char* method, const uint8_t* req,
+                 size_t req_len, const uint8_t* attach, size_t attach_len,
+                 int64_t timeout_us, CallResult* out);
+
+// --- in-process echo bench (hot path stays fully native) -------------------
+
+struct BenchResult {
+  double qps = 0;
+  double p50_us = 0, p90_us = 0, p99_us = 0, p999_us = 0, max_us = 0;
+  uint64_t calls = 0, errors = 0;
+  double gbps = 0;  // payload bytes * 2 (echo) / wall time
+};
+
+int run_echo_bench(const char* ip, int port, int nconn, int concurrency,
+                   int payload_size, int attach_size, double seconds,
+                   BenchResult* out);
+
+}  // namespace trpc

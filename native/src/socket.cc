@@ -1,0 +1,435 @@
+#include "socket.h"
+
+#include <errno.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <thread>
+#include <vector>
+
+#include "object_pool.h"
+
+namespace trpc {
+
+namespace {
+// Sentinel: a freshly-exchanged request whose producer has not linked its
+// next pointer yet (≙ the reference's UNCONNECTED marker in StartWrite).
+WriteRequest* const UNCONNECTED = (WriteRequest*)(intptr_t)-1;
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// lifetime: versioned refcount
+
+// Version stepping (≙ the reference's versioned_ref discipline,
+// socket.h:808 / socket.cpp SetFailed): live versions are EVEN; SetFailed
+// bumps to ODD (no new Address can succeed, existing refs drain); Recycle
+// CASes odd -> next even.  This makes stale Address / concurrent teardown
+// race-free: only the actor that transitions odd->even recycles.
+
+int Socket::Create(const SocketOptions& opts, SocketId* id_out) {
+  Socket* s = nullptr;
+  uint32_t slot = ResourcePool<Socket>::Get(&s);
+  if (s == nullptr) {
+    return -ENOMEM;
+  }
+  s->slot = slot;
+  s->fd = opts.fd;
+  s->edge_fn = opts.edge_fn;
+  s->user = opts.user;
+  s->on_failed = opts.on_failed;
+  s->failed.store(false, std::memory_order_relaxed);
+  s->error_code = 0;
+  s->nevent.store(0, std::memory_order_relaxed);
+  s->read_buf.clear();
+  s->bytes_in.store(0, std::memory_order_relaxed);
+  s->bytes_out.store(0, std::memory_order_relaxed);
+  if (s->epollout_butex == nullptr) {
+    s->epollout_butex = butex_create();
+  }
+  // version in the slab is even (fresh slab: 0; recycled: last+2);
+  // set owner refcount to 1
+  uint64_t v = s->versioned_ref.load(std::memory_order_relaxed);
+  s->versioned_ref.store((v & 0xffffffff00000000ULL) | 1,
+                         std::memory_order_release);
+  *id_out = s->id();
+  return 0;
+}
+
+SocketId Socket::id() const {
+  // mask the failed bit so ids taken before/after SetFailed are identical
+  return ((uint64_t)(version() & ~1u) << 32) | slot;
+}
+
+Socket* Socket::Address(SocketId id) {
+  Socket* s = ResourcePool<Socket>::Address((uint32_t)id);
+  if (s == nullptr) {
+    return nullptr;
+  }
+  uint32_t idver = (uint32_t)(id >> 32);
+  uint64_t old = s->versioned_ref.fetch_add(1, std::memory_order_acq_rel);
+  uint32_t ver = (uint32_t)(old >> 32);
+  if (ver != idver) {
+    // stale id (failed or recycled): undo, and recycle iff we held the
+    // last ref of the failed-not-yet-recycled generation
+    uint64_t old2 = s->versioned_ref.fetch_sub(1, std::memory_order_acq_rel);
+    if ((uint32_t)old2 == 1 && (uint32_t)(old2 >> 32) == (idver | 1)) {
+      s->TryRecycle(idver | 1);
+    }
+    return nullptr;
+  }
+  return s;
+}
+
+void Socket::Dereference() {
+  uint64_t old = versioned_ref.fetch_sub(1, std::memory_order_acq_rel);
+  if ((uint32_t)old == 1) {
+    uint32_t ver = (uint32_t)(old >> 32);
+    if (ver & 1) {  // count hit 0 after SetFailed: recycle this generation
+      TryRecycle(ver);
+    }
+  }
+}
+
+// Only the caller that CASes (odd_ver, count 0) -> (odd_ver+1, count 0)
+// performs the recycle.  Spins out transient stale-Address increments.
+void Socket::TryRecycle(uint32_t odd_ver) {
+  uint64_t expected = ((uint64_t)odd_ver << 32);
+  while (true) {
+    if (versioned_ref.compare_exchange_weak(
+            expected, ((uint64_t)(odd_ver + 1) << 32),
+            std::memory_order_acq_rel)) {
+      break;  // we own the transition
+    }
+    if ((uint32_t)(expected >> 32) != odd_ver) {
+      return;  // someone else recycled (or a new generation started)
+    }
+    // transient ref from a stale Address in flight: retry
+    expected = ((uint64_t)odd_ver << 32);
+#if defined(__x86_64__)
+    __builtin_ia32_pause();
+#endif
+  }
+  if (fd >= 0) {
+    EventDispatcher::Instance().RemoveConsumer(fd);
+    ::close(fd);
+    fd = -1;
+  }
+  read_buf.clear();
+  ResourcePool<Socket>::Return(slot);
+}
+
+void Socket::SetFailed(int err) {
+  bool expected = false;
+  if (!failed.compare_exchange_strong(expected, true,
+                                      std::memory_order_acq_rel)) {
+    return;  // only the first failure proceeds
+  }
+  error_code = err;
+  // flip version to odd FIRST: from here no new Address can take a ref,
+  // so the count can only drain to zero once
+  versioned_ref.fetch_add(1ULL << 32, std::memory_order_acq_rel);
+  if (fd >= 0) {
+    ::shutdown(fd, SHUT_RDWR);  // wake in-flight reads/writes
+  }
+  butex_value(epollout_butex).fetch_add(1, std::memory_order_release);
+  butex_wake_all(epollout_butex);
+  if (on_failed != nullptr) {
+    on_failed(this);
+  }
+  Dereference();  // drop the owner reference from Create()
+}
+
+// ---------------------------------------------------------------------------
+// read path
+
+ssize_t Socket::ReadToBuf(bool* eof) {
+  ssize_t n = read_buf.append_from_fd(fd, (size_t)-1, eof);
+  if (n < 0) {
+    return -1;
+  }
+  bytes_in.fetch_add((uint64_t)n, std::memory_order_relaxed);
+  return n;
+}
+
+void Socket::ProcessEventFiber(void* arg) {
+  SocketId id = (SocketId)(uintptr_t)arg;
+  Socket* s = Socket::Address(id);
+  if (s == nullptr) {
+    return;
+  }
+  uint32_t seen = s->nevent.load(std::memory_order_acquire);
+  while (true) {
+    if (!s->failed.load(std::memory_order_acquire) && s->edge_fn != nullptr) {
+      s->edge_fn(s);  // reads to EAGAIN + parses, or accepts connections
+    }
+    if (s->nevent.compare_exchange_strong(seen, 0,
+                                          std::memory_order_acq_rel)) {
+      break;
+    }
+    // seen was refreshed: new events arrived while processing
+  }
+  s->Dereference();
+}
+
+void Socket::StartInputEvent(SocketId id) {
+  Socket* s = Socket::Address(id);
+  if (s == nullptr) {
+    return;
+  }
+  if (s->nevent.fetch_add(1, std::memory_order_acq_rel) == 0) {
+    // first event: spawn the processing fiber (it re-Addresses by id, so a
+    // socket recycled in between is caught by its own version check)
+    fiber_t f;
+    if (fiber_start(&f, ProcessEventFiber, (void*)(uintptr_t)id) != 0) {
+      s->nevent.store(0, std::memory_order_release);
+    }
+  }
+  s->Dereference();
+}
+
+void Socket::HandleEpollOut(SocketId id) {
+  Socket* s = Socket::Address(id);
+  if (s == nullptr) {
+    return;
+  }
+  butex_value(s->epollout_butex).fetch_add(1, std::memory_order_release);
+  butex_wake_all(s->epollout_butex);
+  s->Dereference();
+}
+
+// ---------------------------------------------------------------------------
+// wait-free write path
+
+struct KeepWriteArg {
+  SocketId id;
+  WriteRequest* req;
+};
+
+int Socket::Write(IOBuf&& data, Butex* notify) {
+  if (failed.load(std::memory_order_acquire)) {
+    return -TRPC_EFAILEDSOCKET;
+  }
+  WriteRequest* req = ObjectPool<WriteRequest>::Get();
+  req->data = std::move(data);
+  req->notify = notify;
+  req->next = UNCONNECTED;
+  WriteRequest* prev = write_head.exchange(req, std::memory_order_acq_rel);
+  if (prev != nullptr) {
+    req->next = prev;  // newest -> ... -> oldest stack linkage
+    return 0;          // the current writer will pick it up
+  }
+  req->next = nullptr;
+  // we are the writer: one inline write attempt, then hand off
+  if (!failed.load(std::memory_order_acquire)) {
+    ssize_t n = req->data.cut_into_fd(fd);
+    if (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
+      SetFailed(errno != 0 ? errno : EPIPE);
+    } else if (n > 0) {
+      bytes_out.fetch_add((uint64_t)n, std::memory_order_relaxed);
+    }
+  }
+  if (req->data.empty() && !failed.load(std::memory_order_acquire)) {
+    if (req->notify != nullptr) {
+      butex_value(req->notify).fetch_add(1, std::memory_order_release);
+      butex_wake_all(req->notify);
+    }
+    WriteRequest* expected = req;
+    if (write_head.compare_exchange_strong(expected, nullptr,
+                                           std::memory_order_acq_rel)) {
+      ObjectPool<WriteRequest>::Return(req);
+      return 0;
+    }
+  }
+  // leftover data, failure drain, or newer requests: background fiber
+  Socket* self = Address(id());  // ref held by the KeepWrite fiber
+  if (self == nullptr) {
+    // socket failed concurrently (version already odd): we still own the
+    // writer-ship, so drain inline using the caller's implicit validity
+    RunKeepWrite(req);
+    return -TRPC_EFAILEDSOCKET;
+  }
+  KeepWriteArg* kw = ObjectPool<KeepWriteArg>::Get();
+  kw->id = id();
+  kw->req = req;
+  fiber_t f;
+  if (fiber_start(&f, KeepWriteFiber, kw) != 0) {
+    ObjectPool<KeepWriteArg>::Return(kw);
+    // cannot spawn: drain inline (blocking this caller) rather than
+    // orphaning the queue — newer producers may already be chained to req
+    RunKeepWrite(req);
+    self->Dereference();
+    return 0;
+  }
+  return 0;
+}
+
+// Reverse the [current head .. anchor) segment into FIFO order and return
+// anchor's FIFO successor.  Caller must own writer-ship and `anchor`.
+WriteRequest* Socket::GrabNewer(WriteRequest* anchor) {
+  WriteRequest* head = write_head.load(std::memory_order_acquire);
+  WriteRequest* prev = nullptr;
+  WriteRequest* p = head;
+  while (p != anchor) {
+    // spin until the producer links its next pointer
+    WriteRequest* nx;
+    while ((nx = p->next) == UNCONNECTED) {
+#if defined(__x86_64__)
+      __builtin_ia32_pause();
+#endif
+    }
+    p->next = prev;
+    prev = p;
+    p = nx;
+  }
+  return prev;  // oldest of the newer batch; newest has next == nullptr
+}
+
+void Socket::KeepWriteFiber(void* arg) {
+  KeepWriteArg* kw = (KeepWriteArg*)arg;
+  SocketId id = kw->id;
+  WriteRequest* req = kw->req;
+  ObjectPool<KeepWriteArg>::Return(kw);
+  Socket* s = ResourcePool<Socket>::Address((uint32_t)id);
+  // the Write() that spawned us holds a ref; s is valid until we Dereference
+  s->RunKeepWrite(req);
+  s->Dereference();
+}
+
+// The writer drain loop: writes FIFO until the queue CASes empty; on
+// failure, discards instead of writing.  Runs on a KeepWrite fiber or
+// inline in Write() when spawning is impossible.
+void Socket::RunKeepWrite(WriteRequest* req) {
+  Socket* s = this;
+  while (true) {
+    // drain req->data
+    while (!req->data.empty()) {
+      if (s->failed.load(std::memory_order_acquire)) {
+        req->data.clear();
+        break;
+      }
+      ssize_t n = req->data.cut_into_fd(s->fd);
+      if (n > 0) {
+        s->bytes_out.fetch_add((uint64_t)n, std::memory_order_relaxed);
+        continue;
+      }
+      if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        // arm EPOLLOUT and wait for writability (or failure)
+        int32_t w = butex_value(s->epollout_butex)
+                        .load(std::memory_order_acquire);
+        EventDispatcher::Instance().RegisterEpollOut(s->id(), s->fd);
+        butex_wait(s->epollout_butex, w, 1000 * 1000);
+        EventDispatcher::Instance().UnregisterEpollOut(s->id(), s->fd);
+        continue;
+      }
+      if (n < 0 && errno == EINTR) {
+        continue;
+      }
+      s->SetFailed(errno != 0 ? errno : EPIPE);
+    }
+    if (req->notify != nullptr && !s->failed.load(std::memory_order_acquire)) {
+      butex_value(req->notify).fetch_add(1, std::memory_order_release);
+      butex_wake_all(req->notify);
+    }
+    WriteRequest* next = req->next;
+    if (next != nullptr) {
+      ObjectPool<WriteRequest>::Return(req);
+      req = next;
+      continue;
+    }
+    // req is the last grabbed; if head still == req, queue is empty
+    WriteRequest* expected = req;
+    if (s->write_head.compare_exchange_strong(expected, nullptr,
+                                              std::memory_order_acq_rel)) {
+      ObjectPool<WriteRequest>::Return(req);
+      break;
+    }
+    WriteRequest* fifo = s->GrabNewer(req);
+    ObjectPool<WriteRequest>::Return(req);
+    req = fifo;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// EventDispatcher
+
+EventDispatcher& EventDispatcher::Instance() {
+  static EventDispatcher* d = new EventDispatcher();  // leaked on purpose
+  return *d;
+}
+
+void EventDispatcher::Start(int nthreads) {
+  bool expected = false;
+  if (!started_.compare_exchange_strong(expected, true)) {
+    return;
+  }
+  epfd_ = epoll_create1(EPOLL_CLOEXEC);
+  if (nthreads <= 0) {
+    nthreads = 1;
+  }
+  for (int i = 0; i < nthreads; ++i) {
+    std::thread t([this] { Loop(); });
+    t.detach();
+  }
+}
+
+int EventDispatcher::AddConsumer(SocketId id, int fd) {
+  Start(1);
+  epoll_event ev;
+  memset(&ev, 0, sizeof(ev));
+  ev.events = EPOLLIN | EPOLLET;
+  ev.data.u64 = id;
+  return epoll_ctl(epfd_, EPOLL_CTL_ADD, fd, &ev);
+}
+
+int EventDispatcher::RemoveConsumer(int fd) {
+  if (epfd_ < 0) {
+    return -1;
+  }
+  return epoll_ctl(epfd_, EPOLL_CTL_DEL, fd, nullptr);
+}
+
+int EventDispatcher::RegisterEpollOut(SocketId id, int fd) {
+  epoll_event ev;
+  memset(&ev, 0, sizeof(ev));
+  ev.events = EPOLLIN | EPOLLOUT | EPOLLET;
+  ev.data.u64 = id;
+  return epoll_ctl(epfd_, EPOLL_CTL_MOD, fd, &ev);
+}
+
+int EventDispatcher::UnregisterEpollOut(SocketId id, int fd) {
+  epoll_event ev;
+  memset(&ev, 0, sizeof(ev));
+  ev.events = EPOLLIN | EPOLLET;
+  ev.data.u64 = id;
+  return epoll_ctl(epfd_, EPOLL_CTL_MOD, fd, &ev);
+}
+
+void EventDispatcher::Loop() {
+  pthread_setname_np(pthread_self(), "trpc_epoll");
+  epoll_event evs[256];
+  while (true) {
+    int n = epoll_wait(epfd_, evs, 256, -1);
+    if (n < 0) {
+      if (errno == EINTR) {
+        continue;
+      }
+      return;
+    }
+    for (int i = 0; i < n; ++i) {
+      SocketId id = evs[i].data.u64;
+      uint32_t e = evs[i].events;
+      if (e & (EPOLLIN | EPOLLERR | EPOLLHUP | EPOLLRDHUP)) {
+        Socket::StartInputEvent(id);
+      }
+      if (e & EPOLLOUT) {
+        Socket::HandleEpollOut(id);
+      }
+    }
+  }
+}
+
+}  // namespace trpc

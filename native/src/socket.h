@@ -1,0 +1,109 @@
+// socket.h — the connection object + event dispatcher (capability of the
+// reference brpc/socket.h:269 and event_dispatcher_epoll.cpp):
+//   * 64-bit SocketId with versioned refcount for ABA-safe addressing
+//     (≙ _versioned_ref, socket.h:808: Address/SetFailed/Dereference —
+//     "like shared_ptr/weak_ptr with forced-zero", docs/en/io.md:39)
+//   * wait-free write: producers exchange onto an atomic stack; the first
+//     writer writes inline once and hands the rest to a KeepWrite fiber
+//     (≙ Socket::Write socket.cpp:1850, StartWrite :1924, KeepWrite :2066)
+//   * edge-triggered epoll dispatcher; EPOLLIN spawns a processing fiber
+//     with an atomic event-count dedup (≙ StartInputEvent socket.cpp:2553)
+#pragma once
+
+#include <functional>
+
+#include "fiber.h"
+#include "iobuf.h"
+
+namespace trpc {
+
+class Socket;
+
+// (version << 32) | pool slot
+typedef uint64_t SocketId;
+constexpr SocketId INVALID_SOCKET_ID = (uint64_t)-1;
+
+// Edge-trigger callback: consume readiness (read+parse, or accept loop).
+typedef void (*EdgeFn)(Socket*);
+
+struct WriteRequest {
+  IOBuf data;
+  WriteRequest* next = nullptr;
+  // notify_butex: optional completion hook (streaming flow control)
+  Butex* notify = nullptr;
+};
+
+struct SocketOptions {
+  int fd = -1;
+  EdgeFn edge_fn = nullptr;
+  void* user = nullptr;       // owner: Server* / Channel* / Acceptor ctx
+  void (*on_failed)(Socket*) = nullptr;  // called once from SetFailed
+};
+
+class Socket {
+ public:
+  int fd = -1;
+  uint32_t slot = 0;
+  std::atomic<uint64_t> versioned_ref{0};  // [version:32][nref:32]
+  std::atomic<WriteRequest*> write_head{nullptr};
+  std::atomic<uint32_t> nevent{0};
+  std::atomic<bool> failed{false};
+  int error_code = 0;
+  IOBuf read_buf;
+  EdgeFn edge_fn = nullptr;
+  void* user = nullptr;
+  void (*on_failed)(Socket*) = nullptr;
+  Butex* epollout_butex = nullptr;
+  // running statistics
+  std::atomic<uint64_t> bytes_in{0};
+  std::atomic<uint64_t> bytes_out{0};
+
+  static int Create(const SocketOptions& opts, SocketId* id_out);
+  // +1 ref; nullptr if the id is stale.
+  static Socket* Address(SocketId id);
+  void Dereference();
+  SocketId id() const;
+  uint32_t version() const {
+    return (uint32_t)(versioned_ref.load(std::memory_order_acquire) >> 32);
+  }
+
+  // Mark broken: wakes writers, runs on_failed once, drops the owner ref.
+  void SetFailed(int err);
+
+  // Wait-free write; takes ownership of data.  Returns 0 or -errno.
+  int Write(IOBuf&& data, Butex* notify = nullptr);
+
+  // Called by the dispatcher on EPOLLIN/EPOLLOUT.
+  static void StartInputEvent(SocketId id);
+  static void HandleEpollOut(SocketId id);
+
+  // Read until EAGAIN into read_buf.  Returns bytes read; sets *eof.
+  ssize_t ReadToBuf(bool* eof);
+
+ private:
+  friend struct KeepWriteArg;
+  static void ProcessEventFiber(void* arg);
+  static void KeepWriteFiber(void* arg);
+  void RunKeepWrite(WriteRequest* req);  // drain loop (fiber or inline)
+  WriteRequest* GrabNewer(WriteRequest* anchor);  // see .cc
+  void TryRecycle(uint32_t odd_ver);
+};
+
+// Global epoll dispatcher threads (flag: event_dispatcher_num).
+class EventDispatcher {
+ public:
+  static EventDispatcher& Instance();
+  void Start(int nthreads);
+  int AddConsumer(SocketId id, int fd);
+  int RemoveConsumer(int fd);
+  int RegisterEpollOut(SocketId id, int fd);
+  int UnregisterEpollOut(SocketId id, int fd);
+
+ private:
+  EventDispatcher() = default;
+  void Loop();
+  int epfd_ = -1;
+  std::atomic<bool> started_{false};
+};
+
+}  // namespace trpc

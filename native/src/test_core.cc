@@ -12,6 +12,7 @@
 
 #include "fiber.h"
 #include "iobuf.h"
+#include "rpc.h"
 #include "timer_thread.h"
 
 using namespace trpc;
@@ -208,6 +209,50 @@ static void bench_switch() {
   printf("yield cost: %lld ns\n", (long long)arg.ns);
 }
 
+static void test_rpc_echo() {
+  // real loopback sockets, no mocks (≙ brpc_server_unittest.cpp:168 starting
+  // servers on real ports and driving Channels against them)
+  Server* srv = server_create();
+  server_add_service(srv, "Echo", 0, nullptr, nullptr);
+  CHECK_TRUE(server_start(srv, "127.0.0.1", 0) == 0);
+  int port = server_port(srv);
+  CHECK_TRUE(port > 0);
+
+  Channel* ch = channel_create("127.0.0.1", port);
+  CallResult res;
+  std::string req = "hello rpc";
+  int rc = channel_call(ch, "Echo.echo", (const uint8_t*)req.data(),
+                        req.size(), (const uint8_t*)"ATT", 3,
+                        2 * 1000 * 1000, &res);
+  CHECK_TRUE(rc == 0);
+  CHECK_TRUE(res.response == req);
+  CHECK_TRUE(res.attachment == "ATT");
+
+  // unknown method
+  rc = channel_call(ch, "Nope.x", nullptr, 0, nullptr, 0, 2 * 1000 * 1000,
+                    &res);
+  CHECK_TRUE(rc == TRPC_ENOMETHOD);
+
+  // big payload crossing many blocks
+  std::string big(1 << 20, 'B');
+  rc = channel_call(ch, "Echo.echo", (const uint8_t*)big.data(), big.size(),
+                    nullptr, 0, 5 * 1000 * 1000, &res);
+  CHECK_TRUE(rc == 0);
+  CHECK_TRUE(res.response == big);
+
+  channel_destroy(ch);
+  printf("rpc echo ok (port %d)\n", port);
+
+  // quick in-process bench (short: 1s)
+  BenchResult br;
+  run_echo_bench("127.0.0.1", port, 4, 32, 32, 0, 1.0, &br);
+  printf("bench: qps=%.0f p50=%.0fus p99=%.0fus errors=%llu\n", br.qps,
+         br.p50_us, br.p99_us, (unsigned long long)br.errors);
+  CHECK_TRUE(br.qps > 1000);
+  CHECK_TRUE(br.errors == 0);
+  server_stop(srv);
+}
+
 int main() {
   test_iobuf();
   test_fibers_basic();
@@ -216,6 +261,7 @@ int main() {
   test_butex_pingpong();
   test_pthread_butex();
   test_stress_yield();
+  test_rpc_echo();
   bench_switch();
   if (g_failures > 0) {
     printf("FAILED: %d checks\n", g_failures);

@@ -1,0 +1,158 @@
+"""RPC layer tests over real loopback sockets — no mocks (same policy as the
+reference: brpc_server_unittest.cpp:168 starts servers on real ports,
+brpc_channel_unittest.cpp drives every path against them)."""
+
+import threading
+import time
+
+import pytest
+
+from brpc_tpu.rpc import (Channel, Controller, RpcError, Server,
+                          ServerOptions, errors)
+
+
+@pytest.fixture(scope="module")
+def echo_server():
+    s = Server()
+    s.add_echo_service()  # native echo
+
+    def py_echo(cntl, req):
+        cntl.response_attachment = cntl.request_attachment
+        return b"py:" + req
+
+    def py_fail(cntl, req):
+        raise RpcError(errors.EINTERNAL, "deliberate")
+
+    def py_raise(cntl, req):
+        raise ValueError("unexpected bug")
+
+    def py_slow(cntl, req):
+        time.sleep(0.5)
+        return b"slow"
+
+    s.add_service("PyEcho", py_echo)
+    s.add_service("PyFail", py_fail)
+    s.add_service("PyRaise", py_raise)
+    s.add_service("PySlow", py_slow)
+    s.start("127.0.0.1:0")
+    yield s
+    s.stop()
+
+
+class TestEcho:
+    def test_native_echo(self, echo_server):
+        ch = Channel(echo_server.listen_address)
+        resp = ch.call("Echo.echo", b"hello")
+        assert resp == b"hello"
+        ch.close()
+
+    def test_python_handler(self, echo_server):
+        ch = Channel(echo_server.listen_address)
+        cntl = Controller()
+        resp = ch.call("PyEcho.run", b"data", attachment=b"ATT", cntl=cntl)
+        assert resp == b"py:data"
+        assert cntl.response_attachment == b"ATT"
+        ch.close()
+
+    def test_large_payload(self, echo_server):
+        ch = Channel(echo_server.listen_address)
+        big = b"B" * (1 << 20)
+        assert ch.call("Echo.echo", big) == big
+        ch.close()
+
+    def test_concurrent_calls(self, echo_server):
+        ch = Channel(echo_server.listen_address)
+        results = []
+        lock = threading.Lock()
+
+        def work(i):
+            r = ch.call("Echo.echo", f"msg{i}".encode())
+            with lock:
+                results.append(r)
+
+        ts = [threading.Thread(target=work, args=(i,)) for i in range(16)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert sorted(results) == sorted(f"msg{i}".encode()
+                                         for i in range(16))
+        ch.close()
+
+
+class TestErrors:
+    def test_no_method(self, echo_server):
+        ch = Channel(echo_server.listen_address)
+        with pytest.raises(RpcError) as e:
+            ch.call("Missing.method", b"x")
+        assert e.value.code == errors.ENOMETHOD
+        ch.close()
+
+    def test_handler_rpc_error(self, echo_server):
+        ch = Channel(echo_server.listen_address)
+        cntl = Controller()
+        cntl.max_retry = 0
+        with pytest.raises(RpcError) as e:
+            ch.call("PyFail.run", b"x", cntl=cntl)
+        assert e.value.code == errors.EINTERNAL
+        assert "deliberate" in e.value.text
+
+    def test_handler_exception_becomes_einternal(self, echo_server):
+        ch = Channel(echo_server.listen_address)
+        cntl = Controller()
+        cntl.max_retry = 0
+        with pytest.raises(RpcError) as e:
+            ch.call("PyRaise.run", b"x", cntl=cntl)
+        assert e.value.code == errors.EINTERNAL
+        assert "ValueError" in e.value.text
+
+    def test_timeout(self, echo_server):
+        ch = Channel(echo_server.listen_address)
+        cntl = Controller()
+        cntl.timeout_ms = 100
+        cntl.max_retry = 0
+        t0 = time.monotonic()
+        with pytest.raises(RpcError) as e:
+            ch.call("PySlow.run", b"x", cntl=cntl)
+        dt = time.monotonic() - t0
+        assert e.value.code == errors.ERPCTIMEDOUT
+        assert dt < 0.45  # did not wait for the 500ms handler
+        ch.close()
+
+    def test_connect_refused(self):
+        ch = Channel("127.0.0.1:1")  # nothing listens
+        cntl = Controller()
+        cntl.timeout_ms = 500
+        cntl.max_retry = 1
+        with pytest.raises(RpcError) as e:
+            ch.call("Echo.echo", b"x", cntl=cntl)
+        assert e.value.code in (errors.EFAILEDSOCKET, errors.ERPCTIMEDOUT)
+        assert cntl.retried_count == 1  # retry policy engaged
+        ch.close()
+
+
+class TestServerIntrospection:
+    def test_method_stats_and_requests(self, echo_server):
+        ch = Channel(echo_server.listen_address)
+        before = echo_server.request_count()
+        for _ in range(5):
+            ch.call("PyEcho.run", b"x")
+        stats = echo_server.method_stats()
+        assert stats["PyEcho"]["count"] >= 5
+        assert echo_server.request_count() >= before + 5
+        ch.close()
+
+
+class TestBackupRequest:
+    def test_backup_wins_against_slow_first(self, echo_server):
+        # PySlow takes 500ms; with backup at 100ms a second attempt races.
+        # Both hit the same slow service here, so this only asserts the
+        # mechanism fires and the call still completes.
+        ch = Channel(echo_server.listen_address)
+        cntl = Controller()
+        cntl.timeout_ms = 3000
+        cntl.backup_request_ms = 100
+        resp = ch.call("PySlow.run", b"x", cntl=cntl)
+        assert resp == b"slow"
+        assert cntl.backup_fired
+        ch.close()
