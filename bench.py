@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Driver benchmark: echo QPS over the native loopback transport.
+
+Mirrors the reference's headline benchmark (docs/cn/benchmark.md:7 — echo
+QPS on one machine, 1M-5M on 24 HT cores ⇒ ~41.7k QPS/core at the low end).
+The whole hot path is native (native/src/rpc.cc run_echo_bench): fibers,
+wait-free socket writes, TRPC framing; Python only launches it.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline = our QPS/core ÷ reference QPS/core (1M/24).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")  # bench is host-side
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import ctypes
+
+    from brpc_tpu._native import lib
+
+    L = lib()
+    ncpu = os.cpu_count() or 1
+    workers = max(2, min(ncpu, 8))
+    L.trpc_init(workers)
+
+    # in-process echo server with the native echo handler (no Python in
+    # the hot path), then the native multi-fiber client loop against it
+    srv = L.trpc_server_create()
+    L.trpc_server_add_echo(srv)
+    if L.trpc_server_start(srv, b"127.0.0.1", 0) != 0:
+        print(json.dumps({"metric": "echo_qps", "value": 0.0,
+                          "unit": "qps", "vs_baseline": 0.0,
+                          "error": "server start failed"}))
+        return 1
+    port = L.trpc_server_port(srv)
+
+    out = (ctypes.c_double * 9)()
+    nconn = max(2, workers)
+    concurrency = 4 * nconn
+    rc = L.trpc_run_echo_bench(b"127.0.0.1", port, nconn, concurrency,
+                               16, 0, 3.0, out)
+    if rc != 0:
+        print(json.dumps({"metric": "echo_qps", "value": 0.0,
+                          "unit": "qps", "vs_baseline": 0.0,
+                          "error": f"bench rc={rc}"}))
+        return 1
+    qps, p50, p90, p99 = out[0], out[1], out[2], out[3]
+    ref_qps_per_core = 1_000_000 / 24.0  # docs/cn/benchmark.md:7 low end
+    vs = (qps / ncpu) / ref_qps_per_core
+    print(json.dumps({
+        "metric": "echo_qps",
+        "value": round(qps, 1),
+        "unit": "qps",
+        "vs_baseline": round(vs, 3),
+        "p50_us": round(p50, 1),
+        "p99_us": round(p99, 1),
+        "cores": ncpu,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

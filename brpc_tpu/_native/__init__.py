@@ -97,10 +97,15 @@ def _declare(L: ctypes.CDLL) -> None:
                                c.c_size_t]
     L.trpc_respond.restype = c.c_int
 
+    L.trpc_set_usercode_workers.argtypes = [c.c_int]
+    L.trpc_set_usercode_workers.restype = None
+
     # channel
     L.trpc_channel_create.argtypes = [c.c_char_p, c.c_int]
     L.trpc_channel_create.restype = c.c_void_p
     L.trpc_channel_destroy.argtypes = [c.c_void_p]
+    L.trpc_channel_set_connect_timeout.argtypes = [c.c_void_p, c.c_int64]
+    L.trpc_channel_set_connect_timeout.restype = None
     L.trpc_channel_call.argtypes = [c.c_void_p, c.c_char_p, c.c_char_p,
                                     c.c_size_t, c.c_char_p, c.c_size_t,
                                     c.c_int64, c.POINTER(c.c_void_p)]

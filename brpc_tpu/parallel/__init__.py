@@ -1,0 +1,28 @@
+"""parallel/ — distribution layer.
+
+Two halves, mirroring the reference's split between "combo channels" and the
+transport underneath (SURVEY.md §2.9):
+
+  mesh.py / collectives.py — the TPU-native lowering target: a
+      jax.sharding.Mesh over ICI/DCN plus XLA collectives (psum, all_gather,
+      reduce_scatter, ppermute).  This is the layer ParallelChannel and
+      PartitionChannel lower onto when their member set is a mesh axis
+      (reference parallel_channel.h:185, partition_channel.h:136).
+  channels.py — the host-side combo channels themselves (CallMapper /
+      ResponseMerger / fail_limit semantics) for heterogeneous member sets
+      that are NOT a mesh axis (talking over TCP/DCN like the reference).
+"""
+
+from brpc_tpu.parallel.mesh import (  # noqa: F401
+    auto_mesh,
+    axis_size,
+    make_mesh,
+)
+from brpc_tpu.parallel.collectives import (  # noqa: F401
+    all_gather,
+    all_reduce,
+    all_to_all,
+    bus_bandwidth_gbps,
+    reduce_scatter,
+    ring_permute,
+)

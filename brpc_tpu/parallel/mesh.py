@@ -1,0 +1,99 @@
+"""Device mesh construction.
+
+The reference reaches N peers by fanning RPCs over sub-channels
+(parallel_channel.cpp:776); a TPU slice reaches N chips through a
+jax.sharding.Mesh whose axes ride ICI.  This module owns the factoring of a
+device list into named axes so every other layer (models, combo channels,
+streaming) agrees on axis names:
+
+  dp — data parallel (batch dim; gradient psum)
+  sp — sequence/context parallel (long-context activations)
+  tp — tensor parallel (heads / ffn-hidden; layer-internal collectives)
+  ep — expert parallel (MoE experts)
+  pp — pipeline parallel (layer stages; ppermute between stages)
+
+Axis order is outer→inner = slowest→fastest-varying over the device list, so
+`tp` (the most collective-chatty axis) lands on adjacent devices — the
+layout that keeps its collectives on ICI neighbors (the analog of the
+reference pinning hot sockets to one worker's io_uring, task_group.h:190).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_ORDER = ("pp", "dp", "ep", "sp", "tp")
+
+
+def make_mesh(axes: Dict[str, int],
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a Mesh from {axis_name: size}.
+
+    Sizes must multiply to the device count; a single axis may be -1 to
+    absorb the remainder (like a reshape).  Axes are laid out in AXIS_ORDER.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    names = [a for a in AXIS_ORDER if a in axes]
+    extra = set(axes) - set(names)
+    if extra:
+        raise ValueError(f"unknown mesh axes {sorted(extra)}; "
+                         f"known: {AXIS_ORDER}")
+    sizes = [axes[a] for a in names]
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one axis may be -1")
+    known = math.prod(s for s in sizes if s != -1)
+    if -1 in sizes:
+        if len(devs) % known:
+            raise ValueError(
+                f"{len(devs)} devices not divisible by {known}")
+        sizes[sizes.index(-1)] = len(devs) // known
+    if math.prod(sizes) != len(devs):
+        raise ValueError(
+            f"mesh {dict(zip(names, sizes))} wants {math.prod(sizes)} "
+            f"devices, have {len(devs)}")
+    arr = np.asarray(devs, dtype=object).reshape(sizes)
+    return Mesh(arr, tuple(names))
+
+
+def auto_mesh(n_devices: Optional[int] = None,
+              axis_names: Sequence[str] = ("dp", "sp", "tp")) -> Mesh:
+    """Factor n devices into the given axes, largest factors innermost.
+
+    8 devices over (dp, sp, tp) → dp=2, sp=2, tp=2; 4 → dp=1, sp=2, tp=2;
+    prime counts degrade gracefully (extra axes get size 1).
+    """
+    devs = list(jax.devices())
+    n = n_devices if n_devices is not None else len(devs)
+    devs = devs[:n]
+    # axes that should get device factors first: tp (chattiest, wants ICI
+    # neighbors), then dp (the gradient-psum axis), then sp, ep, pp
+    priority = [a for a in ("tp", "dp", "sp", "ep", "pp") if a in axis_names]
+    priority += [a for a in axis_names if a not in priority]
+    sizes = dict.fromkeys(axis_names, 1)
+    i = 0
+    for p in sorted(_primes(n), reverse=True):
+        sizes[priority[i % len(priority)]] *= p
+        i += 1
+    return make_mesh(sizes, devices=devs)
+
+
+def _primes(n: int) -> list:
+    out = []
+    d = 2
+    while d * d <= n:
+        while n % d == 0:
+            out.append(d)
+            n //= d
+        d += 1
+    if n > 1:
+        out.append(n)
+    return out
+
+
+def axis_size(mesh: Mesh, name: str) -> int:
+    return mesh.shape.get(name, 1)

@@ -84,11 +84,14 @@ class SubChannel:
     ≙ the single-server brpc::Channel (SocketMap entry, channel.cpp:317).
     """
 
-    def __init__(self, endpoint: EndPoint):
+    def __init__(self, endpoint: EndPoint,
+                 connect_timeout_ms: float = 500.0):
         self.endpoint = endpoint
         L = lib()
         self._handle = L.trpc_channel_create(
             endpoint.ip.encode(), endpoint.port)
+        L.trpc_channel_set_connect_timeout(
+            self._handle, int(connect_timeout_ms * 1000))
         self._native = _NativeCall(self._handle)
         self._lock = threading.Lock()
         self._closed = False
@@ -132,7 +135,7 @@ class Channel:
             if ep.is_device:
                 # device endpoints carry the control plane on DCN/TCP
                 ep = EndPoint(ip=ep.ip, port=ep.port)
-            self._sub = SubChannel(ep)
+            self._sub = SubChannel(ep, self.options.connect_timeout_ms)
         if Channel._latency is None:
             Channel._latency = bvar.LatencyRecorder()
             Channel._latency.expose("rpc_client")
@@ -203,6 +206,7 @@ class Channel:
         second attempt; first success wins."""
         result = []
         cond = threading.Condition()
+        deadline = time.monotonic() + timeout_us / 1e6  # from attempt start
 
         def attempt(budget_us):
             r = sub.call_once(method, payload, attachment, budget_us)
@@ -223,7 +227,6 @@ class Channel:
                 target=attempt, args=(remaining,), daemon=True)
             t2.start()
         with cond:
-            deadline = time.monotonic() + timeout_us / 1e6
             while True:
                 for r in result:
                     if r[0] == 0:

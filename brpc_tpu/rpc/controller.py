@@ -13,9 +13,11 @@ class Controller:
     context; the handler sets response fields."""
 
     def __init__(self):
-        # client options
-        self.timeout_ms: Optional[float] = 1000.0
-        self.max_retry: int = 3
+        # client options; None = inherit the ChannelOptions value
+        # (≙ reference: unset Controller fields fall back to the channel's,
+        # controller.cpp set_timeout_ms / ChannelOptions.timeout_ms)
+        self.timeout_ms: Optional[float] = None
+        self.max_retry: Optional[int] = None
         self.backup_request_ms: Optional[float] = None
         # shared state
         self.error_code: int = 0

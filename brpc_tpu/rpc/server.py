@@ -151,6 +151,8 @@ class Server:
     def start(self, address: str = "127.0.0.1:0") -> int:
         from brpc_tpu import fiber
         fiber.init(self.options.num_workers)
+        lib().trpc_set_usercode_workers(
+            int(flags.get_flag("usercode_workers")))
         ip, _, port = address.rpartition(":")
         rc = lib().trpc_server_start(self._handle, ip.encode(), int(port))
         if rc != 0:

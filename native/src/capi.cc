@@ -86,6 +86,8 @@ int trpc_server_stop(void* s) { return server_stop((Server*)s); }
 void trpc_server_destroy(void* s) { server_destroy((Server*)s); }
 uint64_t trpc_server_requests(void* s) { return server_requests((Server*)s); }
 
+void trpc_set_usercode_workers(int n) { set_usercode_workers(n); }
+
 int trpc_respond(uint64_t token, int32_t error_code, const char* error_text,
                  const uint8_t* data, size_t len, const uint8_t* attach,
                  size_t attach_len) {
@@ -100,6 +102,9 @@ void* trpc_channel_create(const char* ip, int port) {
 }
 
 void trpc_channel_destroy(void* c) { channel_destroy((Channel*)c); }
+void trpc_channel_set_connect_timeout(void* c, int64_t us) {
+  channel_set_connect_timeout((Channel*)c, us);
+}
 
 // Synchronous call.  Response/attachment/error_text are returned through a
 // heap CallResult the caller must free with trpc_result_destroy.

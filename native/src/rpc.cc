@@ -5,6 +5,7 @@
 #include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <string.h>
 #include <sys/socket.h>
 #include <unistd.h>
@@ -201,6 +202,10 @@ struct CallCtx {
   }
 };
 
+// set before the first Python-handler request via trpc_set_usercode_workers
+// (the usercode_workers flag, ≙ reference FLAGS_usercode_backup_pool size)
+std::atomic<int> g_usercode_workers{4};
+
 class UsercodePool {
  public:
   static UsercodePool& Instance() {
@@ -223,7 +228,10 @@ class UsercodePool {
     if (!started_.compare_exchange_strong(expected, true)) {
       return;
     }
-    int n = 4;
+    int n = g_usercode_workers.load(std::memory_order_relaxed);
+    if (n < 1) {
+      n = 1;
+    }
     for (int i = 0; i < n; ++i) {
       std::thread t([this] {
         pthread_setname_np(pthread_self(), "trpc_usercode");
@@ -559,6 +567,7 @@ class Channel {
  public:
   std::string ip;
   int port = 0;
+  int64_t connect_timeout_us = 500 * 1000;
   std::atomic<uint64_t> next_corr{1};
   std::mutex map_mu;
   std::unordered_map<uint64_t, PendingCall*> pending;
@@ -663,16 +672,28 @@ int EnsureConnected(Channel* c, SocketId* out) {
   addr.sin_family = AF_INET;
   addr.sin_port = htons((uint16_t)c->port);
   addr.sin_addr.s_addr = inet_addr(c->ip.c_str());
+  // non-blocking connect with a deadline (ChannelOptions.connect_timeout_ms)
+  int fl = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, fl | O_NONBLOCK);
   if (connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
-    int e = errno;
-    ::close(fd);
-    return -e;
+    if (errno != EINPROGRESS) {
+      int e = errno;
+      ::close(fd);
+      return -e;
+    }
+    pollfd pfd{fd, POLLOUT, 0};
+    int pr = poll(&pfd, 1, (int)(c->connect_timeout_us / 1000));
+    int soerr = 0;
+    socklen_t slen = sizeof(soerr);
+    if (pr <= 0 ||
+        getsockopt(fd, SOL_SOCKET, SO_ERROR, &soerr, &slen) != 0 ||
+        soerr != 0) {
+      ::close(fd);
+      return pr <= 0 ? -ETIMEDOUT : -(soerr != 0 ? soerr : EIO);
+    }
   }
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-  // non-blocking after connect: reads/writes go through the dispatcher
-  int fl = fcntl(fd, F_GETFL, 0);
-  fcntl(fd, F_SETFL, fl | O_NONBLOCK);
   SocketOptions opts;
   opts.fd = fd;
   opts.edge_fn = ChannelOnMessages;
@@ -696,6 +717,14 @@ Channel* channel_create(const char* ip, int port) {
   c->ip = ip;
   c->port = port;
   return c;
+}
+
+void channel_set_connect_timeout(Channel* c, int64_t us) {
+  c->connect_timeout_us = us;
+}
+
+void set_usercode_workers(int n) {
+  g_usercode_workers.store(n, std::memory_order_relaxed);
 }
 
 void channel_destroy(Channel* c) {

@@ -94,6 +94,10 @@ class Channel;
 
 Channel* channel_create(const char* ip, int port);
 void channel_destroy(Channel* c);
+void channel_set_connect_timeout(Channel* c, int64_t us);
+
+// size of the pthread pool running Python handlers (before first request)
+void set_usercode_workers(int n);
 
 struct CallResult {
   int32_t error_code = 0;

@@ -216,13 +216,13 @@ int Socket::Write(IOBuf&& data, Butex* notify) {
   WriteRequest* req = ObjectPool<WriteRequest>::Get();
   req->data = std::move(data);
   req->notify = notify;
-  req->next = UNCONNECTED;
+  req->next.store(UNCONNECTED, std::memory_order_relaxed);
   WriteRequest* prev = write_head.exchange(req, std::memory_order_acq_rel);
   if (prev != nullptr) {
-    req->next = prev;  // newest -> ... -> oldest stack linkage
+    req->next.store(prev, std::memory_order_release);  // newest -> ... -> oldest
     return 0;          // the current writer will pick it up
   }
-  req->next = nullptr;
+  req->next.store(nullptr, std::memory_order_relaxed);
   // we are the writer: one inline write attempt, then hand off
   if (!failed.load(std::memory_order_acquire)) {
     ssize_t n = req->data.cut_into_fd(fd);
@@ -276,12 +276,12 @@ WriteRequest* Socket::GrabNewer(WriteRequest* anchor) {
   while (p != anchor) {
     // spin until the producer links its next pointer
     WriteRequest* nx;
-    while ((nx = p->next) == UNCONNECTED) {
+    while ((nx = p->next.load(std::memory_order_acquire)) == UNCONNECTED) {
 #if defined(__x86_64__)
       __builtin_ia32_pause();
 #endif
     }
-    p->next = prev;
+    p->next.store(prev, std::memory_order_relaxed);
     prev = p;
     p = nx;
   }
@@ -334,7 +334,7 @@ void Socket::RunKeepWrite(WriteRequest* req) {
       butex_value(req->notify).fetch_add(1, std::memory_order_release);
       butex_wake_all(req->notify);
     }
-    WriteRequest* next = req->next;
+    WriteRequest* next = req->next.load(std::memory_order_relaxed);
     if (next != nullptr) {
       ObjectPool<WriteRequest>::Return(req);
       req = next;

@@ -10,6 +10,7 @@
 //     with an atomic event-count dedup (≙ StartInputEvent socket.cpp:2553)
 #pragma once
 
+#include <atomic>
 #include <functional>
 
 #include "fiber.h"
@@ -28,7 +29,9 @@ typedef void (*EdgeFn)(Socket*);
 
 struct WriteRequest {
   IOBuf data;
-  WriteRequest* next = nullptr;
+  // atomic: producers publish the stack linkage concurrently with the
+  // KeepWrite fiber spinning on it in GrabNewer
+  std::atomic<WriteRequest*> next{nullptr};
   // notify_butex: optional completion hook (streaming flow control)
   Butex* notify = nullptr;
 };

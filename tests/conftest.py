@@ -15,4 +15,15 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
+# The axon sitecustomize force-selects the real-TPU platform at interpreter
+# start (jax.config.update("jax_platforms", "axon,cpu")), overriding the env
+# vars above — undo that so tests always see 8 virtual CPU devices.
+import jax  # noqa: E402
+
+from jax.extend import backend as _jex_backend  # noqa: E402
+
+_jex_backend.clear_backends()
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

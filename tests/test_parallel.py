@@ -1,0 +1,139 @@
+"""parallel/ + models/ tests on the 8-virtual-device CPU mesh.
+
+Mirrors the reference's strategy of testing distribution logic against real
+in-process infrastructure, not mocks (SURVEY.md §4: multiple loopback
+servers ≙ here a real 8-device Mesh with real XLA collectives).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from brpc_tpu.parallel import (
+    all_gather,
+    all_reduce,
+    all_to_all,
+    auto_mesh,
+    bus_bandwidth_gbps,
+    make_mesh,
+    reduce_scatter,
+    ring_permute,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return make_mesh({"dp": 8})
+
+
+def test_make_mesh_factoring():
+    m = make_mesh({"dp": 2, "tp": 4})
+    assert m.shape == {"dp": 2, "tp": 4}
+    m = make_mesh({"dp": -1, "tp": 2})
+    assert m.shape == {"dp": 4, "tp": 2}
+    with pytest.raises(ValueError):
+        make_mesh({"dp": 3})
+    with pytest.raises(ValueError):
+        make_mesh({"bogus": 8})
+
+
+def test_auto_mesh_priority():
+    m = auto_mesh(8, axis_names=("dp", "ep", "sp", "tp"))
+    assert m.shape["tp"] == 2 and m.shape["dp"] == 2 and m.shape["sp"] == 2
+    m = auto_mesh(4, axis_names=("dp", "tp"))
+    assert m.shape["tp"] == 2 and m.shape["dp"] == 2
+
+
+def test_all_reduce(mesh8):
+    x = jnp.arange(16.0)
+    y = all_reduce(mesh8, "dp", x)
+    # every shard becomes the sum over the 8 shards of its own position
+    expect = np.arange(16.0).reshape(8, 2).sum(0)
+    got = np.asarray(y).reshape(8, 2)
+    for row in got:
+        np.testing.assert_allclose(row, expect)
+
+
+def test_all_gather_and_reduce_scatter(mesh8):
+    x = jnp.arange(8.0)
+    g = all_gather(mesh8, "dp", x)
+    np.testing.assert_allclose(np.asarray(g)[:8], np.arange(8.0))
+    # 8 shards of [8]; member i ends with sum_s shard_s[i] = 224 + 8i
+    x = jnp.arange(64.0)
+    rs = reduce_scatter(mesh8, "dp", x)
+    np.testing.assert_allclose(np.asarray(rs), 224.0 + 8 * np.arange(8.0))
+
+
+def test_ring_permute(mesh8):
+    x = jnp.arange(8.0)
+    y = ring_permute(mesh8, "dp", x, shift=1)
+    np.testing.assert_allclose(np.asarray(y), np.roll(np.arange(8.0), 1))
+
+
+def test_all_to_all_is_resharding(mesh8):
+    x = jax.device_put(jnp.arange(64.0).reshape(8, 8),
+                       NamedSharding(mesh8, P("dp")))
+    y = all_to_all(mesh8, "dp", x)
+    # global value unchanged; sharded dim moved 0 → 1 (Ulysses)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x))
+    assert y.sharding.spec == P(None, "dp")
+
+
+def test_bus_bandwidth_runs(mesh8):
+    bw = bus_bandwidth_gbps(mesh8, "dp", mbytes_per_shard=0.5, iters=2)
+    assert bw > 0
+
+
+# --- flagship model ---------------------------------------------------------
+
+
+def _tiny(moe=False):
+    from brpc_tpu.models import ModelConfig
+    return ModelConfig(vocab=64, d_model=32, n_heads=2, n_layers=2,
+                       d_ff=64, max_seq=32,
+                       n_experts=4 if moe else 0, moe_every=2)
+
+
+def test_model_forward_single():
+    from brpc_tpu.models import apply, init
+    cfg = _tiny()
+    params = init(jax.random.key(0), cfg)
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    logits = jax.jit(lambda p, t: apply(p, t, cfg))(params, tokens)
+    assert logits.shape == (2, 16, 64)
+    assert bool(jnp.isfinite(logits).all())
+
+
+def test_model_moe_forward():
+    from brpc_tpu.models import apply, init
+    cfg = _tiny(moe=True)
+    params = init(jax.random.key(0), cfg)
+    assert "moe" in params
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    logits = jax.jit(lambda p, t: apply(p, t, cfg))(params, tokens)
+    assert bool(jnp.isfinite(logits).all())
+
+
+def test_train_step_sharded_loss_decreases():
+    from brpc_tpu.models import TrainState, init, make_train_step
+    from brpc_tpu.models.transformer import param_specs
+    cfg = _tiny(moe=True)
+    mesh = auto_mesh(8, axis_names=("dp", "ep", "sp", "tp"))
+    tx, step = make_train_step(cfg, mesh, lr=1e-2)
+    params = init(jax.random.key(1), cfg)
+    specs = param_specs(cfg)
+    params = jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params, specs, is_leaf=lambda x: isinstance(x, P))
+    state = TrainState(params=params, opt_state=tx.init(params),
+                       step=jnp.zeros((), jnp.int32))
+    tokens = jax.device_put(
+        jax.random.randint(jax.random.key(2), (4, 32), 0, cfg.vocab),
+        NamedSharding(mesh, P("dp", "sp")))
+    state, l0 = step(state, tokens)
+    for _ in range(5):
+        state, l1 = step(state, tokens)
+    assert float(l1) < float(l0), (float(l0), float(l1))
+    assert int(state.step) == 6
