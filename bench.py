@@ -52,7 +52,8 @@ def main() -> int:
         return 1
     qps, p50, p90, p99 = out[0], out[1], out[2], out[3]
     ref_qps_per_core = 1_000_000 / 24.0  # docs/cn/benchmark.md:7 low end
-    vs = (qps / ncpu) / ref_qps_per_core
+    cores_used = min(ncpu, workers)  # bench engages `workers` cores at most
+    vs = (qps / cores_used) / ref_qps_per_core
     print(json.dumps({
         "metric": "echo_qps",
         "value": round(qps, 1),

@@ -1,0 +1,25 @@
+"""cluster/ — naming services, load balancers, fault tolerance, admission
+control (≙ the reference's policy/ + details/ client-cluster machinery,
+SURVEY.md §2.4 "Load balancers"/"Naming services"/"Client fault tolerance"/
+"Server admission control" rows).
+"""
+
+from brpc_tpu.cluster.naming import (  # noqa: F401
+    NamingService,
+    ServerNode,
+    get_naming_thread,
+    register_naming_service,
+)
+from brpc_tpu.cluster.load_balancer import (  # noqa: F401
+    LoadBalancer,
+    create_load_balancer,
+    register_load_balancer,
+)
+from brpc_tpu.cluster.circuit_breaker import CircuitBreaker  # noqa: F401
+from brpc_tpu.cluster.limiter import (  # noqa: F401
+    AutoConcurrencyLimiter,
+    ConstantConcurrencyLimiter,
+    Interceptor,
+    TimeoutConcurrencyLimiter,
+)
+from brpc_tpu.cluster.health_check import HealthChecker  # noqa: F401

@@ -1,0 +1,102 @@
+"""CircuitBreaker — per-node error isolation.
+
+≙ reference circuit_breaker.h:25-88: two EMA windows (long + short) over
+error rate; when either window's error count exceeds its budget the node is
+isolated; the isolation duration doubles on repeated isolation within
+`window_s` and resets after a quiet period.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+
+
+@dataclass
+class CircuitBreakerOptions:
+    # max error RATE the long/short windows tolerate (reference flags
+    # circuit_breaker_{long,short}_window_error_percent)
+    long_window: int = 128          # samples
+    long_error_percent: int = 50
+    short_window: int = 32
+    short_error_percent: int = 75
+    min_isolation_s: float = 0.1
+    max_isolation_s: float = 30.0
+    # after this long without isolation, the doubling resets
+    reset_after_s: float = 60.0
+
+
+class _EmaWindow:
+    """EMA over a nominal sample window: error fraction with decay
+    alpha = 1/window (≙ circuit_breaker.cpp EmaErrorRecorder)."""
+
+    def __init__(self, window: int, max_error_percent: int):
+        self.alpha = 1.0 / window
+        self.limit = max_error_percent / 100.0
+        self.ema = 0.0
+        self.samples = 0
+        self.window = window
+
+    def record(self, failed: bool) -> bool:
+        """Returns False when the node should be isolated."""
+        self.samples += 1
+        self.ema += self.alpha * ((1.0 if failed else 0.0) - self.ema)
+        if self.samples < self.window // 2:
+            return True  # not enough signal yet
+        return self.ema < self.limit
+
+    def reset(self):
+        self.ema = 0.0
+        self.samples = 0
+
+
+class CircuitBreaker:
+    def __init__(self, options: CircuitBreakerOptions = None):
+        self.opt = options or CircuitBreakerOptions()
+        self._long = _EmaWindow(self.opt.long_window,
+                                self.opt.long_error_percent)
+        self._short = _EmaWindow(self.opt.short_window,
+                                 self.opt.short_error_percent)
+        self._lock = threading.Lock()
+        self._isolated_until = 0.0
+        self._isolation_s = self.opt.min_isolation_s
+        self._last_isolation = 0.0
+        self.isolated_times = 0
+
+    def on_call_end(self, latency_us: int, failed: bool) -> bool:
+        """Record one call (≙ OnCallEnd, circuit_breaker.h:38).
+        Returns False if the node just tripped into isolation."""
+        with self._lock:
+            ok = self._long.record(failed) and self._short.record(failed)
+            if not ok:
+                self._isolate_locked()
+            return ok
+
+    def is_isolated(self) -> bool:
+        with self._lock:
+            return time.monotonic() < self._isolated_until
+
+    def remaining_isolation_s(self) -> float:
+        with self._lock:
+            return max(0.0, self._isolated_until - time.monotonic())
+
+    def mark_recovered(self) -> None:
+        """Health check succeeded: close the breaker
+        (≙ Reset on revive)."""
+        with self._lock:
+            self._isolated_until = 0.0
+            self._long.reset()
+            self._short.reset()
+
+    def _isolate_locked(self) -> None:
+        now = time.monotonic()
+        if now - self._last_isolation > self.opt.reset_after_s:
+            self._isolation_s = self.opt.min_isolation_s
+        self._isolated_until = now + self._isolation_s
+        self._last_isolation = now
+        self._isolation_s = min(self._isolation_s * 2,
+                                self.opt.max_isolation_s)
+        self.isolated_times += 1
+        self._long.reset()
+        self._short.reset()

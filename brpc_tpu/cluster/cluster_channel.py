@@ -1,0 +1,138 @@
+"""ClusterChannel — the cluster-mode guts of rpc.Channel
+(≙ LoadBalancerWithNaming, details/load_balancer_with_naming.cpp, plus the
+per-node fault tolerance from controller.cpp OnVersionedRPCReturned:
+circuit breaking, exclusion, health-check revival).
+
+One ClusterChannel = one naming URL + one LB + per-node native connections,
+circuit breakers and health checking.  rpc.Channel owns retries/backup; this
+layer owns "which server does this attempt go to".
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from brpc_tpu.cluster.circuit_breaker import CircuitBreaker
+from brpc_tpu.cluster.health_check import HealthChecker
+from brpc_tpu.cluster.load_balancer import (
+    NoServerError,
+    create_load_balancer,
+)
+from brpc_tpu.cluster.naming import ServerNode, Watcher, get_naming_thread
+from brpc_tpu.metrics import bvar
+from brpc_tpu.rpc import errors
+
+
+class _LBWatcher(Watcher):
+    def __init__(self, channel: "ClusterChannel"):
+        self.channel = channel
+
+    def on_servers(self, added, removed, all_nodes):
+        if added:
+            self.channel.lb.add_servers_in_batch(added)
+        if removed:
+            self.channel.lb.remove_servers_in_batch(removed)
+            self.channel._prune(removed)
+
+
+class ClusterChannel:
+    def __init__(self, address: str, options):
+        from brpc_tpu.rpc.channel import SubChannel  # cycle: rpc ↔ cluster
+        self._SubChannel = SubChannel
+        self.options = options
+        self.lb = create_load_balancer(options.load_balancer or "rr")
+        self._subs: Dict[ServerNode, object] = {}
+        self._breakers: Dict[ServerNode, CircuitBreaker] = {}
+        self._lock = threading.Lock()
+        self._health = HealthChecker(on_revive=self._on_revive)
+        self._watcher = _LBWatcher(self)
+        self._ns = get_naming_thread(address)
+        self._ns.add_watcher(self._watcher)
+        self._ns.wait_first_resolve()
+        self._closed = False
+
+    def _prune(self, removed) -> None:
+        """Drop per-node state for ex-members so membership churn (DNS
+        rotation etc.) doesn't leak native channels/fds."""
+        with self._lock:
+            subs = [self._subs.pop(n) for n in removed if n in self._subs]
+            for n in removed:
+                self._breakers.pop(n, None)
+        for n in removed:
+            self._health.discard(n)
+        for s in subs:
+            s.close()
+
+    # -- node plumbing ------------------------------------------------------
+
+    def _sub(self, node: ServerNode):
+        with self._lock:
+            sub = self._subs.get(node)
+            if sub is None:
+                sub = self._subs[node] = self._SubChannel(
+                    node.endpoint, self.options.connect_timeout_ms)
+            return sub
+
+    def _breaker(self, node: ServerNode) -> CircuitBreaker:
+        with self._lock:
+            br = self._breakers.get(node)
+            if br is None:
+                br = self._breakers[node] = CircuitBreaker()
+            return br
+
+    def _on_revive(self, node: ServerNode) -> None:
+        self._breaker(node).mark_recovered()
+
+    def _isolated_nodes(self):
+        with self._lock:
+            items = list(self._breakers.items())
+        return {n for n, br in items if br.is_isolated()}
+
+    # -- one attempt (rpc.Channel drives retries around this) ---------------
+
+    def call_once(self, method: bytes, payload: bytes, attachment: bytes,
+                  timeout_us: int, cntl) -> Tuple[int, str, bytes, bytes]:
+        # breaker-isolated nodes + nodes that already failed THIS call's
+        # earlier attempts (≙ ExcludedServers): without the latter, sticky
+        # LBs (c_md5) would re-pick the same dead node on every retry
+        excluded = self._isolated_nodes() | cntl.excluded_nodes
+        try:
+            node = self.lb.select(request_code=cntl.log_id,
+                                  excluded=excluded)
+        except NoServerError:
+            if not excluded:
+                return (errors.ENOSERVICE, "no servers resolved", b"", b"")
+            # every node isolated: pick through the breaker anyway rather
+            # than failing hard (≙ ClusterRecoverPolicy letting probes in)
+            try:
+                node = self.lb.select(request_code=cntl.log_id)
+            except NoServerError:
+                return (errors.ENOSERVICE, "no servers resolved", b"", b"")
+        sub = self._sub(node)
+        t0 = time.monotonic_ns()
+        code, text, data, att = sub.call_once(method, payload, attachment,
+                                              timeout_us)
+        latency_us = (time.monotonic_ns() - t0) // 1000
+        failed = code != 0
+        self.lb.feedback(node, latency_us, failed)
+        self._breaker(node).on_call_end(latency_us, failed)
+        if failed:
+            cntl.excluded_nodes.add(node)
+        if code == errors.EFAILEDSOCKET:
+            self._health.mark_broken(node)
+        cntl.remote_side = str(node.endpoint)
+        return code, text, data, att
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        self._ns.remove_watcher(self._watcher)
+        self._health.stop()
+        with self._lock:
+            subs = list(self._subs.values())
+            self._subs.clear()
+        for s in subs:
+            s.close()

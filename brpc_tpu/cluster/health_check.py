@@ -1,0 +1,83 @@
+"""Health checking — periodic probe of isolated/failed nodes, revive on
+success (≙ details/health_check.cpp:146-241 HealthCheckTask: periodic
+reconnect probe + optional app-level RPC check via health_check_path).
+"""
+
+from __future__ import annotations
+
+import socket as pysocket
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from brpc_tpu.cluster.naming import ServerNode
+from brpc_tpu.utils import logging as log
+
+
+def tcp_probe(node: ServerNode, timeout_s: float = 0.5) -> bool:
+    """Default probe: can we (re)connect? (≙ the reconnect probe)."""
+    try:
+        with pysocket.create_connection((node.endpoint.ip,
+                                         node.endpoint.port),
+                                        timeout=timeout_s):
+            return True
+    except OSError:
+        return False
+
+
+class HealthChecker:
+    """Watches broken nodes, revives them via on_revive when the probe
+    passes.  `rpc_probe` (≙ health_check_path) upgrades the TCP probe to an
+    application-level call."""
+
+    def __init__(self, interval_s: float = 0.2,
+                 probe: Callable[[ServerNode], bool] = tcp_probe,
+                 on_revive: Optional[Callable[[ServerNode], None]] = None):
+        self.interval_s = interval_s
+        self.probe = probe
+        self.on_revive = on_revive
+        self._broken: Dict[ServerNode, float] = {}  # node -> since
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def mark_broken(self, node: ServerNode) -> None:
+        with self._lock:
+            if node not in self._broken:
+                self._broken[node] = time.monotonic()
+            self._ensure_thread_locked()
+
+    def discard(self, node: ServerNode) -> None:
+        with self._lock:
+            self._broken.pop(node, None)
+
+    def broken_nodes(self):
+        with self._lock:
+            return list(self._broken)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _ensure_thread_locked(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="health_check", daemon=True)
+            self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            with self._lock:
+                nodes = list(self._broken)
+            if not nodes:
+                return  # exit when idle; restarted on next mark_broken
+            for node in nodes:
+                if self.probe(node):
+                    with self._lock:
+                        since = self._broken.pop(node, None)
+                    if since is not None:
+                        log.LOG(log.LOG_INFO,
+                                "health check revived %s after %.1fs",
+                                node, time.monotonic() - since)
+                        if self.on_revive is not None:
+                            self.on_revive(node)

@@ -1,0 +1,160 @@
+"""Server admission control (≙ concurrency_limiter.h:29-44 + policy/
+{constant,auto,timeout}_concurrency_limiter.cpp + interceptor.h:26).
+
+A limiter sees on_request (admit or reject with ELIMIT) and on_response
+(with latency) — exactly the reference's OnRequest/OnResponded contract.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+
+class ConcurrencyLimiter:
+    def on_request(self) -> bool:
+        raise NotImplementedError
+
+    def on_response(self, latency_us: int, error: bool = False) -> None:
+        raise NotImplementedError
+
+
+class ConstantConcurrencyLimiter(ConcurrencyLimiter):
+    """max N in-flight (≙ constant_concurrency_limiter.cpp)."""
+
+    def __init__(self, max_concurrency: int):
+        self.max = max_concurrency
+        self._inflight = 0
+        self._lock = threading.Lock()
+
+    def on_request(self) -> bool:
+        with self._lock:
+            if self.max > 0 and self._inflight >= self.max:
+                return False
+            self._inflight += 1
+            return True
+
+    def on_response(self, latency_us: int, error: bool = False) -> None:
+        with self._lock:
+            self._inflight = max(self._inflight - 1, 0)
+
+    @property
+    def inflight(self) -> int:
+        return self._inflight
+
+
+class AutoConcurrencyLimiter(ConcurrencyLimiter):
+    """Gradient limiter (≙ auto_concurrency_limiter.cpp, doc
+    docs/cn/auto_concurrency_limiter.md):
+
+      max_concurrency = max_qps * ((2+alpha) * min_latency - latency)
+
+    where min_latency is an EMA of the best observed (no-load) latency and
+    max_qps the peak measured throughput.  Periodically the limit is lowered
+    to re-sample min_latency (the exploration step).
+    """
+
+    ALPHA = 0.3
+    SAMPLE_WINDOW_S = 0.1
+    MIN_SAMPLES = 10
+    EXPLORE_EVERY = 20  # windows
+
+    def __init__(self, max_concurrency: int = 40):
+        self._limit = max_concurrency
+        self._inflight = 0
+        self._lock = threading.Lock()
+        self._win_start = time.monotonic()
+        self._win_count = 0
+        self._win_lat_sum = 0
+        self._min_latency_us: Optional[float] = None
+        self._max_qps = 0.0
+        self._windows = 0
+
+    @property
+    def max_concurrency(self) -> int:
+        return int(self._limit)
+
+    def on_request(self) -> bool:
+        with self._lock:
+            if self._inflight >= max(int(self._limit), 1):
+                return False
+            self._inflight += 1
+            return True
+
+    def on_response(self, latency_us: int, error: bool = False) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self._inflight = max(self._inflight - 1, 0)
+            if error:
+                return
+            self._win_count += 1
+            self._win_lat_sum += latency_us
+            dt = now - self._win_start
+            if dt >= self.SAMPLE_WINDOW_S and \
+                    self._win_count >= self.MIN_SAMPLES:
+                self._end_window_locked(dt)
+
+    def _end_window_locked(self, dt: float) -> None:
+        avg_lat = self._win_lat_sum / self._win_count
+        qps = self._win_count / dt
+        if self._min_latency_us is None:
+            self._min_latency_us = avg_lat
+        else:
+            # fast decay downward, slow upward: track the no-load floor
+            if avg_lat < self._min_latency_us:
+                self._min_latency_us = avg_lat
+            else:
+                self._min_latency_us += 0.1 * (avg_lat
+                                               - self._min_latency_us)
+        self._max_qps = max(self._max_qps * 0.98, qps)
+        self._windows += 1
+        if self._windows % self.EXPLORE_EVERY == 0:
+            # exploration: drop concurrency so min_latency can re-sample
+            self._limit = max(self._limit * 0.75, 1)
+        else:
+            target = (self._max_qps / 1e6) * \
+                ((2 + self.ALPHA) * self._min_latency_us - avg_lat)
+            if target > 0:
+                self._limit = 0.5 * self._limit + 0.5 * max(target, 1.0)
+        self._win_start = time.monotonic()
+        self._win_count = 0
+        self._win_lat_sum = 0
+
+
+class TimeoutConcurrencyLimiter(ConcurrencyLimiter):
+    """Admit while the queue's expected wait stays under max_wait_ms
+    (≙ timeout_concurrency_limiter.cpp: estimated latency * inflight
+    vs the deadline)."""
+
+    def __init__(self, max_wait_ms: float = 100.0):
+        self.max_wait_us = max_wait_ms * 1000
+        self._inflight = 0
+        self._lat_ema_us = 1000.0
+        self._lock = threading.Lock()
+
+    def on_request(self) -> bool:
+        with self._lock:
+            expected_wait = self._lat_ema_us * self._inflight
+            if expected_wait > self.max_wait_us:
+                return False
+            self._inflight += 1
+            return True
+
+    def on_response(self, latency_us: int, error: bool = False) -> None:
+        with self._lock:
+            self._inflight = max(self._inflight - 1, 0)
+            if not error:
+                self._lat_ema_us += 0.125 * (latency_us - self._lat_ema_us)
+
+
+class Interceptor:
+    """Global accept/reject hook before user code
+    (≙ interceptor.h:26-37)."""
+
+    def __init__(self, fn: Callable[[object], Optional[str]]):
+        """fn(controller) -> None to accept, or an error string to reject."""
+        self.fn = fn
+
+    def process(self, cntl) -> Optional[str]:
+        return self.fn(cntl)

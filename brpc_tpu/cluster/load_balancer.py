@@ -1,0 +1,303 @@
+"""Load balancers (≙ reference load_balancer.h:35-98 + policy/ LBs,
+registered in global.cpp:368-377).
+
+Server lists live in DoublyBufferedData so SelectServer is lock-free against
+concurrent membership updates (the reference's stated reason for DBD,
+load_balancer.h:72).  Feedback (latency/errors) flows back per node for
+locality-aware weighting and circuit-breaker accounting.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import random
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+from brpc_tpu.cluster.naming import ServerNode
+from brpc_tpu.utils.doubly_buffered import DoublyBufferedData
+
+
+class NoServerError(Exception):
+    pass
+
+
+class LoadBalancer:
+    """AddServer/RemoveServer(+batch)/SelectServer/Feedback
+    (≙ load_balancer.h:35-98)."""
+
+    name = "base"
+
+    def __init__(self):
+        self._dbd: DoublyBufferedData[List[ServerNode]] = \
+            DoublyBufferedData(list)
+
+    # membership -----------------------------------------------------------
+    def add_server(self, node: ServerNode) -> None:
+        self.add_servers_in_batch([node])
+
+    def remove_server(self, node: ServerNode) -> None:
+        self.remove_servers_in_batch([node])
+
+    def add_servers_in_batch(self, nodes: Sequence[ServerNode]) -> None:
+        def mod(lst: List[ServerNode]):
+            have = set(lst)
+            lst.extend(n for n in nodes if n not in have)
+            return True
+        self._dbd.modify(mod)
+        self._on_membership()
+
+    def remove_servers_in_batch(self, nodes: Sequence[ServerNode]) -> None:
+        gone = set(nodes)
+
+        def mod(lst: List[ServerNode]):
+            lst[:] = [n for n in lst if n not in gone]
+            return True
+        self._dbd.modify(mod)
+        self._on_membership()
+
+    def servers(self) -> List[ServerNode]:
+        with self._dbd.read() as lst:
+            return list(lst)
+
+    # selection ------------------------------------------------------------
+    def select(self, request_code: int = 0,
+               excluded: Optional[set] = None) -> ServerNode:
+        """≙ SelectServer; excluded = per-call blacklist
+        (excluded_servers.h)."""
+        with self._dbd.read() as lst:
+            if not lst:
+                raise NoServerError(f"no servers in {self.name} LB")
+            node = self._pick(lst, request_code, excluded or ())
+            if node is None:
+                raise NoServerError("all servers excluded")
+            return node
+
+    def feedback(self, node: ServerNode, latency_us: int,
+                 failed: bool) -> None:
+        """≙ LoadBalancer::Feedback — only LA uses it by default."""
+
+    # subclass hooks -------------------------------------------------------
+    def _pick(self, lst, request_code, excluded) -> Optional[ServerNode]:
+        raise NotImplementedError
+
+    def _on_membership(self) -> None:
+        pass
+
+
+def _first_not_excluded(ordered, excluded):
+    for n in ordered:
+        if n not in excluded:
+            return n
+    return None
+
+
+class RoundRobinLB(LoadBalancer):
+    name = "rr"
+
+    def __init__(self):
+        super().__init__()
+        self._i = 0
+        self._lock = threading.Lock()
+
+    def _pick(self, lst, request_code, excluded):
+        with self._lock:
+            start = self._i
+            self._i += 1
+        n = len(lst)
+        return _first_not_excluded(
+            (lst[(start + k) % n] for k in range(n)), excluded)
+
+
+class WeightedRoundRobinLB(LoadBalancer):
+    """Smooth WRR (the nginx algorithm): per-node current weight grows by
+    its static weight each round; the max is picked and decremented by the
+    total (≙ policy/weighted_round_robin_load_balancer.cpp semantics)."""
+
+    name = "wrr"
+
+    def __init__(self):
+        super().__init__()
+        self._cw: Dict[ServerNode, int] = {}
+        self._lock = threading.Lock()
+
+    def _pick(self, lst, request_code, excluded):
+        with self._lock:
+            total = 0
+            best = None
+            for n in lst:
+                if n in excluded:
+                    continue
+                w = max(n.weight, 1)
+                total += w
+                self._cw[n] = self._cw.get(n, 0) + w
+                if best is None or self._cw[n] > self._cw[best]:
+                    best = n
+            if best is not None:
+                self._cw[best] -= total
+            return best
+
+    def _on_membership(self):
+        with self._lock:
+            live = set(self.servers())
+            self._cw = {n: w for n, w in self._cw.items() if n in live}
+
+
+class RandomizedLB(LoadBalancer):
+    name = "random"
+
+    def _pick(self, lst, request_code, excluded):
+        n = len(lst)
+        start = random.randrange(n)
+        return _first_not_excluded(
+            (lst[(start + k) % n] for k in range(n)), excluded)
+
+
+class WeightedRandomLB(LoadBalancer):
+    name = "wrandom"
+
+    def _pick(self, lst, request_code, excluded):
+        cand = [n for n in lst if n not in excluded]
+        if not cand:
+            return None
+        return random.choices(cand,
+                              [max(n.weight, 1) for n in cand])[0]
+
+
+def _hash_md5(data: bytes) -> int:
+    return int.from_bytes(hashlib.md5(data).digest()[:8], "little")
+
+
+def _hash_murmur(data: bytes) -> int:
+    # 64-bit FNV-1a stand-in for murmurhash (same role: cheap, well-mixed;
+    # the reference offers md5/murmur/ketama hashers, policy/hasher.cpp)
+    h = 0xcbf29ce484222325
+    for b in data:
+        h = ((h ^ b) * 0x100000001b3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+class ConsistentHashLB(LoadBalancer):
+    """Ketama-style ring: `replicas` virtual nodes per server; requests with
+    the same request_code stick to the same server across membership churn
+    (≙ policy/consistent_hashing_load_balancer.cpp, 3 hasher variants)."""
+
+    name = "c_md5"
+    replicas = 100
+
+    def __init__(self, hasher: Callable[[bytes], int] = _hash_md5):
+        super().__init__()
+        self._hasher = hasher
+        self._ring: List[int] = []
+        self._ring_nodes: List[ServerNode] = []
+        self._ring_lock = threading.Lock()
+
+    def _on_membership(self):
+        ring = []
+        for node in self.servers():
+            base = str(node.endpoint).encode()
+            for r in range(self.replicas * max(node.weight, 1)):
+                ring.append((self._hasher(base + b"#%d" % r), node))
+        ring.sort(key=lambda t: t[0])
+        with self._ring_lock:
+            self._ring = [h for h, _ in ring]
+            self._ring_nodes = [n for _, n in ring]
+
+    def _pick(self, lst, request_code, excluded):
+        with self._ring_lock:
+            ring, nodes = self._ring, self._ring_nodes
+        if not ring:
+            return None
+        i = bisect.bisect_left(ring, self._hasher(
+            request_code.to_bytes(8, "little", signed=False)))
+        n = len(ring)
+        for k in range(n):
+            node = nodes[(i + k) % n]
+            if node not in excluded:
+                return node
+        return None
+
+
+class ConsistentHashMurmurLB(ConsistentHashLB):
+    name = "c_murmurhash"
+
+    def __init__(self):
+        super().__init__(hasher=_hash_murmur)
+
+
+@dataclass
+class _NodeStat:
+    # EMA of latency + inflight count (≙ locality_aware_load_balancer.cpp
+    # weight = 1 / (latency * inflight); doc docs/cn/lalb.md)
+    latency_ema_us: float = 1000.0
+    inflight: int = 0
+    errors: int = 0
+
+
+class LocalityAwareLB(LoadBalancer):
+    """Weight ∝ 1 / (latency_ema * (inflight + 1)); feedback-driven."""
+
+    name = "la"
+    DECAY = 0.85
+
+    def __init__(self):
+        super().__init__()
+        self._stats: Dict[ServerNode, _NodeStat] = {}
+        self._lock = threading.Lock()
+
+    def _pick(self, lst, request_code, excluded):
+        cand = [n for n in lst if n not in excluded]
+        if not cand:
+            return None
+        with self._lock:
+            weights = []
+            for n in cand:
+                st = self._stats.setdefault(n, _NodeStat())
+                weights.append(1.0 / (max(st.latency_ema_us, 1.0)
+                                      * (st.inflight + 1)))
+            chosen = random.choices(cand, weights)[0]
+            self._stats[chosen].inflight += 1
+            return chosen
+
+    def feedback(self, node: ServerNode, latency_us: int,
+                 failed: bool) -> None:
+        with self._lock:
+            st = self._stats.setdefault(node, _NodeStat())
+            st.inflight = max(st.inflight - 1, 0)
+            if failed:
+                st.errors += 1
+                # punish: treat a failure as a slow response
+                latency_us = max(latency_us, int(st.latency_ema_us * 4), 1)
+            st.latency_ema_us = (self.DECAY * st.latency_ema_us
+                                 + (1 - self.DECAY) * latency_us)
+
+    def _on_membership(self):
+        with self._lock:
+            live = set(self.servers())
+            self._stats = {n: s for n, s in self._stats.items() if n in live}
+
+
+_LB_REGISTRY: Dict[str, Callable[[], LoadBalancer]] = {
+    "rr": RoundRobinLB,
+    "wrr": WeightedRoundRobinLB,
+    "random": RandomizedLB,
+    "wrandom": WeightedRandomLB,
+    "c_md5": ConsistentHashLB,
+    "c_murmurhash": ConsistentHashMurmurLB,
+    "la": LocalityAwareLB,
+}
+
+
+def register_load_balancer(name: str,
+                           factory: Callable[[], LoadBalancer]) -> None:
+    """Extension point (≙ RegisterLoadBalancer, global.cpp:368)."""
+    _LB_REGISTRY[name] = factory
+
+
+def create_load_balancer(name: str) -> LoadBalancer:
+    if name not in _LB_REGISTRY:
+        raise ValueError(f"unknown load balancer '{name}' "
+                         f"(known: {sorted(_LB_REGISTRY)})")
+    return _LB_REGISTRY[name]()

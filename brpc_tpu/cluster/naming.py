@@ -1,0 +1,213 @@
+"""Naming services — cluster membership sources.
+
+≙ reference naming_service.h:36-61 (`RunNamingService` pushing `ResetServers`
+diffs) + details/naming_service_thread.h:58,136 (one shared thread per URL).
+
+A NamingService yields full server lists; the NamingServiceThread diffs them
+and notifies watchers (load balancers) with add/remove batches, so LBs apply
+membership changes without stopping traffic (DoublyBufferedData underneath).
+
+URLs: ``list://ip:port[ tag][,ip:port...]`` (inline),
+``file:///path`` (one "ip:port [tag]" per line, # comments),
+``dns://host:port`` (re-resolved every poll).
+Partition tags "N/M" are parsed by PartitionChannel (parallel/channels.py).
+"""
+
+from __future__ import annotations
+
+import os
+import socket as pysocket
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from brpc_tpu.utils import logging as log
+from brpc_tpu.utils.endpoint import EndPoint, str2endpoint
+
+
+@dataclass(frozen=True)
+class ServerNode:
+    """One cluster member (≙ reference ServerNode: EndPoint + tag)."""
+    endpoint: EndPoint
+    tag: str = ""
+    weight: int = 1
+
+    def __str__(self):
+        return f"{self.endpoint}" + (f" {self.tag}" if self.tag else "")
+
+
+class NamingService:
+    """Subclass and implement get_servers(); poll-style services set
+    poll_interval_s (≙ PeriodicNamingService)."""
+
+    poll_interval_s: float = 5.0
+
+    def __init__(self, param: str):
+        self.param = param
+
+    def get_servers(self) -> List[ServerNode]:
+        raise NotImplementedError
+
+    @staticmethod
+    def parse_nodes(lines: Sequence[str]) -> List[ServerNode]:
+        nodes = []
+        for raw in lines:
+            line = raw.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split(None, 1)
+            ep = str2endpoint(parts[0])
+            tag = parts[1].strip() if len(parts) > 1 else ""
+            nodes.append(ServerNode(ep, tag))
+        return nodes
+
+
+class ListNamingService(NamingService):
+    """list://ip:port[ tag],ip:port[ tag],...  — static inline membership."""
+
+    poll_interval_s = 0.0  # static: resolve once
+
+    def get_servers(self) -> List[ServerNode]:
+        return self.parse_nodes(self.param.split(","))
+
+
+class FileNamingService(NamingService):
+    """file:///path — re-read when mtime changes (reference
+    policy/file_naming_service.cpp watches the file)."""
+
+    poll_interval_s = 0.5
+
+    def get_servers(self) -> List[ServerNode]:
+        with open(self.param) as f:
+            return self.parse_nodes(f.readlines())
+
+
+class DNSNamingService(NamingService):
+    """dns://host:port — getaddrinfo on every poll."""
+
+    poll_interval_s = 5.0
+
+    def get_servers(self) -> List[ServerNode]:
+        host, _, port = self.param.rpartition(":")
+        infos = pysocket.getaddrinfo(host, int(port), pysocket.AF_INET,
+                                     pysocket.SOCK_STREAM)
+        nodes = []
+        seen = set()
+        for info in infos:
+            ip = info[4][0]
+            if ip not in seen:
+                seen.add(ip)
+                nodes.append(ServerNode(EndPoint(ip=ip, port=int(port))))
+        return nodes
+
+
+_NS_REGISTRY: Dict[str, type] = {
+    "list": ListNamingService,
+    "file": FileNamingService,
+    "dns": DNSNamingService,
+}
+
+
+def register_naming_service(scheme: str, cls: type) -> None:
+    """Extension point (≙ RegisterNamingService, global.cpp:352)."""
+    _NS_REGISTRY[scheme] = cls
+
+
+# ---------------------------------------------------------------------------
+# NamingServiceThread — shared per URL, diffs lists, fans out to watchers
+
+
+class Watcher:
+    """Receives membership diffs (≙ NamingServiceActions)."""
+
+    def on_servers(self, added: List[ServerNode],
+                   removed: List[ServerNode],
+                   all_nodes: List[ServerNode]) -> None:
+        raise NotImplementedError
+
+
+class NamingServiceThread:
+    def __init__(self, url: str,
+                 ns_filter: Optional[Callable[[ServerNode], bool]] = None):
+        scheme, _, param = url.partition("://")
+        if scheme not in _NS_REGISTRY:
+            raise ValueError(f"unknown naming scheme '{scheme}://' "
+                             f"(known: {sorted(_NS_REGISTRY)})")
+        self.url = url
+        self.ns = _NS_REGISTRY[scheme](param)
+        self.filter = ns_filter
+        self._watchers: List[Watcher] = []
+        self._nodes: List[ServerNode] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._resolved_once = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name=f"ns:{url}", daemon=True)
+        self._thread.start()
+
+    def add_watcher(self, w: Watcher) -> None:
+        with self._lock:
+            self._watchers.append(w)
+            nodes = list(self._nodes)
+        if nodes:
+            w.on_servers(nodes, [], nodes)
+
+    def remove_watcher(self, w: Watcher) -> None:
+        with self._lock:
+            if w in self._watchers:
+                self._watchers.remove(w)
+
+    def wait_first_resolve(self, timeout_s: float = 5.0) -> bool:
+        return self._resolved_once.wait(timeout_s)
+
+    def nodes(self) -> List[ServerNode]:
+        with self._lock:
+            return list(self._nodes)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _poll_once(self) -> None:
+        try:
+            fresh = self.ns.get_servers()
+        except Exception as e:  # naming outage: keep the last good list
+            log.LOG(log.LOG_WARNING, "naming %s failed: %s", self.url, e)
+            self._resolved_once.set()
+            return
+        if self.filter is not None:
+            fresh = [n for n in fresh if self.filter(n)]
+        with self._lock:
+            old = set(self._nodes)
+            new = set(fresh)
+            added = [n for n in fresh if n not in old]
+            removed = [n for n in self._nodes if n not in new]
+            self._nodes = fresh
+            watchers = list(self._watchers)
+        if added or removed:
+            for w in watchers:
+                w.on_servers(added, removed, fresh)
+        self._resolved_once.set()
+
+    def _run(self) -> None:
+        self._poll_once()
+        interval = self.ns.poll_interval_s
+        if interval <= 0:
+            return  # static list
+        while not self._stop.wait(interval):
+            self._poll_once()
+
+
+_threads: Dict[str, NamingServiceThread] = {}
+_threads_lock = threading.Lock()
+
+
+def get_naming_thread(url: str) -> NamingServiceThread:
+    """Shared per URL (≙ GetNamingServiceThread,
+    details/naming_service_thread.h:136)."""
+    with _threads_lock:
+        t = _threads.get(url)
+        if t is None or not t._thread.is_alive():
+            t = NamingServiceThread(url)
+            _threads[url] = t
+        return t

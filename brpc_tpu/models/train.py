@@ -43,7 +43,8 @@ def make_optimizer(lr: float = 1e-3):
 
 def make_train_step(cfg: ModelConfig, mesh: Optional[Mesh] = None,
                     lr: float = 1e-3, donate: bool = True):
-    """Returns (init_state, step_fn) — both jitted with mesh shardings."""
+    """Returns (optimizer, step_fn): the optax transform (use tx.init(params)
+    to build the opt_state) and the jitted step with mesh shardings."""
     tx = make_optimizer(lr)
 
     def step(state: TrainState, tokens) -> Tuple[TrainState, jax.Array]:

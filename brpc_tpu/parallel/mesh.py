@@ -62,10 +62,11 @@ def make_mesh(axes: Dict[str, int],
 
 def auto_mesh(n_devices: Optional[int] = None,
               axis_names: Sequence[str] = ("dp", "sp", "tp")) -> Mesh:
-    """Factor n devices into the given axes, largest factors innermost.
+    """Factor n devices into the given axes; tp gets a factor first, then
+    dp, sp, ep, pp (see `priority` below).
 
-    8 devices over (dp, sp, tp) → dp=2, sp=2, tp=2; 4 → dp=1, sp=2, tp=2;
-    prime counts degrade gracefully (extra axes get size 1).
+    8 devices over (dp, sp, tp) → dp=2, sp=2, tp=2; 4 → tp=2, dp=2, sp=1;
+    prime counts degrade gracefully (leftover axes get size 1).
     """
     devs = list(jax.devices())
     n = n_devices if n_devices is not None else len(devs)

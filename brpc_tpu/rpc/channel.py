@@ -39,7 +39,10 @@ class RetryPolicy:
     """≙ brpc::RetryPolicy (retry_policy.h): DoRetry decides, backoff_time_us
     spaces the attempts."""
 
-    RETRIABLE = {errors.EFAILEDSOCKET, errors.EOVERCROWDED, errors.EINTERNAL}
+    # ≙ reference DefaultRetryPolicy (retry_policy.cpp): connection-level
+    # and server-unavailable errors retry; ESTOP maps to ELOGOFF
+    RETRIABLE = {errors.EFAILEDSOCKET, errors.EOVERCROWDED,
+                 errors.EINTERNAL, errors.ESTOP}
 
     def do_retry(self, cntl: Controller) -> bool:
         return cntl.error_code in self.RETRIABLE
@@ -148,12 +151,14 @@ class Channel:
         """Synchronous call.  Raises RpcError on failure; returns response
         payload (attachment lands on cntl.response_attachment)."""
         cntl = cntl or Controller()
-        if cntl.timeout_ms is None:
-            cntl.timeout_ms = self.options.timeout_ms
         cntl.reset()
+        # effective knobs: Controller overrides, else ChannelOptions —
+        # computed into locals so a reused Controller keeps None = inherit
+        timeout_ms = (cntl.timeout_ms if cntl.timeout_ms is not None
+                      else self.options.timeout_ms)
         mb = method.encode()
         start = time.monotonic_ns()
-        deadline = start + int(cntl.timeout_ms * 1e6)
+        deadline = start + int(timeout_ms * 1e6)
         policy = self.options.retry_policy or _default_retry
         max_retry = cntl.max_retry if cntl.max_retry is not None \
             else self.options.max_retry

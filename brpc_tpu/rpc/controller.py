@@ -35,6 +35,10 @@ class Controller:
         self.latency_us: int = 0
         self.retried_count: int = 0
         self.backup_fired: bool = False
+        # per-call blacklist shared across this call's retry attempts
+        # (≙ ExcludedServers, excluded_servers.h); cluster layer adds the
+        # node of each failed attempt so retries go elsewhere
+        self.excluded_nodes: set = set()
 
     def failed(self) -> bool:
         return self.error_code != 0
@@ -49,3 +53,4 @@ class Controller:
         self.latency_us = 0
         self.retried_count = 0
         self.backup_fired = False
+        self.excluded_nodes = set()

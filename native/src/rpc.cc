@@ -681,8 +681,19 @@ int EnsureConnected(Channel* c, SocketId* out) {
       ::close(fd);
       return -e;
     }
-    pollfd pfd{fd, POLLOUT, 0};
-    int pr = poll(&pfd, 1, (int)(c->connect_timeout_us / 1000));
+    int64_t deadline = monotonic_ns() + c->connect_timeout_us * 1000;
+    int pr = 0;
+    while (true) {
+      int64_t left_ms = (deadline - monotonic_ns()) / 1000000;
+      if (left_ms < 1) {
+        left_ms = left_ms < 0 ? 0 : 1;  // round sub-ms budgets up, not to 0
+      }
+      pollfd pfd{fd, POLLOUT, 0};
+      pr = poll(&pfd, 1, (int)left_ms);
+      if (pr >= 0 || errno != EINTR || monotonic_ns() >= deadline) {
+        break;
+      }
+    }
     int soerr = 0;
     socklen_t slen = sizeof(soerr);
     if (pr <= 0 ||

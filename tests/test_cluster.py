@@ -1,0 +1,256 @@
+"""Cluster layer tests — LBs/naming/breaker/limiters against real
+in-process servers on loopback ports (≙ reference
+brpc_load_balancer_unittest.cpp:59-445 + brpc_naming_service_unittest:
+multiple loopback servers behind list:// / file:// naming, no mocks)."""
+
+import collections
+import threading
+import time
+
+import pytest
+
+from brpc_tpu.cluster import (
+    AutoConcurrencyLimiter,
+    CircuitBreaker,
+    ConstantConcurrencyLimiter,
+    TimeoutConcurrencyLimiter,
+    create_load_balancer,
+)
+from brpc_tpu.cluster.circuit_breaker import CircuitBreakerOptions
+from brpc_tpu.cluster.load_balancer import NoServerError
+from brpc_tpu.cluster.naming import (
+    FileNamingService,
+    NamingServiceThread,
+    ServerNode,
+    Watcher,
+)
+from brpc_tpu.rpc import Channel, ChannelOptions, Server, errors
+from brpc_tpu.utils.endpoint import EndPoint
+
+
+def _nodes(*ports, weight=1):
+    return [ServerNode(EndPoint(ip="127.0.0.1", port=p), weight=weight)
+            for p in ports]
+
+
+class TestLoadBalancers:
+    def test_rr_cycles_evenly(self):
+        lb = create_load_balancer("rr")
+        lb.add_servers_in_batch(_nodes(1, 2, 3))
+        got = [lb.select().endpoint.port for _ in range(9)]
+        assert collections.Counter(got) == {1: 3, 2: 3, 3: 3}
+
+    def test_rr_excluded(self):
+        lb = create_load_balancer("rr")
+        lb.add_servers_in_batch(_nodes(1, 2))
+        ex = {_nodes(1)[0]}
+        assert all(lb.select(excluded=ex).endpoint.port == 2
+                   for _ in range(4))
+        with pytest.raises(NoServerError):
+            lb.select(excluded=set(_nodes(1, 2)))
+
+    def test_wrr_respects_weights(self):
+        lb = create_load_balancer("wrr")
+        a = ServerNode(EndPoint(ip="127.0.0.1", port=1), weight=3)
+        b = ServerNode(EndPoint(ip="127.0.0.1", port=2), weight=1)
+        lb.add_servers_in_batch([a, b])
+        got = collections.Counter(
+            lb.select().endpoint.port for _ in range(8))
+        assert got == {1: 6, 2: 2}
+
+    def test_random_covers_all(self):
+        lb = create_load_balancer("random")
+        lb.add_servers_in_batch(_nodes(1, 2, 3))
+        got = {lb.select().endpoint.port for _ in range(100)}
+        assert got == {1, 2, 3}
+
+    def test_consistent_hash_sticky(self):
+        lb = create_load_balancer("c_md5")
+        lb.add_servers_in_batch(_nodes(*range(1, 6)))
+        where = {code: lb.select(request_code=code).endpoint.port
+                 for code in range(200)}
+        # same code → same node, always
+        for code, port in where.items():
+            assert lb.select(request_code=code).endpoint.port == port
+        # removing one node remaps only that node's keys (ketama property)
+        victim_port = where[0]
+        lb.remove_server(_nodes(victim_port)[0])
+        moved = sum(1 for code, port in where.items()
+                    if port != victim_port
+                    and lb.select(request_code=code).endpoint.port != port)
+        assert moved == 0
+
+    def test_locality_aware_prefers_fast(self):
+        lb = create_load_balancer("la")
+        fast, slow = _nodes(1, 2)
+        lb.add_servers_in_batch([fast, slow])
+        for _ in range(50):
+            n = lb.select()
+            lb.feedback(n, 100 if n == fast else 20000, failed=False)
+        got = collections.Counter(
+            lb.select().endpoint.port for _ in range(200))
+        # selection itself feeds inflight, so release them
+        assert got[1] > got[2] * 2
+
+    def test_concurrent_select_and_update(self):
+        # ≙ brpc_load_balancer_unittest consistency test: selections under
+        # concurrent membership churn never crash or return ghosts
+        lb = create_load_balancer("rr")
+        lb.add_servers_in_batch(_nodes(*range(1, 9)))
+        valid_ports = set(range(1, 17))
+        stop = threading.Event()
+        errors_seen = []
+
+        def selector():
+            while not stop.is_set():
+                try:
+                    assert lb.select().endpoint.port in valid_ports
+                except NoServerError:
+                    pass
+                except Exception as e:  # pragma: no cover
+                    errors_seen.append(e)
+                    return
+
+        def churner():
+            i = 0
+            while not stop.is_set():
+                batch = _nodes(9 + (i % 8))
+                lb.add_servers_in_batch(batch)
+                lb.remove_servers_in_batch(batch)
+                i += 1
+
+        threads = [threading.Thread(target=selector) for _ in range(4)] + \
+                  [threading.Thread(target=churner) for _ in range(2)]
+        for t in threads:
+            t.start()
+        time.sleep(0.5)
+        stop.set()
+        for t in threads:
+            t.join()
+        assert not errors_seen
+
+
+class TestNaming:
+    def test_list_ns(self):
+        t = NamingServiceThread("list://127.0.0.1:10,127.0.0.1:11 tagA")
+        assert t.wait_first_resolve()
+        nodes = t.nodes()
+        assert [n.endpoint.port for n in nodes] == [10, 11]
+        assert nodes[1].tag == "tagA"
+        t.stop()
+
+    def test_file_ns_watches_updates(self, tmp_path):
+        f = tmp_path / "servers"
+        f.write_text("127.0.0.1:10\n# comment\n127.0.0.1:11\n")
+        diffs = []
+
+        class W(Watcher):
+            def on_servers(self, added, removed, all_nodes):
+                diffs.append((len(added), len(removed)))
+
+        t = NamingServiceThread(f"file://{f}")
+        assert t.wait_first_resolve()
+        t.add_watcher(W())
+        assert len(t.nodes()) == 2
+        time.sleep(0.1)
+        f.write_text("127.0.0.1:11\n127.0.0.1:12\n127.0.0.1:13\n")
+        deadline = time.time() + 5
+        while len(t.nodes()) != 3 and time.time() < deadline:
+            time.sleep(0.05)
+        assert [n.endpoint.port for n in t.nodes()] == [11, 12, 13]
+        assert (2, 1) in diffs  # +12,+13 / -10
+        t.stop()
+
+
+class TestCircuitBreaker:
+    def test_isolates_on_errors_and_doubles(self):
+        opt = CircuitBreakerOptions(min_isolation_s=0.05, max_isolation_s=1.0)
+        br = CircuitBreaker(opt)
+        for _ in range(100):
+            br.on_call_end(100, failed=True)
+        assert br.is_isolated()
+        assert br.isolated_times >= 1
+        first = br.remaining_isolation_s()
+        # trip again: duration doubled
+        time.sleep(first + 0.01)
+        for _ in range(100):
+            br.on_call_end(100, failed=True)
+        assert br.remaining_isolation_s() > first
+        br.mark_recovered()
+        assert not br.is_isolated()
+
+    def test_healthy_node_stays_closed(self):
+        br = CircuitBreaker()
+        for _ in range(500):
+            assert br.on_call_end(100, failed=False)
+        assert not br.is_isolated()
+
+
+class TestLimiters:
+    def test_constant(self):
+        lim = ConstantConcurrencyLimiter(2)
+        assert lim.on_request() and lim.on_request()
+        assert not lim.on_request()
+        lim.on_response(100)
+        assert lim.on_request()
+
+    def test_timeout_limiter_rejects_long_queue(self):
+        lim = TimeoutConcurrencyLimiter(max_wait_ms=1.0)
+        # teach it ~10ms latency
+        for _ in range(20):
+            assert lim.on_request()
+            lim.on_response(10_000)
+        admitted = 0
+        while lim.on_request():
+            admitted += 1
+            assert admitted < 100
+        assert admitted <= 1  # expected wait 10ms > 1ms budget after 1
+
+    def test_auto_limiter_tracks_load(self):
+        lim = AutoConcurrencyLimiter(max_concurrency=8)
+        for _ in range(300):
+            if lim.on_request():
+                lim.on_response(200)
+        assert lim.max_concurrency >= 1
+
+
+class TestClusterChannel:
+    @pytest.fixture()
+    def trio(self):
+        servers, ports = [], []
+        for i in range(3):
+            s = Server()
+
+            def handler(cntl, req, i=i):
+                return b"srv%d" % i
+
+            s.add_service("Who", handler)
+            s.start("127.0.0.1:0")
+            servers.append(s)
+            ports.append(s.port)
+        yield servers, ports
+        for s in servers:
+            s.stop()
+
+    def test_rr_spreads_across_cluster(self, trio):
+        servers, ports = trio
+        url = "list://" + ",".join(f"127.0.0.1:{p}" for p in ports)
+        ch = Channel(url, load_balancer="rr")
+        got = collections.Counter(ch.call("Who.ami") for _ in range(9))
+        assert sum(got.values()) == 9
+        assert len(got) == 3  # every server saw traffic
+        ch.close()
+
+    def test_failover_when_one_dies(self, trio):
+        servers, ports = trio
+        url = "list://" + ",".join(f"127.0.0.1:{p}" for p in ports)
+        ch = Channel(url, ChannelOptions(timeout_ms=2000, max_retry=3,
+                                         load_balancer="rr"))
+        assert ch.call("Who.ami").startswith(b"srv")
+        servers[0].stop()
+        # every call must still succeed: retries skip the dead node and the
+        # circuit breaker isolates it
+        oks = sum(
+            1 for _ in range(12) if ch.call("Who.ami").startswith(b"srv"))
+        assert oks == 12
+        ch.close()

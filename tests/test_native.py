@@ -26,9 +26,12 @@ class TestFiberPython:
     def test_init_and_stats(self):
         from brpc_tpu import fiber
         n = fiber.init(2)
-        assert fiber.workers() >= 2 or n == 0  # 0 if already started wider
+        # n == 0 means the runtime was already up (another test started it,
+        # possibly narrower on a 1-core host); init is then a no-op
+        if n != 0:
+            assert fiber.workers() >= 2
         s = fiber.stats()
-        assert s["workers"] >= 2
+        assert s["workers"] == fiber.workers() >= 1
 
     def test_start_join(self):
         from brpc_tpu import fiber
