@@ -122,6 +122,37 @@ def _declare(L: ctypes.CDLL) -> None:
     L.trpc_result_attachment.restype = c.c_size_t
     L.trpc_result_destroy.argtypes = [c.c_void_p]
 
+    # streaming RPC
+    L.trpc_channel_call_stream.argtypes = [c.c_void_p, c.c_char_p, c.c_char_p,
+                                           c.c_size_t, c.c_char_p, c.c_size_t,
+                                           c.c_int64, c.c_uint64,
+                                           c.POINTER(c.c_void_p)]
+    L.trpc_channel_call_stream.restype = c.c_int
+    L.trpc_stream_create.argtypes = [c.c_uint64]
+    L.trpc_stream_create.restype = c.c_uint64
+    L.trpc_token_stream_id.argtypes = [c.c_uint64]
+    L.trpc_token_stream_id.restype = c.c_uint64
+    L.trpc_stream_accept.argtypes = [c.c_uint64, c.c_uint64]
+    L.trpc_stream_accept.restype = c.c_uint64
+    L.trpc_stream_write.argtypes = [c.c_uint64, c.c_char_p, c.c_size_t,
+                                    c.c_int64]
+    L.trpc_stream_write.restype = c.c_int
+    L.trpc_stream_read.argtypes = [c.c_uint64, c.c_int64,
+                                   c.POINTER(c.POINTER(c.c_uint8))]
+    L.trpc_stream_read.restype = c.c_int64
+    L.trpc_stream_buf_free.argtypes = [c.POINTER(c.c_uint8)]
+    L.trpc_stream_buf_free.restype = None
+    L.trpc_stream_close.argtypes = [c.c_uint64]
+    L.trpc_stream_close.restype = c.c_int
+    L.trpc_stream_destroy.argtypes = [c.c_uint64]
+    L.trpc_stream_destroy.restype = None
+    L.trpc_stream_remote_closed.argtypes = [c.c_uint64]
+    L.trpc_stream_remote_closed.restype = c.c_int
+    L.trpc_stream_failed.argtypes = [c.c_uint64]
+    L.trpc_stream_failed.restype = c.c_int
+    L.trpc_stream_pending_bytes.argtypes = [c.c_uint64]
+    L.trpc_stream_pending_bytes.restype = c.c_int64
+
     # bench
     L.trpc_run_echo_bench.argtypes = [c.c_char_p, c.c_int, c.c_int, c.c_int,
                                       c.c_int, c.c_int, c.c_double,

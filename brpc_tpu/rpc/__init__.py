@@ -7,3 +7,5 @@ from brpc_tpu.rpc.errors import (  # noqa: F401
 from brpc_tpu.rpc.controller import Controller  # noqa: F401
 from brpc_tpu.rpc.channel import Channel, ChannelOptions  # noqa: F401
 from brpc_tpu.rpc.server import Server, ServerOptions  # noqa: F401
+from brpc_tpu.rpc.stream import (  # noqa: F401
+    Stream, StreamClosed, StreamTimeout)

@@ -51,6 +51,22 @@ class RetryPolicy:
         return 0  # no backoff by default (≙ reference default policy)
 
 
+def _unpack_result(L, rc: int, result) -> Tuple[int, str, bytes, bytes]:
+    """Drain and free a native CallResult."""
+    try:
+        code = L.trpc_result_error_code(result)
+        text = L.trpc_result_error_text(result).decode(
+            "utf-8", "replace") if code else ""
+        p = ctypes.POINTER(ctypes.c_uint8)()
+        n = L.trpc_result_data(result, ctypes.byref(p))
+        data = ctypes.string_at(p, n) if n else b""
+        n2 = L.trpc_result_attachment(result, ctypes.byref(p))
+        att = ctypes.string_at(p, n2) if n2 else b""
+        return (rc if rc else code), text, data, att
+    finally:
+        L.trpc_result_destroy(result)
+
+
 class _NativeCall:
     """One sync call against one native channel handle."""
 
@@ -60,25 +76,21 @@ class _NativeCall:
         self.handle = handle
 
     def call(self, method: bytes, payload: bytes, attachment: bytes,
-             timeout_us: int) -> Tuple[int, str, bytes, bytes]:
+             timeout_us: int,
+             stream_handle: int = 0) -> Tuple[int, str, bytes, bytes]:
         L = lib()
         result = ctypes.c_void_p()
-        rc = L.trpc_channel_call(
-            self.handle, method, payload, len(payload),
-            attachment if attachment else None, len(attachment),
-            timeout_us, ctypes.byref(result))
-        try:
-            code = L.trpc_result_error_code(result)
-            text = L.trpc_result_error_text(result).decode(
-                "utf-8", "replace") if code else ""
-            p = ctypes.POINTER(ctypes.c_uint8)()
-            n = L.trpc_result_data(result, ctypes.byref(p))
-            data = ctypes.string_at(p, n) if n else b""
-            n2 = L.trpc_result_attachment(result, ctypes.byref(p))
-            att = ctypes.string_at(p, n2) if n2 else b""
-            return (rc if rc else code), text, data, att
-        finally:
-            L.trpc_result_destroy(result)
+        if stream_handle:
+            rc = L.trpc_channel_call_stream(
+                self.handle, method, payload, len(payload),
+                attachment if attachment else None, len(attachment),
+                timeout_us, stream_handle, ctypes.byref(result))
+        else:
+            rc = L.trpc_channel_call(
+                self.handle, method, payload, len(payload),
+                attachment if attachment else None, len(attachment),
+                timeout_us, ctypes.byref(result))
+        return _unpack_result(L, rc, result)
 
 
 class SubChannel:
@@ -100,8 +112,12 @@ class SubChannel:
         self._closed = False
 
     def call_once(self, method: bytes, payload: bytes, attachment: bytes,
-                  timeout_us: int):
-        return self._native.call(method, payload, attachment, timeout_us)
+                  timeout_us: int, stream_handle: int = 0):
+        """One attempt.  A nonzero stream_handle makes this the streaming
+        handshake (≙ StreamCreate riding CallMethod via stream_settings,
+        baidu_rpc_meta.proto:16)."""
+        return self._native.call(method, payload, attachment, timeout_us,
+                                 stream_handle)
 
     def close(self):
         with self._lock:
@@ -243,6 +259,38 @@ class Channel:
                 if left <= 0:
                     return (errors.ERPCTIMEDOUT, "", b"", b"")
                 cond.wait(left)
+
+    # -- streaming (≙ StreamCreate + CallMethod handshake, stream.cpp:773) --
+
+    def create_stream(self, method: str, payload: bytes = b"",
+                      attachment: bytes = b"", window: Optional[int] = None,
+                      cntl: Optional[Controller] = None):
+        """Issue `method` with a stream attached.  Returns
+        ``(response_bytes, Stream)``; the server handler must call
+        ``cntl.accept_stream()``.  The stream is pinned to the chosen
+        connection for its whole life (no retries across servers)."""
+        from brpc_tpu.rpc import stream as _stream
+        cntl = cntl or Controller()
+        cntl.reset()
+        timeout_ms = (cntl.timeout_ms if cntl.timeout_ms is not None
+                      else self.options.timeout_ms)
+        timeout_us = int(timeout_ms * 1000)
+        handle = lib().trpc_stream_create(window or _stream.DEFAULT_WINDOW)
+        # the cluster path keeps its LB/breaker/health bookkeeping (the
+        # handshake is a normal one-attempt call with a stream attached)
+        if self._cluster is not None:
+            code, text, data, att = self._cluster.call_once(
+                method.encode(), payload, attachment, timeout_us, cntl,
+                stream_handle=handle)
+        else:
+            code, text, data, att = self._sub.call_once(
+                method.encode(), payload, attachment, timeout_us, handle)
+        cntl.error_code, cntl.error_text = code, text
+        cntl.response_attachment = att
+        if code != 0:
+            lib().trpc_stream_destroy(handle)
+            raise errors.RpcError(code, text)
+        return data, _stream.Stream(handle)
 
     def close(self):
         if self._sub is not None:

@@ -39,6 +39,26 @@ class Controller:
         # (≙ ExcludedServers, excluded_servers.h); cluster layer adds the
         # node of each failed attempt so retries go elsewhere
         self.excluded_nodes: set = set()
+        # server-side streaming: the pending-call token, set by the server
+        # dispatcher when the request carries a stream handshake
+        self._stream_token: Optional[int] = None
+
+    def has_stream(self) -> bool:
+        """True if the client attached a stream to this request."""
+        if self._stream_token is None:
+            return False
+        from brpc_tpu.rpc import stream as _stream
+        return _stream.token_has_stream(self._stream_token)
+
+    def accept_stream(self, window: Optional[int] = None):
+        """Accept the request's stream (≙ StreamAccept, stream.cpp:802).
+        Returns a rpc.stream.Stream usable from any thread; the handshake
+        completes when the handler's response is sent."""
+        if self._stream_token is None:
+            return None
+        from brpc_tpu.rpc import stream as _stream
+        return _stream.accept_from_token(
+            self._stream_token, window or _stream.DEFAULT_WINDOW)
 
     def failed(self) -> bool:
         return self.error_code != 0

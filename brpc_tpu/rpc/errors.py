@@ -14,6 +14,7 @@ ESTOP = 1012
 EINTERNAL = 2001
 EOVERCROWDED = 2004
 ELIMIT = 2005
+ESTREAMUNACCEPTED = 2006
 
 _TEXT = {
     OK: "OK",
@@ -27,6 +28,7 @@ _TEXT = {
     EINTERNAL: "server-side exception",
     EOVERCROWDED: "too many buffered writes",
     ELIMIT: "rejected by concurrency limiter",
+    ESTREAMUNACCEPTED: "server did not accept the stream",
 }
 
 

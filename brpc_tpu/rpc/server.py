@@ -109,6 +109,7 @@ class Server:
                 status.errors.add(1)
                 return
             cntl = Controller()
+            cntl._stream_token = token
             cntl.method = method.decode() if method else name
             req = ctypes.string_at(req_p, req_len) if req_len else b""
             cntl.request_attachment = (

@@ -8,6 +8,7 @@
 #include "fiber.h"
 #include "iobuf.h"
 #include "rpc.h"
+#include "stream.h"
 
 using namespace trpc;
 
@@ -135,6 +136,46 @@ size_t trpc_result_attachment(void* r, const uint8_t** p) {
   return cr->attachment.size();
 }
 void trpc_result_destroy(void* r) { delete (CallResult*)r; }
+
+int trpc_channel_call_stream(void* c, const char* method, const uint8_t* req,
+                             size_t req_len, const uint8_t* attach,
+                             size_t attach_len, int64_t timeout_us,
+                             uint64_t stream, void** result) {
+  CallResult* r = new CallResult();
+  int rc = channel_call((Channel*)c, method, req, req_len, attach,
+                        attach_len, timeout_us, r, stream);
+  *result = r;
+  return rc;
+}
+
+// --- streaming RPC (stream.h) ----------------------------------------------
+
+uint64_t trpc_stream_create(uint64_t window_bytes) {
+  return stream_create(window_bytes);
+}
+uint64_t trpc_token_stream_id(uint64_t token) {
+  return token_stream_id(token);
+}
+uint64_t trpc_stream_accept(uint64_t token, uint64_t window_bytes) {
+  return stream_accept(token, window_bytes);
+}
+int trpc_stream_write(uint64_t h, const uint8_t* data, size_t len,
+                      int64_t timeout_us) {
+  return stream_write(h, data, len, timeout_us);
+}
+// Returns msg length (>=0), 0 = clean EOF, <0 = -errno.  *out must be
+// freed with trpc_stream_buf_free.
+int64_t trpc_stream_read(uint64_t h, int64_t timeout_us, uint8_t** out) {
+  return (int64_t)stream_read(h, timeout_us, out);
+}
+void trpc_stream_buf_free(uint8_t* p) { stream_buf_free(p); }
+int trpc_stream_close(uint64_t h) { return stream_close(h); }
+void trpc_stream_destroy(uint64_t h) { stream_destroy(h); }
+int trpc_stream_remote_closed(uint64_t h) { return stream_remote_closed(h); }
+int trpc_stream_failed(uint64_t h) { return stream_failed(h); }
+int64_t trpc_stream_pending_bytes(uint64_t h) {
+  return stream_pending_bytes(h);
+}
 
 // --- bench -----------------------------------------------------------------
 

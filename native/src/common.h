@@ -43,6 +43,7 @@ enum ErrorCode {
   TRPC_EINTERNAL = 2001,      // server-side user exception
   TRPC_EOVERCROWDED = 2004,   // too many buffered writes (≙ brpc EOVERCROWDED)
   TRPC_ELIMIT = 2005,         // concurrency limiter rejected (≙ brpc ELIMIT)
+  TRPC_ESTREAMUNACCEPTED = 2006,  // handshake RPC ok but no StreamAccept
 };
 
 // xorshift per-thread fast random (≙ butil fast_rand).

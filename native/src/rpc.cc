@@ -20,6 +20,7 @@
 #include <vector>
 
 #include "object_pool.h"
+#include "stream.h"
 #include "timer_thread.h"
 
 namespace trpc {
@@ -194,6 +195,12 @@ struct CallCtx {
   std::string attachment;
   HandlerCb cb = nullptr;
   void* user = nullptr;
+  // streaming handshake: the request's stream_id (client handle) + its
+  // advertised receive window, and the stream handle created by
+  // stream_accept() for the response meta
+  uint64_t req_stream_id = 0;
+  uint64_t req_stream_window = 0;
+  uint64_t accepted_stream = 0;
   uint32_t slot = 0;
   std::atomic<uint32_t> version{1};
 
@@ -291,7 +298,8 @@ namespace {
 
 void SendResponse(SocketId sock_id, uint64_t correlation_id,
                   int32_t error_code, const char* error_text, IOBuf&& payload,
-                  IOBuf&& attachment) {
+                  IOBuf&& attachment, uint64_t stream_id = 0,
+                  uint64_t stream_window = 0) {
   Socket* s = Socket::Address(sock_id);
   if (s == nullptr) {
     return;
@@ -302,6 +310,8 @@ void SendResponse(SocketId sock_id, uint64_t correlation_id,
   if (error_text != nullptr) {
     meta.error_text = error_text;
   }
+  meta.stream_id = stream_id;  // accepted-stream handle rides the response
+  meta.feedback_bytes = stream_window;  // its advertised receive window
   meta.flags = 1;  // response
   IOBuf frame;
   PackFrame(&frame, meta, std::move(payload), std::move(attachment));
@@ -329,6 +339,10 @@ void ServerOnMessages(Socket* s) {
     if (rc < 0) {
       s->SetFailed(TRPC_EREQUEST);
       return;
+    }
+    if (meta.stream_frame_type != STREAM_FRAME_NONE) {
+      StreamHandleFrame(meta, std::move(payload));
+      continue;
     }
     if (!srv->running.load(std::memory_order_acquire)) {
       // stopping: refuse new requests (≙ ESTOP after Server::Stop)
@@ -360,6 +374,9 @@ void ServerOnMessages(Socket* s) {
       uint32_t slot = ResourcePool<CallCtx>::Get(&ctx);
       ctx->slot = slot;
       ctx->sock = s->id();
+      ctx->req_stream_id = meta.stream_id;
+      ctx->req_stream_window = meta.feedback_bytes;
+      ctx->accepted_stream = 0;
       ctx->correlation_id = meta.correlation_id;
       ctx->method = std::move(meta.method);
       ctx->payload = payload.to_string();
@@ -375,6 +392,7 @@ void ServerOnMessages(Socket* s) {
 }
 
 void ServerConnFailed(Socket* s) {
+  StreamsOnSocketFailed(s->id());
   Server* srv = (Server*)s->user;
   std::lock_guard<std::mutex> lk(srv->conns_mu);
   srv->conns.erase(s->id());
@@ -539,13 +557,55 @@ int respond(uint64_t token, int32_t error_code, const char* error_text,
   if (attach != nullptr && attach_len > 0) {
     attachment.append(attach, attach_len);
   }
+  uint64_t accepted = ctx->accepted_stream;
+  if (error_code != 0 && accepted != 0) {
+    // error response: the client will never bind its half, so the
+    // accepted server half would leak with readers parked forever —
+    // fail it (wakes them) and don't advertise it in the response
+    stream_mark_failed(accepted);
+    accepted = 0;
+  }
   SendResponse(ctx->sock, ctx->correlation_id, error_code, error_text,
-               std::move(payload), std::move(attachment));
+               std::move(payload), std::move(attachment), accepted,
+               accepted != 0 ? stream_window(accepted) : 0);
   ctx->version.fetch_add(1, std::memory_order_release);  // invalidate token
   ctx->payload.clear();
   ctx->attachment.clear();
   ResourcePool<CallCtx>::Return(slot);
   return 0;
+}
+
+// The request's stream handle (0 if the client attached no stream).
+uint64_t token_stream_id(uint64_t token) {
+  uint32_t slot = (uint32_t)token;
+  uint32_t ver = (uint32_t)(token >> 32);
+  CallCtx* ctx = ResourcePool<CallCtx>::Address(slot);
+  if (ctx == nullptr ||
+      ctx->version.load(std::memory_order_acquire) != ver) {
+    return 0;
+  }
+  return ctx->req_stream_id;
+}
+
+// Accept the request's stream: creates the server half bound to the same
+// connection; its handle rides the response meta (≙ StreamAccept,
+// stream.cpp:802).  Call before respond().
+uint64_t stream_accept(uint64_t token, uint64_t window_bytes) {
+  uint32_t slot = (uint32_t)token;
+  uint32_t ver = (uint32_t)(token >> 32);
+  CallCtx* ctx = ResourcePool<CallCtx>::Address(slot);
+  if (ctx == nullptr ||
+      ctx->version.load(std::memory_order_acquire) != ver ||
+      ctx->req_stream_id == 0) {
+    return 0;
+  }
+  if (ctx->accepted_stream != 0) {
+    return ctx->accepted_stream;  // idempotent: second accept returns first
+  }
+  uint64_t h = stream_accept_on(ctx->sock, ctx->req_stream_id, window_bytes,
+                                ctx->req_stream_window);
+  ctx->accepted_stream = h;
+  return h;
 }
 
 // ---------------------------------------------------------------------------
@@ -559,6 +619,8 @@ struct PendingCall {
   std::string error_text;
   IOBuf response;
   IOBuf attachment;
+  uint64_t stream_id = 0;      // server's accepted-stream handle, if any
+  uint64_t stream_window = 0;  // its advertised receive window
 };
 
 }  // namespace
@@ -580,6 +642,7 @@ namespace {
 
 // Fail every pending call of this channel (connection broke).
 void ChannelOnSocketFailed(Socket* s) {
+  StreamsOnSocketFailed(s->id());
   Channel* c = (Channel*)s->user;
   std::vector<std::pair<uint64_t, PendingCall*>> all;
   {
@@ -625,6 +688,10 @@ void ChannelOnMessages(Socket* s) {
       s->SetFailed(TRPC_EREQUEST);
       return;
     }
+    if (meta.stream_frame_type != STREAM_FRAME_NONE) {
+      StreamHandleFrame(meta, std::move(payload));
+      continue;
+    }
     PendingCall* pc = nullptr;
     {
       std::lock_guard<std::mutex> lk(c->map_mu);
@@ -635,12 +702,25 @@ void ChannelOnMessages(Socket* s) {
       }
     }
     if (pc == nullptr) {
-      continue;  // late response after timeout: drop (≙ EREFUSED path)
+      // late response after timeout: drop (≙ EREFUSED path) — but if it
+      // carries an accepted-stream handle, tell the server to close that
+      // half, or its readers would park forever on a healthy connection
+      if (meta.stream_id != 0) {
+        RpcMeta close_meta;
+        close_meta.stream_id = meta.stream_id;
+        close_meta.stream_frame_type = STREAM_FRAME_CLOSE;
+        IOBuf frame;
+        PackFrame(&frame, close_meta, IOBuf(), IOBuf());
+        s->Write(std::move(frame));
+      }
+      continue;
     }
     pc->error_code = meta.error_code;
     pc->error_text = std::move(meta.error_text);
     pc->response = std::move(payload);
     pc->attachment = std::move(attachment);
+    pc->stream_id = meta.stream_id;
+    pc->stream_window = meta.feedback_bytes;
     butex_value(pc->done).store(1, std::memory_order_release);
     butex_wake_all(pc->done);
   }
@@ -770,7 +850,7 @@ void channel_destroy(Channel* c) {
 
 int channel_call(Channel* c, const char* method, const uint8_t* req,
                  size_t req_len, const uint8_t* attach, size_t attach_len,
-                 int64_t timeout_us, CallResult* out) {
+                 int64_t timeout_us, CallResult* out, uint64_t stream) {
   SocketId sid;
   int rc = EnsureConnected(c, &sid);
   if (rc != 0) {
@@ -794,6 +874,8 @@ int channel_call(Channel* c, const char* method, const uint8_t* req,
   pc->error_text.clear();
   pc->response.clear();
   pc->attachment.clear();
+  pc->stream_id = 0;
+  pc->stream_window = 0;
   {
     std::lock_guard<std::mutex> lk(c->map_mu);
     c->pending[corr] = pc;
@@ -801,6 +883,10 @@ int channel_call(Channel* c, const char* method, const uint8_t* req,
   RpcMeta meta;
   meta.method = method;
   meta.correlation_id = corr;
+  meta.stream_id = stream;  // client stream handle rides the request
+  if (stream != 0) {
+    meta.feedback_bytes = stream_window(stream);  // advertise recv window
+  }
   IOBuf payload, attachment, frame;
   if (req != nullptr && req_len > 0) {
     payload.append(req, req_len);
@@ -852,6 +938,16 @@ int channel_call(Channel* c, const char* method, const uint8_t* req,
       }
     }
     result = pc->error_code;
+  }
+  if (stream != 0 && result == 0) {
+    if (pc->stream_id != 0) {
+      stream_bind(stream, sid, pc->stream_id, pc->stream_window);
+    } else {
+      // RPC succeeded but the handler never called StreamAccept
+      result = TRPC_ESTREAMUNACCEPTED;
+      pc->error_code = result;
+      pc->error_text = "server did not accept the stream";
+    }
   }
   if (out != nullptr) {
     out->error_code = pc->error_code;

@@ -107,9 +107,20 @@ struct CallResult {
 };
 
 // Synchronous call (from fiber or pthread).  Returns 0 or error code.
+// `stream` (optional): a stream_create() handle to attach — the streaming
+// handshake rides this RPC (stream.h); on success the stream is bound to
+// the connection and the server's accepted-stream handle.
 int channel_call(Channel* c, const char* method, const uint8_t* req,
                  size_t req_len, const uint8_t* attach, size_t attach_len,
-                 int64_t timeout_us, CallResult* out);
+                 int64_t timeout_us, CallResult* out, uint64_t stream = 0);
+
+// --- streaming handshake helpers (server side; see stream.h) --------------
+
+// The request's stream handle (0 if the client attached no stream).
+uint64_t token_stream_id(uint64_t token);
+// Accept the pending request's stream before respond(); returns the
+// server-side stream handle (0 on failure).
+uint64_t stream_accept(uint64_t token, uint64_t window_bytes);
 
 // --- in-process echo bench (hot path stays fully native) -------------------
 

@@ -1,0 +1,469 @@
+// stream.cc — see stream.h.  Memory model: Stream objects live in a
+// ResourcePool (slabs are immortal), addressed by versioned handles the
+// way Sockets and call tokens are — any racer that dereferences a stale
+// handle re-checks the version under the stream mutex and bails, so no
+// operation ever touches freed memory (≙ the reference's versioned
+// SocketId ABA discipline, socket.h:808).
+#include "stream.h"
+
+#include <cerrno>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+#include "fiber.h"
+#include "object_pool.h"
+#include "rpc.h"
+
+namespace trpc {
+
+namespace {
+
+constexpr uint64_t kDefaultWindow = 2u << 20;  // 2 MiB, like a sane TCP wnd
+
+struct Stream {
+  uint32_t slot = 0;
+  std::atomic<uint32_t> version{1};
+
+  std::mutex mu;
+  SocketId sock = INVALID_SOCKET_ID;
+  uint64_t remote_id = 0;
+  uint64_t window = kDefaultWindow;       // our receive window (advertised)
+  uint64_t peer_window = kDefaultWindow;  // peer's, learned in handshake
+  bool connected = false;
+  bool local_closed = false;   // we sent CLOSE (no more writes)
+  bool remote_closed = false;  // peer sent CLOSE (reads drain then EOF)
+  bool sock_failed = false;
+
+  // flow control: cumulative counters; writer waits on ack_butex
+  uint64_t bytes_sent = 0;
+  uint64_t bytes_acked = 0;
+  // receive side: consumed counter drives Feedback frames
+  std::deque<std::string> rq;
+  uint64_t rq_bytes = 0;
+  uint64_t consumed = 0;
+  uint64_t last_feedback = 0;
+
+  // both butexes: value is a bump counter; any state change bumps+wakes
+  Butex* ack_butex = nullptr;
+  Butex* recv_butex = nullptr;
+
+  uint64_t handle() const {
+    return ((uint64_t)version.load(std::memory_order_relaxed) << 32) | slot;
+  }
+};
+
+// socket -> streams bound to it (for failure propagation)
+std::mutex g_sock_streams_mu;
+std::unordered_map<SocketId, std::vector<StreamHandle>> g_sock_streams;
+
+void register_on_socket(SocketId sid, StreamHandle h) {
+  std::lock_guard<std::mutex> lk(g_sock_streams_mu);
+  g_sock_streams[sid].push_back(h);
+}
+
+void unregister_on_socket(SocketId sid, StreamHandle h) {
+  std::lock_guard<std::mutex> lk(g_sock_streams_mu);
+  auto it = g_sock_streams.find(sid);
+  if (it == g_sock_streams.end()) {
+    return;
+  }
+  auto& v = it->second;
+  for (size_t i = 0; i < v.size(); ++i) {
+    if (v[i] == h) {
+      v[i] = v.back();
+      v.pop_back();
+      break;
+    }
+  }
+  if (v.empty()) {
+    g_sock_streams.erase(it);
+  }
+}
+
+// Address a handle; returns the Stream with mu HELD and version verified,
+// or nullptr.  Caller must unlock.
+Stream* address_locked(StreamHandle h) {
+  uint32_t slot = (uint32_t)h;
+  uint32_t ver = (uint32_t)(h >> 32);
+  if (ver == 0) {
+    return nullptr;
+  }
+  Stream* st = ResourcePool<Stream>::Address(slot);
+  if (st == nullptr) {
+    return nullptr;
+  }
+  st->mu.lock();
+  if (st->version.load(std::memory_order_acquire) != ver) {
+    st->mu.unlock();
+    return nullptr;
+  }
+  return st;
+}
+
+void bump_wake(Butex* b) {
+  butex_value(b).fetch_add(1, std::memory_order_acq_rel);
+  butex_wake_all(b);
+}
+
+// Send a control/data frame on the stream's socket.  st->mu must NOT be
+// held (Socket::Write may run KeepWrite inline).
+int send_stream_frame(SocketId sock, uint64_t peer_id, uint8_t frame_type,
+                      IOBuf&& payload, uint64_t feedback_bytes) {
+  Socket* s = Socket::Address(sock);
+  if (s == nullptr) {
+    return -ECONNRESET;
+  }
+  RpcMeta meta;
+  meta.stream_id = peer_id;
+  meta.stream_frame_type = frame_type;
+  meta.feedback_bytes = feedback_bytes;
+  IOBuf frame;
+  PackFrame(&frame, meta, std::move(payload), IOBuf());
+  int rc = s->Write(std::move(frame));
+  s->Dereference();
+  return rc;
+}
+
+// Wait on a bump-counter butex until its value differs from `seen` or the
+// deadline passes.  Returns 0 (changed) or -EAGAIN (timeout).
+int wait_bump(Butex* b, int32_t seen, int64_t deadline_us) {
+  while (butex_value(b).load(std::memory_order_acquire) == seen) {
+    int64_t left = deadline_us < 0 ? -1 : deadline_us - monotonic_us();
+    if (deadline_us >= 0 && left <= 0) {
+      return -EAGAIN;
+    }
+    if (butex_wait(b, seen, left) != 0 && errno == ETIMEDOUT) {
+      return -EAGAIN;
+    }
+  }
+  return 0;
+}
+
+}  // namespace
+
+StreamHandle stream_create(uint64_t window_bytes) {
+  Stream* st = nullptr;
+  uint32_t slot = ResourcePool<Stream>::Get(&st);
+  std::lock_guard<std::mutex> lk(st->mu);
+  st->slot = slot;
+  if (st->ack_butex == nullptr) {
+    st->ack_butex = butex_create();
+    st->recv_butex = butex_create();
+  }
+  st->sock = INVALID_SOCKET_ID;
+  st->remote_id = 0;
+  st->window = window_bytes > 0 ? window_bytes : kDefaultWindow;
+  st->peer_window = kDefaultWindow;
+  st->connected = false;
+  st->local_closed = false;
+  st->remote_closed = false;
+  st->sock_failed = false;
+  st->bytes_sent = st->bytes_acked = 0;
+  st->rq.clear();
+  st->rq_bytes = 0;
+  st->consumed = st->last_feedback = 0;
+  return st->handle();
+}
+
+int stream_bind(StreamHandle h, SocketId sock, uint64_t remote_id,
+                uint64_t peer_window) {
+  Stream* st = address_locked(h);
+  if (st == nullptr) {
+    return -EINVAL;
+  }
+  st->sock = sock;
+  st->remote_id = remote_id;
+  st->peer_window = peer_window > 0 ? peer_window : kDefaultWindow;
+  st->connected = true;
+  st->mu.unlock();
+  register_on_socket(sock, h);
+  // Close the register-vs-SetFailed race: if the socket died before we
+  // registered, its StreamsOnSocketFailed sweep missed us — detect the
+  // dead socket (Address returns nullptr after SetFailed) and self-fail.
+  Socket* s = Socket::Address(sock);
+  if (s == nullptr) {
+    stream_mark_failed(h);
+  } else {
+    s->Dereference();
+  }
+  return 0;
+}
+
+uint64_t stream_window(StreamHandle h) {
+  Stream* st = address_locked(h);
+  if (st == nullptr) {
+    return 0;
+  }
+  uint64_t w = st->window;
+  st->mu.unlock();
+  return w;
+}
+
+StreamHandle stream_accept_on(SocketId sock, uint64_t remote_id,
+                              uint64_t window_bytes, uint64_t peer_window) {
+  StreamHandle h = stream_create(window_bytes);
+  stream_bind(h, sock, remote_id, peer_window);
+  return h;
+}
+
+int stream_write(StreamHandle h, const uint8_t* data, size_t len,
+                 int64_t timeout_us) {
+  int64_t deadline = timeout_us < 0 ? -1 : monotonic_us() + timeout_us;
+  while (true) {
+    Stream* st = address_locked(h);
+    if (st == nullptr) {
+      return -EINVAL;
+    }
+    if (!st->connected || st->local_closed) {
+      st->mu.unlock();
+      return -EPIPE;
+    }
+    if (st->sock_failed) {
+      st->mu.unlock();
+      return -ECONNRESET;
+    }
+    if (st->remote_closed) {
+      st->mu.unlock();
+      return -EPIPE;
+    }
+    bool fits = st->bytes_sent - st->bytes_acked + len <= st->peer_window;
+    // an oversized message may go alone once the pipe is drained
+    bool alone = len > st->peer_window && st->bytes_sent == st->bytes_acked;
+    if (fits || alone) {
+      // reserve window under mu; the actual socket write happens outside
+      st->bytes_sent += len;
+      SocketId sock = st->sock;
+      uint64_t peer = st->remote_id;
+      st->mu.unlock();
+      IOBuf payload;
+      if (len > 0) {
+        payload.append(data, len);
+      }
+      int rc = send_stream_frame(sock, peer, STREAM_FRAME_DATA,
+                                 std::move(payload), 0);
+      return rc == 0 ? 0 : -ECONNRESET;
+    }
+    Butex* ab = st->ack_butex;
+    int32_t seen = butex_value(ab).load(std::memory_order_acquire);
+    st->mu.unlock();
+    if (wait_bump(ab, seen, deadline) != 0) {
+      return -EAGAIN;
+    }
+  }
+}
+
+ssize_t stream_read(StreamHandle h, int64_t timeout_us, uint8_t** out) {
+  *out = nullptr;
+  int64_t deadline = timeout_us < 0 ? -1 : monotonic_us() + timeout_us;
+  while (true) {
+    Stream* st = address_locked(h);
+    if (st == nullptr) {
+      return -EINVAL;
+    }
+    if (!st->rq.empty()) {
+      std::string msg = std::move(st->rq.front());
+      st->rq.pop_front();
+      st->rq_bytes -= msg.size();
+      st->consumed += msg.size();
+      // credit the sender once we've consumed half a window
+      // (≙ the reference sending Feedback on consumption, stream.cpp:597)
+      bool feedback = st->connected && !st->sock_failed &&
+                      st->consumed - st->last_feedback >= st->window / 2;
+      uint64_t consumed = st->consumed;
+      SocketId sock = st->sock;
+      uint64_t peer = st->remote_id;
+      if (feedback) {
+        st->last_feedback = consumed;
+      }
+      st->mu.unlock();
+      if (feedback) {
+        send_stream_frame(sock, peer, STREAM_FRAME_FEEDBACK, IOBuf(),
+                          consumed);
+      }
+      uint8_t* buf = (uint8_t*)malloc(msg.size() > 0 ? msg.size() : 1);
+      memcpy(buf, msg.data(), msg.size());
+      *out = buf;
+      return (ssize_t)msg.size();
+    }
+    if (st->remote_closed) {
+      st->mu.unlock();
+      return 0;  // clean EOF
+    }
+    if (st->sock_failed) {
+      st->mu.unlock();
+      return -ECONNRESET;
+    }
+    // About to park on an empty queue: flush any unreported credit first.
+    // Without this, a writer blocked on (sent - acked > window) can
+    // deadlock against a reader that drained less than window/2 — both
+    // sides parked, no FEEDBACK in flight.
+    bool flush = st->connected && st->consumed > st->last_feedback;
+    uint64_t consumed = st->consumed;
+    SocketId sock = st->sock;
+    uint64_t peer = st->remote_id;
+    if (flush) {
+      st->last_feedback = consumed;
+    }
+    Butex* rb = st->recv_butex;
+    int32_t seen = butex_value(rb).load(std::memory_order_acquire);
+    st->mu.unlock();
+    if (flush) {
+      send_stream_frame(sock, peer, STREAM_FRAME_FEEDBACK, IOBuf(),
+                        consumed);
+    }
+    if (wait_bump(rb, seen, deadline) != 0) {
+      return -EAGAIN;
+    }
+  }
+}
+
+void stream_buf_free(uint8_t* p) { free(p); }
+
+int stream_close(StreamHandle h) {
+  Stream* st = address_locked(h);
+  if (st == nullptr) {
+    return -EINVAL;
+  }
+  if (st->local_closed || !st->connected || st->sock_failed) {
+    st->local_closed = true;
+    st->mu.unlock();
+    return 0;
+  }
+  st->local_closed = true;
+  SocketId sock = st->sock;
+  uint64_t peer = st->remote_id;
+  Butex* ab = st->ack_butex;
+  st->mu.unlock();
+  // wake writers parked on a full window so they observe local_closed
+  bump_wake(ab);
+  send_stream_frame(sock, peer, STREAM_FRAME_CLOSE, IOBuf(), 0);
+  return 0;
+}
+
+void stream_mark_failed(StreamHandle h) {
+  Stream* st = address_locked(h);
+  if (st == nullptr) {
+    return;
+  }
+  st->sock_failed = true;
+  Butex* ab = st->ack_butex;
+  Butex* rb = st->recv_butex;
+  st->mu.unlock();
+  bump_wake(ab);
+  bump_wake(rb);
+}
+
+void stream_destroy(StreamHandle h) {
+  stream_close(h);
+  Stream* st = address_locked(h);
+  if (st == nullptr) {
+    return;
+  }
+  SocketId sock = st->sock;
+  bool was_bound = st->connected;
+  st->version.fetch_add(1, std::memory_order_release);  // invalidate handle
+  st->rq.clear();
+  st->rq_bytes = 0;
+  Butex* ab = st->ack_butex;
+  Butex* rb = st->recv_butex;
+  uint32_t slot = st->slot;
+  st->mu.unlock();
+  // wake any waiter parked on the old handle; they re-Address and bail
+  bump_wake(ab);
+  bump_wake(rb);
+  if (was_bound) {
+    unregister_on_socket(sock, h);
+  }
+  ResourcePool<Stream>::Return(slot);
+}
+
+int stream_remote_closed(StreamHandle h) {
+  Stream* st = address_locked(h);
+  if (st == nullptr) {
+    return -EINVAL;
+  }
+  int v = st->remote_closed ? 1 : 0;
+  st->mu.unlock();
+  return v;
+}
+
+int stream_failed(StreamHandle h) {
+  Stream* st = address_locked(h);
+  if (st == nullptr) {
+    return -EINVAL;
+  }
+  int v = st->sock_failed ? 1 : 0;
+  st->mu.unlock();
+  return v;
+}
+
+int64_t stream_pending_bytes(StreamHandle h) {
+  Stream* st = address_locked(h);
+  if (st == nullptr) {
+    return -1;
+  }
+  int64_t v = (int64_t)st->rq_bytes;
+  st->mu.unlock();
+  return v;
+}
+
+void StreamHandleFrame(const RpcMeta& meta, IOBuf&& payload) {
+  Stream* st = address_locked(meta.stream_id);
+  if (st == nullptr) {
+    return;  // stale/unknown stream: drop (≙ reference dropping RST races)
+  }
+  switch (meta.stream_frame_type) {
+    case STREAM_FRAME_DATA:
+      st->rq.push_back(payload.to_string());
+      st->rq_bytes += st->rq.back().size();
+      st->mu.unlock();
+      bump_wake(st->recv_butex);
+      break;
+    case STREAM_FRAME_CLOSE:
+      st->remote_closed = true;
+      st->mu.unlock();
+      bump_wake(st->recv_butex);
+      bump_wake(st->ack_butex);
+      break;
+    case STREAM_FRAME_FEEDBACK:
+      if (meta.feedback_bytes > st->bytes_acked) {
+        st->bytes_acked = meta.feedback_bytes;
+      }
+      st->mu.unlock();
+      bump_wake(st->ack_butex);
+      break;
+    default:
+      st->mu.unlock();
+      break;
+  }
+}
+
+void StreamsOnSocketFailed(SocketId sid) {
+  std::vector<StreamHandle> handles;
+  {
+    std::lock_guard<std::mutex> lk(g_sock_streams_mu);
+    auto it = g_sock_streams.find(sid);
+    if (it == g_sock_streams.end()) {
+      return;
+    }
+    handles = it->second;
+    g_sock_streams.erase(it);
+  }
+  for (StreamHandle h : handles) {
+    Stream* st = address_locked(h);
+    if (st == nullptr) {
+      continue;
+    }
+    st->sock_failed = true;
+    st->mu.unlock();
+    bump_wake(st->recv_butex);
+    bump_wake(st->ack_butex);
+  }
+}
+
+}  // namespace trpc

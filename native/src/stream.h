@@ -1,0 +1,95 @@
+// stream.h — streaming RPC (capability of the reference stream.h:102-120 +
+// policy/streaming_rpc_protocol.cpp, re-designed for the TRPC transport):
+// a Stream is a pooled, version-addressed object bound to a connection
+// Socket; frames ride the normal TRPC framing (meta tags stream_id /
+// stream_frame_type / feedback_bytes, rpc.h) so the parse loop stays one
+// code path.  Flow control is credit-based like the reference's Feedback
+// frames (stream.cpp:597): the receiver reports cumulative consumed bytes,
+// the writer blocks on a butex when (sent - acked) would exceed the window
+// — the same butex a PJRT completion callback can wake, so a fiber
+// streaming tensors out of HBM costs no thread while throttled.
+//
+// Handshake (≙ StreamCreate/StreamAccept attaching stream_settings to an
+// RPC, baidu_rpc_meta.proto:16): the request's meta.stream_id carries the
+// client's handle; the server accepts by creating its half and echoing its
+// handle in the response's meta.stream_id.  Thereafter each side tags data
+// frames with the PEER's handle, so the receiver routes by its own id.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+#include "iobuf.h"
+#include "socket.h"
+
+namespace trpc {
+
+struct RpcMeta;
+
+// (version << 32) | pool slot, like SocketId; 0 is never a valid handle.
+typedef uint64_t StreamHandle;
+
+enum StreamFrameType : uint8_t {
+  STREAM_FRAME_NONE = 0,
+  STREAM_FRAME_DATA = 1,
+  STREAM_FRAME_CLOSE = 2,
+  STREAM_FRAME_FEEDBACK = 3,
+};
+
+// Create the local half (client side, before the handshake RPC).
+// `window_bytes` is this side's RECEIVE window (like TCP rwnd): it is
+// advertised to the peer during the handshake and throttles the peer's
+// writes; our own writes throttle against the peer's advertised window.
+StreamHandle stream_create(uint64_t window_bytes);
+
+// This stream's receive window (0 on a dead handle).
+uint64_t stream_window(StreamHandle h);
+
+// Bind a created stream to its connection after the handshake response
+// (internal, called by channel_call with a stream attached).
+int stream_bind(StreamHandle h, SocketId sock, uint64_t remote_id,
+                uint64_t peer_window);
+
+// Server side: create an accepted stream already bound to `sock`, peer
+// handle `remote_id` (the request's meta.stream_id).
+StreamHandle stream_accept_on(SocketId sock, uint64_t remote_id,
+                              uint64_t window_bytes, uint64_t peer_window);
+
+// Write one message.  Blocks (butex) while the flow-control window is
+// full.  Returns 0, or -EAGAIN on timeout, -EPIPE if the peer closed,
+// -ECONNRESET if the connection failed, -EINVAL on a dead handle.
+int stream_write(StreamHandle h, const uint8_t* data, size_t len,
+                 int64_t timeout_us);
+
+// Read one message into *out (malloc'd; free with stream_buf_free).
+// Returns message length, 0 on clean EOF (peer closed and queue drained),
+// -EAGAIN on timeout, -ECONNRESET if the connection failed, -EINVAL on a
+// dead handle.
+ssize_t stream_read(StreamHandle h, int64_t timeout_us, uint8_t** out);
+void stream_buf_free(uint8_t* p);
+
+// Send CLOSE to the peer and forbid further writes (reads still drain).
+int stream_close(StreamHandle h);
+
+// Release the handle (implies close if not already closed).
+void stream_destroy(StreamHandle h);
+
+// Mark the stream dead and wake all blocked readers/writers (used when the
+// handshake carrying it fails after the server already accepted).
+void stream_mark_failed(StreamHandle h);
+
+// State queries: 1/0, or -EINVAL on a dead handle.
+int stream_remote_closed(StreamHandle h);
+int stream_failed(StreamHandle h);
+// Unconsumed bytes waiting in the receive queue, or -1 on a dead handle.
+int64_t stream_pending_bytes(StreamHandle h);
+
+// --- hooks for the rpc.cc parse loops -------------------------------------
+
+// Route a frame whose meta.stream_frame_type != 0.  Consumes payload.
+void StreamHandleFrame(const RpcMeta& meta, IOBuf&& payload);
+
+// Fail every stream bound to this socket (called from socket on_failed).
+void StreamsOnSocketFailed(SocketId sid);
+
+}  // namespace trpc
