@@ -26,3 +26,16 @@ from brpc_tpu.parallel.collectives import (  # noqa: F401
     reduce_scatter,
     ring_permute,
 )
+from brpc_tpu.parallel.channels import (  # noqa: F401
+    CallMapper,
+    DynamicPartitionChannel,
+    FirstResponseMerger,
+    MeshParallelChannel,
+    MeshPartitionChannel,
+    ParallelChannel,
+    PartitionChannel,
+    PartitionParser,
+    ResponseMerger,
+    SelectiveChannel,
+    SubCall,
+)

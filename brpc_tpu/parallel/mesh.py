@@ -35,14 +35,22 @@ def make_mesh(axes: Dict[str, int],
     """Build a Mesh from {axis_name: size}.
 
     Sizes must multiply to the device count; a single axis may be -1 to
-    absorb the remainder (like a reshape).  Axes are laid out in AXIS_ORDER.
+    absorb the remainder (like a reshape).  The canonical parallelism axes
+    (AXIS_ORDER) are laid out slowest-to-fastest in that order — tp stays
+    innermost so its collectives (the chattiest) ride neighbor ICI links.
+    Custom axes (e.g. a combo-channel fan-out group) go OUTERMOST, in
+    insertion order, so they never break that adjacency; their names must
+    be ≥3 chars (every canonical name is 2, so 2-char unknowns are almost
+    certainly typos of a canonical axis).
     """
     devs = list(devices if devices is not None else jax.devices())
-    names = [a for a in AXIS_ORDER if a in axes]
-    extra = set(axes) - set(names)
-    if extra:
-        raise ValueError(f"unknown mesh axes {sorted(extra)}; "
-                         f"known: {AXIS_ORDER}")
+    custom = [a for a in axes if a not in AXIS_ORDER]
+    bad = [a for a in custom if len(a) < 3]
+    if bad:
+        raise ValueError(f"unknown 2-char axes {bad} look like typos of "
+                         f"the canonical axes {AXIS_ORDER}; custom axis "
+                         f"names must be >=3 chars")
+    names = custom + [a for a in AXIS_ORDER if a in axes]
     sizes = [axes[a] for a in names]
     if sizes.count(-1) > 1:
         raise ValueError("at most one axis may be -1")

@@ -1,0 +1,250 @@
+"""Combo channel tests — multiple real in-process servers behind list://
+naming (the reference's "multi-node without a cluster" strategy,
+SURVEY.md §4: brpc_load_balancer_unittest drives LBs against fake server
+sets; here the servers are real loopback ones)."""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from brpc_tpu.parallel import (CallMapper, DynamicPartitionChannel,
+                               FirstResponseMerger, MeshParallelChannel,
+                               MeshPartitionChannel, ParallelChannel,
+                               PartitionChannel, ResponseMerger,
+                               SelectiveChannel, SubCall, make_mesh)
+from brpc_tpu.rpc import Channel, RpcError, Server, errors
+
+
+def make_server(name: bytes):
+    s = Server()
+
+    def who(cntl, req):
+        return name + b":" + req
+
+    def sum_ints(cntl, req):
+        vals = [int(x) for x in req.split(b",") if x]
+        return str(sum(vals)).encode()
+
+    s.add_service("Who", who)
+    s.add_service("Sum", sum_ints)
+    s.start("127.0.0.1:0")
+    return s
+
+
+@pytest.fixture(scope="module")
+def trio():
+    servers = [make_server(f"s{i}".encode()) for i in range(3)]
+    yield servers
+    for s in servers:
+        s.stop()
+        s.destroy()
+
+
+# --- ParallelChannel -------------------------------------------------------
+
+
+def test_parallel_broadcast_concat(trio):
+    pc = ParallelChannel()
+    chans = [Channel(f"127.0.0.1:{s.port}") for s in trio]
+    for ch in chans:
+        pc.add_channel(ch)
+    out = pc.call("Who", b"x")
+    assert out == b"s0:xs1:xs2:x"  # in-order merge of all members
+    for ch in chans:
+        ch.close()
+
+
+def test_parallel_mapper_shards_request(trio):
+    """CallMapper splits the request per member (scatter, ≙ the
+    partition_echo example's per-partition requests)."""
+
+    class ShardMapper(CallMapper):
+        def map(self, i, n, method, payload, attachment):
+            parts = payload.split(b",")
+            share = parts[i::n]
+            return SubCall(method, b",".join(share))
+
+    class SumMerger(ResponseMerger):
+        def merge(self, results):
+            return str(sum(int(r) for r in results
+                           if r is not None)).encode()
+
+    pc = ParallelChannel(SumMerger())
+    chans = [Channel(f"127.0.0.1:{s.port}") for s in trio]
+    for ch in chans:
+        pc.add_channel(ch, ShardMapper())
+    out = pc.call("Sum", b"1,2,3,4,5,6,7,8,9")
+    assert out == b"45"
+    for ch in chans:
+        ch.close()
+
+
+def test_parallel_skip(trio):
+    class SkipOdd(CallMapper):
+        def map(self, i, n, method, payload, attachment):
+            return None if i % 2 else SubCall(method, payload)
+
+    pc = ParallelChannel()
+    chans = [Channel(f"127.0.0.1:{s.port}") for s in trio]
+    for ch in chans:
+        pc.add_channel(ch, SkipOdd())
+    assert pc.call("Who", b"y") == b"s0:ys2:y"
+    for ch in chans:
+        ch.close()
+
+
+def test_parallel_fail_limit(trio):
+    pc = ParallelChannel(fail_limit=1, timeout_ms=300)
+    chans = [Channel(f"127.0.0.1:{s.port}") for s in trio[:2]]
+    dead = Channel("127.0.0.1:1")  # nothing listens here
+    for ch in chans:
+        pc.add_channel(ch)
+    pc.add_channel(dead)
+    out = pc.call("Who", b"z")  # 1 failure tolerated
+    assert out == b"s0:zs1:z"
+
+    strict = ParallelChannel(timeout_ms=300)  # fail_limit=None: all or bust
+    for ch in chans:
+        strict.add_channel(ch)
+    strict.add_channel(dead)
+    with pytest.raises(RpcError):
+        strict.call("Who", b"z")
+    for ch in chans:
+        ch.close()
+    dead.close()
+
+
+def test_first_response_merger(trio):
+    pc = ParallelChannel(FirstResponseMerger())
+    chans = [Channel(f"127.0.0.1:{s.port}") for s in trio]
+    for ch in chans:
+        pc.add_channel(ch)
+    assert pc.call("Who", b"r") == b"s0:r"
+    for ch in chans:
+        ch.close()
+
+
+# --- PartitionChannel ------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def partitioned(trio):
+    """trio servers tagged as a 2-partition scheme + one 3-scheme straggler
+    that must be ignored by a partition_count=2 channel."""
+    s0, s1, s2 = trio
+    url = (f"list://127.0.0.1:{s0.port} 0/2,"
+           f"127.0.0.1:{s1.port} 1/2,"
+           f"127.0.0.1:{s2.port} 0/3")
+    return url
+
+
+def test_partition_channel_fans_to_all_partitions(partitioned):
+    class TagMapper(CallMapper):
+        def map(self, i, n, method, payload, attachment):
+            return SubCall(method, payload + f"@{i}/{n}".encode())
+
+    pch = PartitionChannel(partitioned, partition_count=2,
+                           call_mapper=TagMapper())
+    assert pch.partitions_ready() == 2
+    out = pch.call("Who", b"p")
+    # partition 0 = s0, partition 1 = s1; 0/3-tagged s2 ignored
+    assert out == b"s0:p@0/2s1:p@1/2"
+    pch.close()
+
+
+def test_partition_channel_missing_partition():
+    # only partition 0 of 2 exists
+    srv = make_server(b"only")
+    try:
+        pch = PartitionChannel(f"list://127.0.0.1:{srv.port} 0/2",
+                               partition_count=2)
+        with pytest.raises(RpcError) as ei:
+            pch.call("Who", b"x")
+        assert ei.value.code == errors.ENOSERVICE
+        pch.close()
+    finally:
+        srv.stop()
+        srv.destroy()
+
+
+def test_dynamic_partition_channel(trio):
+    """Two schemes live at once; capacity weighting picks only complete
+    ones (the 3-way scheme has 1/3 partitions -> capacity 0)."""
+    s0, s1, s2 = trio
+    url = (f"list://127.0.0.1:{s0.port} 0/2,"
+           f"127.0.0.1:{s1.port} 1/2,"
+           f"127.0.0.1:{s2.port} 0/3")
+    dpc = DynamicPartitionChannel(url)
+    caps = dpc.scheme_capacities()
+    assert caps[2] == 1 and caps[3] == 0
+    out = dpc.call("Who", b"d")  # must route to the complete 2-way scheme
+    assert out == b"s0:ds1:d"
+    dpc.close()
+
+
+# --- SelectiveChannel ------------------------------------------------------
+
+
+def test_selective_failover(trio):
+    sel = SelectiveChannel(max_retry=2)
+    dead = Channel("127.0.0.1:1", timeout_ms=200)
+    live = Channel(f"127.0.0.1:{trio[0].port}")
+    sel.add_channel(dead)
+    sel.add_channel(live)
+    # first pick hits the dead channel, failover lands on the live one
+    assert sel.call("Who", b"f") == b"s0:f"
+    # dead one is now isolated: next calls go straight to live
+    assert sel.call("Who", b"g") == b"s0:g"
+    dead.close()
+    live.close()
+
+
+def test_selective_nests_parallel(trio):
+    """Sub-channels can be combo channels (slice-level failover over a
+    fan-out group, SURVEY §2.9)."""
+    pc = ParallelChannel()
+    chans = [Channel(f"127.0.0.1:{s.port}") for s in trio[:2]]
+    for ch in chans:
+        pc.add_channel(ch)
+    sel = SelectiveChannel()
+    sel.add_channel(pc)
+    assert sel.call("Who", b"n") == b"s0:ns1:n"
+    for ch in chans:
+        ch.close()
+
+
+# --- mesh lowering ---------------------------------------------------------
+
+
+def test_mesh_parallel_channel_allreduce():
+    """Row i = member i's contribution; the merge (psum over the axis)
+    replaces the host-side ResponseMerger."""
+    mesh = make_mesh({"fanout": 8})
+    mpc = MeshParallelChannel(mesh, "fanout", merger="add")
+    assert mpc.channel_count() == 8
+    x = jnp.stack([jnp.arange(16, dtype=jnp.float32) + i
+                   for i in range(8)])
+    out = mpc.call_tensor(x)
+    want = np.tile(8.0 * np.arange(16) + 28.0, (8, 1))  # replicated sum
+    np.testing.assert_allclose(np.asarray(out), want)
+
+
+def test_mesh_parallel_channel_concat():
+    mesh = make_mesh({"fanout": 8})
+    mpc = MeshParallelChannel(mesh, "fanout", merger="concat")
+    x = jnp.ones((8, 2), jnp.float32)
+    out = mpc.call_tensor(x)
+    assert out.shape == (8, 2)  # tiled gather of the 8 shards
+
+
+def test_mesh_partition_channel_reduce_scatter():
+    mesh = make_mesh({"part": 8})
+    mpch = MeshPartitionChannel(mesh, "part")
+    assert mpch.partition_count() == 8
+    x = jnp.ones((64, 4), jnp.float32)  # each partition holds (8, 4)
+    out = mpch.call_reduce_scatter(x)
+    # every partition ends with its 1/8 slice of the summed gradient
+    np.testing.assert_allclose(np.asarray(out), np.full((8, 4), 8.0))

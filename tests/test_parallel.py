@@ -35,8 +35,13 @@ def test_make_mesh_factoring():
     assert m.shape == {"dp": 4, "tp": 2}
     with pytest.raises(ValueError):
         make_mesh({"dp": 3})
+    # custom axis names (combo-channel fan-out groups) go outermost so tp
+    # keeps neighbor ICI links; 2-char unknowns are rejected as typos
+    m = make_mesh({"fanout": 4, "tp": 2})
+    assert m.axis_names == ("fanout", "tp")
+    assert m.shape == {"fanout": 4, "tp": 2}
     with pytest.raises(ValueError):
-        make_mesh({"bogus": 8})
+        make_mesh({"pt": 8})  # typo of tp
 
 
 def test_auto_mesh_priority():
