@@ -22,6 +22,13 @@ _lib_lock = threading.Lock()
 
 FIBER_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
 
+# HTTP request dispatcher: (token, verb, path, query, headers, headers_len,
+# body, body_len, user) — headers is "lower-key: value\n" lines
+HTTP_FN = ctypes.CFUNCTYPE(
+    None, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+    ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,
+    ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t, ctypes.c_void_p)
+
 
 def _build() -> None:
     script = os.path.join(_REPO, "native", "build.sh")
@@ -96,6 +103,30 @@ def _declare(L: ctypes.CDLL) -> None:
                                c.c_char_p, c.c_size_t, c.c_char_p,
                                c.c_size_t]
     L.trpc_respond.restype = c.c_int
+    L.trpc_respond_compressed.argtypes = [c.c_uint64, c.c_int32, c.c_char_p,
+                                          c.c_char_p, c.c_size_t, c.c_char_p,
+                                          c.c_size_t, c.c_int]
+    L.trpc_respond_compressed.restype = c.c_int
+    L.trpc_token_compress.argtypes = [c.c_uint64]
+    L.trpc_token_compress.restype = c.c_int
+
+    # HTTP on the shared port
+    L.trpc_server_set_http_handler.argtypes = [c.c_void_p, c.c_void_p,
+                                               c.c_void_p]
+    L.trpc_server_set_http_handler.restype = None
+    L.trpc_http_respond.argtypes = [c.c_uint64, c.c_int, c.c_char_p,
+                                    c.c_char_p, c.c_size_t]
+    L.trpc_http_respond.restype = c.c_int
+
+    # auth
+    L.trpc_server_set_auth.argtypes = [c.c_void_p, c.c_char_p, c.c_size_t]
+    L.trpc_server_set_auth.restype = None
+    L.trpc_channel_set_auth.argtypes = [c.c_void_p, c.c_char_p, c.c_size_t]
+    L.trpc_channel_set_auth.restype = None
+
+    # introspection
+    L.trpc_server_conn_stats.argtypes = [c.c_void_p, c.c_char_p, c.c_size_t]
+    L.trpc_server_conn_stats.restype = c.c_size_t
 
     L.trpc_set_usercode_workers.argtypes = [c.c_int]
     L.trpc_set_usercode_workers.restype = None
@@ -110,6 +141,12 @@ def _declare(L: ctypes.CDLL) -> None:
                                     c.c_size_t, c.c_char_p, c.c_size_t,
                                     c.c_int64, c.POINTER(c.c_void_p)]
     L.trpc_channel_call.restype = c.c_int
+    L.trpc_channel_call_compressed.argtypes = [
+        c.c_void_p, c.c_char_p, c.c_char_p, c.c_size_t, c.c_char_p,
+        c.c_size_t, c.c_int64, c.c_int, c.POINTER(c.c_void_p)]
+    L.trpc_channel_call_compressed.restype = c.c_int
+    L.trpc_result_compress.argtypes = [c.c_void_p]
+    L.trpc_result_compress.restype = c.c_int
     L.trpc_result_error_code.argtypes = [c.c_void_p]
     L.trpc_result_error_code.restype = c.c_int32
     L.trpc_result_error_text.argtypes = [c.c_void_p]

@@ -1,0 +1,1 @@
+from brpc_tpu.builtin.portal import install_builtin_services  # noqa: F401

@@ -1,0 +1,179 @@
+"""Builtin HTTP debug services (≙ the reference's builtin/ portal —
+25+ services auto-registered at Server::Start, server.cpp:468-537:
+index, status, vars, flags, connections, rpcz, prometheus metrics, health,
+version, threads/bthreads introspection).
+
+They ride the server's main port: the native transport sniffs HTTP beside
+TRPC (native/src/http.cc), so `curl host:port/status` works against any
+running server — same operator experience as the reference portal.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import sys
+import time
+
+from brpc_tpu._native import lib
+from brpc_tpu.metrics import bvar
+from brpc_tpu.rpc.http import HttpDispatcher, HttpRequest, HttpResponse
+from brpc_tpu.utils import flags
+
+VERSION = "brpc-tpu/0.1"
+
+_START_TIME = time.time()
+
+_SERVICES = [
+    ("/", "index: this page"),
+    ("/health", "liveness probe"),
+    ("/version", "framework version"),
+    ("/status", "per-method qps / latency / errors"),
+    ("/vars", "all exposed bvars (?filter=substring)"),
+    ("/flags", "gflags: list, /flags/<name>, ?setvalue= to reload"),
+    ("/connections", "live server connections"),
+    ("/metrics", "Prometheus text exposition"),
+    ("/fibers", "fiber runtime counters (≙ /bthreads)"),
+    ("/rpcz", "sampled RPC spans (?trace_id=, ?max_scan=)"),
+    ("/hotspots", "collapsed-stack CPU samples (?seconds=)"),
+]
+
+
+def _index(req: HttpRequest) -> HttpResponse:
+    rows = "".join(
+        f'<tr><td><a href="{p}">{p}</a></td><td>{d}</td></tr>'
+        for p, d in _SERVICES)
+    return HttpResponse.html(
+        "<html><head><title>brpc-tpu</title></head><body>"
+        f"<h2>{VERSION} builtin services</h2>"
+        f"<table border=1 cellpadding=4>{rows}</table></body></html>")
+
+
+def _health(req: HttpRequest) -> str:
+    return "OK\n"
+
+
+def _version(req: HttpRequest) -> str:
+    return VERSION + "\n"
+
+
+def _vars(req: HttpRequest) -> HttpResponse:
+    needle = req.query_params().get("filter", "")
+    lines = []
+    for name, val in bvar.dump_exposed(
+            (lambda n: needle in n) if needle else None):
+        lines.append(f"{name} : {val}")
+    return HttpResponse.text("\n".join(lines) + "\n")
+
+
+def _metrics(req: HttpRequest) -> HttpResponse:
+    return HttpResponse(200, {"Content-Type": "text/plain; version=0.0.4"},
+                        bvar.dump_prometheus().encode())
+
+
+def _fibers(req: HttpRequest) -> HttpResponse:
+    out = (ctypes.c_uint64 * 5)()
+    lib().trpc_runtime_stats(out)
+    return HttpResponse.json({
+        "fibers_created": out[0],
+        "context_switches": out[1],
+        "steals": out[2],
+        "parks": out[3],
+        "workers": out[4],
+        "uptime_s": round(time.time() - _START_TIME, 1),
+    })
+
+
+def _flags_service(req: HttpRequest) -> HttpResponse:
+    """GET /flags — list; GET /flags/<name> — one; ?setvalue=v — hot reload
+    (≙ builtin/flags_service.cpp: live GET/SET of gflags; only reloadable
+    flags accept a set, reloadable_flags.h)."""
+    name = req.path[len("/flags"):].lstrip("/")
+    params = req.query_params()
+    if name and "setvalue" in params:
+        try:
+            flags.set_flag(name, params["setvalue"])
+        except Exception as e:
+            return HttpResponse.text(f"set {name} failed: {e}\n", 400)
+        return HttpResponse.text(f"{name} set to {flags.get_flag(name)}\n")
+    if name:
+        if not flags.flag_exists(name):
+            return HttpResponse.text(f"no such flag {name}\n", 404)
+        f = next(fl for fl in flags.all_flags() if fl.name == name)
+        return HttpResponse.text(
+            f"{name}={f.value} (default {f.default})"
+            f"{' [reloadable]' if f.reloadable else ''}  {f.help}\n")
+    lines = []
+    for f in sorted(flags.all_flags(), key=lambda fl: fl.name):
+        mark = " [R]" if f.reloadable else ""
+        lines.append(f"{f.name}={f.value}{mark}  # {f.help}")
+    return HttpResponse.text("\n".join(lines) + "\n")
+
+
+def _hotspots(req: HttpRequest) -> HttpResponse:
+    """Sampling CPU profiler: collapsed stacks over ?seconds= (default 1) —
+    the capability of /hotspots/cpu (builtin/hotspots_service.cpp drives
+    pprof sampling); TPU build renders flamegraph-ready collapsed lines
+    instead of embedding pprof perl."""
+    seconds = min(float(req.query_params().get("seconds", "1")), 30.0)
+    interval = 0.005
+    counts: dict = {}
+    deadline = time.monotonic() + seconds
+    while time.monotonic() < deadline:
+        for tid, frame in sys._current_frames().items():
+            stack = []
+            f = frame
+            while f is not None and len(stack) < 64:
+                code = f.f_code
+                stack.append(f"{code.co_name} ({os.path.basename(code.co_filename)}:{f.f_lineno})")
+                f = f.f_back
+            key = ";".join(reversed(stack))
+            counts[key] = counts.get(key, 0) + 1
+        time.sleep(interval)
+    lines = [f"{k} {v}" for k, v in
+             sorted(counts.items(), key=lambda kv: -kv[1])]
+    return HttpResponse.text("\n".join(lines) + "\n")
+
+
+def install_builtin_services(server, dispatcher: HttpDispatcher) -> None:
+    """Register the portal routes on a server's dispatcher
+    (≙ Server::AddBuiltinServices, server.cpp:468-537)."""
+    d = dispatcher
+    d.register("/", _index)
+    d.register("/index", _index)
+    d.register("/health", _health)
+    d.register("/version", _version)
+    d.register("/vars", _vars)
+    d.register("/metrics", _metrics)
+    d.register("/fibers", _fibers)
+    d.register("/flags", _flags_service)
+    d.register("/flags/", _flags_service, prefix=True)
+    d.register("/hotspots", _hotspots)
+
+    def _status(req: HttpRequest) -> HttpResponse:
+        return HttpResponse.json({
+            "version": VERSION,
+            "uptime_s": round(time.time() - _START_TIME, 1),
+            "requests": server.request_count(),
+            "methods": server.method_stats(),
+        })
+
+    def _connections(req: HttpRequest) -> HttpResponse:
+        buf = ctypes.create_string_buffer(1 << 20)
+        n = lib().trpc_server_conn_stats(server._handle, buf, len(buf))
+        header = "sockid fd peer bytes_in bytes_out\n"
+        return HttpResponse.text(header + buf.raw[:n].decode())
+
+    def _rpcz(req: HttpRequest) -> HttpResponse:
+        from brpc_tpu.rpc import span as _span
+        params = req.query_params()
+        trace_id = params.get("trace_id")
+        spans = _span.recent_spans(
+            int(params.get("max_scan", "100")),
+            int(trace_id, 0) if trace_id else None)
+        return HttpResponse.json([s.describe() for s in spans])
+
+    d.register("/status", _status)
+    d.register("/connections", _connections)
+    d.register("/rpcz", _rpcz)

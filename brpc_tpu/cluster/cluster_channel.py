@@ -72,7 +72,8 @@ class ClusterChannel:
             sub = self._subs.get(node)
             if sub is None:
                 sub = self._subs[node] = self._SubChannel(
-                    node.endpoint, self.options.connect_timeout_ms)
+                    node.endpoint, self.options.connect_timeout_ms,
+                    getattr(self.options, "auth", None))
             return sub
 
     def _breaker(self, node: ServerNode) -> CircuitBreaker:
@@ -93,8 +94,8 @@ class ClusterChannel:
     # -- one attempt (rpc.Channel drives retries around this) ---------------
 
     def call_once(self, method: bytes, payload: bytes, attachment: bytes,
-                  timeout_us: int, cntl,
-                  stream_handle: int = 0) -> Tuple[int, str, bytes, bytes]:
+                  timeout_us: int, cntl, stream_handle: int = 0,
+                  compress: int = 0) -> Tuple[int, str, bytes, bytes]:
         # breaker-isolated nodes + nodes that already failed THIS call's
         # earlier attempts (≙ ExcludedServers): without the latter, sticky
         # LBs (c_md5) would re-pick the same dead node on every retry
@@ -114,7 +115,8 @@ class ClusterChannel:
         sub = self._sub(node)
         t0 = time.monotonic_ns()
         code, text, data, att = sub.call_once(method, payload, attachment,
-                                              timeout_us, stream_handle)
+                                              timeout_us, stream_handle,
+                                              compress)
         latency_us = (time.monotonic_ns() - t0) // 1000
         failed = code != 0
         self.lb.feedback(node, latency_us, failed)

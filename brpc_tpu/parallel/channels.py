@@ -110,12 +110,21 @@ class ParallelChannel:
 
     def _ensure_pool(self) -> ThreadPoolExecutor:
         with self._lock:
-            if self._pool is None or self._pool._max_workers < max(
-                    4, 2 * len(self._subs)):
+            want = max(4, 2 * len(self._subs))
+            if self._pool is None or self._pool._max_workers < want:
+                old = self._pool
                 self._pool = ThreadPoolExecutor(
-                    max_workers=max(4, 2 * len(self._subs)),
+                    max_workers=want,
                     thread_name_prefix="parallel_channel")
+                if old is not None:
+                    old.shutdown(wait=False)
             return self._pool
+
+    def close(self):
+        with self._lock:
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
 
     def call(self, method: str, payload: bytes = b"",
              attachment: bytes = b"",
@@ -209,6 +218,7 @@ class PartitionChannel:
         self._lock = threading.Lock()
         self._members: Dict[int, List[ServerNode]] = {}
         self._parts: Dict[int, object] = {}  # index -> rpc.Channel
+        self._pc: Optional[ParallelChannel] = None  # persistent fan-out
         self._ns = get_naming_thread(naming_url)
         self._watcher = _PartitionWatcher(self)
         self._ns.add_watcher(self._watcher)
@@ -224,6 +234,7 @@ class PartitionChannel:
             if parsed is None or parsed[1] != self.partition_count:
                 continue
             groups.setdefault(parsed[0], []).append(node)
+        old_pc = None
         with self._lock:
             old = self._members
             self._members = groups
@@ -232,20 +243,36 @@ class PartitionChannel:
             for i in stale:
                 ch = self._parts.pop(i)
                 ch.close()
+            if stale or groups.keys() != old.keys():
+                old_pc, self._pc = self._pc, None  # fan-out set changed
+        if old_pc is not None:
+            old_pc.close()
 
-    def _part_channel(self, index: int):
+    def _get_pc(self) -> "ParallelChannel":
+        """The persistent fan-out channel: one member per logical partition
+        (a dead placeholder where the partition has no servers, so the
+        merger always sees `partition_count` positional slots and a missing
+        partition counts against the SAME fail_limit as a failed RPC)."""
         with self._lock:
-            ch = self._parts.get(index)
-            if ch is None:
-                members = self._members.get(index, [])
-                if not members:
-                    return None
-                url = "list://" + ",".join(
-                    str(m.endpoint) for m in members)
-                ch = self._parts[index] = self._Channel(
-                    url, load_balancer=self.load_balancer,
-                    timeout_ms=self.timeout_ms)
-            return ch
+            if self._pc is not None:
+                return self._pc
+            pc = ParallelChannel(self._merger, self.fail_limit,
+                                 self.timeout_ms)
+            n = self.partition_count
+            for i in range(n):
+                ch = self._parts.get(i)
+                if ch is None:
+                    members = self._members.get(i, [])
+                    if members:
+                        url = "list://" + ",".join(
+                            str(m.endpoint) for m in members)
+                        ch = self._parts[i] = self._Channel(
+                            url, load_balancer=self.load_balancer,
+                            timeout_ms=self.timeout_ms)
+                pc.add_channel(ch if ch is not None else _DeadChannel(i),
+                               _FixedIndexMapper(self._mapper, i, n))
+            self._pc = pc
+            return pc
 
     def partitions_ready(self) -> int:
         with self._lock:
@@ -257,29 +284,30 @@ class PartitionChannel:
     def call(self, method: str, payload: bytes = b"",
              attachment: bytes = b"",
              cntl: Optional[Controller] = None) -> bytes:
-        n = self.partition_count
-        pc = ParallelChannel(self._merger, self.fail_limit, self.timeout_ms)
-        missing = []
-        for i in range(n):
-            ch = self._part_channel(i)
-            if ch is None:
-                missing.append(i)
-            else:
-                pc.add_channel(ch, _FixedIndexMapper(self._mapper, i, n))
-        if missing:
-            limit = self.fail_limit if self.fail_limit is not None else 0
-            if len(missing) > limit:
-                raise errors.RpcError(
-                    errors.ENOSERVICE,
-                    f"partitions {missing} have no servers")
-        return pc.call(method, payload, attachment, cntl)
+        return self._get_pc().call(method, payload, attachment, cntl)
 
     def close(self):
         self._ns.remove_watcher(self._watcher)
         with self._lock:
             parts, self._parts = self._parts, {}
+            pc, self._pc = self._pc, None
         for ch in parts.values():
             ch.close()
+        if pc is not None:
+            pc.close()
+
+
+class _DeadChannel:
+    """Placeholder member for a partition with no resolved servers — every
+    call fails with ENOSERVICE so the missing partition spends the shared
+    fail_limit budget exactly like a failed RPC."""
+
+    def __init__(self, index: int):
+        self._index = index
+
+    def call(self, method, payload=b"", attachment=b"", cntl=None):
+        raise errors.RpcError(errors.ENOSERVICE,
+                              f"partition {self._index} has no servers")
 
 
 class _FixedIndexMapper(CallMapper):
@@ -381,7 +409,12 @@ class DynamicPartitionChannel:
         if chosen is None:
             chosen = max((cap, n) for n, cap in caps.items())[1]
         with self._lock:
-            pc = self._schemes[chosen]
+            pc = self._schemes.get(chosen)
+        if pc is None:
+            # naming update removed the scheme between snapshot and lookup
+            raise errors.RpcError(
+                errors.ENOSERVICE,
+                f"partitioning scheme {chosen} disappeared during call")
         return pc.call(method, payload, attachment, cntl)
 
     def close(self):

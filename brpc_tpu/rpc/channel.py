@@ -33,6 +33,12 @@ class ChannelOptions:
     # cluster mode (set via Channel(naming_url, load_balancer=...))
     load_balancer: str = ""
     retry_policy: Optional["RetryPolicy"] = None
+    # request payload compression: 0 none, 1 gzip, 2 zlib (rpc/compress.py;
+    # ≙ ChannelOptions request_compress_type)
+    request_compress_type: int = 0
+    # credential sent in every request meta (≙ ChannelOptions.auth +
+    # Authenticator::GenerateCredential); verified natively by the server
+    auth: Optional[bytes] = None
 
 
 class RetryPolicy:
@@ -52,7 +58,8 @@ class RetryPolicy:
 
 
 def _unpack_result(L, rc: int, result) -> Tuple[int, str, bytes, bytes]:
-    """Drain and free a native CallResult."""
+    """Drain and free a native CallResult (decompressing the response if
+    the server compressed it — meta tag 6 rides back on the wire)."""
     try:
         code = L.trpc_result_error_code(result)
         text = L.trpc_result_error_text(result).decode(
@@ -60,6 +67,15 @@ def _unpack_result(L, rc: int, result) -> Tuple[int, str, bytes, bytes]:
         p = ctypes.POINTER(ctypes.c_uint8)()
         n = L.trpc_result_data(result, ctypes.byref(p))
         data = ctypes.string_at(p, n) if n else b""
+        ct = L.trpc_result_compress(result)
+        if ct > 0 and data:
+            from brpc_tpu.rpc import compress as compress_mod
+            try:
+                data = compress_mod.decompress(data, ct)
+            except Exception as e:
+                # undecodable response stays inside the RpcError contract
+                return errors.ERESPONSE, f"bad compressed response: {e}", \
+                    b"", b""
         n2 = L.trpc_result_attachment(result, ctypes.byref(p))
         att = ctypes.string_at(p, n2) if n2 else b""
         return (rc if rc else code), text, data, att
@@ -76,8 +92,8 @@ class _NativeCall:
         self.handle = handle
 
     def call(self, method: bytes, payload: bytes, attachment: bytes,
-             timeout_us: int,
-             stream_handle: int = 0) -> Tuple[int, str, bytes, bytes]:
+             timeout_us: int, stream_handle: int = 0,
+             compress: int = 0) -> Tuple[int, str, bytes, bytes]:
         L = lib()
         result = ctypes.c_void_p()
         if stream_handle:
@@ -85,6 +101,11 @@ class _NativeCall:
                 self.handle, method, payload, len(payload),
                 attachment if attachment else None, len(attachment),
                 timeout_us, stream_handle, ctypes.byref(result))
+        elif compress:
+            rc = L.trpc_channel_call_compressed(
+                self.handle, method, payload, len(payload),
+                attachment if attachment else None, len(attachment),
+                timeout_us, compress, ctypes.byref(result))
         else:
             rc = L.trpc_channel_call(
                 self.handle, method, payload, len(payload),
@@ -100,24 +121,28 @@ class SubChannel:
     """
 
     def __init__(self, endpoint: EndPoint,
-                 connect_timeout_ms: float = 500.0):
+                 connect_timeout_ms: float = 500.0,
+                 auth: Optional[bytes] = None):
         self.endpoint = endpoint
         L = lib()
         self._handle = L.trpc_channel_create(
             endpoint.ip.encode(), endpoint.port)
         L.trpc_channel_set_connect_timeout(
             self._handle, int(connect_timeout_ms * 1000))
+        if auth:
+            L.trpc_channel_set_auth(self._handle, auth, len(auth))
         self._native = _NativeCall(self._handle)
         self._lock = threading.Lock()
         self._closed = False
 
     def call_once(self, method: bytes, payload: bytes, attachment: bytes,
-                  timeout_us: int, stream_handle: int = 0):
+                  timeout_us: int, stream_handle: int = 0,
+                  compress: int = 0):
         """One attempt.  A nonzero stream_handle makes this the streaming
         handshake (≙ StreamCreate riding CallMethod via stream_settings,
         baidu_rpc_meta.proto:16)."""
         return self._native.call(method, payload, attachment, timeout_us,
-                                 stream_handle)
+                                 stream_handle, compress)
 
     def close(self):
         with self._lock:
@@ -154,7 +179,8 @@ class Channel:
             if ep.is_device:
                 # device endpoints carry the control plane on DCN/TCP
                 ep = EndPoint(ip=ep.ip, port=ep.port)
-            self._sub = SubChannel(ep, self.options.connect_timeout_ms)
+            self._sub = SubChannel(ep, self.options.connect_timeout_ms,
+                                   self.options.auth)
         if Channel._latency is None:
             Channel._latency = bvar.LatencyRecorder()
             Channel._latency.expose("rpc_client")
@@ -182,6 +208,17 @@ class Channel:
                      if cntl.backup_request_ms is not None
                      else self.options.backup_request_ms)
 
+        # request compression happens once, before the attempt loop
+        # (≙ compress in CallMethod before IssueRPC, channel.cpp:527)
+        compress_type = (cntl.request_compress_type
+                         or self.options.request_compress_type)
+        if compress_type:
+            from brpc_tpu.rpc import compress as compress_mod
+            payload = compress_mod.compress(payload, compress_type)
+
+        from brpc_tpu.rpc import span as span_mod
+        sp = span_mod.start_span("client", method)
+
         attempt = 0
         while True:
             remaining_us = (deadline - time.monotonic_ns()) // 1000
@@ -189,39 +226,49 @@ class Channel:
                 cntl.set_failed(errors.ERPCTIMEDOUT)
                 break
             code, text, data, att = self._call_attempt(
-                mb, payload, attachment, remaining_us, backup_ms, cntl)
+                mb, payload, attachment, remaining_us, backup_ms, cntl,
+                compress_type)
             cntl.error_code, cntl.error_text = code, text
             if code == 0:
                 cntl.response_attachment = att
                 cntl.latency_us = (time.monotonic_ns() - start) // 1000
                 Channel._latency.record(cntl.latency_us)
+                if sp is not None:
+                    sp.remote_side = cntl.remote_side
+                    span_mod.finish_span(sp, 0)
                 return data
             if attempt >= max_retry or not policy.do_retry(cntl):
                 break
             attempt += 1
             cntl.retried_count = attempt
+            if sp is not None:
+                sp.annotate(f"retry #{attempt} after E{code}")
             backoff = policy.backoff_us(attempt)
             if backoff > 0:
                 time.sleep(backoff / 1e6)
         cntl.latency_us = (time.monotonic_ns() - start) // 1000
+        if sp is not None:
+            sp.remote_side = cntl.remote_side
+            span_mod.finish_span(sp, cntl.error_code)
         raise errors.RpcError(cntl.error_code, cntl.error_text)
 
     def _call_attempt(self, method: bytes, payload: bytes, attachment: bytes,
                       timeout_us: int, backup_ms: Optional[float],
-                      cntl: Controller):
+                      cntl: Controller, compress: int = 0):
         if self._cluster is not None:
             return self._cluster.call_once(method, payload, attachment,
-                                           timeout_us, cntl)
+                                           timeout_us, cntl,
+                                           compress=compress)
         if backup_ms is None or timeout_us <= backup_ms * 1000:
             return self._sub.call_once(method, payload, attachment,
-                                       timeout_us)
+                                       timeout_us, compress=compress)
         return self._backup_race(self._sub, method, payload, attachment,
-                                 timeout_us, backup_ms, cntl)
+                                 timeout_us, backup_ms, cntl, compress)
 
     @staticmethod
     def _backup_race(sub: SubChannel, method: bytes, payload: bytes,
                      attachment: bytes, timeout_us: int, backup_ms: float,
-                     cntl: Controller):
+                     cntl: Controller, compress: int = 0):
         """Backup request (≙ reference channel.cpp:551-560,
         controller.cpp:601-634): if no response within backup_ms, race a
         second attempt; first success wins."""
@@ -230,7 +277,8 @@ class Channel:
         deadline = time.monotonic() + timeout_us / 1e6  # from attempt start
 
         def attempt(budget_us):
-            r = sub.call_once(method, payload, attachment, budget_us)
+            r = sub.call_once(method, payload, attachment, budget_us,
+                              compress=compress)
             with cond:
                 result.append(r)
                 cond.notify_all()

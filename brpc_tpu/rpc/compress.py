@@ -1,0 +1,91 @@
+"""Compression registry (≙ brpc compress.h:105 CompressHandler registry
+keyed by CompressType; impls policy/gzip_compress.cpp + snappy).
+
+The native core carries the compress_type meta tag (rpc.h tag 6) untouched;
+codecs run here, on the usercode side of the boundary — requests are
+compressed before entering the native write path, responses after leaving
+it.  Type ids are part of the wire contract:
+    0 = none    1 = gzip    2 = zlib (deflate)
+New codecs register with :func:`register` (≙ RegisterCompressHandler).
+"""
+
+from __future__ import annotations
+
+import gzip as _gzip
+import zlib as _zlib
+from typing import Callable, Dict, Optional, Tuple
+
+from brpc_tpu.utils import flags
+
+COMPRESS_NONE = 0
+COMPRESS_GZIP = 1
+COMPRESS_ZLIB = 2
+
+# ≙ FLAGS_max_body_size bounding what a peer can make us materialize —
+# applied to DECOMPRESSED size so a small zip bomb cannot OOM the process
+flags.define_int32("max_decompressed_size", 512 * 1024 * 1024,
+                   "cap on decompressed payload bytes")
+
+
+def _bounded_inflate(data: bytes, wbits: int) -> bytes:
+    limit = int(flags.get_flag("max_decompressed_size"))
+    d = _zlib.decompressobj(wbits)
+    out = d.decompress(data, limit)
+    if d.unconsumed_tail or (not d.eof and d.decompress(b"", 1)):
+        raise ValueError(
+            f"decompressed payload exceeds {limit} bytes")
+    return out
+
+_handlers: Dict[int, Tuple[str, Callable[[bytes], bytes],
+                           Callable[[bytes], bytes]]] = {}
+_by_name: Dict[str, int] = {}
+
+
+def register(type_id: int, name: str, compress_fn: Callable[[bytes], bytes],
+             decompress_fn: Callable[[bytes], bytes]) -> None:
+    """≙ RegisterCompressHandler (compress.cpp): type_id must be stable
+    across every peer speaking the protocol."""
+    if type_id == COMPRESS_NONE:
+        raise ValueError("type 0 is reserved for 'none'")
+    _handlers[type_id] = (name, compress_fn, decompress_fn)
+    _by_name[name] = type_id
+
+
+def type_of(name: str) -> int:
+    if name in ("", "none"):
+        return COMPRESS_NONE
+    if name not in _by_name:
+        raise KeyError(f"unknown compression {name!r}")
+    return _by_name[name]
+
+
+def name_of(type_id: int) -> str:
+    if type_id == COMPRESS_NONE:
+        return "none"
+    h = _handlers.get(type_id)
+    return h[0] if h else f"unknown({type_id})"
+
+
+def compress(data: bytes, type_id: int) -> bytes:
+    if type_id == COMPRESS_NONE:
+        return data
+    h = _handlers.get(type_id)
+    if h is None:
+        raise KeyError(f"no compress handler for type {type_id}")
+    return h[1](data)
+
+
+def decompress(data: bytes, type_id: int) -> bytes:
+    if type_id == COMPRESS_NONE:
+        return data
+    h = _handlers.get(type_id)
+    if h is None:
+        raise KeyError(f"no decompress handler for type {type_id}")
+    return h[2](data)
+
+
+register(COMPRESS_GZIP, "gzip",
+         lambda b: _gzip.compress(b, compresslevel=6),
+         lambda b: _bounded_inflate(b, 16 + _zlib.MAX_WBITS))
+register(COMPRESS_ZLIB, "zlib", _zlib.compress,
+         lambda b: _bounded_inflate(b, _zlib.MAX_WBITS))

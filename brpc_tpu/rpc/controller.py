@@ -24,6 +24,12 @@ class Controller:
         self.error_text: str = ""
         self.request_attachment: bytes = b""
         self.response_attachment: bytes = b""
+        # compression (≙ set_request_compress_type/set_response_compress_type,
+        # controller.h; codecs in rpc/compress.py): server side sees the
+        # request's type and picks the response's; client side sets the
+        # request's via ChannelOptions or this field
+        self.request_compress_type: int = 0
+        self.response_compress_type: int = 0
         # server-side context
         self.method: str = ""
         self.remote_side: str = ""

@@ -11,10 +11,12 @@ EFAILEDSOCKET = 1009
 EBACKUPREQUEST = 1010
 EREQUEST = 1011
 ESTOP = 1012
+ERESPONSE = 1013
 EINTERNAL = 2001
 EOVERCROWDED = 2004
 ELIMIT = 2005
 ESTREAMUNACCEPTED = 2006
+EAUTH = 2008
 
 _TEXT = {
     OK: "OK",
@@ -25,10 +27,12 @@ _TEXT = {
     EBACKUPREQUEST: "backup request fired",
     EREQUEST: "bad request bytes",
     ESTOP: "server is stopping",
+    ERESPONSE: "bad response bytes",
     EINTERNAL: "server-side exception",
     EOVERCROWDED: "too many buffered writes",
     ELIMIT: "rejected by concurrency limiter",
     ESTREAMUNACCEPTED: "server did not accept the stream",
+    EAUTH: "authentication failed",
 }
 
 

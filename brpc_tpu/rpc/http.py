@@ -1,0 +1,173 @@
+"""HTTP service layer over the shared port (capability of the reference's
+HTTP support: policy/http_rpc_protocol.cpp — pb services callable as
+/Service/Method with JSON bodies via json2pb, plus raw HTTP services with
+restful mappings, restful.cpp).
+
+The native core sniffs HTTP on the same listening port as TRPC
+(native/src/http.cc; ≙ one-port-many-protocols, input_messenger.cpp:77),
+parses requests, and hands them to one dispatcher callback per server on
+the usercode pthread pool.  This module is that dispatcher: an exact+prefix
+route table plus the /rpc/<Service.Method> JSON bridge into registered TRPC
+services (≙ json2pb: HTTP+JSON access to binary services).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.parse
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple, Union
+
+from brpc_tpu.rpc import errors
+
+
+@dataclass
+class HttpRequest:
+    method: str = "GET"
+    path: str = "/"
+    query: str = ""                 # raw query string
+    headers: Dict[str, str] = field(default_factory=dict)  # lower-case keys
+    body: bytes = b""
+
+    def query_params(self) -> Dict[str, str]:
+        return {k: v[-1] for k, v in
+                urllib.parse.parse_qs(self.query, keep_blank_values=True)
+                .items()}
+
+
+@dataclass
+class HttpResponse:
+    status: int = 200
+    headers: Dict[str, str] = field(default_factory=dict)
+    body: bytes = b""
+
+    @staticmethod
+    def text(s: str, status: int = 200) -> "HttpResponse":
+        return HttpResponse(status, {"Content-Type": "text/plain"},
+                            s.encode())
+
+    @staticmethod
+    def html(s: str, status: int = 200) -> "HttpResponse":
+        return HttpResponse(status, {"Content-Type": "text/html"},
+                            s.encode())
+
+    @staticmethod
+    def json(obj, status: int = 200) -> "HttpResponse":
+        return HttpResponse(status, {"Content-Type": "application/json"},
+                            json.dumps(obj, indent=1).encode())
+
+
+# A handler returns HttpResponse | str (text/plain) | bytes (octet-stream) |
+# dict/list (JSON).
+HttpHandler = Callable[[HttpRequest], Union[HttpResponse, str, bytes, dict,
+                                            list]]
+
+
+def _coerce(out) -> HttpResponse:
+    if isinstance(out, HttpResponse):
+        return out
+    if isinstance(out, str):
+        return HttpResponse.text(out)
+    if isinstance(out, bytes):
+        return HttpResponse(200, {"Content-Type":
+                                  "application/octet-stream"}, out)
+    if isinstance(out, (dict, list)):
+        return HttpResponse.json(out)
+    return HttpResponse.text(str(out))
+
+
+def parse_headers_blob(blob: bytes) -> Dict[str, str]:
+    """Native layer hands headers as 'lower-key: value\\n' lines."""
+    out: Dict[str, str] = {}
+    for line in blob.decode("utf-8", "replace").split("\n"):
+        if not line:
+            continue
+        k, _, v = line.partition(": ")
+        out[k] = v
+    return out
+
+
+class HttpDispatcher:
+    """Route table: exact paths first, then longest registered prefix
+    (≙ restful mapping '/path => Service.Method', restful.cpp), then the
+    /rpc JSON bridge, then 404."""
+
+    def __init__(self):
+        self._exact: Dict[str, HttpHandler] = {}
+        self._prefix: List[Tuple[str, HttpHandler]] = []  # sorted, longest 1st
+        self._server = None  # set by Server for the /rpc bridge
+
+    def register(self, path: str, handler: HttpHandler,
+                 prefix: bool = False) -> None:
+        if prefix:
+            self._prefix.append((path, handler))
+            self._prefix.sort(key=lambda kv: -len(kv[0]))
+        else:
+            self._exact[path] = handler
+
+    def dispatch(self, req: HttpRequest) -> HttpResponse:
+        h = self._exact.get(req.path)
+        if h is None:
+            for p, ph in self._prefix:
+                if req.path.startswith(p):
+                    h = ph
+                    break
+        if h is None and req.path.startswith("/rpc/"):
+            return self._rpc_bridge(req)
+        if h is None:
+            return HttpResponse.text(f"no handler for {req.path}\n", 404)
+        try:
+            return _coerce(h(req))
+        except Exception as e:  # handler bug → 500 (≙ EINTERNAL)
+            import traceback
+            return HttpResponse.text(
+                f"handler raised: {e}\n{traceback.format_exc(limit=5)}", 500)
+
+    # -- /rpc/<Service.Method> — JSON/raw access to TRPC services -----------
+    # (≙ json2pb powering HTTP+JSON access to pb services,
+    #  http_rpc_protocol.cpp + json_to_pb.cpp)
+
+    def _rpc_bridge(self, req: HttpRequest) -> HttpResponse:
+        if self._server is None:
+            return HttpResponse.text("no TRPC services attached\n", 503)
+        method = req.path[len("/rpc/"):]
+        handler = self._server._find_handler(method)
+        if handler is None:
+            return HttpResponse.text(f"no such method {method}\n", 404)
+        from brpc_tpu.rpc.controller import Controller
+        cntl = Controller()
+        cntl.method = method
+        is_json = "json" in req.headers.get("content-type", "")
+        body = req.body
+        if is_json and body:
+            # JSON envelope: {"payload": "...", ...} or raw string body
+            try:
+                obj = json.loads(body)
+                if isinstance(obj, dict) and "payload" in obj:
+                    body = str(obj["payload"]).encode()
+                elif isinstance(obj, str):
+                    body = obj.encode()
+            except ValueError:
+                return HttpResponse.text("bad JSON body\n", 400)
+        try:
+            out = handler(cntl, body)
+        except errors.RpcError as e:
+            return HttpResponse.json(
+                {"error_code": e.code, "error_text": e.text}, 500)
+        except Exception as e:
+            return HttpResponse.json(
+                {"error_code": errors.EINTERNAL, "error_text": str(e)}, 500)
+        resp = out[0] if isinstance(out, tuple) else (out or b"")
+        if cntl.failed():
+            return HttpResponse.json({"error_code": cntl.error_code,
+                                      "error_text": cntl.error_text}, 500)
+        if is_json:
+            return HttpResponse.json(
+                {"payload": resp.decode("utf-8", "replace")})
+        return HttpResponse(200, {"Content-Type":
+                                  "application/octet-stream"}, resp)
+
+
+def pack_headers(headers: Dict[str, str]) -> bytes:
+    """To the native response blob: 'Key: Value\\r\\n' lines."""
+    return "".join(f"{k}: {v}\r\n" for k, v in headers.items()).encode()

@@ -16,10 +16,13 @@ import traceback
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Optional, Tuple, Union
 
-from brpc_tpu._native import lib
+from brpc_tpu._native import HTTP_FN, lib
 from brpc_tpu.metrics import bvar
-from brpc_tpu.rpc import errors
+from brpc_tpu.rpc import compress as compress_mod
+from brpc_tpu.rpc import errors, span
 from brpc_tpu.rpc.controller import Controller
+from brpc_tpu.rpc.http import (HttpDispatcher, HttpRequest, pack_headers,
+                               parse_headers_blob)
 from brpc_tpu.utils import flags, logging as log
 
 flags.define_int32("usercode_workers", 4,
@@ -39,8 +42,13 @@ Handler = Callable[[Controller, bytes], Union[bytes, Tuple[bytes, bytes], None]]
 class ServerOptions:
     num_workers: int = 0           # fiber workers (0 = ncpu)
     max_concurrency: int = 0       # 0 = unlimited (limiters in cluster/)
+    # The HTTP debug portal rides the main port (the native transport
+    # sniffs HTTP beside TRPC — one-port-many-protocols)
     enable_builtin_services: bool = True
-    builtin_port: Optional[int] = None  # HTTP debug portal port (None = off)
+    # Require this credential on every TRPC request (≙ ServerOptions.auth,
+    # verified natively before dispatch).  Channels send it via
+    # ChannelOptions.auth.
+    auth: Optional[bytes] = None
 
 
 class _MethodStatus:
@@ -66,8 +74,9 @@ class Server:
         self._cb_keepalive = []
         self._started = False
         self._port = 0
-        self._builtin = None
         self._limiter = None  # cluster.ConcurrencyLimiter, set via option
+        self.http = HttpDispatcher()
+        self.http._server = self  # for the /rpc/<method> JSON bridge
 
     # -- registration (≙ Server::AddService) --------------------------------
 
@@ -90,6 +99,19 @@ class Server:
         ≙ ConcurrencyLimiter, concurrency_limiter.h:29)."""
         self._limiter = limiter
 
+    def register_http(self, path: str, handler, prefix: bool = False) -> None:
+        """RESTful mapping (≙ restful.cpp '/path => Service.Method'):
+        handler(HttpRequest) -> HttpResponse|str|bytes|dict, served on the
+        main port beside TRPC."""
+        self.http.register(path, handler, prefix=prefix)
+
+    def _find_handler(self, method: str) -> Optional[Handler]:
+        """Lookup with the native server's Service fallback."""
+        h = self._services.get(method)
+        if h is None and "." in method:
+            h = self._services.get(method.split(".", 1)[0])
+        return h
+
     def _make_dispatcher(self, name: str, handler: Handler):
         status = self._method_status.get(name)
         if status is None:
@@ -111,10 +133,26 @@ class Server:
             cntl = Controller()
             cntl._stream_token = token
             cntl.method = method.decode() if method else name
-            req = ctypes.string_at(req_p, req_len) if req_len else b""
-            cntl.request_attachment = (
-                ctypes.string_at(att_p, att_len) if att_len else b"")
+            sp = None
             try:
+                req = ctypes.string_at(req_p, req_len) if req_len else b""
+                cntl.request_compress_type = max(
+                    L.trpc_token_compress(token), 0)
+                if cntl.request_compress_type:
+                    try:
+                        req = compress_mod.decompress(
+                            req, cntl.request_compress_type)
+                    except Exception:
+                        cntl.error_code = errors.EREQUEST
+                        L.trpc_respond(token, errors.EREQUEST,
+                                       b"bad compressed payload", None, 0,
+                                       None, 0)
+                        status.errors.add(1)
+                        return  # finally below still releases the limiter
+                cntl.request_attachment = (
+                    ctypes.string_at(att_p, att_len) if att_len else b"")
+                sp = span.start_span("server", cntl.method)
+                span.set_current(sp)
                 out = handler(cntl, req)
                 resp, resp_att = b"", cntl.response_attachment
                 if isinstance(out, tuple):
@@ -126,14 +164,19 @@ class Server:
                                    cntl.error_text.encode(), None, 0, None, 0)
                     status.errors.add(1)
                 else:
-                    L.trpc_respond(token, 0, None, resp, len(resp),
-                                   resp_att if resp_att else None,
-                                   len(resp_att))
+                    ct = cntl.response_compress_type
+                    if ct:
+                        resp = compress_mod.compress(resp, ct)
+                    L.trpc_respond_compressed(
+                        token, 0, None, resp, len(resp),
+                        resp_att if resp_att else None, len(resp_att), ct)
             except errors.RpcError as e:
+                cntl.error_code = e.code
                 L.trpc_respond(token, e.code, e.text.encode(), None, 0,
                                None, 0)
                 status.errors.add(1)
             except Exception:
+                cntl.error_code = errors.EINTERNAL
                 log.LOG(log.LOG_ERROR, "handler %s raised:\n%s", name,
                         traceback.format_exc())
                 L.trpc_respond(token, errors.EINTERNAL,
@@ -141,6 +184,8 @@ class Server:
                                None, 0, None, 0)
                 status.errors.add(1)
             finally:
+                span.set_current(None)
+                span.finish_span(sp, cntl.error_code)
                 if limiter is not None:
                     limiter.on_response((time.monotonic_ns() - t0) // 1000)
                 status.latency.record((time.monotonic_ns() - t0) // 1000)
@@ -149,11 +194,61 @@ class Server:
 
     # -- lifecycle (≙ Server::Start/Stop/Join) ------------------------------
 
+    def _install_http(self) -> None:
+        """Native HTTP requests (sniffed on the main port) land here on the
+        usercode pool; routed through self.http."""
+        dispatcher = self.http
+        auth = self.options.auth
+
+        def on_http(token, verb, path, query, hdr_p, hdr_len, body_p,
+                    body_len, _user):
+            import hmac
+            L = lib()
+            try:
+                req = HttpRequest(
+                    method=verb.decode() if verb else "GET",
+                    path=path.decode() if path else "/",
+                    query=query.decode() if query else "",
+                    headers=parse_headers_blob(
+                        ctypes.string_at(hdr_p, hdr_len) if hdr_len else b""),
+                    body=ctypes.string_at(body_p, body_len)
+                    if body_len else b"")
+                if auth is not None:
+                    # the TRPC credential also gates the HTTP surface —
+                    # otherwise /rpc and /flags would bypass server auth
+                    given = req.headers.get("authorization", "").encode()
+                    if not hmac.compare_digest(given, auth):
+                        L.trpc_http_respond(token, 401, None,
+                                            b"unauthorized\n", 13)
+                        return
+                resp = dispatcher.dispatch(req)
+                body = b"" if req.method == "HEAD" else resp.body
+                L.trpc_http_respond(token, resp.status,
+                                    pack_headers(resp.headers), body,
+                                    len(body))
+            except Exception:
+                log.LOG(log.LOG_ERROR, "http dispatch raised:\n%s",
+                        traceback.format_exc())
+                msg = b"internal error\n"
+                L.trpc_http_respond(token, 500, None, msg, len(msg))
+
+        cb = HTTP_FN(on_http)
+        self._cb_keepalive.append(cb)
+        lib().trpc_server_set_http_handler(
+            self._handle, ctypes.cast(cb, ctypes.c_void_p), None)
+
     def start(self, address: str = "127.0.0.1:0") -> int:
         from brpc_tpu import fiber
         fiber.init(self.options.num_workers)
         lib().trpc_set_usercode_workers(
             int(flags.get_flag("usercode_workers")))
+        if self.options.enable_builtin_services:
+            from brpc_tpu.builtin import install_builtin_services
+            install_builtin_services(self, self.http)
+        self._install_http()
+        if self.options.auth:
+            lib().trpc_server_set_auth(self._handle, self.options.auth,
+                                       len(self.options.auth))
         ip, _, port = address.rpartition(":")
         rc = lib().trpc_server_start(self._handle, ip.encode(), int(port))
         if rc != 0:
@@ -161,11 +256,6 @@ class Server:
         self._port = lib().trpc_server_port(self._handle)
         self._started = True
         flags.freeze_nonreloadable()
-        if (self.options.enable_builtin_services
-                and self.options.builtin_port is not None):
-            from brpc_tpu.builtin.portal import BuiltinPortal
-            self._builtin = BuiltinPortal(self)
-            self._builtin.start(self.options.builtin_port)
         log.LOG(log.LOG_INFO, "Server started on %s:%d", ip or "0.0.0.0",
                 self._port)
         return self._port
@@ -185,9 +275,6 @@ class Server:
         if self._started:
             lib().trpc_server_stop(self._handle)
             self._started = False
-        if self._builtin is not None:
-            self._builtin.stop()
-            self._builtin = None
 
     def destroy(self) -> None:
         """Stop, fail live connections, drain, and free the native server.

@@ -1,0 +1,160 @@
+"""rpcz — sampled per-RPC spans (≙ the reference Span, span.h:47: created
+per RPC client-side in Channel::CallMethod (channel.cpp:467-485) and
+server-side in ProcessRpcRequest; free-text Annotate (span.h:80); sampling
+throttled by bvar::Collector, collector.h:41 COLLECTOR_SAMPLING_BASE;
+browsed through the /rpcz builtin service, builtin/rpcz_service.cpp).
+
+TPU build differences: spans live in an in-process ring (the reference
+persists to leveldb — operators here scrape /rpcz or read
+``recent_spans()``), and sampling is a plain token bucket refilled per
+second.  Span creation is off unless the ``enable_rpcz`` flag is on
+(≙ --enable_rpcz).
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from brpc_tpu.utils import flags
+
+flags.define_bool("enable_rpcz", False, "collect rpcz spans")
+flags.define_int32("rpcz_max_samples_per_second", 16384,
+                   "span sampling budget (≙ COLLECTOR_SAMPLING_BASE)")
+flags.define_int32("rpcz_keep_spans", 10000, "ring size of kept spans")
+
+_id_gen = itertools.count(random.getrandbits(48) << 8)
+_tls = threading.local()
+
+
+def _new_id() -> int:
+    return next(_id_gen)
+
+
+@dataclass
+class Span:
+    trace_id: int
+    span_id: int
+    parent_span_id: int = 0
+    kind: str = "server"            # "server" | "client"
+    method: str = ""
+    remote_side: str = ""
+    start_ts: float = 0.0           # wall clock
+    latency_us: int = 0
+    error_code: int = 0
+    annotations: List[str] = field(default_factory=list)
+
+    def annotate(self, text: str) -> None:
+        """≙ TRACEPRINTF (traceprintf.h): free text with a timestamp."""
+        dt_us = int((time.time() - self.start_ts) * 1e6)
+        self.annotations.append(f"+{dt_us}us {text}")
+
+    def describe(self) -> dict:
+        return {
+            "trace_id": f"{self.trace_id:x}",
+            "span_id": f"{self.span_id:x}",
+            "parent_span_id": f"{self.parent_span_id:x}",
+            "kind": self.kind,
+            "method": self.method,
+            "remote_side": self.remote_side,
+            "start": time.strftime("%Y-%m-%d %H:%M:%S",
+                                   time.localtime(self.start_ts)),
+            "latency_us": self.latency_us,
+            "error_code": self.error_code,
+            "annotations": self.annotations,
+        }
+
+
+class _Store:
+    """Ring of finished spans + per-second sampling budget."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=int(flags.get_flag(
+            "rpcz_keep_spans")))
+        self._budget = 0
+        self._budget_sec = 0
+
+    def try_sample(self) -> bool:
+        now = int(time.time())
+        with self._lock:
+            if now != self._budget_sec:
+                self._budget_sec = now
+                self._budget = int(flags.get_flag(
+                    "rpcz_max_samples_per_second"))
+            if self._budget <= 0:
+                return False
+            self._budget -= 1
+            return True
+
+    def add(self, span: Span) -> None:
+        with self._lock:
+            self._ring.append(span)
+
+    def recent(self, n: int, trace_id: Optional[int]) -> List[Span]:
+        with self._lock:
+            items = list(self._ring)
+        if trace_id is not None:
+            items = [s for s in items if s.trace_id == trace_id]
+        return items[-n:][::-1]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+
+_store = _Store()
+
+
+def enabled() -> bool:
+    return bool(flags.get_flag("enable_rpcz"))
+
+
+def start_span(kind: str, method: str, trace_id: int = 0,
+               parent_span_id: int = 0) -> Optional[Span]:
+    """Create a sampled span, or None (disabled / over budget).
+    A zero trace_id starts a new trace (≙ Span::CreateServerSpan with no
+    inherited ids)."""
+    if not enabled() or not _store.try_sample():
+        return None
+    s = Span(trace_id=trace_id or _new_id(), span_id=_new_id(),
+             parent_span_id=parent_span_id, kind=kind, method=method,
+             start_ts=time.time())
+    return s
+
+
+def finish_span(span: Optional[Span], error_code: int = 0) -> None:
+    if span is None:
+        return
+    span.latency_us = int((time.time() - span.start_ts) * 1e6)
+    span.error_code = error_code
+    _store.add(span)
+
+
+def set_current(span: Optional[Span]) -> None:
+    """TLS parent for annotate() (≙ tls_parent, span.h:115)."""
+    _tls.span = span
+
+
+def current() -> Optional[Span]:
+    return getattr(_tls, "span", None)
+
+
+def annotate(text: str) -> None:
+    """≙ TRACEPRINTF into the current span; no-op when unsampled."""
+    s = current()
+    if s is not None:
+        s.annotate(text)
+
+
+def recent_spans(n: int = 100, trace_id: Optional[int] = None) -> List[Span]:
+    return _store.recent(n, trace_id)
+
+
+def clear() -> None:
+    _store.clear()

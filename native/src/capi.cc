@@ -6,6 +6,7 @@
 #include <cstring>
 
 #include "fiber.h"
+#include "http.h"
 #include "iobuf.h"
 #include "rpc.h"
 #include "stream.h"
@@ -96,6 +97,43 @@ int trpc_respond(uint64_t token, int32_t error_code, const char* error_text,
                  attach_len);
 }
 
+int trpc_respond_compressed(uint64_t token, int32_t error_code,
+                            const char* error_text, const uint8_t* data,
+                            size_t len, const uint8_t* attach,
+                            size_t attach_len, int compress_type) {
+  return respond(token, error_code, error_text, data, len, attach,
+                 attach_len, (uint8_t)compress_type);
+}
+
+int trpc_token_compress(uint64_t token) { return token_compress_type(token); }
+
+// --- HTTP on the shared port ----------------------------------------------
+
+void trpc_server_set_http_handler(void* s, HttpHandlerCb cb, void* user) {
+  server_set_http_handler((Server*)s, cb, user);
+}
+
+int trpc_http_respond(uint64_t token, int status, const char* headers_blob,
+                      const uint8_t* body, size_t body_len) {
+  return http_respond(token, status, headers_blob, body, body_len);
+}
+
+// --- auth ------------------------------------------------------------------
+
+void trpc_server_set_auth(void* s, const uint8_t* secret, size_t len) {
+  server_set_auth((Server*)s, secret, len);
+}
+
+void trpc_channel_set_auth(void* c, const uint8_t* secret, size_t len) {
+  channel_set_auth((Channel*)c, secret, len);
+}
+
+// --- introspection ---------------------------------------------------------
+
+size_t trpc_server_conn_stats(void* s, char* buf, size_t cap) {
+  return server_conn_stats((Server*)s, buf, cap);
+}
+
 // --- channel ---------------------------------------------------------------
 
 void* trpc_channel_create(const char* ip, int port) {
@@ -119,6 +157,18 @@ int trpc_channel_call(void* c, const char* method, const uint8_t* req,
   return rc;
 }
 
+int trpc_channel_call_compressed(void* c, const char* method,
+                                 const uint8_t* req, size_t req_len,
+                                 const uint8_t* attach, size_t attach_len,
+                                 int64_t timeout_us, int compress_type,
+                                 void** result) {
+  CallResult* r = new CallResult();
+  int rc = channel_call((Channel*)c, method, req, req_len, attach, attach_len,
+                        timeout_us, r, 0, (uint8_t)compress_type);
+  *result = r;
+  return rc;
+}
+
 int32_t trpc_result_error_code(void* r) {
   return ((CallResult*)r)->error_code;
 }
@@ -134,6 +184,9 @@ size_t trpc_result_attachment(void* r, const uint8_t** p) {
   CallResult* cr = (CallResult*)r;
   *p = (const uint8_t*)cr->attachment.data();
   return cr->attachment.size();
+}
+int trpc_result_compress(void* r) {
+  return ((CallResult*)r)->compress_type;
 }
 void trpc_result_destroy(void* r) { delete (CallResult*)r; }
 

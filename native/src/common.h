@@ -37,6 +37,7 @@ enum ErrorCode {
   TRPC_EFAILEDSOCKET = 1009,  // the connection was broken
   TRPC_EBACKUPREQUEST = 1010, // backup-request timer fired (internal)
   TRPC_EREQUEST = 1011,       // bad request bytes
+  TRPC_ERESPONSE = 1013,      // bad response bytes (client-side decode)
   TRPC_ENOSERVICE = 1001,     // no such service
   TRPC_ENOMETHOD = 1002,      // no such method
   TRPC_ESTOP = 1012,          // server is stopping
@@ -44,6 +45,7 @@ enum ErrorCode {
   TRPC_EOVERCROWDED = 2004,   // too many buffered writes (≙ brpc EOVERCROWDED)
   TRPC_ELIMIT = 2005,         // concurrency limiter rejected (≙ brpc ELIMIT)
   TRPC_ESTREAMUNACCEPTED = 2006,  // handshake RPC ok but no StreamAccept
+  TRPC_EAUTH = 2008,          // credential verify failed (≙ brpc ERPCAUTH)
 };
 
 // xorshift per-thread fast random (≙ butil fast_rand).

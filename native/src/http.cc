@@ -1,0 +1,197 @@
+#include "http.h"
+
+#include <string.h>
+
+#include <algorithm>
+
+namespace trpc {
+
+namespace {
+
+constexpr size_t kMaxHeaderBytes = 64 * 1024;
+constexpr size_t kMaxBodyBytes = 512u * 1024 * 1024;
+
+// Verbs we accept on the shared port.  A 4-byte prefix is enough to
+// distinguish every one of them from the "TRPC" frame magic.
+const char* kVerbs[] = {"GET ",     "POST ",  "PUT ",   "DELETE ",
+                        "HEAD ",    "PATCH ", "OPTIONS ", "TRACE ",
+                        "CONNECT "};
+
+void lower_inplace(std::string* s) {
+  for (char& ch : *s) {
+    if (ch >= 'A' && ch <= 'Z') {
+      ch += 'a' - 'A';
+    }
+  }
+}
+
+// Case-insensitive "does the comma-separated header value contain token".
+bool value_has_token(const std::string& v, const char* token) {
+  std::string low = v;
+  lower_inplace(&low);
+  return low.find(token) != std::string::npos;
+}
+
+}  // namespace
+
+bool LooksLikeHttp(const IOBuf& buf) {
+  char head[8];
+  size_t n = std::min(buf.size(), sizeof(head));
+  buf.copy_to(head, n);
+  for (const char* verb : kVerbs) {
+    size_t vl = strlen(verb);
+    size_t cmp = std::min(n, vl);
+    if (memcmp(head, verb, cmp) == 0) {
+      return true;  // full or still-possible prefix match
+    }
+  }
+  return false;
+}
+
+int ParseHttpRequest(IOBuf* buf, HttpRequest* out) {
+  // Pull the (bounded) header region into a flat string to find CRLFCRLF.
+  size_t scan = std::min(buf->size(), kMaxHeaderBytes);
+  std::string head;
+  head.resize(scan);
+  buf->copy_to(&head[0], scan);
+  size_t hdr_end = head.find("\r\n\r\n");
+  if (hdr_end == std::string::npos) {
+    return buf->size() >= kMaxHeaderBytes ? -1 : 0;
+  }
+  // request line
+  size_t line_end = head.find("\r\n");
+  const std::string line = head.substr(0, line_end);
+  size_t sp1 = line.find(' ');
+  size_t sp2 = line.rfind(' ');
+  if (sp1 == std::string::npos || sp2 == sp1) {
+    return -1;
+  }
+  std::string method = line.substr(0, sp1);
+  std::string target = line.substr(sp1 + 1, sp2 - sp1 - 1);
+  std::string version = line.substr(sp2 + 1);
+  if (version != "HTTP/1.1" && version != "HTTP/1.0") {
+    return -1;
+  }
+  bool keep_alive = (version == "HTTP/1.1");
+  // headers
+  std::string headers_blob;
+  headers_blob.reserve(hdr_end - line_end);
+  size_t content_length = 0;
+  bool have_cl = false;
+  size_t pos = line_end + 2;
+  while (pos < hdr_end) {
+    size_t eol = head.find("\r\n", pos);
+    if (eol == std::string::npos || eol > hdr_end) {
+      eol = hdr_end;
+    }
+    std::string hline = head.substr(pos, eol - pos);
+    pos = eol + 2;
+    size_t colon = hline.find(':');
+    if (colon == std::string::npos) {
+      return -1;
+    }
+    std::string key = hline.substr(0, colon);
+    size_t vstart = colon + 1;
+    while (vstart < hline.size() &&
+           (hline[vstart] == ' ' || hline[vstart] == '\t')) {
+      ++vstart;
+    }
+    std::string value = hline.substr(vstart);
+    lower_inplace(&key);
+    if (key == "content-length") {
+      char* end = nullptr;
+      unsigned long long v = strtoull(value.c_str(), &end, 10);
+      if (end == value.c_str() || v > kMaxBodyBytes) {
+        return -1;
+      }
+      content_length = (size_t)v;
+      have_cl = true;
+    } else if (key == "transfer-encoding") {
+      if (value_has_token(value, "chunked")) {
+        return -1;  // chunked request bodies unsupported
+      }
+    } else if (key == "connection") {
+      if (value_has_token(value, "close")) {
+        keep_alive = false;
+      } else if (value_has_token(value, "keep-alive")) {
+        keep_alive = true;
+      }
+    }
+    headers_blob += key;
+    headers_blob += ": ";
+    headers_blob += value;
+    headers_blob += '\n';
+  }
+  (void)have_cl;
+  size_t total = hdr_end + 4 + content_length;
+  if (buf->size() < total) {
+    return 0;
+  }
+  buf->pop_front(hdr_end + 4);
+  out->body.resize(content_length);
+  if (content_length > 0) {
+    buf->copy_to(&out->body[0], content_length);
+    buf->pop_front(content_length);
+  }
+  size_t q = target.find('?');
+  if (q != std::string::npos) {
+    out->path = target.substr(0, q);
+    out->query = target.substr(q + 1);
+  } else {
+    out->path = std::move(target);
+    out->query.clear();
+  }
+  out->method = std::move(method);
+  out->headers = std::move(headers_blob);
+  out->keep_alive = keep_alive;
+  return 1;
+}
+
+const char* HttpStatusText(int status) {
+  switch (status) {
+    case 200: return "OK";
+    case 204: return "No Content";
+    case 301: return "Moved Permanently";
+    case 302: return "Found";
+    case 304: return "Not Modified";
+    case 400: return "Bad Request";
+    case 401: return "Unauthorized";
+    case 403: return "Forbidden";
+    case 404: return "Not Found";
+    case 405: return "Method Not Allowed";
+    case 408: return "Request Timeout";
+    case 413: return "Payload Too Large";
+    case 429: return "Too Many Requests";
+    case 500: return "Internal Server Error";
+    case 501: return "Not Implemented";
+    case 503: return "Service Unavailable";
+    default:  return "Unknown";
+  }
+}
+
+void PackHttpResponse(IOBuf* out, int status, const char* headers_blob,
+                      const uint8_t* body, size_t body_len, bool keep_alive) {
+  std::string h;
+  h.reserve(256 + (headers_blob ? strlen(headers_blob) : 0));
+  h += "HTTP/1.1 ";
+  h += std::to_string(status);
+  h += ' ';
+  h += HttpStatusText(status);
+  h += "\r\n";
+  if (headers_blob != nullptr && headers_blob[0] != '\0') {
+    h += headers_blob;
+    if (h.size() < 2 || h[h.size() - 2] != '\r' || h[h.size() - 1] != '\n') {
+      h += "\r\n";
+    }
+  }
+  h += "Server: brpc-tpu\r\nContent-Length: ";
+  h += std::to_string(body_len);
+  h += keep_alive ? "\r\nConnection: keep-alive\r\n\r\n"
+                  : "\r\nConnection: close\r\n\r\n";
+  out->append(h.data(), h.size());
+  if (body != nullptr && body_len > 0) {
+    out->append(body, body_len);
+  }
+}
+
+}  // namespace trpc

@@ -1,0 +1,47 @@
+// http.h — minimal HTTP/1.x server-side protocol for the shared port
+// (capability of the reference HTTP support: details/http_parser.cpp +
+// policy/http_rpc_protocol.cpp — re-designed, not ported: the reference
+// vendors joyent/http_parser; this is a small restartable parser over the
+// chained read buffer, enough for the debug portal, RESTful services and
+// JSON access to TRPC services).  The same listening port speaks TRPC and
+// HTTP: InputMessenger-style protocol sniffing on the first bytes
+// (≙ input_messenger.cpp:77 CutInputMessage trying registered protocols).
+#pragma once
+
+#include <cstdint>
+#include <string>
+
+#include "iobuf.h"
+
+namespace trpc {
+
+struct HttpRequest {
+  std::string method;   // "GET", "POST", ... (upper-case)
+  std::string path;     // request target before '?'
+  std::string query;    // after '?' (no '?'), may be empty
+  // header lines joined as "lower-key: value\n" — the Python layer splits
+  // them; keys are lower-cased here so lookups are case-insensitive
+  std::string headers;
+  std::string body;
+  bool keep_alive = true;  // HTTP/1.1 default, honoring Connection:
+};
+
+// True if the buffer's first bytes look like an HTTP request line verb.
+// Needs at most 8 readable bytes; returns false when undecidable yet.
+bool LooksLikeHttp(const IOBuf& buf);
+
+// Try to parse one complete request from buf (consuming it).  Returns
+//   1 parsed, 0 need more bytes, -1 malformed / unsupported.
+// Bodies require Content-Length (chunked request bodies are rejected);
+// header block is capped at 64KB, bodies at 512MB.
+int ParseHttpRequest(IOBuf* buf, HttpRequest* out);
+
+// Serialize a full response with Content-Length framing.  headers_blob is
+// zero or more "Key: Value\r\n" lines (may be nullptr); Content-Length,
+// Connection and Server are added here.
+void PackHttpResponse(IOBuf* out, int status, const char* headers_blob,
+                      const uint8_t* body, size_t body_len, bool keep_alive);
+
+const char* HttpStatusText(int status);
+
+}  // namespace trpc

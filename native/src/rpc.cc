@@ -19,6 +19,7 @@
 #include <unordered_map>
 #include <vector>
 
+#include "http.h"
 #include "object_pool.h"
 #include "stream.h"
 #include "timer_thread.h"
@@ -90,6 +91,9 @@ std::string EncodeMeta(const RpcMeta& m) {
   if (m.feedback_bytes != 0) {
     put_tlv_u64(&s, 12, m.feedback_bytes);
   }
+  if (!m.auth.empty()) {
+    put_tlv(&s, 13, m.auth.data(), (uint32_t)m.auth.size());
+  }
   return s;
 }
 
@@ -117,6 +121,7 @@ bool DecodeMeta(const char* p, size_t n, RpcMeta* m) {
       case 10: if (len == 8) memcpy(&m->stream_id, v, 8); break;
       case 11: if (len == 1) m->stream_frame_type = (uint8_t)v[0]; break;
       case 12: if (len == 8) memcpy(&m->feedback_bytes, v, 8); break;
+      case 13: m->auth.assign(v, len); break;
       default: break;  // forward compatibility: skip unknown tags
     }
     i += len;
@@ -195,6 +200,15 @@ struct CallCtx {
   std::string attachment;
   HandlerCb cb = nullptr;
   void* user = nullptr;
+  uint8_t compress_type = 0;
+  // HTTP requests share the CallCtx/usercode-pool path; method carries the
+  // verb, payload the body, and these the rest of the request line
+  bool is_http = false;
+  bool http_keep_alive = true;
+  std::string http_path;
+  std::string http_query;
+  std::string http_headers;
+  HttpHandlerCb hcb = nullptr;
   // streaming handshake: the request's stream_id (client handle) + its
   // advertised receive window, and the stream handle created by
   // stream_accept() for the response meta
@@ -255,10 +269,19 @@ class UsercodePool {
       CallCtx* ctx = q_.front();
       q_.pop_front();
       lk.unlock();
-      ctx->cb(ctx->token(), ctx->method.c_str(),
-              (const uint8_t*)ctx->payload.data(), ctx->payload.size(),
-              (const uint8_t*)ctx->attachment.data(), ctx->attachment.size(),
-              ctx->user);
+      if (ctx->is_http) {
+        ctx->hcb(ctx->token(), ctx->method.c_str(), ctx->http_path.c_str(),
+                 ctx->http_query.c_str(),
+                 (const uint8_t*)ctx->http_headers.data(),
+                 ctx->http_headers.size(),
+                 (const uint8_t*)ctx->payload.data(), ctx->payload.size(),
+                 ctx->user);
+      } else {
+        ctx->cb(ctx->token(), ctx->method.c_str(),
+                (const uint8_t*)ctx->payload.data(), ctx->payload.size(),
+                (const uint8_t*)ctx->attachment.data(),
+                ctx->attachment.size(), ctx->user);
+      }
       lk.lock();
     }
   }
@@ -283,6 +306,10 @@ struct ServiceHandler {
 class Server {
  public:
   std::unordered_map<std::string, ServiceHandler> services;
+  HttpHandlerCb http_cb = nullptr;
+  void* http_user = nullptr;
+  bool has_auth = false;
+  std::string auth_secret;
   int listen_fd = -1;
   SocketId listen_sock = INVALID_SOCKET_ID;
   int port = 0;
@@ -299,7 +326,7 @@ namespace {
 void SendResponse(SocketId sock_id, uint64_t correlation_id,
                   int32_t error_code, const char* error_text, IOBuf&& payload,
                   IOBuf&& attachment, uint64_t stream_id = 0,
-                  uint64_t stream_window = 0) {
+                  uint64_t stream_window = 0, uint8_t compress_type = 0) {
   Socket* s = Socket::Address(sock_id);
   if (s == nullptr) {
     return;
@@ -313,10 +340,59 @@ void SendResponse(SocketId sock_id, uint64_t correlation_id,
   meta.stream_id = stream_id;  // accepted-stream handle rides the response
   meta.feedback_bytes = stream_window;  // its advertised receive window
   meta.flags = 1;  // response
+  meta.compress_type = compress_type;
   IOBuf frame;
   PackFrame(&frame, meta, std::move(payload), std::move(attachment));
   s->Write(std::move(frame));
   s->Dereference();
+}
+
+// Constant-time credential compare (≙ VerifyCredential; not data-dependent
+// so EAUTH timing leaks neither length progress nor a matching prefix).
+bool ConstantTimeEq(const std::string& a, const std::string& b) {
+  unsigned char diff = a.size() == b.size() ? 0 : 1;
+  size_t n = std::min(a.size(), b.size());
+  for (size_t i = 0; i < n; ++i) {
+    diff |= (unsigned char)(a[i] ^ b[i]);
+  }
+  return diff == 0;
+}
+
+// One parsed HTTP request → usercode pool (or immediate error response).
+void DispatchHttp(Socket* s, Server* srv, HttpRequest&& req) {
+  if (srv->http_cb == nullptr || !srv->running.load(std::memory_order_acquire)) {
+    int status = srv->http_cb == nullptr ? 404 : 503;
+    IOBuf resp;
+    const char* msg = status == 404 ? "no HTTP handler registered\n"
+                                    : "server is stopping\n";
+    PackHttpResponse(&resp, status, "Content-Type: text/plain\r\n",
+                     (const uint8_t*)msg, strlen(msg), req.keep_alive);
+    s->Write(std::move(resp));
+    return;
+  }
+  srv->nrequests.fetch_add(1, std::memory_order_relaxed);
+  // block further HTTP parsing on this connection until the response is out
+  // (HTTP/1.1 responses must come back in request order; the usercode pool
+  // is multi-threaded, so concurrent dispatch would race)
+  s->http_inflight.store(1, std::memory_order_release);
+  CallCtx* ctx = nullptr;
+  uint32_t slot = ResourcePool<CallCtx>::Get(&ctx);
+  ctx->slot = slot;
+  ctx->sock = s->id();
+  ctx->is_http = true;
+  ctx->http_keep_alive = req.keep_alive;
+  ctx->method = std::move(req.method);
+  ctx->http_path = std::move(req.path);
+  ctx->http_query = std::move(req.query);
+  ctx->http_headers = std::move(req.headers);
+  ctx->payload = std::move(req.body);
+  ctx->attachment.clear();
+  ctx->req_stream_id = 0;
+  ctx->req_stream_window = 0;
+  ctx->accepted_stream = 0;
+  ctx->hcb = srv->http_cb;
+  ctx->user = srv->http_user;
+  UsercodePool::Instance().Submit(ctx);
 }
 
 // edge_fn of server-side connection sockets: read + parse + dispatch
@@ -330,6 +406,33 @@ void ServerOnMessages(Socket* s) {
     return;
   }
   while (true) {
+    // protocol sniff per message (≙ CutInputMessage trying protocols,
+    // input_messenger.cpp:77): "TRPC" magic or an HTTP verb
+    if (s->read_buf.size() < 4) {
+      break;
+    }
+    char magic[4];
+    s->read_buf.copy_to(magic, 4);
+    if (memcmp(magic, "TRPC", 4) != 0) {
+      if (!LooksLikeHttp(s->read_buf)) {
+        s->SetFailed(TRPC_EREQUEST);
+        return;
+      }
+      if (s->http_inflight.load(std::memory_order_acquire) != 0) {
+        break;  // pipelined request: wait for the in-flight response
+      }
+      HttpRequest hreq;
+      int hrc = ParseHttpRequest(&s->read_buf, &hreq);
+      if (hrc == 0) {
+        break;
+      }
+      if (hrc < 0) {
+        s->SetFailed(TRPC_EREQUEST);
+        return;
+      }
+      DispatchHttp(s, srv, std::move(hreq));
+      continue;
+    }
     RpcMeta meta;
     IOBuf payload, attachment;
     int rc = ParseFrame(&s->read_buf, &meta, &payload, &attachment);
@@ -341,6 +444,13 @@ void ServerOnMessages(Socket* s) {
       return;
     }
     if (meta.stream_frame_type != STREAM_FRAME_NONE) {
+      if (srv->has_auth && !s->authed.load(std::memory_order_acquire)) {
+        // stream frames carry no credential: they are only honored once
+        // this connection authenticated a request (else a stranger could
+        // close/inject into another client's stream by guessing ids)
+        s->SetFailed(TRPC_EAUTH);
+        return;
+      }
       StreamHandleFrame(meta, std::move(payload));
       continue;
     }
@@ -349,6 +459,16 @@ void ServerOnMessages(Socket* s) {
       SendResponse(s->id(), meta.correlation_id, TRPC_ESTOP,
                    "server is stopping", IOBuf(), IOBuf());
       continue;
+    }
+    if (srv->has_auth && !s->authed.load(std::memory_order_acquire)) {
+      // per-connection verify on the first request (≙ brpc verifying the
+      // first message, Authenticator::VerifyCredential → ERPCAUTH)
+      if (!ConstantTimeEq(meta.auth, srv->auth_secret)) {
+        SendResponse(s->id(), meta.correlation_id, TRPC_EAUTH,
+                     "authentication failed", IOBuf(), IOBuf());
+        continue;
+      }
+      s->authed.store(true, std::memory_order_release);
     }
     srv->nrequests.fetch_add(1, std::memory_order_relaxed);
     auto it = srv->services.find(meta.method);
@@ -374,6 +494,8 @@ void ServerOnMessages(Socket* s) {
       uint32_t slot = ResourcePool<CallCtx>::Get(&ctx);
       ctx->slot = slot;
       ctx->sock = s->id();
+      ctx->is_http = false;
+      ctx->compress_type = meta.compress_type;
       ctx->req_stream_id = meta.stream_id;
       ctx->req_stream_window = meta.feedback_bytes;
       ctx->accepted_stream = 0;
@@ -443,6 +565,58 @@ int server_add_service(Server* s, const char* name, int kind, HandlerCb cb,
   h.user = user;
   s->services[name] = h;
   return 0;
+}
+
+void server_set_http_handler(Server* s, HttpHandlerCb cb, void* user) {
+  s->http_cb = cb;
+  s->http_user = user;
+}
+
+void server_set_auth(Server* s, const uint8_t* secret, size_t len) {
+  s->auth_secret.assign((const char*)secret, len);
+  s->has_auth = len > 0;
+}
+
+size_t server_conn_stats(Server* s, char* buf, size_t cap) {
+  std::vector<SocketId> conns;
+  {
+    std::lock_guard<std::mutex> lk(s->conns_mu);
+    for (auto& kv : s->conns) {
+      conns.push_back(kv.first);
+    }
+  }
+  size_t off = 0;
+  for (SocketId id : conns) {
+    Socket* cs = Socket::Address(id);
+    if (cs == nullptr) {
+      continue;
+    }
+    sockaddr_in peer;
+    socklen_t plen = sizeof(peer);
+    char ip[32] = "?";
+    int pport = 0;
+    if (getpeername(cs->fd, (sockaddr*)&peer, &plen) == 0) {
+      inet_ntop(AF_INET, &peer.sin_addr, ip, sizeof(ip));
+      pport = ntohs(peer.sin_port);
+    }
+    int n = snprintf(buf + off, off < cap ? cap - off : 0,
+                     "%llu %d %s:%d %llu %llu\n", (unsigned long long)id,
+                     cs->fd, ip, pport,
+                     (unsigned long long)cs->bytes_in.load(
+                         std::memory_order_relaxed),
+                     (unsigned long long)cs->bytes_out.load(
+                         std::memory_order_relaxed));
+    cs->Dereference();
+    if (n < 0) {
+      break;
+    }
+    off += (size_t)n;
+    if (off >= cap) {
+      off = cap;
+      break;
+    }
+  }
+  return off;
 }
 
 int server_start(Server* s, const char* ip, int port) {
@@ -542,7 +716,7 @@ uint64_t server_requests(Server* s) {
 
 int respond(uint64_t token, int32_t error_code, const char* error_text,
             const uint8_t* data, size_t len, const uint8_t* attach,
-            size_t attach_len) {
+            size_t attach_len, uint8_t compress_type) {
   uint32_t slot = (uint32_t)token;
   uint32_t ver = (uint32_t)(token >> 32);
   CallCtx* ctx = ResourcePool<CallCtx>::Address(slot);
@@ -567,12 +741,115 @@ int respond(uint64_t token, int32_t error_code, const char* error_text,
   }
   SendResponse(ctx->sock, ctx->correlation_id, error_code, error_text,
                std::move(payload), std::move(attachment), accepted,
-               accepted != 0 ? stream_window(accepted) : 0);
+               accepted != 0 ? stream_window(accepted) : 0, compress_type);
   ctx->version.fetch_add(1, std::memory_order_release);  // invalidate token
   ctx->payload.clear();
   ctx->attachment.clear();
   ResourcePool<CallCtx>::Return(slot);
   return 0;
+}
+
+namespace {
+
+// Waits (on a fiber, off the usercode pool) for a Connection:-close HTTP
+// response to drain, then closes the connection (≙ the reference closing
+// non-keep-alive HTTP connections after the response is flushed).
+struct CloseWaitArg {
+  SocketId id;
+  Butex* done;
+};
+
+void CloseAfterWriteFiber(void* a) {
+  CloseWaitArg* arg = (CloseWaitArg*)a;
+  int64_t budget_us = 5 * 1000 * 1000;
+  while (budget_us > 0 &&
+         butex_value(arg->done).load(std::memory_order_acquire) == 0) {
+    butex_wait(arg->done, 0, 100 * 1000);
+    budget_us -= 100 * 1000;
+    Socket* s = Socket::Address(arg->id);
+    if (s == nullptr) {
+      butex_destroy(arg->done);
+      delete arg;
+      return;  // already recycled
+    }
+    bool failed = s->failed.load(std::memory_order_acquire);
+    s->Dereference();
+    if (failed) {
+      break;  // peer already gone; the write notify won't fire
+    }
+  }
+  Socket* s = Socket::Address(arg->id);
+  if (s != nullptr) {
+    s->SetFailed(TRPC_ESTOP);
+    s->Dereference();
+  }
+  butex_destroy(arg->done);
+  delete arg;
+}
+
+}  // namespace
+
+int http_respond(uint64_t token, int status, const char* headers_blob,
+                 const uint8_t* body, size_t body_len) {
+  uint32_t slot = (uint32_t)token;
+  uint32_t ver = (uint32_t)(token >> 32);
+  CallCtx* ctx = ResourcePool<CallCtx>::Address(slot);
+  if (ctx == nullptr || !ctx->is_http ||
+      ctx->version.load(std::memory_order_acquire) != ver) {
+    return -EINVAL;
+  }
+  bool keep_alive = ctx->http_keep_alive;
+  Socket* s = Socket::Address(ctx->sock);
+  if (s != nullptr) {
+    IOBuf resp;
+    PackHttpResponse(&resp, status, headers_blob, body, body_len, keep_alive);
+    if (keep_alive) {
+      s->Write(std::move(resp));
+      // release the per-connection ordering gate and re-arm parsing so a
+      // buffered pipelined request (parse loop broke on http_inflight)
+      // gets dispatched
+      s->http_inflight.store(0, std::memory_order_release);
+      Socket::StartInputEvent(s->id());
+    } else {
+      // "Connection: close": actively close once the response is on the
+      // wire.  The wait happens on a fiber (CloseAfterWriteFiber), never
+      // on this usercode-pool thread — a slow reader must not stall the
+      // shared handler pool.
+      Butex* done = butex_create();
+      if (s->Write(std::move(resp), done) != 0) {
+        butex_destroy(done);
+        s->SetFailed(TRPC_ESTOP);
+      } else {
+        CloseWaitArg* arg = new CloseWaitArg{s->id(), done};
+        fiber_t f;
+        if (fiber_start(&f, CloseAfterWriteFiber, arg) != 0) {
+          butex_destroy(done);
+          delete arg;
+          s->SetFailed(TRPC_ESTOP);
+        }
+      }
+    }
+    s->Dereference();
+  }
+  ctx->version.fetch_add(1, std::memory_order_release);
+  ctx->payload.clear();
+  ctx->http_path.clear();
+  ctx->http_query.clear();
+  ctx->http_headers.clear();
+  ctx->is_http = false;
+  ResourcePool<CallCtx>::Return(slot);
+  return 0;
+}
+
+int token_compress_type(uint64_t token) {
+  uint32_t slot = (uint32_t)token;
+  uint32_t ver = (uint32_t)(token >> 32);
+  CallCtx* ctx = ResourcePool<CallCtx>::Address(slot);
+  if (ctx == nullptr ||
+      ctx->version.load(std::memory_order_acquire) != ver) {
+    return -EINVAL;
+  }
+  return ctx->compress_type;
 }
 
 // The request's stream handle (0 if the client attached no stream).
@@ -621,6 +898,7 @@ struct PendingCall {
   IOBuf attachment;
   uint64_t stream_id = 0;      // server's accepted-stream handle, if any
   uint64_t stream_window = 0;  // its advertised receive window
+  uint8_t compress_type = 0;   // of the response payload
 };
 
 }  // namespace
@@ -630,6 +908,7 @@ class Channel {
   std::string ip;
   int port = 0;
   int64_t connect_timeout_us = 500 * 1000;
+  std::string auth;  // credential riding every request meta (tag 13)
   std::atomic<uint64_t> next_corr{1};
   std::mutex map_mu;
   std::unordered_map<uint64_t, PendingCall*> pending;
@@ -721,6 +1000,7 @@ void ChannelOnMessages(Socket* s) {
     pc->attachment = std::move(attachment);
     pc->stream_id = meta.stream_id;
     pc->stream_window = meta.feedback_bytes;
+    pc->compress_type = meta.compress_type;
     butex_value(pc->done).store(1, std::memory_order_release);
     butex_wake_all(pc->done);
   }
@@ -814,6 +1094,10 @@ void channel_set_connect_timeout(Channel* c, int64_t us) {
   c->connect_timeout_us = us;
 }
 
+void channel_set_auth(Channel* c, const uint8_t* secret, size_t len) {
+  c->auth.assign((const char*)secret, len);
+}
+
 void set_usercode_workers(int n) {
   g_usercode_workers.store(n, std::memory_order_relaxed);
 }
@@ -850,7 +1134,8 @@ void channel_destroy(Channel* c) {
 
 int channel_call(Channel* c, const char* method, const uint8_t* req,
                  size_t req_len, const uint8_t* attach, size_t attach_len,
-                 int64_t timeout_us, CallResult* out, uint64_t stream) {
+                 int64_t timeout_us, CallResult* out, uint64_t stream,
+                 uint8_t compress) {
   SocketId sid;
   int rc = EnsureConnected(c, &sid);
   if (rc != 0) {
@@ -876,6 +1161,7 @@ int channel_call(Channel* c, const char* method, const uint8_t* req,
   pc->attachment.clear();
   pc->stream_id = 0;
   pc->stream_window = 0;
+  pc->compress_type = 0;
   {
     std::lock_guard<std::mutex> lk(c->map_mu);
     c->pending[corr] = pc;
@@ -883,6 +1169,8 @@ int channel_call(Channel* c, const char* method, const uint8_t* req,
   RpcMeta meta;
   meta.method = method;
   meta.correlation_id = corr;
+  meta.compress_type = compress;
+  meta.auth = c->auth;
   meta.stream_id = stream;  // client stream handle rides the request
   if (stream != 0) {
     meta.feedback_bytes = stream_window(stream);  // advertise recv window
@@ -954,6 +1242,7 @@ int channel_call(Channel* c, const char* method, const uint8_t* req,
     out->error_text = pc->error_text;
     out->response = pc->response.to_string();
     out->attachment = pc->attachment.to_string();
+    out->compress_type = pc->compress_type;
   }
   pc->response.clear();
   pc->attachment.clear();

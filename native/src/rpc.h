@@ -19,6 +19,9 @@
 //   7 trace_id (u64 LE)                 8 span_id (u64 LE)
 //   9 flags (u8: bit0 = response)      10 stream_id (u64 LE)
 //  11 stream_frame_type (u8)           12 feedback_bytes (u64 LE)
+//  13 auth (bytes — connection credential, ≙ Authenticator,
+//     authenticator.h: the client's generate_credential output, verified
+//     server-side before dispatch)
 #pragma once
 
 #include <cstdint>
@@ -41,6 +44,7 @@ struct RpcMeta {
   uint64_t stream_id = 0;
   uint8_t stream_frame_type = 0;  // 0 none, 1 data, 2 close, 3 feedback
   uint64_t feedback_bytes = 0;
+  std::string auth;
 
   bool is_response() const { return flags & 1; }
 };
@@ -67,6 +71,15 @@ typedef void (*HandlerCb)(uint64_t token, const char* method,
                           const uint8_t* attach, size_t attach_len,
                           void* user);
 
+// HTTP request handler (≙ the reference's http services: the server's one
+// port also speaks HTTP/1.x, sniffed per input_messenger.cpp:77).  headers
+// is "lower-key: value\n" lines.  Responder must call http_respond(token,…).
+typedef void (*HttpHandlerCb)(uint64_t token, const char* verb,
+                              const char* path, const char* query,
+                              const uint8_t* headers, size_t headers_len,
+                              const uint8_t* body, size_t body_len,
+                              void* user);
+
 class Server;
 
 Server* server_create();
@@ -74,6 +87,10 @@ Server* server_create();
 //       1 = callback on usercode pthread pool
 int server_add_service(Server* s, const char* name, int kind, HandlerCb cb,
                        void* user);
+// One HTTP dispatcher per server handles every HTTP request on the port.
+void server_set_http_handler(Server* s, HttpHandlerCb cb, void* user);
+// Require this credential (meta tag 13) on every TRPC request.
+void server_set_auth(Server* s, const uint8_t* secret, size_t len);
 int server_start(Server* s, const char* ip, int port);
 int server_port(Server* s);
 int server_stop(Server* s);
@@ -82,11 +99,19 @@ int server_stop(Server* s);
 void server_destroy(Server* s);
 // per-server counters
 uint64_t server_requests(Server* s);
+// Write "sockid fd peer bytes_in bytes_out\n" lines for live connections
+// into buf (≙ the /connections builtin); returns bytes written.
+size_t server_conn_stats(Server* s, char* buf, size_t cap);
 
 // Respond to a pending call token (thread-safe, any thread).
 int respond(uint64_t token, int32_t error_code, const char* error_text,
             const uint8_t* data, size_t len, const uint8_t* attach,
-            size_t attach_len);
+            size_t attach_len, uint8_t compress_type = 0);
+// Respond to a pending HTTP token.  headers_blob: "Key: Value\r\n" lines.
+int http_respond(uint64_t token, int status, const char* headers_blob,
+                 const uint8_t* body, size_t body_len);
+// Compress type of a pending request's meta (what the client used).
+int token_compress_type(uint64_t token);
 
 // --- client ---------------------------------------------------------------
 
@@ -95,6 +120,8 @@ class Channel;
 Channel* channel_create(const char* ip, int port);
 void channel_destroy(Channel* c);
 void channel_set_connect_timeout(Channel* c, int64_t us);
+// Credential attached to every request meta (≙ generate_credential).
+void channel_set_auth(Channel* c, const uint8_t* secret, size_t len);
 
 // size of the pthread pool running Python handlers (before first request)
 void set_usercode_workers(int n);
@@ -104,15 +131,19 @@ struct CallResult {
   std::string error_text;
   std::string response;
   std::string attachment;
+  uint8_t compress_type = 0;  // of the response payload
 };
 
 // Synchronous call (from fiber or pthread).  Returns 0 or error code.
 // `stream` (optional): a stream_create() handle to attach — the streaming
 // handshake rides this RPC (stream.h); on success the stream is bound to
 // the connection and the server's accepted-stream handle.
+// `compress` declares how the caller already encoded `req` (the native
+// layer only carries the tag; codecs live in the Python compress registry).
 int channel_call(Channel* c, const char* method, const uint8_t* req,
                  size_t req_len, const uint8_t* attach, size_t attach_len,
-                 int64_t timeout_us, CallResult* out, uint64_t stream = 0);
+                 int64_t timeout_us, CallResult* out, uint64_t stream = 0,
+                 uint8_t compress = 0);
 
 // --- streaming handshake helpers (server side; see stream.h) --------------
 

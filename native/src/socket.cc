@@ -47,6 +47,11 @@ int Socket::Create(const SocketOptions& opts, SocketId* id_out) {
   s->read_buf.clear();
   s->bytes_in.store(0, std::memory_order_relaxed);
   s->bytes_out.store(0, std::memory_order_relaxed);
+  // pooled slot may carry the previous connection's HTTP ordering gate
+  // (left set by a Connection:-close response) — a stale 1 here would make
+  // the new connection's requests sit unparsed forever
+  s->http_inflight.store(0, std::memory_order_relaxed);
+  s->authed.store(false, std::memory_order_relaxed);
   if (s->epollout_butex == nullptr) {
     s->epollout_butex = butex_create();
   }

@@ -60,6 +60,14 @@ class Socket {
   // running statistics
   std::atomic<uint64_t> bytes_in{0};
   std::atomic<uint64_t> bytes_out{0};
+  // HTTP/1.1 response ordering: while a pooled HTTP request is in flight
+  // the parse loop must not dispatch the next pipelined request (responses
+  // would race out of order); http_respond clears this and re-arms parsing
+  std::atomic<uint32_t> http_inflight{0};
+  // server auth state: set once the first request's credential verifies
+  // (≙ brpc verifying auth on a connection's first message); stream frames
+  // are only honored on authed connections
+  std::atomic<bool> authed{false};
 
   static int Create(const SocketOptions& opts, SocketId* id_out);
   // +1 ref; nullptr if the id is stale.

@@ -170,6 +170,37 @@ def test_partition_channel_missing_partition():
         srv.destroy()
 
 
+def test_partition_channel_missing_shares_fail_budget():
+    """A missing partition and a failed RPC draw from the SAME fail_limit:
+    1 missing of 3 with fail_limit=1 succeeds, fail_limit=0 fails — and the
+    merger still sees one positional slot per logical partition."""
+    s0, s1 = make_server(b"p0"), make_server(b"p1")
+    slots = {}
+
+    class Recorder(ResponseMerger):
+        def merge(self, results):
+            slots["n"] = len(results)
+            return b"".join(r for r in results if r is not None)
+
+    try:
+        url = (f"list://127.0.0.1:{s0.port} 0/3,"
+               f"127.0.0.1:{s1.port} 1/3")  # partition 2 missing
+        pch = PartitionChannel(url, partition_count=3,
+                               response_merger=Recorder(), fail_limit=1)
+        out = pch.call("Who", b"x")
+        assert out == b"p0:xp1:x"
+        assert slots["n"] == 3  # merger saw the missing partition's slot
+        pch.close()
+        strict = PartitionChannel(url, partition_count=3, fail_limit=0)
+        with pytest.raises(RpcError):
+            strict.call("Who", b"x")
+        strict.close()
+    finally:
+        for s in (s0, s1):
+            s.stop()
+            s.destroy()
+
+
 def test_dynamic_partition_channel(trio):
     """Two schemes live at once; capacity weighting picks only complete
     ones (the 3-way scheme has 1/3 partitions -> capacity 0)."""
