@@ -14,6 +14,7 @@ import ctypes
 import json
 import os
 import sys
+import threading
 import time
 
 from brpc_tpu._native import lib
@@ -85,13 +86,19 @@ def _fibers(req: HttpRequest) -> HttpResponse:
     })
 
 
-def _flags_service(req: HttpRequest) -> HttpResponse:
+def _flags_service(req: HttpRequest,
+                   writable: bool = False) -> HttpResponse:
     """GET /flags — list; GET /flags/<name> — one; ?setvalue=v — hot reload
     (≙ builtin/flags_service.cpp: live GET/SET of gflags; only reloadable
-    flags accept a set, reloadable_flags.h)."""
+    flags accept a set, reloadable_flags.h).  Writes require
+    ServerOptions.builtin_writable."""
     name = req.path[len("/flags"):].lstrip("/")
     params = req.query_params()
     if name and "setvalue" in params:
+        if not writable:
+            return HttpResponse.text(
+                "flag writes disabled (ServerOptions.builtin_writable)\n",
+                403)
         try:
             flags.set_flag(name, params["setvalue"])
         except Exception as e:
@@ -111,12 +118,26 @@ def _flags_service(req: HttpRequest) -> HttpResponse:
     return HttpResponse.text("\n".join(lines) + "\n")
 
 
+_hotspots_gate = threading.Semaphore(1)
+
+
 def _hotspots(req: HttpRequest) -> HttpResponse:
     """Sampling CPU profiler: collapsed stacks over ?seconds= (default 1) —
     the capability of /hotspots/cpu (builtin/hotspots_service.cpp drives
     pprof sampling); TPU build renders flamegraph-ready collapsed lines
-    instead of embedding pprof perl."""
-    seconds = min(float(req.query_params().get("seconds", "1")), 30.0)
+    instead of embedding pprof perl.  Single profile at a time, capped at
+    10s: the handler occupies one shared usercode-pool thread while it
+    samples (≙ the reference rejecting concurrent profiling sessions)."""
+    if not _hotspots_gate.acquire(blocking=False):
+        return HttpResponse.text("another profile is running\n", 429)
+    try:
+        return _hotspots_locked(req)
+    finally:
+        _hotspots_gate.release()
+
+
+def _hotspots_locked(req: HttpRequest) -> HttpResponse:
+    seconds = min(float(req.query_params().get("seconds", "1")), 10.0)
     interval = 0.005
     counts: dict = {}
     deadline = time.monotonic() + seconds
@@ -147,8 +168,10 @@ def install_builtin_services(server, dispatcher: HttpDispatcher) -> None:
     d.register("/vars", _vars)
     d.register("/metrics", _metrics)
     d.register("/fibers", _fibers)
-    d.register("/flags", _flags_service)
-    d.register("/flags/", _flags_service, prefix=True)
+    writable = bool(getattr(server.options, "builtin_writable", False))
+    d.register("/flags", lambda r: _flags_service(r, writable))
+    d.register("/flags/", lambda r: _flags_service(r, writable),
+               prefix=True)
     d.register("/hotspots", _hotspots)
 
     def _status(req: HttpRequest) -> HttpResponse:
@@ -169,9 +192,14 @@ def install_builtin_services(server, dispatcher: HttpDispatcher) -> None:
         from brpc_tpu.rpc import span as _span
         params = req.query_params()
         trace_id = params.get("trace_id")
+        try:
+            # ids are printed as bare hex by Span.describe — parse them back
+            # the same way
+            tid = int(trace_id, 16) if trace_id else None
+        except ValueError:
+            return HttpResponse.text(f"bad trace_id {trace_id!r}\n", 400)
         spans = _span.recent_spans(
-            int(params.get("max_scan", "100")),
-            int(trace_id, 0) if trace_id else None)
+            int(params.get("max_scan", "100")), tid)
         return HttpResponse.json([s.describe() for s in spans])
 
     d.register("/status", _status)

@@ -1,0 +1,35 @@
+"""Collector — shared sampling budget (≙ bvar::Collector, collector.h:41:
+one global sampling service with a speed limit, shared by rpcz spans and
+rpc_dump in the reference; COLLECTOR_SAMPLING_BASE=16384/s).
+
+A ``PerSecondBudget`` refills from its flag once per wall second, on a
+monotonic clock so NTP steps can't double-refill it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from brpc_tpu.utils import flags
+
+
+class PerSecondBudget:
+    """Token bucket refilled to ``flags[flag_name]`` each second."""
+
+    def __init__(self, flag_name: str):
+        self._flag = flag_name
+        self._lock = threading.Lock()
+        self._budget = 0
+        self._sec = -1
+
+    def try_take(self) -> bool:
+        now = int(time.monotonic())
+        with self._lock:
+            if now != self._sec:
+                self._sec = now
+                self._budget = int(flags.get_flag(self._flag))
+            if self._budget <= 0:
+                return False
+            self._budget -= 1
+            return True

@@ -1,0 +1,153 @@
+"""rpc_dump — sampled request snapshotting + replay iteration
+(≙ the reference rpc_dump.{h,cpp}: SampledRequest rpc_dump.h:50 throttled
+by the bvar Collector :69, written to butil::recordio files with rotation,
+RpcDumpContext rpc_dump.cpp:68,150; read back by SampleIterator rpc_dump.h:81
+and replayed by tools/rpc_replay).
+
+Enable with the ``rpc_dump`` flag; sampled inbound requests are serialized
+(method, payload, attachment, compress type) into recordio files under
+``rpc_dump_dir``, rotated by size.  ``SampleIterator`` yields them back for
+tools.rpc_replay.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Iterator, Optional
+
+from brpc_tpu.utils import flags, recordio
+
+flags.define_bool("rpc_dump", False, "sample inbound requests to disk")
+flags.define_string("rpc_dump_dir", "./rpc_dump",
+                    "directory of rpc_dump sample files")
+flags.define_int32("rpc_dump_max_requests_in_one_file", 1000,
+                   "rotate after this many samples per file")
+flags.define_int32("rpc_dump_max_files", 32,
+                   "keep at most this many rotated files")
+flags.define_int32("rpc_dump_max_samples_per_second", 1024,
+                   "sampling budget (≙ collector speed limit)")
+
+
+@dataclass
+class SampledRequest:
+    """One captured inbound request (≙ SampledRequest, rpc_dump.h:50)."""
+    method: str
+    payload: bytes
+    attachment: bytes = b""
+    compress_type: int = 0
+    timestamp: float = 0.0
+
+    def serialize(self) -> bytes:
+        head = json.dumps({
+            "method": self.method,
+            "compress_type": self.compress_type,
+            "timestamp": self.timestamp,
+            "payload_len": len(self.payload),
+            "attachment_len": len(self.attachment),
+        }).encode()
+        return b"%d\n%s%s%s" % (len(head), head, self.payload,
+                                self.attachment)
+
+    @staticmethod
+    def deserialize(blob: bytes) -> "SampledRequest":
+        nl = blob.index(b"\n")
+        head_len = int(blob[:nl])
+        head = json.loads(blob[nl + 1:nl + 1 + head_len])
+        rest = blob[nl + 1 + head_len:]
+        pl = head["payload_len"]
+        return SampledRequest(
+            method=head["method"],
+            payload=rest[:pl],
+            attachment=rest[pl:pl + head["attachment_len"]],
+            compress_type=head["compress_type"],
+            timestamp=head["timestamp"])
+
+
+class RpcDumpContext:
+    """Per-server dump state: sampling budget + rotating writer
+    (≙ RpcDumpContext, rpc_dump.cpp:68)."""
+
+    def __init__(self, dir_path: Optional[str] = None):
+        from brpc_tpu.metrics.collector import PerSecondBudget
+        # dir resolved lazily at first rotate so a context constructed at
+        # server init still honors a later rpc_dump_dir flag change
+        self._dir_override = dir_path
+        self._lock = threading.Lock()
+        self._writer: Optional[recordio.RecordWriter] = None
+        self._in_file = 0
+        self._seq = 0
+        self._budget = PerSecondBudget("rpc_dump_max_samples_per_second")
+
+    def _try_sample(self) -> bool:
+        return self._budget.try_take()
+
+    @property
+    def _dir(self) -> str:
+        return self._dir_override or str(flags.get_flag("rpc_dump_dir"))
+
+    def _rotate(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+        os.makedirs(self._dir, exist_ok=True)
+        path = os.path.join(
+            self._dir, f"requests.{int(time.time())}.{self._seq:06d}")
+        self._seq += 1
+        self._writer = recordio.RecordWriter(path)
+        self._in_file = 0
+        # prune old files (reference keeps a bounded set of rotated files)
+        keep = int(flags.get_flag("rpc_dump_max_files"))
+        files = sorted(f for f in os.listdir(self._dir)
+                       if f.startswith("requests."))
+        for f in files[:-keep] if len(files) > keep else []:
+            try:
+                os.unlink(os.path.join(self._dir, f))
+            except OSError:
+                pass
+
+    def sample(self, req: SampledRequest) -> bool:
+        """Called on the server hot path; cheap no-op unless enabled and
+        under budget."""
+        if not flags.get_flag("rpc_dump"):
+            return False
+        with self._lock:
+            if not self._try_sample():
+                return False
+            if (self._writer is None or self._in_file >=
+                    int(flags.get_flag("rpc_dump_max_requests_in_one_file"))):
+                self._rotate()
+            req.timestamp = time.time()
+            self._writer.write(req.serialize())
+            self._writer.flush()
+            self._in_file += 1
+            return True
+
+    def close(self) -> None:
+        with self._lock:
+            if self._writer is not None:
+                self._writer.close()
+                self._writer = None
+
+
+class SampleIterator:
+    """Iterate every sample under a dump dir (≙ SampleIterator,
+    rpc_dump.h:81)."""
+
+    def __init__(self, dir_path: Optional[str] = None):
+        self._dir = dir_path or str(flags.get_flag("rpc_dump_dir"))
+
+    def __iter__(self) -> Iterator[SampledRequest]:
+        if not os.path.isdir(self._dir):
+            return
+        for name in sorted(os.listdir(self._dir)):
+            if not name.startswith("requests."):
+                continue
+            for blob in recordio.read_records(
+                    os.path.join(self._dir, name)):
+                try:
+                    yield SampledRequest.deserialize(blob)
+                except (ValueError, KeyError, IndexError):
+                    continue  # skip corrupt sample

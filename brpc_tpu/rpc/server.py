@@ -19,6 +19,7 @@ from typing import Callable, Dict, Optional, Tuple, Union
 from brpc_tpu._native import HTTP_FN, lib
 from brpc_tpu.metrics import bvar
 from brpc_tpu.rpc import compress as compress_mod
+from brpc_tpu.rpc import dump as dump_mod
 from brpc_tpu.rpc import errors, span
 from brpc_tpu.rpc.controller import Controller
 from brpc_tpu.rpc.http import (HttpDispatcher, HttpRequest, pack_headers,
@@ -49,6 +50,11 @@ class ServerOptions:
     # verified natively before dispatch).  Channels send it via
     # ChannelOptions.auth.
     auth: Optional[bytes] = None
+    # Allow state-mutating builtin endpoints (/flags?setvalue=) on the
+    # portal.  Deviation from the reference (which allows flag writes by
+    # default): unauthenticated remote flag mutation is too sharp a tool
+    # to expose implicitly — opt in, or set `auth` which gates all HTTP.
+    builtin_writable: bool = False
 
 
 class _MethodStatus:
@@ -75,6 +81,9 @@ class Server:
         self._started = False
         self._port = 0
         self._limiter = None  # cluster.ConcurrencyLimiter, set via option
+        # dump context built eagerly (cheap: opens no file until the
+        # rpc_dump flag turns on) so usercode threads never race a lazy init
+        self._dump = dump_mod.RpcDumpContext()
         self.http = HttpDispatcher()
         self.http._server = self  # for the /rpc/<method> JSON bridge
 
@@ -138,6 +147,14 @@ class Server:
                 req = ctypes.string_at(req_p, req_len) if req_len else b""
                 cntl.request_compress_type = max(
                     L.trpc_token_compress(token), 0)
+                if flags.get_flag("rpc_dump"):
+                    # sample the wire-form request (pre-decompression,
+                    # ≙ rpc_dump capturing what arrived, rpc_dump.cpp)
+                    limiter_box._dump.sample(dump_mod.SampledRequest(
+                        method=cntl.method, payload=req,
+                        attachment=ctypes.string_at(att_p, att_len)
+                        if att_len else b"",
+                        compress_type=cntl.request_compress_type))
                 if cntl.request_compress_type:
                     try:
                         req = compress_mod.decompress(
@@ -283,6 +300,7 @@ class Server:
             self.stop()
             lib().trpc_server_destroy(self._handle)
             self._handle = None
+        self._dump.close()
         for st in self._method_status.values():
             st.close()
         self._method_status.clear()

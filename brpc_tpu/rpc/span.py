@@ -71,26 +71,18 @@ class Span:
 
 
 class _Store:
-    """Ring of finished spans + per-second sampling budget."""
+    """Ring of finished spans + per-second sampling budget
+    (the budget is the shared Collector primitive, ≙ bvar::Collector)."""
 
     def __init__(self):
+        from brpc_tpu.metrics.collector import PerSecondBudget
         self._lock = threading.Lock()
         self._ring: deque = deque(maxlen=int(flags.get_flag(
             "rpcz_keep_spans")))
-        self._budget = 0
-        self._budget_sec = 0
+        self._budget = PerSecondBudget("rpcz_max_samples_per_second")
 
     def try_sample(self) -> bool:
-        now = int(time.time())
-        with self._lock:
-            if now != self._budget_sec:
-                self._budget_sec = now
-                self._budget = int(flags.get_flag(
-                    "rpcz_max_samples_per_second"))
-            if self._budget <= 0:
-                return False
-            self._budget -= 1
-            return True
+        return self._budget.try_take()
 
     def add(self, span: Span) -> None:
         with self._lock:

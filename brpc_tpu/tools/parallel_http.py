@@ -1,0 +1,74 @@
+"""parallel_http — mass concurrent HTTP fetcher
+(≙ reference tools/parallel_http: fetch many URLs with bounded
+concurrency and report per-URL outcomes).
+
+    python -m brpc_tpu.tools.parallel_http --url-file urls.txt -c 32
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+
+@dataclass
+class FetchResult:
+    url: str
+    status: int          # HTTP status, or -1 on transport error
+    bytes: int
+    latency_ms: float
+    error: str = ""
+
+
+def fetch_all(urls: List[str], concurrency: int = 16,
+              timeout_s: float = 10.0) -> List[FetchResult]:
+    def one(url: str) -> FetchResult:
+        t0 = time.monotonic()
+        try:
+            with urllib.request.urlopen(url, timeout=timeout_s) as r:
+                body = r.read()
+                return FetchResult(url, r.status, len(body),
+                                   (time.monotonic() - t0) * 1000)
+        except urllib.error.HTTPError as e:
+            return FetchResult(url, e.code, 0,
+                               (time.monotonic() - t0) * 1000)
+        except Exception as e:
+            return FetchResult(url, -1, 0,
+                               (time.monotonic() - t0) * 1000, str(e))
+
+    with ThreadPoolExecutor(max_workers=concurrency) as pool:
+        return list(pool.map(one, urls))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description="mass HTTP fetch")
+    ap.add_argument("urls", nargs="*", help="URLs to fetch")
+    ap.add_argument("--url-file", help="file with one URL per line")
+    ap.add_argument("-c", "--concurrency", type=int, default=16)
+    ap.add_argument("--timeout", type=float, default=10.0)
+    args = ap.parse_args(argv)
+    urls = list(args.urls)
+    if args.url_file:
+        with open(args.url_file) as f:
+            urls += [ln.strip() for ln in f if ln.strip()]
+    if not urls:
+        ap.error("no URLs given")
+    results = fetch_all(urls, args.concurrency, args.timeout)
+    ok = 0
+    for r in results:
+        mark = "OK " if 200 <= r.status < 300 else "ERR"
+        ok += mark == "OK "
+        print(f"{mark} {r.status:4d} {r.bytes:8d}B {r.latency_ms:7.1f}ms "
+              f"{r.url} {r.error}", file=sys.stdout)
+    print(f"{ok}/{len(results)} succeeded")
+    return 0 if ok == len(results) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,114 @@
+"""rpc_press — load generator (≙ reference tools/rpc_press: target QPS,
+concurrency, latency bvars printed by an info thread,
+rpc_press_impl.{h,cpp}).
+
+    python -m brpc_tpu.tools.rpc_press -s 127.0.0.1:8000 -m Echo.echo \
+        -d 'hello' -q 10000 -c 8 -t 10
+"""
+
+from __future__ import annotations
+
+import argparse
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass
+class PressResult:
+    calls: int = 0
+    errors: int = 0
+    wall_s: float = 0.0
+    qps: float = 0.0
+    latencies_us: List[int] = field(default_factory=list)
+
+    def percentile(self, p: float) -> float:
+        if not self.latencies_us:
+            return 0.0
+        s = sorted(self.latencies_us)
+        return s[min(len(s) - 1, int(p * len(s)))]
+
+    def summary(self) -> str:
+        return (f"calls={self.calls} errors={self.errors} "
+                f"qps={self.qps:.0f} "
+                f"p50={self.percentile(.5):.0f}us "
+                f"p90={self.percentile(.9):.0f}us "
+                f"p99={self.percentile(.99):.0f}us")
+
+
+def press(server: str, method: str, payload: bytes, qps: float = 0.0,
+          concurrency: int = 4, duration_s: float = 5.0,
+          attachment: bytes = b"",
+          timeout_ms: float = 1000.0) -> PressResult:
+    """Drive `method` at `qps` (0 = as fast as possible) with `concurrency`
+    caller threads for `duration_s`."""
+    from brpc_tpu.rpc.channel import Channel, ChannelOptions
+
+    res = PressResult()
+    lock = threading.Lock()
+    stop = threading.Event()
+    # per-thread QPS share via interval pacing (≙ rpc_press -qps)
+    interval = concurrency / qps if qps > 0 else 0.0
+
+    def worker():
+        ch = Channel(server, ChannelOptions(timeout_ms=timeout_ms,
+                                            max_retry=0))
+        local_lat, local_calls, local_errs = [], 0, 0
+        next_at = time.monotonic()
+        while not stop.is_set():
+            if interval > 0:
+                now = time.monotonic()
+                if now < next_at:
+                    time.sleep(min(next_at - now, 0.05))
+                    continue
+                next_at += interval
+            t0 = time.monotonic_ns()
+            try:
+                ch.call(method, payload, attachment)
+                local_lat.append((time.monotonic_ns() - t0) // 1000)
+            except Exception:
+                local_errs += 1
+            local_calls += 1
+        ch.close()
+        with lock:
+            res.calls += local_calls
+            res.errors += local_errs
+            res.latencies_us.extend(local_lat)
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(concurrency)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    time.sleep(duration_s)
+    stop.set()
+    for t in threads:
+        t.join(timeout=timeout_ms / 1000 + 1)
+    res.wall_s = time.monotonic() - t0
+    res.qps = res.calls / res.wall_s if res.wall_s > 0 else 0.0
+    return res
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description="rpc_press load generator")
+    ap.add_argument("-s", "--server", required=True, help="ip:port")
+    ap.add_argument("-m", "--method", default="Echo.echo")
+    ap.add_argument("-d", "--data", default="", help="request payload")
+    ap.add_argument("-f", "--file", help="read payload from file")
+    ap.add_argument("-q", "--qps", type=float, default=0.0,
+                    help="target qps (0 = unlimited)")
+    ap.add_argument("-c", "--concurrency", type=int, default=4)
+    ap.add_argument("-t", "--time", type=float, default=5.0,
+                    help="duration seconds")
+    args = ap.parse_args(argv)
+    payload = (open(args.file, "rb").read() if args.file
+               else args.data.encode())
+    res = press(args.server, args.method, payload, args.qps,
+                args.concurrency, args.time)
+    print(res.summary())
+    return 1 if res.errors and not res.calls - res.errors else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,62 @@
+"""rpc_view — proxy a remote server's builtin portal through a local port
+(≙ reference tools/rpc_view: view builtin pages of a server that is only
+reachable from this host).
+
+    python -m brpc_tpu.tools.rpc_view --target 10.0.0.7:8000 --port 8888
+    # then browse http://localhost:8888/status etc.
+"""
+
+from __future__ import annotations
+
+import argparse
+import urllib.error
+import urllib.request
+from typing import List, Optional
+
+from brpc_tpu.rpc.http import HttpRequest, HttpResponse
+from brpc_tpu.rpc.server import Server, ServerOptions
+
+
+def make_proxy(target: str) -> Server:
+    """A Server whose every HTTP path forwards to `target`'s portal."""
+    srv = Server(ServerOptions(enable_builtin_services=False))
+
+    def forward(req: HttpRequest) -> HttpResponse:
+        url = f"http://{target}{req.path}"
+        if req.query:
+            url += "?" + req.query
+        try:
+            with urllib.request.urlopen(url, timeout=10) as r:
+                return HttpResponse(
+                    r.status,
+                    {"Content-Type": r.headers.get(
+                        "Content-Type", "text/plain")},
+                    r.read())
+        except urllib.error.HTTPError as e:
+            return HttpResponse(e.code, {}, e.read())
+        except OSError as e:
+            return HttpResponse.text(f"cannot reach {target}: {e}\n", 502)
+
+    srv.register_http("/", forward, prefix=True)
+    return srv
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description="portal proxy")
+    ap.add_argument("--target", required=True, help="remote ip:port")
+    ap.add_argument("--port", type=int, default=8888)
+    args = ap.parse_args(argv)
+    srv = make_proxy(args.target)
+    srv.start(f"0.0.0.0:{args.port}")
+    print(f"viewing {args.target} on http://localhost:{srv.port}/")
+    try:
+        import time
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.destroy()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

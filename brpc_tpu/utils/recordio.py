@@ -1,0 +1,74 @@
+"""recordio — length-prefixed record files (capability of the reference
+butil/recordio.{h,cpp}: the persistent format under rpc_dump sample files,
+replayed by tools/rpc_replay).
+
+Record framing (new design, not the reference's on-disk layout):
+    magic "TREC" | u32 payload_len (LE) | u32 crc32(payload) | payload
+A torn tail (partial record after a crash) is skipped by scanning for the
+next magic, the same recovery property the reference format has.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from typing import Iterator, Optional
+
+_MAGIC = b"TREC"
+_HDR = struct.Struct("<4sII")
+
+
+class RecordWriter:
+    def __init__(self, path: str):
+        self._f = open(path, "ab")
+
+    def write(self, payload: bytes) -> None:
+        self._f.write(_HDR.pack(_MAGIC, len(payload),
+                                zlib.crc32(payload) & 0xFFFFFFFF))
+        self._f.write(payload)
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def tell(self) -> int:
+        return self._f.tell()
+
+    def close(self) -> None:
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def read_records(path: str) -> Iterator[bytes]:
+    """Yields payloads; corrupt/torn records are skipped by resyncing on
+    the magic."""
+    with open(path, "rb") as f:
+        data = f.read()
+    i = 0
+    n = len(data)
+    while i + _HDR.size <= n:
+        magic, length, crc = _HDR.unpack_from(data, i)
+        if magic != _MAGIC:
+            j = data.find(_MAGIC, i + 1)
+            if j < 0:
+                return
+            i = j
+            continue
+        start = i + _HDR.size
+        end = start + length
+        if end > n:
+            return  # torn tail
+        payload = data[start:end]
+        if (zlib.crc32(payload) & 0xFFFFFFFF) == crc:
+            yield payload
+            i = end
+        else:
+            j = data.find(_MAGIC, i + 1)
+            if j < 0:
+                return
+            i = j

@@ -23,7 +23,7 @@ from brpc_tpu.utils import flags
 
 @pytest.fixture
 def server():
-    srv = Server()
+    srv = Server(ServerOptions(builtin_writable=True))
     srv.add_echo_service()
     srv.add_service("Upper", lambda cntl, req: req.upper())
     srv.start("127.0.0.1:0")
@@ -190,6 +190,33 @@ class TestBuiltinServices:
             _get(server.port, "/flags/no_such_flag")
         assert ei.value.code == 404
 
+    def test_flags_write_gated_by_default(self):
+        srv = Server()  # builtin_writable defaults to False
+        srv.start("127.0.0.1:0")
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(srv.port, "/flags/rpcz_keep_spans?setvalue=1")
+            assert ei.value.code == 403
+            # reads still work
+            assert b"rpcz_keep_spans" in _get(srv.port, "/flags").read()
+        finally:
+            srv.destroy()
+
+    def test_rpcz_trace_id_roundtrip(self, server):
+        flags.set_flag("enable_rpcz", True)
+        span.clear()
+        try:
+            ch = Channel(f"127.0.0.1:{server.port}")
+            ch.call("Upper", b"x")
+            spans = json.load(_get(server.port, "/rpcz"))
+            tid = spans[0]["trace_id"]  # bare hex, as rendered
+            filtered = json.load(
+                _get(server.port, f"/rpcz?trace_id={tid}"))
+            assert filtered and all(s["trace_id"] == tid for s in filtered)
+            ch.close()
+        finally:
+            flags.set_flag("enable_rpcz", False)
+
     def test_connections_lists_peer(self, server):
         ch = Channel(f"127.0.0.1:{server.port}")
         ch.call("Echo.echo", b"x")
@@ -221,7 +248,6 @@ class TestCompression:
             cntl.response_compress_type = compress.COMPRESS_GZIP
             return b"z" * 10000
 
-        server._services  # server already started: register via new Server
         srv = Server()
         srv.add_service("Big", big)
         srv.start("127.0.0.1:0")

@@ -26,9 +26,12 @@ def stream_server():
         st = cntl.accept_stream()
 
         def pump():
-            for msg in st:
-                st.write(b"echo:" + msg)
-            st.close()
+            try:
+                for msg in st:
+                    st.write(b"echo:" + msg)
+                st.close()
+            except RpcError:
+                pass  # peer tore the connection down mid-echo: fine
 
         t = threading.Thread(target=pump, daemon=True)
         state["echo_threads"].append(t)
