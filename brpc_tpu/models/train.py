@@ -1,10 +1,12 @@
 """Training step for the flagship LM.
 
 The step is one jit: forward (bf16) → CE loss → grads → adamw update.
-Under a mesh, params carry the tp/ep specs from transformer.param_specs and
-the batch is sharded (dp, sp); XLA then emits the gradient psum over dp —
-which is exactly the ParallelChannel parameter-server allreduce config from
-BASELINE.json, lowered to ICI instead of host fan-out.
+Under a mesh, params carry the tp/ep specs from transformer.param_specs;
+tokens arrive batch-sharded (dp only — their S+1 length is not sp-divisible)
+and the model's first constraint re-shards activations to (dp, sp).  XLA
+then emits the gradient psum over dp — which is exactly the ParallelChannel
+parameter-server allreduce config from BASELINE.json, lowered to ICI
+instead of host fan-out.
 """
 
 from __future__ import annotations
@@ -64,7 +66,10 @@ def make_train_step(cfg: ModelConfig, mesh: Optional[Mesh] = None,
 
     param_sh = jax.tree.map(shard_of, pspecs,
                             is_leaf=lambda x: isinstance(x, P))
-    batch_sh = NamedSharding(mesh, P("dp", "sp"))
+    # tokens are batch-sharded only: their length is S+1 (the shift target),
+    # which sp cannot divide when sp | S — and int32 tokens are tiny; the
+    # model's first sharding constraint re-shards activations to (dp, sp)
+    batch_sh = NamedSharding(mesh, P("dp", None))
     repl = NamedSharding(mesh, P())
 
     # opt_state shardings mirror the params by TREE POSITION: any subtree of

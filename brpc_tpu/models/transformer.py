@@ -48,6 +48,18 @@ class ModelConfig:
     moe_every: int = 2       # every k-th block is MoE (when n_experts > 0)
     moe_topk: int = 1
     dtype: Any = jnp.bfloat16
+    # context parallelism over the sp axis (parallel/ring_attention.py):
+    #   "gather"  — K/V all-gathered over sp (XLA-inserted; fine at short S)
+    #   "ring"    — blockwise ring attention, K/V rotate via ppermute; peak
+    #               HBM O(S/n) per chip — the long-context path
+    #   "ulysses" — all-to-all head<->sequence re-shard, local attention
+    attn_impl: str = "gather"
+
+    def __post_init__(self):
+        if self.attn_impl not in ("gather", "ring", "ulysses"):
+            raise ValueError(
+                f"attn_impl must be gather|ring|ulysses, "
+                f"got {self.attn_impl!r}")
 
     @property
     def head_dim(self) -> int:
@@ -161,19 +173,33 @@ def _attention(x, lp, i, cfg: ModelConfig, mesh):
     q = jnp.einsum("bsd,dhk->bshk", xc, lp["wq"][i].astype(cfg.dtype))
     kk = jnp.einsum("bsd,dhk->bshk", xc, lp["wk"][i].astype(cfg.dtype))
     v = jnp.einsum("bsd,dhk->bshk", xc, lp["wv"][i].astype(cfg.dtype))
-    # q keeps the sequence shard; k/v go head-sharded → XLA all-gathers
-    # their sequence over sp (all-gather context parallelism)
-    q = _cs(q, mesh, P("dp", "sp", "tp", None))
-    kk = _cs(kk, mesh, P("dp", None, "tp", None))
-    v = _cs(v, mesh, P("dp", None, "tp", None))
-    scores = jnp.einsum("bshk,bthk->bhst", q, kk) / np.sqrt(cfg.head_dim)
-    mask = jnp.tril(jnp.ones((S, S), bool))
-    scores = jnp.where(mask[None, None], scores.astype(jnp.float32),
-                       -1e30)
-    w = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
-    o = jnp.einsum("bhst,bthk->bshk", w, v)
+    use_sp = (mesh is not None and not mesh.empty
+              and "sp" in mesh.axis_names and mesh.shape["sp"] > 1)
+    if cfg.attn_impl != "gather" and use_sp:
+        # sequence-parallel attention: q/k/v all stay sequence-sharded;
+        # the collective (ring ppermute / all-to-all) IS the data plane
+        from brpc_tpu.parallel import ring_attention as ra
+        q = _cs(q, mesh, P("dp", "sp", "tp", None))
+        kk = _cs(kk, mesh, P("dp", "sp", "tp", None))
+        v = _cs(v, mesh, P("dp", "sp", "tp", None))
+        fn = (ra.ring_attention if cfg.attn_impl == "ring"
+              else ra.ulysses_attention)
+        o = fn(q, kk, v, mesh, axis="sp", causal=True)
+    else:
+        # q keeps the sequence shard; k/v go head-sharded → XLA all-gathers
+        # their sequence over sp (all-gather context parallelism)
+        q = _cs(q, mesh, P("dp", "sp", "tp", None))
+        kk = _cs(kk, mesh, P("dp", None, "tp", None))
+        v = _cs(v, mesh, P("dp", None, "tp", None))
+        scores = jnp.einsum("bshk,bthk->bhst", q, kk) / np.sqrt(cfg.head_dim)
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        scores = jnp.where(mask[None, None], scores.astype(jnp.float32),
+                           -1e30)
+        w = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        o = jnp.einsum("bhst,bthk->bshk", w, v)
     o = _cs(o, mesh, P("dp", "sp", "tp", None))
-    out = jnp.einsum("bshk,hkd->bsd", o, lp["wo"][i].astype(cfg.dtype))
+    out = jnp.einsum("bshk,hkd->bsd", o.astype(cfg.dtype),
+                     lp["wo"][i].astype(cfg.dtype))
     return _cs(out, mesh, P("dp", "sp", None))
 
 

@@ -135,8 +135,8 @@ def test_train_step_sharded_loss_decreases():
     state = TrainState(params=params, opt_state=tx.init(params),
                        step=jnp.zeros((), jnp.int32))
     tokens = jax.device_put(
-        jax.random.randint(jax.random.key(2), (4, 32), 0, cfg.vocab),
-        NamedSharding(mesh, P("dp", "sp")))
+        jax.random.randint(jax.random.key(2), (4, 33), 0, cfg.vocab),
+        NamedSharding(mesh, P("dp", None)))
     state, l0 = step(state, tokens)
     for _ in range(5):
         state, l1 = step(state, tokens)
