@@ -133,6 +133,8 @@ class SubChannel:
             L.trpc_channel_set_auth(self._handle, auth, len(auth))
         self._native = _NativeCall(self._handle)
         self._lock = threading.Lock()
+        self._drained = threading.Condition(self._lock)
+        self._inflight = 0
         self._closed = False
 
     def call_once(self, method: bytes, payload: bytes, attachment: bytes,
@@ -141,14 +143,31 @@ class SubChannel:
         """One attempt.  A nonzero stream_handle makes this the streaming
         handshake (≙ StreamCreate riding CallMethod via stream_settings,
         baidu_rpc_meta.proto:16)."""
-        return self._native.call(method, payload, attachment, timeout_us,
-                                 stream_handle, compress)
+        # in-flight accounting so close() can't free the native handle
+        # under a concurrent (e.g. async-pool) caller
+        with self._lock:
+            if self._closed:
+                return (errors.EFAILEDSOCKET, "channel closed", b"", b"")
+            self._inflight += 1
+        try:
+            return self._native.call(method, payload, attachment,
+                                     timeout_us, stream_handle, compress)
+        finally:
+            with self._lock:
+                self._inflight -= 1
+                if self._inflight == 0:
+                    self._drained.notify_all()
 
     def close(self):
+        """Blocks until in-flight calls drain (each bounded by its own RPC
+        timeout), then frees the native handle."""
         with self._lock:
-            if not self._closed:
-                self._closed = True
-                lib().trpc_channel_destroy(self._handle)
+            if self._closed:
+                return
+            self._closed = True
+            while self._inflight > 0:
+                self._drained.wait()
+        lib().trpc_channel_destroy(self._handle)
 
     def __del__(self):
         try:
@@ -309,6 +328,63 @@ class Channel:
                 cond.wait(left)
 
     # -- streaming (≙ StreamCreate + CallMethod handshake, stream.cpp:773) --
+
+    # -- async call (≙ CallMethod with done != NULL: the call returns
+    # immediately and done->Run() fires on completion,
+    # docs/en/client.md "Asynchronous call") -------------------------------
+
+    _async_pool = None
+    _async_pool_lock = threading.Lock()
+
+    @classmethod
+    def _pool(cls):
+        if cls._async_pool is None:
+            with cls._async_pool_lock:
+                if cls._async_pool is None:
+                    from concurrent.futures import ThreadPoolExecutor
+                    cls._async_pool = ThreadPoolExecutor(
+                        max_workers=32, thread_name_prefix="rpc_async")
+        return cls._async_pool
+
+    def call_async(self, method: str, payload: bytes = b"",
+                   attachment: bytes = b"",
+                   cntl: Optional[Controller] = None,
+                   done: Optional[Callable[[Controller,
+                                            Optional[bytes]], None]] = None):
+        """Asynchronous call: returns a Future of the response bytes.
+        `done(cntl, response_or_None)` runs exactly once on completion
+        (response is None when cntl.failed()); the Future raises RpcError
+        on failure.  The timeout clock starts NOW (≙ the reference timer
+        arming in CallMethod), not when a pool thread picks the call up."""
+        cntl = cntl or Controller()
+        timeout_ms = (cntl.timeout_ms if cntl.timeout_ms is not None
+                      else self.options.timeout_ms)
+        deadline = time.monotonic() + timeout_ms / 1e3
+
+        def run():
+            resp = None
+            try:
+                remaining_ms = (deadline - time.monotonic()) * 1e3
+                if remaining_ms <= 0:
+                    # queued past its deadline behind other async calls
+                    cntl.set_failed(errors.ERPCTIMEDOUT)
+                    raise errors.RpcError(errors.ERPCTIMEDOUT,
+                                          "timed out in async queue")
+                cntl.timeout_ms = remaining_ms
+                resp = self.call(method, payload, attachment, cntl)
+                return resp
+            finally:
+                if done is not None:
+                    try:
+                        done(cntl, resp)
+                    except Exception:
+                        from brpc_tpu.utils import logging as _log
+                        import traceback as _tb
+                        _log.LOG(_log.LOG_ERROR,
+                                 "async done callback raised:\n%s",
+                                 _tb.format_exc())
+
+        return self._pool().submit(run)
 
     def create_stream(self, method: str, payload: bytes = b"",
                       attachment: bytes = b"", window: Optional[int] = None,

@@ -1,0 +1,5 @@
+"""Shared path bootstrap so examples run from any cwd."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

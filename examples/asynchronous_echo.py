@@ -1,0 +1,35 @@
+"""Async call with done callback (≙ example/asynchronous_echo: CallMethod
+with a done closure; the call returns immediately)."""
+import _bootstrap  # noqa: F401
+
+import threading
+
+from brpc_tpu.rpc.channel import Channel
+from brpc_tpu.rpc.server import Server
+
+
+def main():
+    server = Server()
+    server.add_echo_service()
+    port = server.start("127.0.0.1:0")
+    ch = Channel(f"127.0.0.1:{port}")
+
+    finished = threading.Event()
+
+    def on_done(cntl, response):
+        if response is None:
+            print("failed:", cntl.error_code, cntl.error_text)
+        else:
+            print(f"done callback: {response!r} latency={cntl.latency_us}us")
+        finished.set()
+
+    fut = ch.call_async("Echo.echo", b"async hello", done=on_done)
+    print("call issued; doing other work...")
+    print("future result:", fut.result(timeout=5))
+    finished.wait(5)
+    ch.close()
+    server.destroy()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+"""Cascading RPC: a middle server whose handler calls a downstream server
+(≙ example/cascade_echo — latency composes, portals show both hops)."""
+import _bootstrap  # noqa: F401
+
+from brpc_tpu.rpc.channel import Channel
+from brpc_tpu.rpc.server import Server
+
+
+def main():
+    backend = Server()
+    backend.add_service("Deep", lambda cntl, req: b"deep(" + req + b")")
+    backend.start("127.0.0.1:0")
+
+    middle = Server()
+    down = Channel(f"127.0.0.1:{backend.port}")
+
+    def relay(cntl, req):
+        inner = down.call("Deep", req)  # handler issues its own RPC
+        return b"relay(" + inner + b")"
+
+    middle.add_service("Relay", relay)
+    middle.start("127.0.0.1:0")
+
+    ch = Channel(f"127.0.0.1:{middle.port}")
+    print("cascaded:", ch.call("Relay", b"x"))
+    ch.close()
+    down.close()
+    middle.destroy()
+    backend.destroy()
+
+
+if __name__ == "__main__":
+    main()

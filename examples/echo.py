@@ -1,0 +1,32 @@
+"""Sync echo — server + client (≙ example/echo_c++)."""
+import _bootstrap  # noqa: F401
+
+from brpc_tpu.rpc.channel import Channel
+from brpc_tpu.rpc.server import Server
+
+
+def main():
+    server = Server()
+    server.add_echo_service()  # native hot path
+
+    def upper(cntl, req):
+        cntl.response_attachment = b"meta"
+        return req.upper()
+
+    server.add_service("Upper", upper)
+    port = server.start("127.0.0.1:0")
+    print(f"server on :{port} — portal at http://127.0.0.1:{port}/")
+
+    ch = Channel(f"127.0.0.1:{port}")
+    print("Echo.echo  ->", ch.call("Echo.echo", b"hello world"))
+    from brpc_tpu.rpc.controller import Controller
+    cntl = Controller()
+    print("Upper      ->", ch.call("Upper", b"hello world", cntl=cntl),
+          "attachment:", cntl.response_attachment,
+          f"latency={cntl.latency_us}us")
+    ch.close()
+    server.destroy()
+
+
+if __name__ == "__main__":
+    main()

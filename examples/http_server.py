@@ -1,0 +1,36 @@
+"""HTTP on the RPC port: RESTful routes, the JSON bridge, and the builtin
+portal (≙ example/http — one port speaks TRPC and HTTP simultaneously)."""
+import _bootstrap  # noqa: F401
+
+import json
+import urllib.request
+
+from brpc_tpu.rpc.http import HttpRequest, HttpResponse
+from brpc_tpu.rpc.server import Server
+
+
+def main():
+    server = Server()
+    server.add_service("Upper", lambda cntl, req: req.upper())
+
+    def greet(req: HttpRequest) -> HttpResponse:
+        name = req.query_params().get("name", "world")
+        return HttpResponse.json({"hello": name})
+
+    server.register_http("/greet", greet)
+    port = server.start("127.0.0.1:0")
+    base = f"http://127.0.0.1:{port}"
+
+    print("GET /greet?name=tpu ->",
+          urllib.request.urlopen(f"{base}/greet?name=tpu").read())
+    req = urllib.request.Request(
+        f"{base}/rpc/Upper", data=json.dumps({"payload": "json in"}).encode(),
+        headers={"Content-Type": "application/json"})
+    print("POST /rpc/Upper     ->", urllib.request.urlopen(req).read())
+    print("GET /status         ->",
+          urllib.request.urlopen(f"{base}/status").read()[:80], "...")
+    server.destroy()
+
+
+if __name__ == "__main__":
+    main()

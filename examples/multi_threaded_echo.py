@@ -1,0 +1,44 @@
+"""N caller threads sharing one channel (≙ example/multi_threaded_echo:
+channels are thread-safe; one connection multiplexes all callers)."""
+import _bootstrap  # noqa: F401
+
+import threading
+import time
+
+from brpc_tpu.rpc.channel import Channel
+from brpc_tpu.rpc.server import Server
+
+THREADS, SECONDS = 8, 1.0
+
+
+def main():
+    server = Server()
+    server.add_echo_service()
+    port = server.start("127.0.0.1:0")
+    ch = Channel(f"127.0.0.1:{port}")
+
+    counts = [0] * THREADS
+    stop = threading.Event()
+
+    def worker(i):
+        while not stop.is_set():
+            assert ch.call("Echo.echo", b"x" * 64) == b"x" * 64
+            counts[i] += 1
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(THREADS)]
+    for t in threads:
+        t.start()
+    time.sleep(SECONDS)
+    stop.set()
+    for t in threads:
+        t.join()
+    total = sum(counts)
+    print(f"{THREADS} threads, {SECONDS}s: {total} echos "
+          f"({total / SECONDS:.0f} qps) per-thread={counts}")
+    ch.close()
+    server.destroy()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+"""ParallelChannel fan-out + merge (≙ example/parallel_echo: one logical
+call broadcast to N servers, responses merged; fail_limit tolerance)."""
+import _bootstrap  # noqa: F401
+
+from brpc_tpu.parallel.channels import (CallMapper, ParallelChannel,
+                                        ResponseMerger, SubCall)
+from brpc_tpu.rpc.channel import Channel
+from brpc_tpu.rpc.server import Server
+
+
+def make_server(name: bytes):
+    s = Server()
+    s.add_service("Who", lambda cntl, req, n=name: n + b"(" + req + b")")
+    s.start("127.0.0.1:0")
+    return s
+
+
+class ShardMapper(CallMapper):
+    """Give each member its slice of the payload (scatter, not broadcast)."""
+
+    def map(self, i, n, method, payload, attachment):
+        chunk = (len(payload) + n - 1) // n
+        return SubCall(method, payload[i * chunk:(i + 1) * chunk])
+
+
+def main():
+    servers = [make_server(f"s{i}".encode()) for i in range(3)]
+    pch = ParallelChannel(fail_limit=1)
+    for s in servers:
+        pch.add_channel(Channel(f"127.0.0.1:{s.port}"))
+
+    print("broadcast:", pch.call("Who", b"hi"))
+
+    scatter = ParallelChannel()
+    for s in servers:
+        scatter.add_channel(Channel(f"127.0.0.1:{s.port}"), ShardMapper())
+    print("scatter:  ", scatter.call("Who", b"abcdef"))
+
+    # fail_limit tolerance: kill one member, broadcast still succeeds
+    servers[1].destroy()
+    print("1 member down, fail_limit=1:", pch.call("Who", b"degraded"))
+    for s in (servers[0], servers[2]):
+        s.destroy()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+"""PartitionChannel: "i/n"-tagged cluster members, one call fans to every
+partition (≙ example/partition_echo)."""
+import _bootstrap  # noqa: F401
+
+from brpc_tpu.parallel.channels import PartitionChannel
+from brpc_tpu.rpc.server import Server
+
+
+def make_server(name: bytes):
+    s = Server()
+    s.add_service("Who", lambda cntl, req, n=name: n + b":" + req)
+    s.start("127.0.0.1:0")
+    return s
+
+
+def main():
+    parts = [make_server(f"part{i}".encode()) for i in range(3)]
+    url = ",".join(f"127.0.0.1:{s.port} {i}/3"
+                   for i, s in enumerate(parts))
+    pch = PartitionChannel("list://" + url, partition_count=3)
+    print("partitions ready:", pch.partitions_ready())
+    print("fan to all 3:    ", pch.call("Who", b"x"))
+    pch.close()
+    for s in parts:
+        s.destroy()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+"""SelectiveChannel: LB over heterogeneous sub-channels with failover
+(≙ example/selective_echo — each sub-channel can itself be a cluster)."""
+import _bootstrap  # noqa: F401
+
+from brpc_tpu.parallel.channels import SelectiveChannel
+from brpc_tpu.rpc.channel import Channel
+from brpc_tpu.rpc.server import Server
+
+
+def make_server(name: bytes):
+    s = Server()
+    s.add_service("Who", lambda cntl, req, n=name: n)
+    s.start("127.0.0.1:0")
+    return s
+
+
+def main():
+    a, b = make_server(b"cluster-a"), make_server(b"cluster-b")
+    sch = SelectiveChannel(max_retry=2)
+    sch.add_channel(Channel(f"127.0.0.1:{a.port}"))
+    sch.add_channel(Channel(f"127.0.0.1:{b.port}"))
+    print("round-robin:", [sch.call("Who", b"").decode() for _ in range(4)])
+
+    a.destroy()  # cluster-a dies: calls fail over to b and a is isolated
+    print("after a down:", [sch.call("Who", b"").decode()
+                            for _ in range(3)])
+    b.destroy()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,42 @@
+"""Streaming RPC: flow-controlled ordered messages on an accepted stream
+(≙ example/streaming_echo — StreamCreate on the client, StreamAccept in
+the handler, credit-based flow control underneath)."""
+import _bootstrap  # noqa: F401
+
+import threading
+
+from brpc_tpu.rpc.channel import Channel
+from brpc_tpu.rpc.server import Server
+
+
+def main():
+    server = Server()
+
+    def open_stream(cntl, req):
+        st = cntl.accept_stream()
+
+        def pump():
+            for msg in st:          # iterate until remote close
+                st.write(b"echo:" + msg)
+            st.close()
+
+        threading.Thread(target=pump, daemon=True).start()
+        return b"stream accepted"
+
+    server.add_service("OpenStream", open_stream)
+    port = server.start("127.0.0.1:0")
+
+    ch = Channel(f"127.0.0.1:{port}")
+    resp, stream = ch.create_stream("OpenStream")
+    print("handshake response:", resp)
+    for i in range(5):
+        stream.write(f"msg-{i}".encode())
+    for i in range(5):
+        print("got:", stream.read(timeout_s=2.0))
+    stream.close()
+    ch.close()
+    server.destroy()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+"""Every example must run clean end to end (≙ the reference treating
+example/ as acceptance workloads, SURVEY.md §2.8)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_EXAMPLES_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples")
+
+_HOST = ["echo", "asynchronous_echo", "multi_threaded_echo",
+         "parallel_echo", "partition_echo", "dynamic_partition_echo",
+         "selective_echo", "cascade_echo", "backup_request",
+         "auto_concurrency_limiter", "streaming_echo", "http_server"]
+_MESH = ["mesh_collectives", "long_context_ring"]
+
+
+def _run(name: str, timeout: float):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.run(
+        [sys.executable, f"{name}.py"], cwd=_EXAMPLES_DIR, env=env,
+        capture_output=True, text=True, timeout=timeout)
+
+
+@pytest.mark.parametrize("name", _HOST)
+def test_host_example(name):
+    r = _run(name, 120)
+    assert r.returncode == 0, f"{name} failed:\n{r.stdout}\n{r.stderr}"
+
+
+@pytest.mark.parametrize("name", _MESH)
+def test_mesh_example(name):
+    r = _run(name, 300)
+    assert r.returncode == 0, f"{name} failed:\n{r.stdout}\n{r.stderr}"

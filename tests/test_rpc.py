@@ -131,6 +131,72 @@ class TestErrors:
         ch.close()
 
 
+class TestAsyncCall:
+    def test_future_and_done_callback(self, echo_server):
+        ch = Channel(f"127.0.0.1:{echo_server.port}")
+        seen = []
+        fut = ch.call_async("PyEcho", b"async",
+                            done=lambda cntl, resp: seen.append(resp))
+        assert fut.result(timeout=5) == b"py:async"
+        assert seen == [b"py:async"]
+        ch.close()
+
+    def test_failed_call_raises_from_future(self, echo_server):
+        ch = Channel(f"127.0.0.1:{echo_server.port}",
+                     max_retry=0)
+        seen = []
+        fut = ch.call_async("PyFail", b"",
+                            done=lambda cntl, resp: seen.append(
+                                (resp, cntl.error_code)))
+        with pytest.raises(RpcError):
+            fut.result(timeout=5)
+        assert seen == [(None, errors.EINTERNAL)]
+        ch.close()
+
+    def test_many_concurrent(self, echo_server):
+        ch = Channel(f"127.0.0.1:{echo_server.port}")
+        futs = [ch.call_async("PyEcho", f"{i}".encode())
+                for i in range(50)]
+        got = sorted(f.result(timeout=10) for f in futs)
+        assert got == sorted(f"py:{i}".encode() for i in range(50))
+        ch.close()
+
+    def test_done_fires_even_on_unexpected_error(self, echo_server):
+        # a codec error is not an RpcError; done must still run once
+        from brpc_tpu.rpc.channel import ChannelOptions
+        ch = Channel(f"127.0.0.1:{echo_server.port}",
+                     ChannelOptions(request_compress_type=99, max_retry=0))
+        seen = []
+        fut = ch.call_async("PyEcho", b"x",
+                            done=lambda cntl, resp: seen.append(resp))
+        with pytest.raises(Exception):
+            fut.result(timeout=5)
+        assert seen == [None]
+        ch.close()
+
+    def test_raising_done_does_not_eat_response(self, echo_server):
+        ch = Channel(f"127.0.0.1:{echo_server.port}")
+
+        def bad_done(cntl, resp):
+            raise TypeError("buggy callback")
+
+        fut = ch.call_async("PyEcho", b"keep", done=bad_done)
+        assert fut.result(timeout=5) == b"py:keep"
+        ch.close()
+
+    def test_close_waits_for_inflight_async(self, echo_server):
+        # close() must not free the native handle under a pool thread
+        ch = Channel(f"127.0.0.1:{echo_server.port}")
+        futs = [ch.call_async("PySlow", b"") for _ in range(3)]
+        time.sleep(0.05)  # let the pool enter the native call
+        ch.close()  # blocks until the slow calls drain
+        for f in futs:
+            assert f.result(timeout=5) == b"slow"
+        # calls after close fail cleanly instead of crashing
+        with pytest.raises(RpcError):
+            ch.call("PyEcho", b"late")
+
+
 class TestServerIntrospection:
     def test_method_stats_and_requests(self, echo_server):
         ch = Channel(echo_server.listen_address)
