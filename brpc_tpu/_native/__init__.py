@@ -117,6 +117,10 @@ def _declare(L: ctypes.CDLL) -> None:
     L.trpc_http_respond.argtypes = [c.c_uint64, c.c_int, c.c_char_p,
                                     c.c_char_p, c.c_size_t]
     L.trpc_http_respond.restype = c.c_int
+    L.trpc_http_respond_trailers.argtypes = [c.c_uint64, c.c_int,
+                                             c.c_char_p, c.c_char_p,
+                                             c.c_size_t, c.c_char_p]
+    L.trpc_http_respond_trailers.restype = c.c_int
 
     # auth
     L.trpc_server_set_auth.argtypes = [c.c_void_p, c.c_char_p, c.c_size_t]

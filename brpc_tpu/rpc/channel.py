@@ -208,15 +208,19 @@ class Channel:
 
     def call(self, method: str, payload: bytes = b"",
              attachment: bytes = b"",
-             cntl: Optional[Controller] = None) -> bytes:
+             cntl: Optional[Controller] = None,
+             timeout_ms: Optional[float] = None) -> bytes:
         """Synchronous call.  Raises RpcError on failure; returns response
-        payload (attachment lands on cntl.response_attachment)."""
+        payload (attachment lands on cntl.response_attachment).
+        `timeout_ms` overrides both cntl and ChannelOptions for this call
+        only (used by call_async's queue-time accounting)."""
         cntl = cntl or Controller()
         cntl.reset()
         # effective knobs: Controller overrides, else ChannelOptions —
         # computed into locals so a reused Controller keeps None = inherit
-        timeout_ms = (cntl.timeout_ms if cntl.timeout_ms is not None
-                      else self.options.timeout_ms)
+        if timeout_ms is None:
+            timeout_ms = (cntl.timeout_ms if cntl.timeout_ms is not None
+                          else self.options.timeout_ms)
         mb = method.encode()
         start = time.monotonic_ns()
         deadline = start + int(timeout_ms * 1e6)
@@ -370,8 +374,8 @@ class Channel:
                     cntl.set_failed(errors.ERPCTIMEDOUT)
                     raise errors.RpcError(errors.ERPCTIMEDOUT,
                                           "timed out in async queue")
-                cntl.timeout_ms = remaining_ms
-                resp = self.call(method, payload, attachment, cntl)
+                resp = self.call(method, payload, attachment, cntl,
+                                 timeout_ms=remaining_ms)
                 return resp
             finally:
                 if done is not None:

@@ -1,0 +1,139 @@
+"""gRPC service adaptor (capability of the reference gRPC support,
+grpc.{h,cpp}:208 + policy/http2_rpc_protocol.cpp: gRPC semantics layered on
+HTTP/2 — the native core speaks h2 on the shared port, this module speaks
+the gRPC wire format on top: 5-byte message framing, content-type
+application/grpc, grpc-status/grpc-message trailers, grpc-encoding gzip,
+and grpc-timeout parsing).
+
+Real gRPC clients (e.g. grpcio with bytes serializers, or generated stubs
+whose messages the handler decodes itself) interoperate directly:
+
+    server.add_grpc_service("pkg.Echo", {"Echo": lambda cntl, b: b})
+    # grpcio: channel.unary_unary("/pkg.Echo/Echo", ...)(payload)
+"""
+
+from __future__ import annotations
+
+import gzip
+import re
+from typing import Callable, Dict
+
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.controller import Controller
+from brpc_tpu.rpc.http import HttpRequest, HttpResponse
+
+# grpc-status codes (subset we map onto)
+GRPC_OK = 0
+GRPC_DEADLINE_EXCEEDED = 4
+GRPC_NOT_FOUND = 5
+GRPC_RESOURCE_EXHAUSTED = 8
+GRPC_UNIMPLEMENTED = 12
+GRPC_INTERNAL = 13
+GRPC_UNAVAILABLE = 14
+GRPC_UNAUTHENTICATED = 16
+GRPC_UNKNOWN = 2
+
+_CODE_MAP = {
+    errors.ENOSERVICE: GRPC_UNIMPLEMENTED,
+    errors.ENOMETHOD: GRPC_UNIMPLEMENTED,
+    errors.ERPCTIMEDOUT: GRPC_DEADLINE_EXCEEDED,
+    errors.ELIMIT: GRPC_RESOURCE_EXHAUSTED,
+    errors.EAUTH: GRPC_UNAUTHENTICATED,
+    errors.ESTOP: GRPC_UNAVAILABLE,
+    errors.EINTERNAL: GRPC_INTERNAL,
+}
+
+_TIMEOUT_UNITS = {"H": 3600e3, "M": 60e3, "S": 1e3, "m": 1.0,
+                  "u": 1e-3, "n": 1e-6}
+
+
+def parse_grpc_timeout(value: str) -> float:
+    """grpc-timeout header → milliseconds (≙ grpc.cpp timeout parsing)."""
+    m = re.fullmatch(r"(\d{1,8})([HMSmun])", value)
+    if not m:
+        raise ValueError(f"bad grpc-timeout {value!r}")
+    return int(m.group(1)) * _TIMEOUT_UNITS[m.group(2)]
+
+
+def _encode_grpc_message(message: str) -> str:
+    """Percent-encode per the gRPC spec: grpc-message allows only printable
+    ASCII minus '%'; anything else (incl. CR/LF, which would otherwise
+    inject extra trailer lines) is %XX-escaped."""
+    out = []
+    for b in message.encode("utf-8", "replace"):
+        if 0x20 <= b <= 0x7E and b != 0x25:
+            out.append(chr(b))
+        else:
+            out.append(f"%{b:02X}")
+    return "".join(out)
+
+
+def _grpc_error(status: int, message: str) -> HttpResponse:
+    # error responses are headers + trailers, no message body
+    return HttpResponse(
+        200, {"content-type": "application/grpc"}, b"",
+        trailers={"grpc-status": str(status),
+                  "grpc-message": _encode_grpc_message(message)})
+
+
+def _wrap(method_full: str, handler: Callable[[Controller, bytes], bytes]):
+    def serve(req: HttpRequest) -> HttpResponse:
+        ct = req.headers.get("content-type", "")
+        if not ct.startswith("application/grpc"):
+            return HttpResponse.text("expected application/grpc\n", 415)
+        body = req.body
+        if len(body) < 5:
+            return _grpc_error(GRPC_INTERNAL, "truncated grpc frame")
+        compressed = body[0]
+        msg_len = int.from_bytes(body[1:5], "big")
+        msg = body[5:5 + msg_len]
+        if len(msg) != msg_len:
+            return _grpc_error(GRPC_INTERNAL, "truncated grpc message")
+        if len(body) != 5 + msg_len:
+            # more than one length-prefixed frame = client streaming,
+            # which unary handlers must not silently truncate
+            return _grpc_error(GRPC_UNIMPLEMENTED,
+                               "client streaming not supported")
+        if compressed:
+            if req.headers.get("grpc-encoding") != "gzip":
+                return _grpc_error(GRPC_UNIMPLEMENTED,
+                                   "unsupported grpc-encoding")
+            try:
+                msg = gzip.decompress(msg)
+            except OSError:
+                return _grpc_error(GRPC_INTERNAL, "bad gzip message")
+        cntl = Controller()
+        cntl.method = method_full
+        if "grpc-timeout" in req.headers:
+            try:
+                cntl.timeout_ms = parse_grpc_timeout(
+                    req.headers["grpc-timeout"])
+            except ValueError:
+                pass
+        try:
+            out = handler(cntl, msg)
+        except errors.RpcError as e:
+            return _grpc_error(_CODE_MAP.get(e.code, GRPC_UNKNOWN), e.text)
+        except Exception as e:  # noqa: BLE001 — handler bug → INTERNAL
+            return _grpc_error(GRPC_INTERNAL, str(e))
+        if isinstance(out, tuple):
+            out = out[0]
+        if cntl.failed():
+            return _grpc_error(_CODE_MAP.get(cntl.error_code, GRPC_UNKNOWN),
+                               cntl.error_text)
+        out = out or b""
+        frame = b"\x00" + len(out).to_bytes(4, "big") + out
+        return HttpResponse(
+            200, {"content-type": "application/grpc"}, frame,
+            trailers={"grpc-status": "0"})
+
+    return serve
+
+
+def install_grpc_service(server, service_name: str,
+                         methods: Dict[str, Callable]) -> None:
+    """Register `methods` under gRPC paths /<service_name>/<Method> on the
+    server's shared port (h2 requests land there natively)."""
+    for method_name, handler in methods.items():
+        full = f"{service_name}/{method_name}"
+        server.register_http("/" + full, _wrap(full, handler))

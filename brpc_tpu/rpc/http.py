@@ -40,6 +40,8 @@ class HttpResponse:
     status: int = 200
     headers: Dict[str, str] = field(default_factory=dict)
     body: bytes = b""
+    # HTTP/2 trailers (gRPC status rides here); ignored on HTTP/1.x
+    trailers: Dict[str, str] = field(default_factory=dict)
 
     @staticmethod
     def text(s: str, status: int = 200) -> "HttpResponse":

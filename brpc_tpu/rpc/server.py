@@ -114,6 +114,14 @@ class Server:
         main port beside TRPC."""
         self.http.register(path, handler, prefix=prefix)
 
+    def add_grpc_service(self, service_name: str, methods) -> None:
+        """Serve gRPC methods at /<service_name>/<Method> — real gRPC
+        clients dial the same port (h2 + gRPC framing handled natively +
+        rpc/grpc_service.py).  `methods`: {method_name: handler(cntl,
+        bytes) -> bytes}."""
+        from brpc_tpu.rpc.grpc_service import install_grpc_service
+        install_grpc_service(self, service_name, methods)
+
     def _find_handler(self, method: str) -> Optional[Handler]:
         """Lookup with the native server's Service fallback."""
         h = self._services.get(method)
@@ -240,9 +248,14 @@ class Server:
                         return
                 resp = dispatcher.dispatch(req)
                 body = b"" if req.method == "HEAD" else resp.body
-                L.trpc_http_respond(token, resp.status,
-                                    pack_headers(resp.headers), body,
-                                    len(body))
+                if resp.trailers:
+                    L.trpc_http_respond_trailers(
+                        token, resp.status, pack_headers(resp.headers),
+                        body, len(body), pack_headers(resp.trailers))
+                else:
+                    L.trpc_http_respond(token, resp.status,
+                                        pack_headers(resp.headers), body,
+                                        len(body))
             except Exception:
                 log.LOG(log.LOG_ERROR, "http dispatch raised:\n%s",
                         traceback.format_exc())

@@ -118,6 +118,14 @@ int trpc_http_respond(uint64_t token, int status, const char* headers_blob,
   return http_respond(token, status, headers_blob, body, body_len);
 }
 
+int trpc_http_respond_trailers(uint64_t token, int status,
+                               const char* headers_blob,
+                               const uint8_t* body, size_t body_len,
+                               const char* trailers_blob) {
+  return http_respond2(token, status, headers_blob, body, body_len,
+                       trailers_blob);
+}
+
 // --- auth ------------------------------------------------------------------
 
 void trpc_server_set_auth(void* s, const uint8_t* secret, size_t len) {

@@ -1,0 +1,769 @@
+#include "h2.h"
+
+#include <string.h>
+
+#include <deque>
+#include <mutex>
+#include <unordered_map>
+
+#include "h2_tables.h"
+
+namespace trpc {
+
+namespace {
+
+constexpr char kPreface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+constexpr size_t kPrefaceLen = 24;
+constexpr uint32_t kMaxFrameAccept = 1u << 20;   // 1MB per frame
+constexpr size_t kMaxHeaderBlock = 256u * 1024;
+constexpr size_t kMaxBodyBytes = 512u * 1024 * 1024;
+constexpr uint32_t kDefaultWindow = 65535;
+constexpr uint32_t kOurMaxFrameSize = 16384;
+
+enum FrameType : uint8_t {
+  F_DATA = 0x0, F_HEADERS = 0x1, F_PRIORITY = 0x2, F_RST = 0x3,
+  F_SETTINGS = 0x4, F_PUSH = 0x5, F_PING = 0x6, F_GOAWAY = 0x7,
+  F_WINDOW_UPDATE = 0x8, F_CONTINUATION = 0x9,
+};
+
+enum Flags : uint8_t {
+  FLAG_END_STREAM = 0x1, FLAG_ACK = 0x1, FLAG_END_HEADERS = 0x4,
+  FLAG_PADDED = 0x8, FLAG_PRIORITY = 0x20,
+};
+
+// --- Huffman decode (RFC 7541 Appendix B) ---------------------------------
+
+struct HuffNode {
+  int16_t next[2] = {-1, -1};
+  int16_t sym = -1;  // 0..256 when leaf
+};
+
+struct HuffTree {
+  std::vector<HuffNode> nodes;
+  HuffTree() {
+    nodes.emplace_back();
+    for (int sym = 0; sym < 257; ++sym) {
+      uint32_t code = kHuffCodes[sym].code;
+      int bits = kHuffCodes[sym].bits;
+      int cur = 0;
+      for (int i = bits - 1; i >= 0; --i) {
+        int b = (code >> i) & 1;
+        if (nodes[cur].next[b] < 0) {
+          nodes[cur].next[b] = (int16_t)nodes.size();
+          nodes.emplace_back();
+        }
+        cur = nodes[cur].next[b];
+      }
+      nodes[cur].sym = (int16_t)sym;
+    }
+  }
+};
+
+const HuffTree& huff_tree() {
+  static const HuffTree* t = new HuffTree();
+  return *t;
+}
+
+// Returns false on invalid coding (EOS symbol, bad padding).
+bool HuffmanDecode(const uint8_t* p, size_t n, std::string* out) {
+  const HuffTree& t = huff_tree();
+  int cur = 0;
+  int depth = 0;  // bits since last emitted symbol
+  for (size_t i = 0; i < n; ++i) {
+    for (int b = 7; b >= 0; --b) {
+      int bit = (p[i] >> b) & 1;
+      int nxt = t.nodes[cur].next[bit];
+      if (nxt < 0) {
+        return false;
+      }
+      cur = nxt;
+      ++depth;
+      if (t.nodes[cur].sym >= 0) {
+        if (t.nodes[cur].sym == 256) {
+          return false;  // EOS in stream is a coding error
+        }
+        out->push_back((char)t.nodes[cur].sym);
+        cur = 0;
+        depth = 0;
+      }
+    }
+  }
+  // padding must be a prefix of EOS (all 1s), strictly < 8 bits
+  return depth < 8;
+}
+
+// --- HPACK decoder ---------------------------------------------------------
+
+struct DynEntry {
+  std::string name, value;
+  size_t size() const { return name.size() + value.size() + 32; }
+};
+
+class Hpack {
+ public:
+  size_t max_size = 4096;
+
+  bool decode_block(const uint8_t* p, size_t n,
+                    std::vector<std::pair<std::string, std::string>>* out) {
+    size_t i = 0;
+    while (i < n) {
+      uint8_t b = p[i];
+      if (b & 0x80) {  // indexed
+        uint64_t idx;
+        if (!read_int(p, n, &i, 7, &idx) || idx == 0) return false;
+        std::string name, value;
+        if (!lookup(idx, &name, &value)) return false;
+        out->emplace_back(std::move(name), std::move(value));
+      } else if (b & 0x40) {  // literal with incremental indexing
+        uint64_t idx;
+        if (!read_int(p, n, &i, 6, &idx)) return false;
+        std::string name, value;
+        if (!read_name(p, n, &i, idx, &name)) return false;
+        if (!read_str(p, n, &i, &value)) return false;
+        add_entry(name, value);
+        out->emplace_back(std::move(name), std::move(value));
+      } else if (b & 0x20) {  // dynamic table size update
+        uint64_t sz;
+        if (!read_int(p, n, &i, 5, &sz)) return false;
+        if (sz > 65536) return false;
+        max_size = (size_t)sz;
+        evict();
+      } else {  // literal without indexing (0x00) / never indexed (0x10)
+        uint64_t idx;
+        if (!read_int(p, n, &i, 4, &idx)) return false;
+        std::string name, value;
+        if (!read_name(p, n, &i, idx, &name)) return false;
+        if (!read_str(p, n, &i, &value)) return false;
+        out->emplace_back(std::move(name), std::move(value));
+      }
+    }
+    return true;
+  }
+
+ private:
+  std::deque<DynEntry> dyn_;
+  size_t dyn_size_ = 0;
+
+  static bool read_int(const uint8_t* p, size_t n, size_t* i, int prefix,
+                       uint64_t* out) {
+    if (*i >= n) return false;
+    uint64_t max_pfx = (1u << prefix) - 1;
+    uint64_t v = p[*i] & max_pfx;
+    ++*i;
+    if (v < max_pfx) {
+      *out = v;
+      return true;
+    }
+    int shift = 0;
+    while (*i < n) {
+      uint8_t b = p[*i];
+      ++*i;
+      v += (uint64_t)(b & 0x7f) << shift;
+      if (v > (1ull << 32)) return false;
+      if (!(b & 0x80)) {
+        *out = v;
+        return true;
+      }
+      shift += 7;
+      if (shift > 28) return false;
+    }
+    return false;
+  }
+
+  static bool read_raw_str(const uint8_t* p, size_t n, size_t* i,
+                           std::string* out) {
+    if (*i >= n) return false;
+    bool huff = (p[*i] & 0x80) != 0;
+    uint64_t len;
+    if (!read_int(p, n, i, 7, &len)) return false;
+    if (*i + len > n || len > kMaxHeaderBlock) return false;
+    if (huff) {
+      if (!HuffmanDecode(p + *i, (size_t)len, out)) return false;
+    } else {
+      out->assign((const char*)p + *i, (size_t)len);
+    }
+    *i += (size_t)len;
+    return true;
+  }
+
+  bool read_str(const uint8_t* p, size_t n, size_t* i, std::string* out) {
+    return read_raw_str(p, n, i, out);
+  }
+
+  bool read_name(const uint8_t* p, size_t n, size_t* i, uint64_t idx,
+                 std::string* name) {
+    if (idx != 0) {
+      std::string v_unused;
+      return lookup(idx, name, &v_unused);
+    }
+    return read_raw_str(p, n, i, name);
+  }
+
+  bool lookup(uint64_t idx, std::string* name, std::string* value) {
+    constexpr size_t kStatic = sizeof(kStaticTable) / sizeof(kStaticTable[0]);
+    if (idx >= 1 && idx <= kStatic) {
+      *name = kStaticTable[idx - 1].name;
+      *value = kStaticTable[idx - 1].value;
+      return true;
+    }
+    size_t d = (size_t)(idx - kStatic - 1);
+    if (d >= dyn_.size()) return false;
+    *name = dyn_[d].name;
+    *value = dyn_[d].value;
+    return true;
+  }
+
+  void add_entry(const std::string& name, const std::string& value) {
+    DynEntry e{name, value};
+    size_t sz = e.size();
+    if (sz > max_size) {  // entry larger than table: table empties
+      dyn_.clear();
+      dyn_size_ = 0;
+      return;
+    }
+    dyn_.push_front(std::move(e));
+    dyn_size_ += sz;
+    evict();
+  }
+
+  void evict() {
+    while (dyn_size_ > max_size && !dyn_.empty()) {
+      dyn_size_ -= dyn_.back().size();
+      dyn_.pop_back();
+    }
+  }
+};
+
+// --- per-connection state --------------------------------------------------
+
+struct StreamState {
+  std::string header_block;   // accumulating until END_HEADERS
+  bool headers_done = false;
+  bool end_stream = false;
+  bool responded = false;
+  H2Request req;
+  int32_t send_window = kDefaultWindow;
+  // bytes waiting for window (flushed on WINDOW_UPDATE), then trailers
+  std::string pending;
+  std::string pending_trailers;  // encoded HEADERS payload, sent after data
+};
+
+}  // namespace
+
+class H2Conn {
+ public:
+  std::atomic<int> refs{1};  // registry's reference
+  std::mutex mu;
+  Hpack hpack;
+  std::unordered_map<uint32_t, StreamState> streams;
+  uint32_t continuation_stream = 0;  // nonzero: expecting CONTINUATION
+  uint8_t continuation_flags = 0;
+  int32_t conn_send_window = kDefaultWindow;
+  int32_t peer_initial_window = kDefaultWindow;
+  bool goaway = false;
+};
+
+namespace {
+
+std::mutex g_conns_mu;
+std::unordered_map<SocketId, H2Conn*> g_conns;
+
+void put_frame_header(std::string* s, uint32_t len, uint8_t type,
+                      uint8_t flags, uint32_t stream) {
+  s->push_back((char)((len >> 16) & 0xff));
+  s->push_back((char)((len >> 8) & 0xff));
+  s->push_back((char)(len & 0xff));
+  s->push_back((char)type);
+  s->push_back((char)flags);
+  s->push_back((char)((stream >> 24) & 0x7f));
+  s->push_back((char)((stream >> 16) & 0xff));
+  s->push_back((char)((stream >> 8) & 0xff));
+  s->push_back((char)(stream & 0xff));
+}
+
+void write_frames(Socket* s, const std::string& frames) {
+  IOBuf b;
+  b.append(frames.data(), frames.size());
+  s->Write(std::move(b));
+}
+
+// HPACK encode: literal without indexing, new name, no huffman.
+void hpack_literal(std::string* out, const std::string& name,
+                   const std::string& value) {
+  auto put_len = [out](size_t len) {
+    if (len < 127) {
+      out->push_back((char)len);
+    } else {
+      out->push_back((char)127);
+      size_t v = len - 127;
+      while (v >= 128) {
+        out->push_back((char)(0x80 | (v & 0x7f)));
+        v >>= 7;
+      }
+      out->push_back((char)v);
+    }
+  };
+  out->push_back((char)0x00);
+  put_len(name.size());
+  out->append(name);
+  put_len(value.size());
+  out->append(value);
+}
+
+// "Key: Value\r\n" lines → hpack literals with lower-cased keys.
+void encode_blob(std::string* out, const char* blob) {
+  if (blob == nullptr) return;
+  const char* p = blob;
+  while (*p) {
+    const char* eol = strstr(p, "\r\n");
+    size_t linelen = eol ? (size_t)(eol - p) : strlen(p);
+    const char* colon = (const char*)memchr(p, ':', linelen);
+    if (colon != nullptr && colon != p) {
+      std::string name(p, colon - p);
+      for (char& c : name) {
+        if (c >= 'A' && c <= 'Z') c += 'a' - 'A';
+      }
+      const char* v = colon + 1;
+      const char* vend = p + linelen;
+      while (v < vend && *v == ' ') ++v;
+      hpack_literal(out, name, std::string(v, vend - v));
+    }
+    if (!eol) break;
+    p = eol + 2;
+  }
+}
+
+int FatalGoaway(Socket* s, uint32_t last_stream, uint32_t err) {
+  std::string f;
+  std::string payload;
+  payload.push_back((char)((last_stream >> 24) & 0x7f));
+  payload.push_back((char)((last_stream >> 16) & 0xff));
+  payload.push_back((char)((last_stream >> 8) & 0xff));
+  payload.push_back((char)(last_stream & 0xff));
+  payload.push_back((char)((err >> 24) & 0xff));
+  payload.push_back((char)((err >> 16) & 0xff));
+  payload.push_back((char)((err >> 8) & 0xff));
+  payload.push_back((char)(err & 0xff));
+  put_frame_header(&f, (uint32_t)payload.size(), F_GOAWAY, 0, 0);
+  f += payload;
+  write_frames(s, f);
+  return -1;
+}
+
+// Process a fully-decoded header list into a request.
+bool FillRequest(StreamState* st,
+                 std::vector<std::pair<std::string, std::string>>& hdrs) {
+  for (auto& kv : hdrs) {
+    const std::string& k = kv.first;
+    if (k == ":method") {
+      st->req.method = kv.second;
+    } else if (k == ":path") {
+      size_t q = kv.second.find('?');
+      if (q == std::string::npos) {
+        st->req.path = kv.second;
+      } else {
+        st->req.path = kv.second.substr(0, q);
+        st->req.query = kv.second.substr(q + 1);
+      }
+    } else if (k == ":authority") {
+      st->req.headers += "host: " + kv.second + "\n";
+    } else if (!k.empty() && k[0] == ':') {
+      // :scheme etc — drop
+    } else {
+      st->req.headers += k + ": " + kv.second + "\n";
+    }
+  }
+  return !st->req.method.empty() && !st->req.path.empty();
+}
+
+}  // namespace
+
+bool LooksLikeH2(const IOBuf& buf) {
+  char head[kPrefaceLen];
+  size_t n = std::min(buf.size(), kPrefaceLen);
+  buf.copy_to(head, n);
+  return memcmp(head, kPreface, n) == 0;
+}
+
+H2Conn* H2ConnCreate(Socket* s) {
+  H2Conn* c = new H2Conn();
+  c->refs.store(2, std::memory_order_relaxed);  // registry + caller
+  {
+    std::lock_guard<std::mutex> lk(g_conns_mu);
+    g_conns[s->id()] = c;
+  }
+  // server preface: SETTINGS with our max frame size
+  std::string f;
+  std::string payload;
+  auto put_setting = [&payload](uint16_t id, uint32_t v) {
+    payload.push_back((char)(id >> 8));
+    payload.push_back((char)(id & 0xff));
+    payload.push_back((char)((v >> 24) & 0xff));
+    payload.push_back((char)((v >> 16) & 0xff));
+    payload.push_back((char)((v >> 8) & 0xff));
+    payload.push_back((char)(v & 0xff));
+  };
+  put_setting(0x5, kOurMaxFrameSize);    // MAX_FRAME_SIZE
+  put_setting(0x3, 1024);                // MAX_CONCURRENT_STREAMS
+  put_frame_header(&f, (uint32_t)payload.size(), F_SETTINGS, 0, 0);
+  f += payload;
+  // generous connection-level recv window so clients can push big bodies
+  put_frame_header(&f, 4, F_WINDOW_UPDATE, 0, 0);
+  uint32_t inc = (1u << 24);
+  f.push_back((char)((inc >> 24) & 0x7f));
+  f.push_back((char)((inc >> 16) & 0xff));
+  f.push_back((char)((inc >> 8) & 0xff));
+  f.push_back((char)(inc & 0xff));
+  write_frames(s, f);
+  return c;
+}
+
+H2Conn* H2ConnFind(SocketId id) {
+  std::lock_guard<std::mutex> lk(g_conns_mu);
+  auto it = g_conns.find(id);
+  if (it == g_conns.end()) {
+    return nullptr;
+  }
+  it->second->refs.fetch_add(1, std::memory_order_acq_rel);
+  return it->second;
+}
+
+void H2ConnRelease(H2Conn* c) {
+  if (c != nullptr &&
+      c->refs.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+    delete c;
+  }
+}
+
+void H2ConnDestroy(SocketId id) {
+  H2Conn* c = nullptr;
+  {
+    std::lock_guard<std::mutex> lk(g_conns_mu);
+    auto it = g_conns.find(id);
+    if (it != g_conns.end()) {
+      c = it->second;
+      g_conns.erase(it);
+    }
+  }
+  H2ConnRelease(c);  // drop the registry's reference
+}
+
+namespace {
+
+// Try to flush a stream's pending bytes within current windows.
+void FlushPending(H2Conn* c, Socket* s, uint32_t sid, StreamState* st,
+                  std::string* frames) {
+  while (!st->pending.empty() && c->conn_send_window > 0 &&
+         st->send_window > 0) {
+    size_t chunk = std::min({st->pending.size(),
+                             (size_t)c->conn_send_window,
+                             (size_t)st->send_window,
+                             (size_t)kOurMaxFrameSize});
+    bool last = chunk == st->pending.size();
+    bool end_stream = last && st->pending_trailers.empty();
+    put_frame_header(frames, (uint32_t)chunk, F_DATA,
+                     end_stream ? FLAG_END_STREAM : 0, sid);
+    frames->append(st->pending.data(), chunk);
+    st->pending.erase(0, chunk);
+    c->conn_send_window -= (int32_t)chunk;
+    st->send_window -= (int32_t)chunk;
+  }
+  if (st->pending.empty() && !st->pending_trailers.empty()) {
+    put_frame_header(frames, (uint32_t)st->pending_trailers.size(),
+                     F_HEADERS, FLAG_END_HEADERS | FLAG_END_STREAM, sid);
+    frames->append(st->pending_trailers);
+    st->pending_trailers.clear();
+  }
+  if (st->pending.empty() && st->pending_trailers.empty() && st->responded) {
+    c->streams.erase(sid);
+  }
+}
+
+}  // namespace
+
+int H2ConnConsume(H2Conn* c, Socket* s, std::vector<H2Request>* out) {
+  std::lock_guard<std::mutex> lk(c->mu);
+  std::string reply;  // protocol frames to write back
+  while (true) {
+    if (s->read_buf.size() < 9) {
+      break;
+    }
+    uint8_t hdr[9];
+    s->read_buf.copy_to(hdr, 9);
+    uint32_t len = ((uint32_t)hdr[0] << 16) | ((uint32_t)hdr[1] << 8) |
+                   hdr[2];
+    uint8_t type = hdr[3];
+    uint8_t flags = hdr[4];
+    uint32_t sid = (((uint32_t)hdr[5] & 0x7f) << 24) |
+                   ((uint32_t)hdr[6] << 16) | ((uint32_t)hdr[7] << 8) |
+                   hdr[8];
+    if (len > kMaxFrameAccept) {
+      if (!reply.empty()) write_frames(s, reply);
+      return FatalGoaway(s, 0, 6 /*FRAME_SIZE_ERROR*/);
+    }
+    if (s->read_buf.size() < 9 + (size_t)len) {
+      break;
+    }
+    s->read_buf.pop_front(9);
+    std::string payload;
+    payload.resize(len);
+    if (len > 0) {
+      s->read_buf.copy_to(&payload[0], len);
+      s->read_buf.pop_front(len);
+    }
+    const uint8_t* p = (const uint8_t*)payload.data();
+    size_t n = payload.size();
+
+    if (c->continuation_stream != 0 &&
+        (type != F_CONTINUATION || sid != c->continuation_stream)) {
+      if (!reply.empty()) write_frames(s, reply);
+      return FatalGoaway(s, 0, 1 /*PROTOCOL_ERROR*/);
+    }
+
+    switch (type) {
+      case F_SETTINGS: {
+        if (flags & FLAG_ACK) break;
+        for (size_t i = 0; i + 6 <= n; i += 6) {
+          uint16_t id = ((uint16_t)p[i] << 8) | p[i + 1];
+          uint32_t v = ((uint32_t)p[i + 2] << 24) |
+                       ((uint32_t)p[i + 3] << 16) |
+                       ((uint32_t)p[i + 4] << 8) | p[i + 5];
+          if (id == 0x4) {  // INITIAL_WINDOW_SIZE: adjust live streams
+            int32_t delta = (int32_t)v - c->peer_initial_window;
+            c->peer_initial_window = (int32_t)v;
+            for (auto& kv : c->streams) {
+              kv.second.send_window += delta;
+            }
+          }
+          // id 0x1 (HEADER_TABLE_SIZE) declares the PEER's decoder table;
+          // our encoder never indexes, so nothing to adjust — and our
+          // decoder's limit only changes via in-band size updates
+        }
+        put_frame_header(&reply, 0, F_SETTINGS, FLAG_ACK, 0);
+        break;
+      }
+      case F_PING: {
+        if (!(flags & FLAG_ACK) && n == 8) {
+          put_frame_header(&reply, 8, F_PING, FLAG_ACK, 0);
+          reply.append(payload);
+        }
+        break;
+      }
+      case F_WINDOW_UPDATE: {
+        if (n != 4) break;
+        uint32_t inc = (((uint32_t)p[0] & 0x7f) << 24) |
+                       ((uint32_t)p[1] << 16) | ((uint32_t)p[2] << 8) |
+                       p[3];
+        if (sid == 0) {
+          c->conn_send_window += (int32_t)inc;
+        } else {
+          auto it = c->streams.find(sid);
+          if (it != c->streams.end()) {
+            it->second.send_window += (int32_t)inc;
+          }
+        }
+        // windows reopened: flush anything queued
+        std::vector<uint32_t> sids;
+        for (auto& kv : c->streams) sids.push_back(kv.first);
+        for (uint32_t fsid : sids) {
+          auto it = c->streams.find(fsid);
+          if (it != c->streams.end()) {
+            FlushPending(c, s, fsid, &it->second, &reply);
+          }
+        }
+        break;
+      }
+      case F_HEADERS: {
+        if (sid == 0) {
+          if (!reply.empty()) write_frames(s, reply);
+          return FatalGoaway(s, 0, 1);
+        }
+        size_t off = 0;
+        if (flags & FLAG_PADDED) {
+          if (n < 1) return FatalGoaway(s, 0, 1);
+          uint8_t pad = p[0];
+          off = 1;
+          if (pad + off > n) return FatalGoaway(s, 0, 1);
+          n -= pad;
+        }
+        if (flags & FLAG_PRIORITY) {
+          if (off + 5 > n) return FatalGoaway(s, 0, 1);
+          off += 5;
+        }
+        bool fresh = c->streams.find(sid) == c->streams.end();
+        StreamState& st = c->streams[sid];
+        if (fresh) {
+          st.send_window = c->peer_initial_window;
+        }
+        st.req.stream_id = sid;
+        st.header_block.append((const char*)p + off, n - off);
+        if (st.header_block.size() > kMaxHeaderBlock) {
+          if (!reply.empty()) write_frames(s, reply);
+          return FatalGoaway(s, sid, 11 /*ENHANCE_YOUR_CALM*/);
+        }
+        if (flags & FLAG_END_STREAM) {
+          st.end_stream = true;
+        }
+        if (flags & FLAG_END_HEADERS) {
+          std::vector<std::pair<std::string, std::string>> hdrs;
+          if (!c->hpack.decode_block(
+                  (const uint8_t*)st.header_block.data(),
+                  st.header_block.size(), &hdrs)) {
+            if (!reply.empty()) write_frames(s, reply);
+            return FatalGoaway(s, sid, 9 /*COMPRESSION_ERROR*/);
+          }
+          st.header_block.clear();
+          st.headers_done = true;
+          if (!FillRequest(&st, hdrs)) {
+            if (!reply.empty()) write_frames(s, reply);
+            return FatalGoaway(s, sid, 1);
+          }
+          if (st.end_stream) {
+            out->push_back(std::move(st.req));
+          }
+        } else {
+          c->continuation_stream = sid;
+        }
+        break;
+      }
+      case F_CONTINUATION: {
+        auto it = c->streams.find(sid);
+        if (it == c->streams.end()) {
+          if (!reply.empty()) write_frames(s, reply);
+          return FatalGoaway(s, 0, 1);
+        }
+        StreamState& st = it->second;
+        st.header_block.append((const char*)p, n);
+        if (st.header_block.size() > kMaxHeaderBlock) {
+          if (!reply.empty()) write_frames(s, reply);
+          return FatalGoaway(s, sid, 11);
+        }
+        if (flags & FLAG_END_HEADERS) {
+          c->continuation_stream = 0;
+          std::vector<std::pair<std::string, std::string>> hdrs;
+          if (!c->hpack.decode_block(
+                  (const uint8_t*)st.header_block.data(),
+                  st.header_block.size(), &hdrs)) {
+            if (!reply.empty()) write_frames(s, reply);
+            return FatalGoaway(s, sid, 9);
+          }
+          st.header_block.clear();
+          st.headers_done = true;
+          if (!FillRequest(&st, hdrs)) {
+            if (!reply.empty()) write_frames(s, reply);
+            return FatalGoaway(s, sid, 1);
+          }
+          if (st.end_stream) {
+            out->push_back(std::move(st.req));
+          }
+        }
+        break;
+      }
+      case F_DATA: {
+        auto it = c->streams.find(sid);
+        if (it == c->streams.end() || !it->second.headers_done) {
+          if (!reply.empty()) write_frames(s, reply);
+          return FatalGoaway(s, 0, 1);
+        }
+        StreamState& st = it->second;
+        size_t off = 0;
+        if (flags & FLAG_PADDED) {
+          if (n < 1) return FatalGoaway(s, 0, 1);
+          uint8_t pad = p[0];
+          off = 1;
+          if (pad + off > n) return FatalGoaway(s, 0, 1);
+          n -= pad;
+        }
+        st.req.body.append((const char*)p + off, n - off);
+        if (st.req.body.size() > kMaxBodyBytes) {
+          if (!reply.empty()) write_frames(s, reply);
+          return FatalGoaway(s, sid, 11);
+        }
+        // replenish recv windows (conn + stream) by what we consumed
+        if (len > 0) {
+          for (uint32_t wsid : {0u, sid}) {
+            put_frame_header(&reply, 4, F_WINDOW_UPDATE, 0, wsid);
+            reply.push_back((char)((len >> 24) & 0x7f));
+            reply.push_back((char)((len >> 16) & 0xff));
+            reply.push_back((char)((len >> 8) & 0xff));
+            reply.push_back((char)(len & 0xff));
+          }
+        }
+        if (flags & FLAG_END_STREAM) {
+          st.end_stream = true;
+          out->push_back(std::move(st.req));
+        }
+        break;
+      }
+      case F_RST: {
+        c->streams.erase(sid);
+        break;
+      }
+      case F_GOAWAY: {
+        c->goaway = true;
+        break;
+      }
+      case F_PRIORITY:
+      case F_PUSH:
+      default:
+        break;  // ignore
+    }
+  }
+  if (!reply.empty()) {
+    write_frames(s, reply);
+  }
+  return 0;
+}
+
+int H2Respond(H2Conn* c, Socket* s, uint32_t stream_id, int status,
+              const char* headers_blob, const uint8_t* body,
+              size_t body_len, const char* trailers_blob) {
+  std::lock_guard<std::mutex> lk(c->mu);
+  auto it = c->streams.find(stream_id);
+  if (it == c->streams.end()) {
+    return -1;  // client reset the stream
+  }
+  StreamState& st = it->second;
+  std::string frames;
+  // response HEADERS
+  std::string block;
+  switch (status) {  // RFC 7541 static entries 8..14
+    case 200: block.push_back((char)0x88); break;
+    case 204: block.push_back((char)0x89); break;
+    case 206: block.push_back((char)0x8a); break;
+    case 304: block.push_back((char)0x8b); break;
+    case 400: block.push_back((char)0x8c); break;
+    case 404: block.push_back((char)0x8d); break;
+    case 500: block.push_back((char)0x8e); break;
+    default: {
+      // literal w/o indexing, name = static index 8 (:status)
+      block.push_back((char)0x08);
+      std::string v = std::to_string(status);
+      block.push_back((char)v.size());
+      block += v;
+    }
+  }
+  encode_blob(&block, headers_blob);
+  bool no_body = body_len == 0 && trailers_blob == nullptr;
+  put_frame_header(&frames, (uint32_t)block.size(), F_HEADERS,
+                   FLAG_END_HEADERS | (no_body ? FLAG_END_STREAM : 0),
+                   stream_id);
+  frames += block;
+  st.responded = true;
+  if (no_body) {
+    c->streams.erase(stream_id);
+    write_frames(s, frames);
+    return 0;
+  }
+  st.pending.assign((const char*)body, body_len);
+  if (trailers_blob != nullptr) {
+    std::string tblock;
+    encode_blob(&tblock, trailers_blob);
+    st.pending_trailers = std::move(tblock);
+  }
+  FlushPending(c, s, stream_id, &st, &frames);
+  write_frames(s, frames);
+  return 0;
+}
+
+}  // namespace trpc

@@ -1,0 +1,65 @@
+// h2.h — HTTP/2 (h2c, prior-knowledge) server-side protocol for the shared
+// port (capability of the reference policy/http2_rpc_protocol.cpp:1835 +
+// details/hpack.cpp:880 — re-designed, not ported: one H2Conn object per
+// connection holds the HPACK dynamic table, stream states and flow-control
+// windows; frames are parsed from the chained read buffer and protocol
+// frames (SETTINGS acks, PING acks, WINDOW_UPDATEs) are written straight
+// back through the wait-free socket write path).  gRPC rides on top: the
+// Python layer routes content-type application/grpc and answers with
+// trailers (H2Respond's trailer block), per grpc.h:208 semantics.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "iobuf.h"
+#include "socket.h"
+
+namespace trpc {
+
+// 24-byte client connection preface "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n".
+// Returns true when the readable prefix still matches it.
+bool LooksLikeH2(const IOBuf& buf);
+
+struct H2Request {
+  uint32_t stream_id = 0;
+  std::string method;   // :method
+  std::string path;     // :path before '?'
+  std::string query;    // after '?'
+  std::string headers;  // "lower-key: value\n" lines (incl. host)
+  std::string body;
+};
+
+class H2Conn;
+
+// H2Conn lifetime is refcounted: the registry holds one reference and
+// every Create/Find caller holds another until H2ConnRelease — a socket
+// failure (H2ConnDestroy runs from SetFailed's on_failed hook, possibly
+// while a usercode thread is mid-H2Respond) must not free state under a
+// concurrent holder.
+
+// Create per-connection state (sends the server SETTINGS frame); caller
+// owns a reference.  The preface must already be verified present.
+H2Conn* H2ConnCreate(Socket* s);
+// Acquire by socket id (nullptr if this connection never spoke h2).
+H2Conn* H2ConnFind(SocketId id);
+// Release a Create/Find reference.
+void H2ConnRelease(H2Conn* c);
+// Unregister on connection failure; frees once all holders release.
+void H2ConnDestroy(SocketId id);
+
+// Parse everything parseable from s->read_buf.  Complete requests
+// (END_STREAM seen) are appended to *out.  Returns 0 ok / -1 fatal
+// connection error (caller should SetFailed).
+int H2ConnConsume(H2Conn* c, Socket* s, std::vector<H2Request>* out);
+
+// Serialize one response onto the stream: HEADERS (+ :status), DATA
+// chunks honoring the send flow-control windows, and — when
+// trailers_blob is non-null — a trailing HEADERS block (gRPC status).
+// headers_blob / trailers_blob: "Key: Value\r\n" lines.
+int H2Respond(H2Conn* c, Socket* s, uint32_t stream_id, int status,
+              const char* headers_blob, const uint8_t* body,
+              size_t body_len, const char* trailers_blob);
+
+}  // namespace trpc

@@ -19,6 +19,7 @@
 #include <unordered_map>
 #include <vector>
 
+#include "h2.h"
 #include "http.h"
 #include "object_pool.h"
 #include "stream.h"
@@ -205,6 +206,7 @@ struct CallCtx {
   // verb, payload the body, and these the rest of the request line
   bool is_http = false;
   bool http_keep_alive = true;
+  uint32_t h2_stream = 0;  // nonzero: respond as HTTP/2 frames
   std::string http_path;
   std::string http_query;
   std::string http_headers;
@@ -380,7 +382,47 @@ void DispatchHttp(Socket* s, Server* srv, HttpRequest&& req) {
   ctx->slot = slot;
   ctx->sock = s->id();
   ctx->is_http = true;
+  ctx->h2_stream = 0;
   ctx->http_keep_alive = req.keep_alive;
+  ctx->method = std::move(req.method);
+  ctx->http_path = std::move(req.path);
+  ctx->http_query = std::move(req.query);
+  ctx->http_headers = std::move(req.headers);
+  ctx->payload = std::move(req.body);
+  ctx->attachment.clear();
+  ctx->req_stream_id = 0;
+  ctx->req_stream_window = 0;
+  ctx->accepted_stream = 0;
+  ctx->hcb = srv->http_cb;
+  ctx->user = srv->http_user;
+  UsercodePool::Instance().Submit(ctx);
+}
+
+// One parsed HTTP/2 request → usercode pool (streams are multiplexed by
+// id, so no ordering gate; concurrency comes for free).
+void DispatchH2(Socket* s, Server* srv, H2Request&& req) {
+  if (srv->http_cb == nullptr ||
+      !srv->running.load(std::memory_order_acquire)) {
+    H2Conn* c = H2ConnFind(s->id());
+    if (c != nullptr) {
+      const char* msg = srv->http_cb == nullptr
+                            ? "no HTTP handler registered\n"
+                            : "server is stopping\n";
+      H2Respond(c, s, req.stream_id, srv->http_cb == nullptr ? 404 : 503,
+                "content-type: text/plain\r\n", (const uint8_t*)msg,
+                strlen(msg), nullptr);
+      H2ConnRelease(c);
+    }
+    return;
+  }
+  srv->nrequests.fetch_add(1, std::memory_order_relaxed);
+  CallCtx* ctx = nullptr;
+  uint32_t slot = ResourcePool<CallCtx>::Get(&ctx);
+  ctx->slot = slot;
+  ctx->sock = s->id();
+  ctx->is_http = true;
+  ctx->h2_stream = req.stream_id;
+  ctx->http_keep_alive = true;  // h2 connections persist
   ctx->method = std::move(req.method);
   ctx->http_path = std::move(req.path);
   ctx->http_query = std::move(req.query);
@@ -405,15 +447,51 @@ void ServerOnMessages(Socket* s) {
     s->SetFailed(errno);
     return;
   }
+  // connections that completed the h2 preface stay h2 for life
+  H2Conn* h2c = H2ConnFind(s->id());
+  if (h2c != nullptr) {
+    std::vector<H2Request> reqs;
+    int hrc = H2ConnConsume(h2c, s, &reqs);
+    H2ConnRelease(h2c);
+    if (hrc != 0) {
+      s->SetFailed(TRPC_EREQUEST);
+      return;
+    }
+    for (H2Request& r : reqs) {
+      DispatchH2(s, srv, std::move(r));
+    }
+    if (eof) {
+      s->SetFailed(ECONNRESET);
+    }
+    return;
+  }
   while (true) {
     // protocol sniff per message (≙ CutInputMessage trying protocols,
-    // input_messenger.cpp:77): "TRPC" magic or an HTTP verb
+    // input_messenger.cpp:77): "TRPC" magic, h2 preface, or an HTTP verb
     if (s->read_buf.size() < 4) {
       break;
     }
     char magic[4];
     s->read_buf.copy_to(magic, 4);
     if (memcmp(magic, "TRPC", 4) != 0) {
+      if (LooksLikeH2(s->read_buf)) {
+        if (s->read_buf.size() < 24) {
+          break;  // wait for the full preface
+        }
+        s->read_buf.pop_front(24);
+        H2Conn* c = H2ConnCreate(s);
+        std::vector<H2Request> reqs;
+        int hrc = H2ConnConsume(c, s, &reqs);
+        H2ConnRelease(c);
+        if (hrc != 0) {
+          s->SetFailed(TRPC_EREQUEST);
+          return;
+        }
+        for (H2Request& r : reqs) {
+          DispatchH2(s, srv, std::move(r));
+        }
+        break;  // rest of the connection handled by the h2 path above
+      }
       if (!LooksLikeHttp(s->read_buf)) {
         s->SetFailed(TRPC_EREQUEST);
         return;
@@ -514,6 +592,7 @@ void ServerOnMessages(Socket* s) {
 }
 
 void ServerConnFailed(Socket* s) {
+  H2ConnDestroy(s->id());
   StreamsOnSocketFailed(s->id());
   Server* srv = (Server*)s->user;
   std::lock_guard<std::mutex> lk(srv->conns_mu);
@@ -789,14 +868,37 @@ void CloseAfterWriteFiber(void* a) {
 
 }  // namespace
 
-int http_respond(uint64_t token, int status, const char* headers_blob,
-                 const uint8_t* body, size_t body_len) {
+int http_respond2(uint64_t token, int status, const char* headers_blob,
+                  const uint8_t* body, size_t body_len,
+                  const char* trailers_blob) {
   uint32_t slot = (uint32_t)token;
   uint32_t ver = (uint32_t)(token >> 32);
   CallCtx* ctx = ResourcePool<CallCtx>::Address(slot);
   if (ctx == nullptr || !ctx->is_http ||
       ctx->version.load(std::memory_order_acquire) != ver) {
     return -EINVAL;
+  }
+  if (ctx->h2_stream != 0) {
+    // HTTP/2: frames multiplex; trailers carry gRPC status
+    Socket* s = Socket::Address(ctx->sock);
+    if (s != nullptr) {
+      H2Conn* c = H2ConnFind(ctx->sock);
+      if (c != nullptr) {
+        H2Respond(c, s, ctx->h2_stream, status, headers_blob, body,
+                  body_len, trailers_blob);
+        H2ConnRelease(c);
+      }
+      s->Dereference();
+    }
+    ctx->version.fetch_add(1, std::memory_order_release);
+    ctx->payload.clear();
+    ctx->http_path.clear();
+    ctx->http_query.clear();
+    ctx->http_headers.clear();
+    ctx->is_http = false;
+    ctx->h2_stream = 0;
+    ResourcePool<CallCtx>::Return(slot);
+    return 0;
   }
   bool keep_alive = ctx->http_keep_alive;
   Socket* s = Socket::Address(ctx->sock);
@@ -839,6 +941,12 @@ int http_respond(uint64_t token, int status, const char* headers_blob,
   ctx->is_http = false;
   ResourcePool<CallCtx>::Return(slot);
   return 0;
+}
+
+int http_respond(uint64_t token, int status, const char* headers_blob,
+                 const uint8_t* body, size_t body_len) {
+  return http_respond2(token, status, headers_blob, body, body_len,
+                       nullptr);
 }
 
 int token_compress_type(uint64_t token) {

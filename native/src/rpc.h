@@ -110,6 +110,11 @@ int respond(uint64_t token, int32_t error_code, const char* error_text,
 // Respond to a pending HTTP token.  headers_blob: "Key: Value\r\n" lines.
 int http_respond(uint64_t token, int status, const char* headers_blob,
                  const uint8_t* body, size_t body_len);
+// Same plus a trailer block — meaningful on HTTP/2 streams (gRPC status
+// rides trailers); ignored on HTTP/1.x connections.
+int http_respond2(uint64_t token, int status, const char* headers_blob,
+                  const uint8_t* body, size_t body_len,
+                  const char* trailers_blob);
 // Compress type of a pending request's meta (what the client used).
 int token_compress_type(uint64_t token);
 

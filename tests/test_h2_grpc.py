@@ -1,0 +1,267 @@
+"""HTTP/2 + gRPC conformance — REAL clients against the native h2 server
+on the shared port (≙ brpc_grpc_protocol_unittest + brpc_h2 tests; the
+client here is the stock grpcio C-core, the strictest conformance check
+available in-process)."""
+
+import shutil
+import subprocess
+import time
+
+import grpc
+import pytest
+
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.channel import Channel
+from brpc_tpu.rpc.grpc_service import parse_grpc_timeout
+from brpc_tpu.rpc.server import Server
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = Server()
+    srv.add_echo_service()
+    srv.add_service("Upper", lambda cntl, req: req.upper())
+
+    def fail(cntl, msg):
+        raise errors.RpcError(errors.EINTERNAL, "deliberate failure")
+
+    def limited(cntl, msg):
+        raise errors.RpcError(errors.ELIMIT, "shed")
+
+    srv.add_grpc_service("test.Echo", {
+        "Echo": lambda cntl, msg: msg,
+        "Upper": lambda cntl, msg: msg.upper(),
+        "Fail": fail,
+        "Limited": limited,
+    })
+    srv.start("127.0.0.1:0")
+    yield srv
+    srv.destroy()
+
+
+@pytest.fixture(scope="module")
+def channel(server):
+    ch = grpc.insecure_channel(f"127.0.0.1:{server.port}")
+    yield ch
+    ch.close()
+
+
+def unary(channel, method):
+    return channel.unary_unary(method,
+                               request_serializer=lambda b: b,
+                               response_deserializer=lambda b: b)
+
+
+class TestGrpc:
+    def test_unary_roundtrip(self, channel):
+        assert unary(channel, "/test.Echo/Echo")(b"hi", timeout=5) == b"hi"
+        assert unary(channel, "/test.Echo/Upper")(b"abc",
+                                                  timeout=5) == b"ABC"
+
+    def test_empty_message(self, channel):
+        assert unary(channel, "/test.Echo/Echo")(b"", timeout=5) == b""
+
+    def test_large_messages_both_ways(self, channel):
+        big = bytes(range(256)) * 2048  # 512KB, crosses flow-control windows
+        assert unary(channel, "/test.Echo/Echo")(big, timeout=15) == big
+
+    def test_error_maps_to_grpc_status(self, channel):
+        with pytest.raises(grpc.RpcError) as ei:
+            unary(channel, "/test.Echo/Fail")(b"", timeout=5)
+        assert ei.value.code() == grpc.StatusCode.INTERNAL
+        assert "deliberate" in ei.value.details()
+
+    def test_limit_maps_to_resource_exhausted(self, channel):
+        with pytest.raises(grpc.RpcError) as ei:
+            unary(channel, "/test.Echo/Limited")(b"", timeout=5)
+        assert ei.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+
+    def test_unknown_method_is_unimplemented_or_notfound(self, channel):
+        with pytest.raises(grpc.RpcError):
+            unary(channel, "/test.Echo/Nope")(b"", timeout=5)
+
+    def test_many_concurrent_on_one_connection(self, channel):
+        stub = unary(channel, "/test.Echo/Echo")
+        futs = [stub.future(f"m{i}".encode(), timeout=10)
+                for i in range(64)]
+        got = [f.result() for f in futs]
+        assert got == [f"m{i}".encode() for i in range(64)]
+
+    def test_gzip_compressed_request(self, channel):
+        stub = unary(channel, "/test.Echo/Upper")
+        out = stub(b"compressed" * 100, timeout=5,
+                   compression=grpc.Compression.Gzip)
+        assert out == b"COMPRESSED" * 100
+
+    def test_trpc_still_lives_on_the_same_port(self, server, channel):
+        # the shared port keeps speaking TRPC while gRPC streams are open
+        stub = unary(channel, "/test.Echo/Echo")
+        assert stub(b"grpc", timeout=5) == b"grpc"
+        ch = Channel(f"127.0.0.1:{server.port}")
+        assert ch.call("Echo.echo", b"trpc") == b"trpc"
+        ch.close()
+        assert stub(b"grpc2", timeout=5) == b"grpc2"
+
+
+class TestGrpcEdgeCases:
+    def test_multiline_error_message_stays_one_trailer(self, server,
+                                                       channel):
+        # CR/LF in exception text must not inject extra trailers
+        srv2 = Server()
+        srv2.add_grpc_service("evil.Svc", {
+            "Boom": lambda cntl, msg: (_ for _ in ()).throw(
+                ValueError("line1\r\ngrpc-status: 0\r\nline2")),
+        })
+        srv2.start("127.0.0.1:0")
+        try:
+            ch2 = grpc.insecure_channel(f"127.0.0.1:{srv2.port}")
+            with pytest.raises(grpc.RpcError) as ei:
+                unary(ch2, "/evil.Svc/Boom")(b"", timeout=5)
+            # the injected 'grpc-status: 0' must NOT read as success
+            assert ei.value.code() == grpc.StatusCode.INTERNAL
+            assert "%0D%0A" in ei.value.details() or \
+                "line1" in ei.value.details()
+            ch2.close()
+        finally:
+            srv2.destroy()
+
+    def test_multiple_frames_rejected(self, server):
+        # two length-prefixed messages = client streaming → UNIMPLEMENTED
+        from brpc_tpu.rpc.grpc_service import _wrap
+        from brpc_tpu.rpc.http import HttpRequest
+        h = _wrap("x/Y", lambda cntl, m: m)
+        one = b"\x00" + (3).to_bytes(4, "big") + b"abc"
+        req = HttpRequest(method="POST", path="/x/Y",
+                          headers={"content-type": "application/grpc"},
+                          body=one + one)
+        resp = h(req)
+        assert resp.trailers["grpc-status"] == "12"
+
+
+class TestGrpcTimeout:
+    @pytest.mark.parametrize("value,ms", [
+        ("5S", 5000.0), ("100m", 100.0), ("1M", 60000.0),
+        ("250000u", 250.0), ("2H", 7200000.0),
+    ])
+    def test_parse(self, value, ms):
+        assert parse_grpc_timeout(value) == ms
+
+    def test_bad_values(self):
+        for bad in ("", "5", "S", "5X", "123456789S"):
+            with pytest.raises(ValueError):
+                parse_grpc_timeout(bad)
+
+
+@pytest.mark.skipif(shutil.which("curl") is None, reason="no curl")
+class TestH2Curl:
+    def test_curl_prior_knowledge_portal(self, server):
+        out = subprocess.run(
+            ["curl", "-s", "--http2-prior-knowledge",
+             f"http://127.0.0.1:{server.port}/health"],
+            capture_output=True, text=True, timeout=10)
+        assert out.stdout == "OK\n"
+
+    def test_curl_h2_post_rpc_bridge(self, server):
+        out = subprocess.run(
+            ["curl", "-s", "--http2-prior-knowledge", "-X", "POST",
+             "-d", "raw-bytes",
+             f"http://127.0.0.1:{server.port}/rpc/Upper"],
+            capture_output=True, timeout=10)
+        assert out.stdout == b"RAW-BYTES"
+
+
+# --- raw-frame conformance (dependency-free h2 client) ----------------------
+# curl 7.88's h2c connection reuse is broken client-side (it sends zero
+# bytes on the reused connection), so multi-stream behavior is verified
+# with hand-rolled frames instead.
+
+
+def _hpack_lit(name: bytes, value: bytes) -> bytes:
+    return (bytes([0x00, len(name)]) + name +
+            bytes([len(value)]) + value)
+
+
+def _frame(ftype: int, flags: int, sid: int, payload: bytes = b"") -> bytes:
+    import struct
+    return (struct.pack(">I", len(payload))[1:] + bytes([ftype, flags]) +
+            struct.pack(">I", sid) + payload)
+
+
+def _read_frames(sock, seconds: float):
+    import socket as pysocket
+    sock.settimeout(seconds)
+    data = b""
+    try:
+        while True:
+            chunk = sock.recv(65536)
+            if not chunk:
+                break
+            data += chunk
+    except pysocket.timeout:
+        pass
+    frames, i = [], 0
+    while i + 9 <= len(data):
+        ln = int.from_bytes(data[i:i + 3], "big")
+        frames.append((data[i + 3], data[i + 4],
+                       int.from_bytes(data[i + 5:i + 9], "big") & 0x7fffffff,
+                       data[i + 9:i + 9 + ln]))
+        i += 9 + ln
+    return frames
+
+
+class TestH2RawFrames:
+    def _get(self, path: bytes) -> bytes:
+        return (_hpack_lit(b":method", b"GET") +
+                _hpack_lit(b":path", path) +
+                _hpack_lit(b":scheme", b"http") +
+                _hpack_lit(b":authority", b"t"))
+
+    def test_sequential_streams_one_connection(self, server):
+        import socket as pysocket
+        s = pysocket.create_connection(("127.0.0.1", server.port),
+                                       timeout=5)
+        s.sendall(b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n" + _frame(4, 0, 0))
+        s.sendall(_frame(1, 0x5, 1, self._get(b"/health")))
+        f1 = _read_frames(s, 0.8)
+        assert any(t == 0 and fl & 1 and sid == 1 and p == b"OK\n"
+                   for t, fl, sid, p in f1)
+        s.sendall(_frame(1, 0x5, 3, self._get(b"/version")))
+        f2 = _read_frames(s, 0.8)
+        assert any(t == 0 and sid == 3 and b"brpc-tpu" in p
+                   for t, fl, sid, p in f2)
+        s.close()
+
+    def test_interleaved_streams(self, server):
+        import socket as pysocket
+        s = pysocket.create_connection(("127.0.0.1", server.port),
+                                       timeout=5)
+        s.sendall(b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n" + _frame(4, 0, 0))
+        # two streams opened back-to-back before reading anything
+        s.sendall(_frame(1, 0x5, 1, self._get(b"/health")) +
+                  _frame(1, 0x5, 3, self._get(b"/version")))
+        frames = _read_frames(s, 1.0)
+        bodies = {sid: p for t, fl, sid, p in frames if t == 0}
+        assert bodies.get(1) == b"OK\n"
+        assert b"brpc-tpu" in bodies.get(3, b"")
+        s.close()
+
+    def test_ping_is_acked(self, server):
+        import socket as pysocket
+        s = pysocket.create_connection(("127.0.0.1", server.port),
+                                       timeout=5)
+        s.sendall(b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n" + _frame(4, 0, 0))
+        s.sendall(_frame(6, 0, 0, b"12345678"))
+        frames = _read_frames(s, 0.8)
+        assert any(t == 6 and fl & 1 and p == b"12345678"
+                   for t, fl, sid, p in frames)
+        s.close()
+
+    def test_bad_hpack_gets_goaway(self, server):
+        import socket as pysocket
+        s = pysocket.create_connection(("127.0.0.1", server.port),
+                                       timeout=5)
+        s.sendall(b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n" + _frame(4, 0, 0))
+        s.sendall(_frame(1, 0x5, 1, b"\xbf\xff\xff\xff\xff\xff"))
+        frames = _read_frames(s, 0.8)
+        assert any(t == 7 for t, fl, sid, p in frames)  # GOAWAY
+        s.close()
