@@ -100,7 +100,7 @@ def _wrap(method_full: str, handler: Callable[[Controller, bytes], bytes]):
                                    "unsupported grpc-encoding")
             try:
                 msg = gzip.decompress(msg)
-            except OSError:
+            except Exception:  # zlib.error / EOFError / OSError
                 return _grpc_error(GRPC_INTERNAL, "bad gzip message")
         cntl = Controller()
         cntl.method = method_full

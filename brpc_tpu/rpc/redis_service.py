@@ -1,0 +1,178 @@
+"""Redis protocol support — server-side services AND a client (capability
+of the reference redis support: redis.{h,cpp} RedisCommand/RedisReply +
+policy/redis_protocol.cpp:428, which lets a brpc server speak RESP and a
+brpc channel dial real redis servers).
+
+Server side: the native core sniffs RESP on the shared port and parses
+command arrays (native/src/redis.cc); commands land here on the usercode
+pool, dispatch by upper-cased command name, and handlers return replies
+encoded with the helpers below.
+
+    svc = RedisService()
+    svc.register("GET", lambda args: bulk(store.get(args[0])))
+    server.add_redis_service(svc)
+    # then: redis-cli -p <port> GET key   (or RedisClient below)
+
+Client side: RedisClient speaks RESP2 over a plain socket (works against
+our servers and real redis).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Callable, Dict, List, Optional, Union
+
+Reply = bytes  # fully RESP-encoded
+
+
+# --- RESP encoding helpers (server replies) --------------------------------
+
+
+def simple(s: str) -> Reply:
+    return f"+{s}\r\n".encode()
+
+
+def error(msg: str) -> Reply:
+    return f"-ERR {msg}\r\n".encode()
+
+
+def integer(v: int) -> Reply:
+    return f":{v}\r\n".encode()
+
+
+def bulk(data: Optional[Union[bytes, str]]) -> Reply:
+    if data is None:
+        return b"$-1\r\n"  # null bulk
+    if isinstance(data, str):
+        data = data.encode()
+    return b"$%d\r\n%s\r\n" % (len(data), data)
+
+
+def array(items: Optional[List[Reply]]) -> Reply:
+    if items is None:
+        return b"*-1\r\n"
+    return b"*%d\r\n%s" % (len(items), b"".join(items))
+
+
+# --- server-side service ----------------------------------------------------
+
+
+Handler = Callable[[List[bytes]], Reply]
+
+
+class RedisService:
+    """Command table: register("SET", handler(args) -> RESP bytes); args
+    excludes the command name.  PING/ECHO/COMMAND are built in (override
+    by registering)."""
+
+    def __init__(self):
+        self._commands: Dict[str, Handler] = {}
+        self.register("PING", lambda args: simple("PONG") if not args
+                      else bulk(args[0]))
+        self.register("ECHO", lambda args: bulk(args[0]) if args
+                      else error("wrong number of arguments"))
+        self.register("COMMAND", lambda args: array([]))
+
+    def register(self, name: str, handler: Handler) -> None:
+        self._commands[name.upper()] = handler
+
+    def dispatch(self, argv: List[bytes]) -> Reply:
+        if not argv:
+            return error("empty command")
+        name = argv[0].decode("utf-8", "replace").upper()
+        h = self._commands.get(name)
+        if h is None:
+            return error(f"unknown command '{name}'")
+        try:
+            return h(argv[1:])
+        except Exception as e:  # noqa: BLE001 — handler bug → -ERR
+            return error(str(e).replace("\r", " ").replace("\n", " "))
+
+
+def unpack_args(blob: bytes) -> List[bytes]:
+    """Native PackRedisArgs blob → argv."""
+    (argc,) = struct.unpack_from("<I", blob, 0)
+    off = 4
+    out = []
+    for _ in range(argc):
+        (ln,) = struct.unpack_from("<I", blob, off)
+        off += 4
+        out.append(blob[off:off + ln])
+        off += ln
+    return out
+
+
+# --- client -----------------------------------------------------------------
+
+
+class RedisError(Exception):
+    pass
+
+
+class RedisClient:
+    """Minimal RESP2 client (≙ the reference redis client capability —
+    pipelining via call_pipeline, inline replies parsed)."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 5.0):
+        self._sock = socket.create_connection((host, port),
+                                              timeout=timeout_s)
+        self._buf = b""
+        self._lock = threading.Lock()
+
+    def call(self, *args: Union[bytes, str]):
+        return self.call_pipeline([args])[0]
+
+    def call_pipeline(self, commands):
+        """Send all commands, then read all replies (ordered)."""
+        out = bytearray()
+        for cmd in commands:
+            parts = [a.encode() if isinstance(a, str) else a for a in cmd]
+            out += b"*%d\r\n" % len(parts)
+            for p in parts:
+                out += b"$%d\r\n%s\r\n" % (len(p), p)
+        with self._lock:
+            self._sock.sendall(bytes(out))
+            return [self._read_reply() for _ in commands]
+
+    # RESP reply parsing -----------------------------------------------------
+
+    def _read_line(self) -> bytes:
+        while b"\r\n" not in self._buf:
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise RedisError("connection closed")
+            self._buf += chunk
+        line, self._buf = self._buf.split(b"\r\n", 1)
+        return line
+
+    def _read_exact(self, n: int) -> bytes:
+        while len(self._buf) < n + 2:
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise RedisError("connection closed")
+            self._buf += chunk
+        data, self._buf = self._buf[:n], self._buf[n + 2:]
+        return data
+
+    def _read_reply(self):
+        line = self._read_line()
+        kind, rest = line[:1], line[1:]
+        if kind == b"+":
+            return rest.decode()
+        if kind == b"-":
+            raise RedisError(rest.decode())
+        if kind == b":":
+            return int(rest)
+        if kind == b"$":
+            n = int(rest)
+            return None if n < 0 else self._read_exact(n)
+        if kind == b"*":
+            n = int(rest)
+            return None if n < 0 else [self._read_reply()
+                                       for _ in range(n)]
+        raise RedisError(f"bad reply type {kind!r}")
+
+    def close(self):
+        self._sock.close()

@@ -114,6 +114,35 @@ class Server:
         main port beside TRPC."""
         self.http.register(path, handler, prefix=prefix)
 
+    def add_redis_service(self, service) -> None:
+        """Make the shared port speak RESP (≙ a brpc server exposing a
+        redis-compatible service, policy/redis_protocol.cpp).  `service`
+        is a rpc.redis_service.RedisService; commands are sniffed natively
+        and dispatched to it on the usercode pool."""
+        from brpc_tpu.rpc import redis_service as rmod
+
+        _REDIS_CB = ctypes.CFUNCTYPE(
+            None, ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_size_t, ctypes.c_void_p)
+
+        def on_command(token, blob_p, blob_len, _user):
+            L = lib()
+            try:
+                argv = rmod.unpack_args(
+                    ctypes.string_at(blob_p, blob_len) if blob_len
+                    else b"\x00\x00\x00\x00")
+                reply = service.dispatch(argv)
+            except Exception:
+                log.LOG(log.LOG_ERROR, "redis dispatch raised:\n%s",
+                        traceback.format_exc())
+                reply = b"-ERR internal error\r\n"
+            L.trpc_redis_respond(token, reply, len(reply))
+
+        cb = _REDIS_CB(on_command)
+        self._cb_keepalive.append(cb)
+        lib().trpc_server_set_redis_handler(
+            self._handle, ctypes.cast(cb, ctypes.c_void_p), None)
+
     def add_grpc_service(self, service_name: str, methods) -> None:
         """Serve gRPC methods at /<service_name>/<Method> — real gRPC
         clients dial the same port (h2 + gRPC framing handled natively +

@@ -126,6 +126,16 @@ int trpc_http_respond_trailers(uint64_t token, int status,
                        trailers_blob);
 }
 
+// --- redis on the shared port ----------------------------------------------
+
+void trpc_server_set_redis_handler(void* s, RedisHandlerCb cb, void* user) {
+  server_set_redis_handler((Server*)s, cb, user);
+}
+
+int trpc_redis_respond(uint64_t token, const uint8_t* data, size_t len) {
+  return redis_respond(token, data, len);
+}
+
 // --- auth ------------------------------------------------------------------
 
 void trpc_server_set_auth(void* s, const uint8_t* secret, size_t len) {

@@ -242,7 +242,7 @@ struct StreamState {
   bool end_stream = false;
   bool responded = false;
   H2Request req;
-  int32_t send_window = kDefaultWindow;
+  int64_t send_window = kDefaultWindow;
   // bytes waiting for window (flushed on WINDOW_UPDATE), then trailers
   std::string pending;
   std::string pending_trailers;  // encoded HEADERS payload, sent after data
@@ -257,9 +257,8 @@ class H2Conn {
   Hpack hpack;
   std::unordered_map<uint32_t, StreamState> streams;
   uint32_t continuation_stream = 0;  // nonzero: expecting CONTINUATION
-  uint8_t continuation_flags = 0;
-  int32_t conn_send_window = kDefaultWindow;
-  int32_t peer_initial_window = kDefaultWindow;
+  int64_t conn_send_window = kDefaultWindow;
+  int64_t peer_initial_window = kDefaultWindow;
   bool goaway = false;
 };
 
@@ -388,6 +387,7 @@ bool LooksLikeH2(const IOBuf& buf) {
 H2Conn* H2ConnCreate(Socket* s) {
   H2Conn* c = new H2Conn();
   c->refs.store(2, std::memory_order_relaxed);  // registry + caller
+  s->is_h2.store(true, std::memory_order_release);
   {
     std::lock_guard<std::mutex> lk(g_conns_mu);
     g_conns[s->id()] = c;
@@ -529,8 +529,12 @@ int H2ConnConsume(H2Conn* c, Socket* s, std::vector<H2Request>* out) {
                        ((uint32_t)p[i + 3] << 16) |
                        ((uint32_t)p[i + 4] << 8) | p[i + 5];
           if (id == 0x4) {  // INITIAL_WINDOW_SIZE: adjust live streams
-            int32_t delta = (int32_t)v - c->peer_initial_window;
-            c->peer_initial_window = (int32_t)v;
+            if (v > 0x7fffffffu) {  // RFC 7540 §6.5.2
+              if (!reply.empty()) write_frames(s, reply);
+              return FatalGoaway(s, 0, 3 /*FLOW_CONTROL_ERROR*/);
+            }
+            int64_t delta = (int64_t)v - c->peer_initial_window;
+            c->peer_initial_window = (int64_t)v;
             for (auto& kv : c->streams) {
               kv.second.send_window += delta;
             }
@@ -555,11 +559,19 @@ int H2ConnConsume(H2Conn* c, Socket* s, std::vector<H2Request>* out) {
                        ((uint32_t)p[1] << 16) | ((uint32_t)p[2] << 8) |
                        p[3];
         if (sid == 0) {
-          c->conn_send_window += (int32_t)inc;
+          c->conn_send_window += (int64_t)inc;
+          if (c->conn_send_window > 0x7fffffffLL) {  // RFC 7540 §6.9.1
+            if (!reply.empty()) write_frames(s, reply);
+            return FatalGoaway(s, 0, 3 /*FLOW_CONTROL_ERROR*/);
+          }
         } else {
           auto it = c->streams.find(sid);
           if (it != c->streams.end()) {
-            it->second.send_window += (int32_t)inc;
+            it->second.send_window += (int64_t)inc;
+            if (it->second.send_window > 0x7fffffffLL) {
+              if (!reply.empty()) write_frames(s, reply);
+              return FatalGoaway(s, sid, 3);
+            }
           }
         }
         // windows reopened: flush anything queued
@@ -619,7 +631,11 @@ int H2ConnConsume(H2Conn* c, Socket* s, std::vector<H2Request>* out) {
             return FatalGoaway(s, sid, 1);
           }
           if (st.end_stream) {
-            out->push_back(std::move(st.req));
+            if (c->goaway) {
+              c->streams.erase(sid);  // client said goaway: refuse new work
+            } else {
+              out->push_back(std::move(st.req));
+            }
           }
         } else {
           c->continuation_stream = sid;
@@ -654,7 +670,11 @@ int H2ConnConsume(H2Conn* c, Socket* s, std::vector<H2Request>* out) {
             return FatalGoaway(s, sid, 1);
           }
           if (st.end_stream) {
-            out->push_back(std::move(st.req));
+            if (c->goaway) {
+              c->streams.erase(sid);
+            } else {
+              out->push_back(std::move(st.req));
+            }
           }
         }
         break;
@@ -691,7 +711,11 @@ int H2ConnConsume(H2Conn* c, Socket* s, std::vector<H2Request>* out) {
         }
         if (flags & FLAG_END_STREAM) {
           st.end_stream = true;
-          out->push_back(std::move(st.req));
+          if (c->goaway) {
+            c->streams.erase(sid);
+          } else {
+            out->push_back(std::move(st.req));
+          }
         }
         break;
       }

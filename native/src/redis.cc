@@ -1,0 +1,122 @@
+#include "redis.h"
+
+#include <string.h>
+
+#include <algorithm>
+
+namespace trpc {
+
+namespace {
+
+constexpr size_t kMaxArgs = 1024 * 1024;
+constexpr size_t kMaxArgLen = 512u * 1024 * 1024;
+constexpr size_t kMaxLine = 64;  // "<sigil><digits>\r\n" upper bound
+
+// Parse "<sigil><digits>\r\n" at *off directly from the chained buffer.
+// Returns 1 parsed (*off advanced past \r\n), 0 need more bytes,
+// -1 malformed (no terminator within kMaxLine, or wrong sigil/digits).
+int parse_num_line(const IOBuf* buf, size_t* off, char sigil, long* out) {
+  char tmp[kMaxLine];
+  size_t avail = buf->size() > *off ? buf->size() - *off : 0;
+  size_t n = std::min(avail, kMaxLine);
+  if (n < 3) {  // sigil + at least one digit + CR...
+    return avail >= kMaxLine ? -1 : 0;
+  }
+  buf->copy_to(tmp, n, *off);
+  if (tmp[0] != sigil) {
+    return -1;
+  }
+  size_t eol = 0;
+  for (size_t i = 1; i + 1 < n; ++i) {
+    if (tmp[i] == '\r' && tmp[i + 1] == '\n') {
+      eol = i;
+      break;
+    }
+  }
+  if (eol == 0) {
+    return avail >= kMaxLine ? -1 : 0;
+  }
+  long v = 0;
+  bool neg = false;
+  size_t i = 1;
+  if (tmp[i] == '-') {
+    neg = true;
+    ++i;
+  }
+  if (i == eol) {
+    return -1;
+  }
+  for (; i < eol; ++i) {
+    if (tmp[i] < '0' || tmp[i] > '9') {
+      return -1;
+    }
+    v = v * 10 + (tmp[i] - '0');
+    if (v > (long)kMaxArgLen + 1) {
+      return -1;
+    }
+  }
+  *out = neg ? -v : v;
+  *off += eol + 2;
+  return 1;
+}
+
+}  // namespace
+
+bool LooksLikeRedis(const IOBuf& buf) {
+  char c;
+  if (buf.size() < 1) {
+    return false;
+  }
+  buf.copy_to(&c, 1);
+  return c == '*';
+}
+
+int ParseRedisCommand(IOBuf* buf, std::vector<std::string>* argv) {
+  size_t off = 0;
+  long argc;
+  int rc = parse_num_line(buf, &off, '*', &argc);
+  if (rc <= 0) {
+    return rc;
+  }
+  if (argc < 0 || (size_t)argc > kMaxArgs) {
+    return -1;
+  }
+  argv->clear();
+  argv->reserve((size_t)argc);
+  for (long i = 0; i < argc; ++i) {
+    long len;
+    rc = parse_num_line(buf, &off, '$', &len);
+    if (rc <= 0) {
+      return rc;
+    }
+    if (len < 0 || (size_t)len > kMaxArgLen) {
+      return -1;
+    }
+    if (off + (size_t)len + 2 > buf->size()) {
+      return 0;  // arg bytes not fully arrived
+    }
+    std::string arg;
+    arg.resize((size_t)len);
+    if (len > 0) {
+      buf->copy_to(&arg[0], (size_t)len, off);
+    }
+    argv->emplace_back(std::move(arg));
+    off += (size_t)len + 2;
+  }
+  buf->pop_front(off);
+  return 1;
+}
+
+std::string PackRedisArgs(const std::vector<std::string>& argv) {
+  std::string out;
+  uint32_t argc = (uint32_t)argv.size();
+  out.append((const char*)&argc, 4);
+  for (const std::string& a : argv) {
+    uint32_t len = (uint32_t)a.size();
+    out.append((const char*)&len, 4);
+    out.append(a);
+  }
+  return out;
+}
+
+}  // namespace trpc

@@ -1,0 +1,29 @@
+// redis.h — server-side RESP (REdis Serialization Protocol) parsing for
+// the shared port (capability of the reference redis support: redis.{h,cpp}
+// + policy/redis_protocol.cpp:428 — "you can build a redis-speaking
+// server").  The native layer frames/parses command arrays; replies are
+// opaque bytes the Python service encodes (rpc/redis_service.py), so the
+// full RESP reply grammar lives in one place.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "iobuf.h"
+
+namespace trpc {
+
+// True when the buffer starts like a RESP command array ('*').
+bool LooksLikeRedis(const IOBuf& buf);
+
+// Try to parse one "*<argc>\r\n$<len>\r\n<arg>\r\n..." command.
+// Returns 1 parsed (argv filled, bytes consumed), 0 incomplete,
+// -1 malformed.
+int ParseRedisCommand(IOBuf* buf, std::vector<std::string>* argv);
+
+// Serialize argv into the blob handed to the usercode callback:
+// u32 argc, then per-arg u32 len + bytes (all LE).
+std::string PackRedisArgs(const std::vector<std::string>& argv);
+
+}  // namespace trpc

@@ -22,6 +22,7 @@
 #include "h2.h"
 #include "http.h"
 #include "object_pool.h"
+#include "redis.h"
 #include "stream.h"
 #include "timer_thread.h"
 
@@ -207,6 +208,8 @@ struct CallCtx {
   bool is_http = false;
   bool http_keep_alive = true;
   uint32_t h2_stream = 0;  // nonzero: respond as HTTP/2 frames
+  bool is_redis = false;   // respond with raw RESP bytes
+  RedisHandlerCb rcb = nullptr;
   std::string http_path;
   std::string http_query;
   std::string http_headers;
@@ -271,7 +274,10 @@ class UsercodePool {
       CallCtx* ctx = q_.front();
       q_.pop_front();
       lk.unlock();
-      if (ctx->is_http) {
+      if (ctx->is_redis) {
+        ctx->rcb(ctx->token(), (const uint8_t*)ctx->payload.data(),
+                 ctx->payload.size(), ctx->user);
+      } else if (ctx->is_http) {
         ctx->hcb(ctx->token(), ctx->method.c_str(), ctx->http_path.c_str(),
                  ctx->http_query.c_str(),
                  (const uint8_t*)ctx->http_headers.data(),
@@ -310,6 +316,8 @@ class Server {
   std::unordered_map<std::string, ServiceHandler> services;
   HttpHandlerCb http_cb = nullptr;
   void* http_user = nullptr;
+  RedisHandlerCb redis_cb = nullptr;
+  void* redis_user = nullptr;
   bool has_auth = false;
   std::string auth_secret;
   int listen_fd = -1;
@@ -382,6 +390,7 @@ void DispatchHttp(Socket* s, Server* srv, HttpRequest&& req) {
   ctx->slot = slot;
   ctx->sock = s->id();
   ctx->is_http = true;
+  ctx->is_redis = false;
   ctx->h2_stream = 0;
   ctx->http_keep_alive = req.keep_alive;
   ctx->method = std::move(req.method);
@@ -421,6 +430,7 @@ void DispatchH2(Socket* s, Server* srv, H2Request&& req) {
   ctx->slot = slot;
   ctx->sock = s->id();
   ctx->is_http = true;
+  ctx->is_redis = false;
   ctx->h2_stream = req.stream_id;
   ctx->http_keep_alive = true;  // h2 connections persist
   ctx->method = std::move(req.method);
@@ -447,8 +457,11 @@ void ServerOnMessages(Socket* s) {
     s->SetFailed(errno);
     return;
   }
-  // connections that completed the h2 preface stay h2 for life
-  H2Conn* h2c = H2ConnFind(s->id());
+  // connections that completed the h2 preface stay h2 for life (is_h2
+  // gates the registry mutex off the non-h2 hot path)
+  H2Conn* h2c = s->is_h2.load(std::memory_order_acquire)
+                    ? H2ConnFind(s->id())
+                    : nullptr;
   if (h2c != nullptr) {
     std::vector<H2Request> reqs;
     int hrc = H2ConnConsume(h2c, s, &reqs);
@@ -491,6 +504,47 @@ void ServerOnMessages(Socket* s) {
           DispatchH2(s, srv, std::move(r));
         }
         break;  // rest of the connection handled by the h2 path above
+      }
+      if (LooksLikeRedis(s->read_buf) && srv->redis_cb != nullptr) {
+        // RESP commands pipeline with ordered replies — same per-
+        // connection gate as HTTP/1.1
+        if (s->http_inflight.load(std::memory_order_acquire) != 0) {
+          break;
+        }
+        std::vector<std::string> argv;
+        int rrc = ParseRedisCommand(&s->read_buf, &argv);
+        if (rrc == 0) {
+          break;
+        }
+        if (rrc < 0) {
+          s->SetFailed(TRPC_EREQUEST);
+          return;
+        }
+        if (!srv->running.load(std::memory_order_acquire)) {
+          IOBuf err;
+          err.append("-ERR server is stopping\r\n", 25);
+          s->Write(std::move(err));
+          continue;
+        }
+        srv->nrequests.fetch_add(1, std::memory_order_relaxed);
+        s->http_inflight.store(1, std::memory_order_release);
+        CallCtx* rctx = nullptr;
+        uint32_t rslot = ResourcePool<CallCtx>::Get(&rctx);
+        rctx->slot = rslot;
+        rctx->sock = s->id();
+        rctx->is_http = false;
+        rctx->is_redis = true;
+        rctx->h2_stream = 0;
+        rctx->method = "REDIS";
+        rctx->payload = PackRedisArgs(argv);
+        rctx->attachment.clear();
+        rctx->req_stream_id = 0;
+        rctx->req_stream_window = 0;
+        rctx->accepted_stream = 0;
+        rctx->rcb = srv->redis_cb;
+        rctx->user = srv->redis_user;
+        UsercodePool::Instance().Submit(rctx);
+        continue;
       }
       if (!LooksLikeHttp(s->read_buf)) {
         s->SetFailed(TRPC_EREQUEST);
@@ -573,6 +627,7 @@ void ServerOnMessages(Socket* s) {
       ctx->slot = slot;
       ctx->sock = s->id();
       ctx->is_http = false;
+      ctx->is_redis = false;
       ctx->compress_type = meta.compress_type;
       ctx->req_stream_id = meta.stream_id;
       ctx->req_stream_window = meta.feedback_bytes;
@@ -649,6 +704,37 @@ int server_add_service(Server* s, const char* name, int kind, HandlerCb cb,
 void server_set_http_handler(Server* s, HttpHandlerCb cb, void* user) {
   s->http_cb = cb;
   s->http_user = user;
+}
+
+void server_set_redis_handler(Server* s, RedisHandlerCb cb, void* user) {
+  s->redis_cb = cb;
+  s->redis_user = user;
+}
+
+int redis_respond(uint64_t token, const uint8_t* data, size_t len) {
+  uint32_t slot = (uint32_t)token;
+  uint32_t ver = (uint32_t)(token >> 32);
+  CallCtx* ctx = ResourcePool<CallCtx>::Address(slot);
+  if (ctx == nullptr || !ctx->is_redis ||
+      ctx->version.load(std::memory_order_acquire) != ver) {
+    return -EINVAL;
+  }
+  Socket* s = Socket::Address(ctx->sock);
+  if (s != nullptr) {
+    IOBuf reply;
+    reply.append(data, len);
+    s->Write(std::move(reply));
+    // release the ordering gate and re-arm parsing for the next
+    // pipelined command
+    s->http_inflight.store(0, std::memory_order_release);
+    Socket::StartInputEvent(s->id());
+    s->Dereference();
+  }
+  ctx->version.fetch_add(1, std::memory_order_release);
+  ctx->payload.clear();
+  ctx->is_redis = false;
+  ResourcePool<CallCtx>::Return(slot);
+  return 0;
 }
 
 void server_set_auth(Server* s, const uint8_t* secret, size_t len) {

@@ -89,6 +89,15 @@ int server_add_service(Server* s, const char* name, int kind, HandlerCb cb,
                        void* user);
 // One HTTP dispatcher per server handles every HTTP request on the port.
 void server_set_http_handler(Server* s, HttpHandlerCb cb, void* user);
+
+// Redis command handler: blob = u32 argc + per-arg (u32 len + bytes), LE
+// (redis.h PackRedisArgs).  Responder must call redis_respond(token, ...)
+// with a fully RESP-encoded reply.
+typedef void (*RedisHandlerCb)(uint64_t token, const uint8_t* blob,
+                               size_t len, void* user);
+void server_set_redis_handler(Server* s, RedisHandlerCb cb, void* user);
+// Write raw (already RESP-encoded) reply bytes for a pending command.
+int redis_respond(uint64_t token, const uint8_t* data, size_t len);
 // Require this credential (meta tag 13) on every TRPC request.
 void server_set_auth(Server* s, const uint8_t* secret, size_t len);
 int server_start(Server* s, const char* ip, int port);

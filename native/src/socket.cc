@@ -52,6 +52,7 @@ int Socket::Create(const SocketOptions& opts, SocketId* id_out) {
   // the new connection's requests sit unparsed forever
   s->http_inflight.store(0, std::memory_order_relaxed);
   s->authed.store(false, std::memory_order_relaxed);
+  s->is_h2.store(false, std::memory_order_relaxed);
   if (s->epollout_butex == nullptr) {
     s->epollout_butex = butex_create();
   }

@@ -68,6 +68,9 @@ class Socket {
   // (≙ brpc verifying auth on a connection's first message); stream frames
   // are only honored on authed connections
   std::atomic<bool> authed{false};
+  // set at h2 preface: gates the (mutexed) H2Conn registry lookup so
+  // TRPC/HTTP/redis connections never touch the global map on reads
+  std::atomic<bool> is_h2{false};
 
   static int Create(const SocketOptions& opts, SocketId* id_out);
   // +1 ref; nullptr if the id is stale.

@@ -1,0 +1,142 @@
+"""Redis protocol on the shared port — real RESP over real loopback
+sockets (≙ brpc_redis_unittest parsing real RESP; the server-side
+capability of policy/redis_protocol.cpp:428)."""
+
+import socket
+
+import pytest
+
+from brpc_tpu.rpc import redis_service as r
+from brpc_tpu.rpc.channel import Channel
+from brpc_tpu.rpc.server import Server
+
+
+@pytest.fixture
+def redis_server():
+    store = {}
+    svc = r.RedisService()
+    svc.register("SET", lambda a: (store.__setitem__(a[0], a[1]),
+                                   r.simple("OK"))[1])
+    svc.register("GET", lambda a: r.bulk(store.get(a[0])))
+    svc.register("DEL", lambda a: r.integer(
+        sum(1 for k in a if store.pop(k, None) is not None)))
+    svc.register("KEYS", lambda a: r.array([r.bulk(k) for k in store]))
+    svc.register("INCR", lambda a: r.integer(
+        store.__setitem__(a[0], str(int(store.get(a[0], b"0")) + 1)
+                          .encode()) or int(store[a[0]])))
+
+    srv = Server()
+    srv.add_echo_service()
+    srv.add_redis_service(svc)
+    srv.start("127.0.0.1:0")
+    yield srv, store
+    srv.destroy()
+
+
+class TestRedisServer:
+    def test_ping_echo(self, redis_server):
+        srv, _ = redis_server
+        c = r.RedisClient("127.0.0.1", srv.port)
+        assert c.call("PING") == "PONG"
+        assert c.call("ECHO", "hello") == b"hello"
+        c.close()
+
+    def test_get_set_del(self, redis_server):
+        srv, store = redis_server
+        c = r.RedisClient("127.0.0.1", srv.port)
+        assert c.call("SET", "k", "v") == "OK"
+        assert store[b"k"] == b"v"
+        assert c.call("GET", "k") == b"v"
+        assert c.call("GET", "missing") is None
+        assert c.call("DEL", "k") == 1
+        assert c.call("GET", "k") is None
+        c.close()
+
+    def test_pipelining_ordered(self, redis_server):
+        srv, _ = redis_server
+        c = r.RedisClient("127.0.0.1", srv.port)
+        replies = c.call_pipeline(
+            [("SET", f"p{i}", str(i)) for i in range(10)] +
+            [("GET", f"p{i}") for i in range(10)])
+        assert replies[:10] == ["OK"] * 10
+        assert replies[10:] == [str(i).encode() for i in range(10)]
+        c.close()
+
+    def test_many_small_args_over_4kb(self, redis_server):
+        # a command whose header region exceeds any fixed scan window
+        srv, store = redis_server
+        c = r.RedisClient("127.0.0.1", srv.port)
+        args = [f"k{i}" for i in range(600)]  # ~4.8KB of headers
+        assert c.call("DEL", *args) == 0
+        assert c.call("SET", "after", "ok") == "OK"
+        c.close()
+
+    def test_binary_safe_values(self, redis_server):
+        srv, _ = redis_server
+        c = r.RedisClient("127.0.0.1", srv.port)
+        blob = bytes(range(256)) * 64
+        assert c.call("SET", b"bin", blob) == "OK"
+        assert c.call("GET", b"bin") == blob
+        c.close()
+
+    def test_unknown_command_errors(self, redis_server):
+        srv, _ = redis_server
+        c = r.RedisClient("127.0.0.1", srv.port)
+        with pytest.raises(r.RedisError, match="unknown command"):
+            c.call("FLUSHALL")
+        c.close()
+
+    def test_handler_exception_becomes_err(self, redis_server):
+        srv, _ = redis_server
+        c = r.RedisClient("127.0.0.1", srv.port)
+        with pytest.raises(r.RedisError):
+            c.call("ECHO")  # wrong arity → handler error reply
+        c.close()
+
+    def test_trpc_and_redis_share_port(self, redis_server):
+        srv, _ = redis_server
+        c = r.RedisClient("127.0.0.1", srv.port)
+        ch = Channel(f"127.0.0.1:{srv.port}")
+        assert c.call("PING") == "PONG"
+        assert ch.call("Echo.echo", b"x") == b"x"
+        assert c.call("SET", "mix", "1") == "OK"
+        ch.close()
+        c.close()
+
+    def test_partial_command_waits(self, redis_server):
+        srv, _ = redis_server
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        # half a command, then the rest
+        s.sendall(b"*1\r\n$4\r\nPI")
+        import time
+        time.sleep(0.1)
+        s.sendall(b"NG\r\n")
+        data = s.recv(100)
+        assert data == b"+PONG\r\n"
+        s.close()
+
+    def test_no_redis_service_rejects_resp(self):
+        srv = Server()
+        srv.add_echo_service()
+        srv.start("127.0.0.1:0")
+        try:
+            s = socket.create_connection(("127.0.0.1", srv.port),
+                                         timeout=5)
+            s.sendall(b"*1\r\n$4\r\nPING\r\n")
+            # no redis handler registered: connection is failed
+            assert s.recv(100) == b""
+            s.close()
+        finally:
+            srv.destroy()
+
+
+class TestRespEncoding:
+    def test_helpers(self):
+        assert r.simple("OK") == b"+OK\r\n"
+        assert r.error("boom") == b"-ERR boom\r\n"
+        assert r.integer(42) == b":42\r\n"
+        assert r.bulk(b"ab") == b"$2\r\nab\r\n"
+        assert r.bulk(None) == b"$-1\r\n"
+        assert r.array([r.integer(1), r.bulk(b"x")]) == \
+            b"*2\r\n:1\r\n$1\r\nx\r\n"
+        assert r.array(None) == b"*-1\r\n"
