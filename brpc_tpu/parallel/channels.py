@@ -108,7 +108,11 @@ class ParallelChannel:
     def channel_count(self) -> int:
         return len(self._subs)
 
-    def _ensure_pool(self) -> ThreadPoolExecutor:
+    def _submit_all(self, fn, arg_tuples):
+        """Grow-and-submit atomically: submissions happen under the lock,
+        so a pool replaced by a concurrent grower can be shut down right
+        away — nobody can be submitting to it (shutdown(wait=False) lets
+        already-submitted work finish on the old pool's threads)."""
         with self._lock:
             want = max(4, 2 * len(self._subs))
             if self._pool is None or self._pool._max_workers < want:
@@ -118,7 +122,7 @@ class ParallelChannel:
                     thread_name_prefix="parallel_channel")
                 if old is not None:
                     old.shutdown(wait=False)
-            return self._pool
+            return [self._pool.submit(fn, *args) for args in arg_tuples]
 
     def close(self):
         with self._lock:
@@ -152,9 +156,8 @@ class ParallelChannel:
                 if first_err[0] is None:
                     first_err[0] = e
 
-        pool = self._ensure_pool()
-        futures = [pool.submit(one, i, sc)
-                   for i, sc in enumerate(mapped) if sc is not None]
+        futures = self._submit_all(
+            one, [(i, sc) for i, sc in enumerate(mapped) if sc is not None])
         for f in futures:
             f.result()
         mapped_n = sum(1 for sc in mapped if sc is not None)

@@ -354,6 +354,15 @@ bool FillRequest(StreamState* st,
                  std::vector<std::pair<std::string, std::string>>& hdrs) {
   for (auto& kv : hdrs) {
     const std::string& k = kv.first;
+    // RFC 9113 §8.2.1: field names/values containing CR, LF or NUL are
+    // malformed — reject rather than let a value inject fake header
+    // lines into the "k: v\n" blob handed to the service layer.
+    static const std::string kBad("\r\n\0", 3);
+    if (k.find_first_of(kBad) != std::string::npos ||
+        k.find(':', 1) != std::string::npos ||
+        kv.second.find_first_of(kBad) != std::string::npos) {
+      return false;
+    }
     if (k == ":method") {
       st->req.method = kv.second;
     } else if (k == ":path") {

@@ -1,5 +1,7 @@
 #include "http.h"
 
+#include <ctype.h>
+#include <stdlib.h>
 #include <string.h>
 
 #include <algorithm>
@@ -32,6 +34,109 @@ bool value_has_token(const std::string& v, const char* token) {
   return low.find(token) != std::string::npos;
 }
 
+// One CRLF-terminated line is at most this long in chunked framing
+// (chunk-size + extensions, or one trailer line).
+constexpr size_t kMaxChunkLine = 4096;
+
+// Find "\r\n" within the first `limit` bytes of buf.  Returns the line
+// length (bytes before CRLF), or SIZE_MAX if no CRLF is buffered yet.
+size_t find_crlf(const IOBuf& buf, size_t limit, char* scratch) {
+  size_t n = std::min(buf.size(), limit);
+  buf.copy_to(scratch, n);
+  for (size_t i = 0; i + 1 < n; ++i) {
+    if (scratch[i] == '\r' && scratch[i + 1] == '\n') {
+      return i;
+    }
+  }
+  return (size_t)-1;
+}
+
+// Advance the chunked-body state machine, consuming completed frames from
+// buf.  Returns 1 when the body (incl. trailers) is complete, 0 when more
+// bytes are needed (consumed bytes already popped), -1 on malformed input.
+int advance_chunked(IOBuf* buf, HttpParseState* st) {
+  char line[kMaxChunkLine + 2];
+  while (true) {
+    switch (st->phase) {
+      case 0: {  // chunk-size line (hex size, optional ";ext")
+        size_t len = find_crlf(*buf, kMaxChunkLine + 2, line);
+        if (len == (size_t)-1) {
+          return buf->size() >= kMaxChunkLine + 2 ? -1 : 0;
+        }
+        // strict RFC 9112 framing: 1*HEXDIG then end-of-line or ';ext'.
+        // strtoull's laxness (whitespace, signs, 0x) would let this parser
+        // disagree with a stricter front proxy on where the body ends —
+        // the classic TE request-smuggling vector.
+        if (len == 0 || !isxdigit((unsigned char)line[0]) ||
+            (line[0] == '0' && len > 1 &&
+             (line[1] == 'x' || line[1] == 'X')) ||
+            memchr(line, '\0', len) != nullptr) {
+          return -1;
+        }
+        line[len] = '\0';
+        char* end = nullptr;
+        unsigned long long sz = strtoull(line, &end, 16);
+        if (end == line || (*end != '\0' && *end != ';') ||
+            sz > kMaxBodyBytes ||
+            st->req.body.size() + sz > kMaxBodyBytes) {
+          return -1;
+        }
+        buf->pop_front(len + 2);
+        if (sz == 0) {
+          st->phase = 3;
+        } else {
+          st->remaining = (size_t)sz;
+          st->phase = 1;
+        }
+        break;
+      }
+      case 1: {  // chunk data: consume whatever is buffered
+        size_t m = std::min(st->remaining, buf->size());
+        if (m > 0) {
+          size_t old = st->req.body.size();
+          st->req.body.resize(old + m);
+          buf->copy_to(&st->req.body[old], m);
+          buf->pop_front(m);
+          st->remaining -= m;
+        }
+        if (st->remaining > 0) {
+          return 0;
+        }
+        st->phase = 2;
+        break;
+      }
+      case 2: {  // CRLF after chunk data
+        if (buf->size() < 2) {
+          return 0;
+        }
+        char crlf[2];
+        buf->copy_to(crlf, 2);
+        if (crlf[0] != '\r' || crlf[1] != '\n') {
+          return -1;
+        }
+        buf->pop_front(2);
+        st->phase = 0;
+        break;
+      }
+      case 3: {  // trailer section, terminated by an empty line
+        size_t len = find_crlf(*buf, kMaxChunkLine + 2, line);
+        if (len == (size_t)-1) {
+          return buf->size() >= kMaxChunkLine + 2 ? -1 : 0;
+        }
+        buf->pop_front(len + 2);
+        if (len == 0) {
+          return 1;
+        }
+        st->trailer_bytes += len + 2;
+        if (st->trailer_bytes > kMaxHeaderBytes) {
+          return -1;  // unauthenticated memory growth guard
+        }
+        break;
+      }
+    }
+  }
+}
+
 }  // namespace
 
 bool LooksLikeHttp(const IOBuf& buf) {
@@ -48,7 +153,18 @@ bool LooksLikeHttp(const IOBuf& buf) {
   return false;
 }
 
-int ParseHttpRequest(IOBuf* buf, HttpRequest* out) {
+int ParseHttpRequest(IOBuf* buf, HttpRequest* out, HttpParseState* st) {
+  if (st != nullptr && st->active) {
+    // resume a chunked body whose headers were consumed on an earlier
+    // read event
+    int crc = advance_chunked(buf, st);
+    if (crc <= 0) {
+      return crc;
+    }
+    *out = std::move(st->req);
+    *st = HttpParseState();
+    return 1;
+  }
   // Pull the (bounded) header region into a flat string to find CRLFCRLF.
   size_t scan = std::min(buf->size(), kMaxHeaderBytes);
   std::string head;
@@ -78,6 +194,7 @@ int ParseHttpRequest(IOBuf* buf, HttpRequest* out) {
   headers_blob.reserve(hdr_end - line_end);
   size_t content_length = 0;
   bool have_cl = false;
+  bool chunked = false;
   size_t pos = line_end + 2;
   while (pos < hdr_end) {
     size_t eol = head.find("\r\n", pos);
@@ -108,7 +225,7 @@ int ParseHttpRequest(IOBuf* buf, HttpRequest* out) {
       have_cl = true;
     } else if (key == "transfer-encoding") {
       if (value_has_token(value, "chunked")) {
-        return -1;  // chunked request bodies unsupported
+        chunked = true;
       }
     } else if (key == "connection") {
       if (value_has_token(value, "close")) {
@@ -123,27 +240,51 @@ int ParseHttpRequest(IOBuf* buf, HttpRequest* out) {
     headers_blob += '\n';
   }
   (void)have_cl;
+  // fill everything except the body into `filled` (one copy of the
+  // target-split logic for both framings)
+  HttpRequest filled;
+  size_t q = target.find('?');
+  if (q != std::string::npos) {
+    filled.path = target.substr(0, q);
+    filled.query = target.substr(q + 1);
+  } else {
+    filled.path = std::move(target);
+  }
+  filled.method = std::move(method);
+  filled.headers = std::move(headers_blob);
+  filled.keep_alive = keep_alive;
+  if (chunked) {
+    // RFC 9112 §6.1: chunked wins over any content-length.  Consume the
+    // header block now and decode chunk frames incrementally via *st.
+    if (st == nullptr) {
+      return -1;  // caller without restartable state (not used today)
+    }
+    buf->pop_front(hdr_end + 4);
+    *st = HttpParseState();
+    st->active = true;
+    st->req = std::move(filled);
+    int crc = advance_chunked(buf, st);
+    if (crc <= 0) {
+      if (crc < 0) {
+        *st = HttpParseState();
+      }
+      return crc;
+    }
+    *out = std::move(st->req);
+    *st = HttpParseState();
+    return 1;
+  }
   size_t total = hdr_end + 4 + content_length;
   if (buf->size() < total) {
     return 0;
   }
   buf->pop_front(hdr_end + 4);
+  *out = std::move(filled);
   out->body.resize(content_length);
   if (content_length > 0) {
     buf->copy_to(&out->body[0], content_length);
     buf->pop_front(content_length);
   }
-  size_t q = target.find('?');
-  if (q != std::string::npos) {
-    out->path = target.substr(0, q);
-    out->query = target.substr(q + 1);
-  } else {
-    out->path = std::move(target);
-    out->query.clear();
-  }
-  out->method = std::move(method);
-  out->headers = std::move(headers_blob);
-  out->keep_alive = keep_alive;
   return 1;
 }
 

@@ -30,11 +30,25 @@ struct HttpRequest {
 // Needs at most 8 readable bytes; returns false when undecidable yet.
 bool LooksLikeHttp(const IOBuf& buf);
 
+// Incremental chunked-body decode progress for one connection.  Bytes are
+// consumed from the read buffer as chunk frames complete, so a large
+// chunked upload costs O(n) total (not a re-scan per read event) and the
+// buffered remainder stays bounded.
+struct HttpParseState {
+  bool active = false;   // a chunked request's headers were consumed
+  HttpRequest req;       // headers parsed; body accumulates here
+  int phase = 0;         // 0 size-line, 1 data, 2 data-CRLF, 3 trailers
+  size_t remaining = 0;  // bytes left in the current chunk
+  size_t trailer_bytes = 0;  // completed trailer-line bytes (capped)
+};
+
 // Try to parse one complete request from buf (consuming it).  Returns
 //   1 parsed, 0 need more bytes, -1 malformed / unsupported.
-// Bodies require Content-Length (chunked request bodies are rejected);
-// header block is capped at 64KB, bodies at 512MB.
-int ParseHttpRequest(IOBuf* buf, HttpRequest* out);
+// Chunked request bodies (RFC 9112 §7.1, incl. extensions + trailers)
+// decode incrementally through *st; plain bodies need Content-Length.
+// Header block and trailers are capped at 64KB, bodies at 512MB.
+int ParseHttpRequest(IOBuf* buf, HttpRequest* out,
+                     HttpParseState* st = nullptr);
 
 // Serialize a full response with Content-Length framing.  headers_blob is
 // zero or more "Key: Value\r\n" lines (may be nullptr); Content-Length,

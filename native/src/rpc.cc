@@ -479,6 +479,23 @@ void ServerOnMessages(Socket* s) {
     return;
   }
   while (true) {
+    // a chunked request body in progress owns the incoming bytes: resume
+    // its decode before any protocol sniffing (body bytes are not a new
+    // message)
+    HttpParseState* hps = (HttpParseState*)s->parse_state;
+    if (hps != nullptr && hps->active) {
+      HttpRequest hreq;
+      int hrc = ParseHttpRequest(&s->read_buf, &hreq, hps);
+      if (hrc == 0) {
+        break;
+      }
+      if (hrc < 0) {
+        s->SetFailed(TRPC_EREQUEST);
+        return;
+      }
+      DispatchHttp(s, srv, std::move(hreq));
+      continue;
+    }
     // protocol sniff per message (≙ CutInputMessage trying protocols,
     // input_messenger.cpp:77): "TRPC" magic, h2 preface, or an HTTP verb
     if (s->read_buf.size() < 4) {
@@ -526,6 +543,25 @@ void ServerOnMessages(Socket* s) {
           s->Write(std::move(err));
           continue;
         }
+        if (srv->has_auth && !s->authed.load(std::memory_order_acquire)) {
+          // the shared-port credential gates RESP too: accept an AUTH
+          // command carrying the secret (AUTH <secret> or
+          // AUTH <user> <secret>), refuse anything else with -NOAUTH
+          bool is_auth_cmd = argv.size() >= 2 && argv[0].size() == 4 &&
+                             (argv[0][0] == 'A' || argv[0][0] == 'a') &&
+                             strncasecmp(argv[0].c_str(), "AUTH", 4) == 0;
+          IOBuf reply;
+          if (is_auth_cmd && ConstantTimeEq(argv.back(), srv->auth_secret)) {
+            s->authed.store(true, std::memory_order_release);
+            reply.append("+OK\r\n", 5);
+          } else if (is_auth_cmd) {
+            reply.append("-WRONGPASS invalid password\r\n", 29);
+          } else {
+            reply.append("-NOAUTH Authentication required.\r\n", 34);
+          }
+          s->Write(std::move(reply));
+          continue;
+        }
         srv->nrequests.fetch_add(1, std::memory_order_relaxed);
         s->http_inflight.store(1, std::memory_order_release);
         CallCtx* rctx = nullptr;
@@ -553,8 +589,12 @@ void ServerOnMessages(Socket* s) {
       if (s->http_inflight.load(std::memory_order_acquire) != 0) {
         break;  // pipelined request: wait for the in-flight response
       }
+      if (s->parse_state == nullptr) {
+        s->parse_state = new HttpParseState();
+      }
       HttpRequest hreq;
-      int hrc = ParseHttpRequest(&s->read_buf, &hreq);
+      int hrc = ParseHttpRequest(&s->read_buf, &hreq,
+                                 (HttpParseState*)s->parse_state);
       if (hrc == 0) {
         break;
       }
@@ -647,6 +687,8 @@ void ServerOnMessages(Socket* s) {
 }
 
 void ServerConnFailed(Socket* s) {
+  delete (HttpParseState*)s->parse_state;
+  s->parse_state = nullptr;
   H2ConnDestroy(s->id());
   StreamsOnSocketFailed(s->id());
   Server* srv = (Server*)s->user;

@@ -71,6 +71,9 @@ class Socket {
   // set at h2 preface: gates the (mutexed) H2Conn registry lookup so
   // TRPC/HTTP/redis connections never touch the global map on reads
   std::atomic<bool> is_h2{false};
+  // opaque per-connection parser state owned by the protocol layer
+  // (rpc.cc: HttpParseState for chunked bodies); freed by on_failed
+  void* parse_state = nullptr;
 
   static int Create(const SocketOptions& opts, SocketId* id_out);
   // +1 ref; nullptr if the id is stale.

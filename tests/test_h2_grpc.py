@@ -265,3 +265,33 @@ class TestH2RawFrames:
         frames = _read_frames(s, 0.8)
         assert any(t == 7 for t, fl, sid, p in frames)  # GOAWAY
         s.close()
+
+
+class TestH2HeaderInjection:
+    """RFC 9113 §8.2.1: field values with CR/LF/NUL are malformed — a
+    client must not be able to inject fake header lines (e.g. a spoofed
+    host:) into the decoded header blob."""
+
+    def _req_with(self, name: bytes, value: bytes) -> bytes:
+        return (_hpack_lit(b":method", b"GET") +
+                _hpack_lit(b":path", b"/health") +
+                _hpack_lit(b":scheme", b"http") +
+                _hpack_lit(b":authority", b"t") +
+                _hpack_lit(name, value))
+
+    @pytest.mark.parametrize("name,value", [
+        (b"x-evil", b"a\r\nhost: spoofed"),
+        (b"x-evil", b"a\nb"),
+        (b"x-evil", b"a\x00b"),
+        (b"x:evil", b"v"),
+    ])
+    def test_crlf_nul_in_header_rejected(self, server, name, value):
+        import socket as pysocket
+        s = pysocket.create_connection(("127.0.0.1", server.port),
+                                       timeout=5)
+        s.sendall(b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n" + _frame(4, 0, 0))
+        s.sendall(_frame(1, 0x5, 1, self._req_with(name, value)))
+        frames = _read_frames(s, 0.8)
+        assert any(t == 7 for t, fl, sid, p in frames)  # GOAWAY
+        assert not any(t == 0 and p == b"OK\n" for t, fl, sid, p in frames)
+        s.close()

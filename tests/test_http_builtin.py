@@ -119,6 +119,40 @@ class TestHttpOrderingAndClose:
         # first response body must be "slow", second "fast"
         assert data.index(b"slow") < data.index(b"fast")
 
+    def test_chunked_request_body(self, server):
+        """RFC 9112 §7.1 chunked request framing, incl. split delivery,
+        extensions-free sizes in hex, and a trailer section."""
+        import socket as pysocket
+        import time
+
+        server.register_http("/echo_body", lambda req: req.body)
+        s = pysocket.create_connection(("127.0.0.1", server.port), timeout=5)
+        s.sendall(b"POST /echo_body HTTP/1.1\r\nHost: x\r\n"
+                  b"Transfer-Encoding: chunked\r\n\r\n"
+                  b"4;ext=quoted\r\nWiki\r\n6\r\npedia ")
+        time.sleep(0.05)  # second half arrives later
+        s.sendall(b"\r\nB\r\nin chunks.\n\r\n"
+                  b"0\r\nX-Trailer: t\r\n\r\n")
+        data = b""
+        while b"in chunks" not in data:
+            chunk = s.recv(4096)
+            if not chunk:
+                break
+            data += chunk
+        assert b"HTTP/1.1 200" in data
+        assert b"Wikipedia in chunks.\n" in data
+        # keep-alive: a second (content-length) request still works
+        s.sendall(b"POST /echo_body HTTP/1.1\r\nHost: x\r\n"
+                  b"Content-Length: 2\r\n\r\nok")
+        data2 = b""
+        while b"ok" not in data2:
+            chunk = s.recv(4096)
+            if not chunk:
+                break
+            data2 += chunk
+        assert b"HTTP/1.1 200" in data2
+        s.close()
+
     def test_connection_close_closes_socket(self, server):
         import socket as pysocket
 

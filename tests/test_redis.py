@@ -130,6 +130,40 @@ class TestRedisServer:
             srv.destroy()
 
 
+class TestRedisAuth:
+    """The shared-port credential gates RESP too (≙ the reference's
+    RedisAuthenticator, policy/redis_authenticator.cpp): unauthenticated
+    commands get -NOAUTH, AUTH with the secret unlocks the connection."""
+
+    def _authed_server(self):
+        from brpc_tpu.rpc.server import ServerOptions
+        svc = r.RedisService()
+        svc.register("PING", lambda a: r.simple("PONG"))
+        srv = Server(ServerOptions(auth=b"s3cret"))
+        srv.add_redis_service(svc)
+        srv.start("127.0.0.1:0")
+        return srv
+
+    def test_noauth_then_auth_unlocks(self):
+        srv = self._authed_server()
+        try:
+            c = r.RedisClient("127.0.0.1", srv.port)
+            with pytest.raises(r.RedisError, match="NOAUTH"):
+                c.call("PING")
+            with pytest.raises(r.RedisError, match="WRONGPASS"):
+                c.call("AUTH", "wrong")
+            assert c.call("AUTH", "s3cret") == "OK"
+            assert c.call("PING") == "PONG"
+            # two-arg form (AUTH <user> <secret>) is accepted too
+            c2 = r.RedisClient("127.0.0.1", srv.port)
+            assert c2.call("AUTH", "default", "s3cret") == "OK"
+            assert c2.call("PING") == "PONG"
+            c.close()
+            c2.close()
+        finally:
+            srv.destroy()
+
+
 class TestRespEncoding:
     def test_helpers(self):
         assert r.simple("OK") == b"+OK\r\n"
