@@ -111,6 +111,11 @@ class ResourcePool {
   }
 
   static T* Address(uint32_t id) {
+    // ids may come off the wire (correlation ids embed slots): bound the
+    // slab index before touching the table
+    if (TRPC_UNLIKELY((id >> kSlabBits) >= kMaxSlabs)) {
+      return nullptr;
+    }
     T* slab = slabs()[id >> kSlabBits].load(std::memory_order_acquire);
     return TRPC_LIKELY(slab != nullptr) ? slab + (id & (kSlabSize - 1))
                                         : nullptr;

@@ -35,68 +35,81 @@ namespace {
 
 constexpr uint32_t kMaxBodySize = 512u * 1024 * 1024;  // ≙ FLAGS_max_body_size
 
-void put_u32le(std::string* s, uint32_t v) {
-  s->append((const char*)&v, 4);
-}
-void put_u64le(std::string* s, uint64_t v) {
-  s->append((const char*)&v, 8);
-}
-void put_tlv(std::string* s, uint8_t tag, const void* data, uint32_t len) {
-  s->push_back((char)tag);
-  put_u32le(s, len);
-  s->append((const char*)data, len);
-}
-void put_tlv_u64(std::string* s, uint8_t tag, uint64_t v) {
-  put_tlv(s, tag, &v, 8);
-}
-void put_tlv_u32(std::string* s, uint8_t tag, uint32_t v) {
-  put_tlv(s, tag, &v, 4);
-}
-void put_tlv_u8(std::string* s, uint8_t tag, uint8_t v) {
-  put_tlv(s, tag, &v, 1);
-}
+// Appends TLV-encoded meta bytes to a caller-provided buffer.  MetaWriter
+// writes into a stack array when everything fits (the hot path: echo
+// request/response metas are ~30 bytes — zero heap traffic per frame) and
+// spills to a std::string only for oversized method/error/auth fields.
+struct MetaWriter {
+  char stack[192];
+  size_t n = 0;
+  std::string heap;      // used iff spilled
+  bool spilled = false;
 
-std::string EncodeMeta(const RpcMeta& m) {
-  std::string s;
-  s.reserve(64 + m.method.size() + m.error_text.size());
-  if (!m.method.empty()) {
-    put_tlv(&s, 1, m.method.data(), (uint32_t)m.method.size());
+  void put(const void* p, size_t len) {
+    if (!spilled) {
+      if (n + len <= sizeof(stack)) {
+        memcpy(stack + n, p, len);
+        n += len;
+        return;
+      }
+      heap.reserve(sizeof(stack) * 2);
+      heap.assign(stack, n);
+      spilled = true;
+    }
+    heap.append((const char*)p, len);
   }
-  put_tlv_u64(&s, 2, m.correlation_id);
+  void tlv(uint8_t tag, const void* data, uint32_t len) {
+    char h[5];
+    h[0] = (char)tag;
+    memcpy(h + 1, &len, 4);
+    put(h, 5);
+    put(data, len);
+  }
+  void tlv_u64(uint8_t tag, uint64_t v) { tlv(tag, &v, 8); }
+  void tlv_u32(uint8_t tag, uint32_t v) { tlv(tag, &v, 4); }
+  void tlv_u8(uint8_t tag, uint8_t v) { tlv(tag, &v, 1); }
+  const char* data() const { return spilled ? heap.data() : stack; }
+  size_t size() const { return spilled ? heap.size() : n; }
+};
+
+void EncodeMeta(const RpcMeta& m, MetaWriter* w) {
+  if (!m.method.empty()) {
+    w->tlv(1, m.method.data(), (uint32_t)m.method.size());
+  }
+  w->tlv_u64(2, m.correlation_id);
   if (m.error_code != 0) {
-    put_tlv_u32(&s, 3, (uint32_t)m.error_code);
+    w->tlv_u32(3, (uint32_t)m.error_code);
   }
   if (!m.error_text.empty()) {
-    put_tlv(&s, 4, m.error_text.data(), (uint32_t)m.error_text.size());
+    w->tlv(4, m.error_text.data(), (uint32_t)m.error_text.size());
   }
   if (m.attachment_size != 0) {
-    put_tlv_u32(&s, 5, m.attachment_size);
+    w->tlv_u32(5, m.attachment_size);
   }
   if (m.compress_type != 0) {
-    put_tlv_u8(&s, 6, m.compress_type);
+    w->tlv_u8(6, m.compress_type);
   }
   if (m.trace_id != 0) {
-    put_tlv_u64(&s, 7, m.trace_id);
+    w->tlv_u64(7, m.trace_id);
   }
   if (m.span_id != 0) {
-    put_tlv_u64(&s, 8, m.span_id);
+    w->tlv_u64(8, m.span_id);
   }
   if (m.flags != 0) {
-    put_tlv_u8(&s, 9, m.flags);
+    w->tlv_u8(9, m.flags);
   }
   if (m.stream_id != 0) {
-    put_tlv_u64(&s, 10, m.stream_id);
+    w->tlv_u64(10, m.stream_id);
   }
   if (m.stream_frame_type != 0) {
-    put_tlv_u8(&s, 11, m.stream_frame_type);
+    w->tlv_u8(11, m.stream_frame_type);
   }
   if (m.feedback_bytes != 0) {
-    put_tlv_u64(&s, 12, m.feedback_bytes);
+    w->tlv_u64(12, m.feedback_bytes);
   }
   if (!m.auth.empty()) {
-    put_tlv(&s, 13, m.auth.data(), (uint32_t)m.auth.size());
+    w->tlv(13, m.auth.data(), (uint32_t)m.auth.size());
   }
-  return s;
 }
 
 bool DecodeMeta(const char* p, size_t n, RpcMeta* m) {
@@ -135,18 +148,21 @@ bool DecodeMeta(const char* p, size_t n, RpcMeta* m) {
 
 void PackFrame(IOBuf* out, const RpcMeta& meta, IOBuf&& payload,
                IOBuf&& attachment) {
+  // attachment_size must reflect the actual attachment; encode meta with
+  // the header reserved up front so the whole prefix lands in one append
+  MetaWriter w;
+  w.n = 12;  // placeholder for the 12-byte frame header
   RpcMeta m2 = meta;
   m2.attachment_size = (uint32_t)attachment.size();
-  std::string ms = EncodeMeta(m2);
+  EncodeMeta(m2, &w);
   uint32_t body = (uint32_t)(payload.size() + attachment.size());
-  char hdr[12];
-  memcpy(hdr, "TRPC", 4);
-  uint32_t mbe = htonl((uint32_t)ms.size());
+  uint32_t mbe = htonl((uint32_t)(w.size() - 12));
   uint32_t bbe = htonl(body);
+  char* hdr = w.spilled ? &w.heap[0] : w.stack;
+  memcpy(hdr, "TRPC", 4);
   memcpy(hdr + 4, &mbe, 4);
   memcpy(hdr + 8, &bbe, 4);
-  out->append(hdr, 12);
-  out->append(ms.data(), ms.size());
+  out->append(w.data(), w.size());
   out->append(std::move(payload));
   out->append(std::move(attachment));
 }
@@ -172,12 +188,21 @@ int ParseFrame(IOBuf* buf, RpcMeta* meta, IOBuf* payload, IOBuf* attachment) {
   if (buf->size() < total) {
     return 0;
   }
-  buf->pop_front(12);
-  std::string ms;
-  ms.resize(meta_size);
-  buf->copy_to(&ms[0], meta_size);
-  buf->pop_front(meta_size);
-  if (!DecodeMeta(ms.data(), ms.size(), meta)) {
+  // decode the meta in place when header+meta sit in one block (the
+  // common case for small frames) — no per-frame string allocation
+  bool ok;
+  if (buf->block_count() > 0 &&
+      buf->ref_at(0).length >= 12 + meta_size) {
+    const BlockRef& r0 = buf->ref_at(0);
+    ok = DecodeMeta(r0.block->data + r0.offset + 12, meta_size, meta);
+  } else {
+    std::string ms;
+    ms.resize(meta_size);
+    buf->copy_to(&ms[0], meta_size, 12);
+    ok = DecodeMeta(ms.data(), ms.size(), meta);
+  }
+  buf->pop_front(12 + meta_size);
+  if (!ok) {
     return -1;
   }
   if (meta->attachment_size > body_size) {
@@ -459,6 +484,15 @@ void ServerOnMessages(Socket* s) {
   }
   // connections that completed the h2 preface stay h2 for life (is_h2
   // gates the registry mutex off the non-h2 hot path)
+  IOBuf batched_out;  // echo responses of this read event, flushed once
+  // every exit from the parse loop must flush: responses already produced
+  // for valid earlier frames are owed to the client even when a later
+  // frame is malformed and fails the connection
+  auto flush = [&] {
+    if (!batched_out.empty()) {
+      s->Write(std::move(batched_out));
+    }
+  };
   H2Conn* h2c = s->is_h2.load(std::memory_order_acquire)
                     ? H2ConnFind(s->id())
                     : nullptr;
@@ -467,6 +501,7 @@ void ServerOnMessages(Socket* s) {
     int hrc = H2ConnConsume(h2c, s, &reqs);
     H2ConnRelease(h2c);
     if (hrc != 0) {
+      flush();
       s->SetFailed(TRPC_EREQUEST);
       return;
     }
@@ -490,6 +525,7 @@ void ServerOnMessages(Socket* s) {
         break;
       }
       if (hrc < 0) {
+        flush();
         s->SetFailed(TRPC_EREQUEST);
         return;
       }
@@ -583,6 +619,7 @@ void ServerOnMessages(Socket* s) {
         continue;
       }
       if (!LooksLikeHttp(s->read_buf)) {
+        flush();
         s->SetFailed(TRPC_EREQUEST);
         return;
       }
@@ -599,6 +636,7 @@ void ServerOnMessages(Socket* s) {
         break;
       }
       if (hrc < 0) {
+        flush();
         s->SetFailed(TRPC_EREQUEST);
         return;
       }
@@ -612,6 +650,7 @@ void ServerOnMessages(Socket* s) {
       break;
     }
     if (rc < 0) {
+      flush();
       s->SetFailed(TRPC_EREQUEST);
       return;
     }
@@ -620,6 +659,7 @@ void ServerOnMessages(Socket* s) {
         // stream frames carry no credential: they are only honored once
         // this connection authenticated a request (else a stranger could
         // close/inject into another client's stream by guessing ids)
+        flush();
         s->SetFailed(TRPC_EAUTH);
         return;
       }
@@ -658,9 +698,15 @@ void ServerOnMessages(Socket* s) {
     }
     const ServiceHandler& h = it->second;
     if (h.kind == 0) {
-      // native echo: respond inline on this fiber (hot path)
-      SendResponse(s->id(), meta.correlation_id, 0, nullptr,
-                   std::move(payload), std::move(attachment));
+      // native echo: pack the response into the batch buffer; one Write
+      // (= one syscall) flushes every response of this read event
+      // (≙ the reference processing all cut messages then writing —
+      // syscall amortization is the single-core win)
+      RpcMeta rmeta;
+      rmeta.correlation_id = meta.correlation_id;
+      rmeta.flags = 1;  // response
+      PackFrame(&batched_out, rmeta, std::move(payload),
+                std::move(attachment));
     } else {
       CallCtx* ctx = nullptr;
       uint32_t slot = ResourcePool<CallCtx>::Get(&ctx);
@@ -681,6 +727,7 @@ void ServerOnMessages(Socket* s) {
       UsercodePool::Instance().Submit(ctx);
     }
   }
+  flush();
   if (eof) {
     s->SetFailed(ECONNRESET);
   }
@@ -1126,8 +1173,28 @@ uint64_t stream_accept(uint64_t token, uint64_t window_bytes) {
 
 namespace {
 
+// Correlation-id = (version << 32) | pool slot: the response path resolves
+// a PendingCall with one array address + one atomic check — no map, no
+// lock, no allocation (≙ the reference's bthread_id version ranges doing
+// ABA-free RPC correlation, id.h:46-60).  The tiny per-channel doubly-
+// linked list exists only so a broken connection can sweep its in-flight
+// calls; its lock guards ~4 pointer ops.
+enum PcState : uint32_t {
+  PC_FREE = 0,       // in pool
+  PC_ARMED = 1,      // caller waiting; response/timeout may claim
+  PC_DELIVERING = 2  // response owner filling results
+};
+
 struct PendingCall {
   Butex* done = nullptr;  // value flips 0 -> 1 on completion
+  // [version:32][PcState:32]; version bumps on release so stale
+  // correlation ids can never match a recycled slot
+  std::atomic<uint64_t> vs{1ULL << 32};
+  uint32_t slot = 0;
+  PendingCall* sweep_prev = nullptr;
+  PendingCall* sweep_next = nullptr;
+  bool linked = false;
+  SocketId sock_id = INVALID_SOCKET_ID;  // connection this call rode
   int32_t error_code = 0;
   std::string error_text;
   IOBuf response;
@@ -1137,6 +1204,39 @@ struct PendingCall {
   uint8_t compress_type = 0;   // of the response payload
 };
 
+// Claim an ARMED call for delivery by correlation id.  Exactly one of
+// {response fiber, failure sweep, timing-out caller} wins the CAS; the
+// others see the state change and back off.  `expect_sock` binds a claim
+// to the connection the call was issued on: a response arriving on any
+// other connection (a misbehaving or malicious peer forging correlation
+// ids) must not complete it.  Pass INVALID_SOCKET_ID to skip the check
+// (the owning caller claiming its own call).
+PendingCall* ClaimPending(uint64_t corr,
+                          SocketId expect_sock = INVALID_SOCKET_ID) {
+  uint32_t slot = (uint32_t)corr;
+  uint32_t ver = (uint32_t)(corr >> 32);
+  PendingCall* pc = ResourcePool<PendingCall>::Address(slot);
+  if (pc == nullptr) {
+    return nullptr;
+  }
+  uint64_t expected = ((uint64_t)ver << 32) | PC_ARMED;
+  if (pc->vs.load(std::memory_order_acquire) != expected) {
+    return nullptr;
+  }
+  // sock_id is written before the ARMED store (release) and stable while
+  // armed, so this read is ordered; checking before the CAS means a
+  // mismatched claim never transitions the state (no revert race)
+  if (expect_sock != INVALID_SOCKET_ID && pc->sock_id != expect_sock) {
+    return nullptr;
+  }
+  if (!pc->vs.compare_exchange_strong(
+          expected, ((uint64_t)ver << 32) | PC_DELIVERING,
+          std::memory_order_acq_rel)) {
+    return nullptr;
+  }
+  return pc;
+}
+
 }  // namespace
 
 class Channel {
@@ -1145,36 +1245,92 @@ class Channel {
   int port = 0;
   int64_t connect_timeout_us = 500 * 1000;
   std::string auth;  // credential riding every request meta (tag 13)
-  std::atomic<uint64_t> next_corr{1};
-  std::mutex map_mu;
-  std::unordered_map<uint64_t, PendingCall*> pending;
+  std::mutex sweep_mu;
+  PendingCall* sweep_head = nullptr;
   std::mutex conn_mu;
   SocketId sock = INVALID_SOCKET_ID;
   bool connected = false;
+  // lock-free fast path for the per-call "is the connection up" check;
+  // source of truth stays under conn_mu
+  std::atomic<SocketId> cached_sock{INVALID_SOCKET_ID};
+
+  void SweepLink(PendingCall* pc) {
+    std::lock_guard<std::mutex> lk(sweep_mu);
+    pc->sweep_prev = nullptr;
+    pc->sweep_next = sweep_head;
+    if (sweep_head != nullptr) {
+      sweep_head->sweep_prev = pc;
+    }
+    sweep_head = pc;
+    pc->linked = true;
+  }
+
+  void SweepUnlink(PendingCall* pc) {
+    std::lock_guard<std::mutex> lk(sweep_mu);
+    if (!pc->linked) {
+      return;  // a failure sweep already detached it
+    }
+    if (pc->sweep_prev != nullptr) {
+      pc->sweep_prev->sweep_next = pc->sweep_next;
+    } else {
+      sweep_head = pc->sweep_next;
+    }
+    if (pc->sweep_next != nullptr) {
+      pc->sweep_next->sweep_prev = pc->sweep_prev;
+    }
+    pc->linked = false;
+  }
 };
 
 namespace {
 
-// Fail every pending call of this channel (connection broke).
+// Fail every pending call that rode this connection (connection broke).
 void ChannelOnSocketFailed(Socket* s) {
   StreamsOnSocketFailed(s->id());
   Channel* c = (Channel*)s->user;
-  std::vector<std::pair<uint64_t, PendingCall*>> all;
+  SocketId failed_id = s->id();
+  // (pc, vs snapshot) pairs: the CAS below must target the exact armed
+  // generation observed here — a slot recycled and re-armed on the new
+  // connection in between must not be spuriously failed
+  std::vector<std::pair<PendingCall*, uint64_t>> mine;
   {
-    std::lock_guard<std::mutex> lk(c->map_mu);
-    for (auto& kv : c->pending) {
-      all.push_back(kv);
+    std::lock_guard<std::mutex> lk(c->sweep_mu);
+    PendingCall* p = c->sweep_head;
+    while (p != nullptr) {
+      PendingCall* next = p->sweep_next;
+      if (p->sock_id == failed_id) {
+        // detach: calls armed on a newer connection stay linked
+        if (p->sweep_prev != nullptr) {
+          p->sweep_prev->sweep_next = p->sweep_next;
+        } else {
+          c->sweep_head = p->sweep_next;
+        }
+        if (p->sweep_next != nullptr) {
+          p->sweep_next->sweep_prev = p->sweep_prev;
+        }
+        p->linked = false;
+        mine.emplace_back(p, p->vs.load(std::memory_order_acquire));
+      }
+      p = next;
     }
-    c->pending.clear();
   }
   {
     std::lock_guard<std::mutex> lk(c->conn_mu);
-    if (c->sock == s->id()) {
+    if (c->sock == failed_id) {
       c->connected = false;
+      c->cached_sock.store(INVALID_SOCKET_ID, std::memory_order_release);
     }
   }
-  for (auto& kv : all) {
-    PendingCall* pc = kv.second;
+  for (auto& [pc, v] : mine) {
+    if ((uint32_t)v != PC_ARMED) {
+      continue;  // response or timeout already claimed it
+    }
+    uint64_t expected = v;
+    if (!pc->vs.compare_exchange_strong(
+            expected, (v & 0xffffffff00000000ULL) | PC_DELIVERING,
+            std::memory_order_acq_rel)) {
+      continue;  // claimed (or recycled + re-armed) since the snapshot
+    }
     pc->error_code = TRPC_EFAILEDSOCKET;
     pc->error_text = "connection failed";
     butex_value(pc->done).store(1, std::memory_order_release);
@@ -1185,7 +1341,6 @@ void ChannelOnSocketFailed(Socket* s) {
 // edge_fn of client-side sockets: parse responses, wake callers
 // (≙ ProcessRpcResponse + bthread_id unlock/destroy).
 void ChannelOnMessages(Socket* s) {
-  Channel* c = (Channel*)s->user;
   bool eof = false;
   ssize_t n = s->ReadToBuf(&eof);
   if (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
@@ -1207,15 +1362,7 @@ void ChannelOnMessages(Socket* s) {
       StreamHandleFrame(meta, std::move(payload));
       continue;
     }
-    PendingCall* pc = nullptr;
-    {
-      std::lock_guard<std::mutex> lk(c->map_mu);
-      auto it = c->pending.find(meta.correlation_id);
-      if (it != c->pending.end()) {
-        pc = it->second;
-        c->pending.erase(it);
-      }
-    }
+    PendingCall* pc = ClaimPending(meta.correlation_id, s->id());
     if (pc == nullptr) {
       // late response after timeout: drop (≙ EREFUSED path) — but if it
       // carries an accepted-stream handle, tell the server to close that
@@ -1245,23 +1392,36 @@ void ChannelOnMessages(Socket* s) {
   }
 }
 
-int EnsureConnected(Channel* c, SocketId* out) {
+// Returns an addressed (ref-held) socket for the channel's connection,
+// dialing if needed; nullptr on connect failure (rc_out set).  The fast
+// path is one atomic load + one Address — no lock per call.
+Socket* EnsureConnected(Channel* c, int* rc_out) {
+  SocketId cached = c->cached_sock.load(std::memory_order_acquire);
+  if (cached != INVALID_SOCKET_ID) {
+    Socket* s = Socket::Address(cached);
+    if (s != nullptr && !s->failed.load(std::memory_order_acquire)) {
+      return s;
+    }
+    if (s != nullptr) {
+      s->Dereference();
+    }
+  }
   std::lock_guard<std::mutex> lk(c->conn_mu);
   if (c->connected) {
     Socket* s = Socket::Address(c->sock);
     if (s != nullptr && !s->failed.load(std::memory_order_acquire)) {
-      s->Dereference();
-      *out = c->sock;
-      return 0;
+      return s;
     }
     if (s != nullptr) {
       s->Dereference();
     }
     c->connected = false;
+    c->cached_sock.store(INVALID_SOCKET_ID, std::memory_order_release);
   }
   int fd = ::socket(AF_INET, SOCK_STREAM | SOCK_CLOEXEC, 0);
   if (fd < 0) {
-    return -errno;
+    *rc_out = -errno;
+    return nullptr;
   }
   sockaddr_in addr;
   memset(&addr, 0, sizeof(addr));
@@ -1273,9 +1433,9 @@ int EnsureConnected(Channel* c, SocketId* out) {
   fcntl(fd, F_SETFL, fl | O_NONBLOCK);
   if (connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
     if (errno != EINPROGRESS) {
-      int e = errno;
+      *rc_out = -errno;
       ::close(fd);
-      return -e;
+      return nullptr;
     }
     int64_t deadline = monotonic_ns() + c->connect_timeout_us * 1000;
     int pr = 0;
@@ -1296,7 +1456,8 @@ int EnsureConnected(Channel* c, SocketId* out) {
         getsockopt(fd, SOL_SOCKET, SO_ERROR, &soerr, &slen) != 0 ||
         soerr != 0) {
       ::close(fd);
-      return pr <= 0 ? -ETIMEDOUT : -(soerr != 0 ? soerr : EIO);
+      *rc_out = pr <= 0 ? -ETIMEDOUT : -(soerr != 0 ? soerr : EIO);
+      return nullptr;
     }
   }
   int one = 1;
@@ -1306,14 +1467,18 @@ int EnsureConnected(Channel* c, SocketId* out) {
   opts.edge_fn = ChannelOnMessages;
   opts.user = c;
   opts.on_failed = ChannelOnSocketFailed;
+  opts.corked = true;  // caller fibers share this connection: batch writes
   if (Socket::Create(opts, &c->sock) != 0) {
     ::close(fd);
-    return -ENOMEM;
+    *rc_out = -ENOMEM;
+    return nullptr;
   }
+  Socket* snew = Socket::Address(c->sock);  // ref for the caller
   EventDispatcher::Instance().AddConsumer(c->sock, fd);
   c->connected = true;
-  *out = c->sock;
-  return 0;
+  c->cached_sock.store(c->sock, std::memory_order_release);
+  *rc_out = 0;
+  return snew;
 }
 
 }  // namespace
@@ -1345,6 +1510,7 @@ void channel_destroy(Channel* c) {
     if (c->connected) {
       sid = c->sock;
       c->connected = false;
+      c->cached_sock.store(INVALID_SOCKET_ID, std::memory_order_release);
     }
   }
   // SetFailed outside conn_mu: its on_failed callback re-locks conn_mu
@@ -1372,21 +1538,19 @@ int channel_call(Channel* c, const char* method, const uint8_t* req,
                  size_t req_len, const uint8_t* attach, size_t attach_len,
                  int64_t timeout_us, CallResult* out, uint64_t stream,
                  uint8_t compress) {
-  SocketId sid;
-  int rc = EnsureConnected(c, &sid);
-  if (rc != 0) {
+  int rc = 0;
+  Socket* s = EnsureConnected(c, &rc);
+  if (s == nullptr) {
     if (out != nullptr) {
       out->error_code = TRPC_EFAILEDSOCKET;
       out->error_text = "connect failed";
     }
     return TRPC_EFAILEDSOCKET;
   }
-  Socket* s = Socket::Address(sid);
-  if (s == nullptr) {
-    return TRPC_EFAILEDSOCKET;
-  }
-  uint64_t corr = c->next_corr.fetch_add(1, std::memory_order_relaxed);
-  PendingCall* pc = ObjectPool<PendingCall>::Get();
+  SocketId sid = s->id();
+  PendingCall* pc = nullptr;
+  uint32_t slot = ResourcePool<PendingCall>::Get(&pc);
+  pc->slot = slot;
   if (pc->done == nullptr) {
     pc->done = butex_create();
   }
@@ -1398,10 +1562,12 @@ int channel_call(Channel* c, const char* method, const uint8_t* req,
   pc->stream_id = 0;
   pc->stream_window = 0;
   pc->compress_type = 0;
-  {
-    std::lock_guard<std::mutex> lk(c->map_mu);
-    c->pending[corr] = pc;
-  }
+  pc->sock_id = sid;
+  uint32_t ver =
+      (uint32_t)(pc->vs.load(std::memory_order_relaxed) >> 32);
+  pc->vs.store(((uint64_t)ver << 32) | PC_ARMED, std::memory_order_release);
+  uint64_t corr = ((uint64_t)ver << 32) | slot;
+  c->SweepLink(pc);
   RpcMeta meta;
   meta.method = method;
   meta.correlation_id = corr;
@@ -1423,17 +1589,12 @@ int channel_call(Channel* c, const char* method, const uint8_t* req,
   s->Dereference();
   int result;
   if (rc != 0) {
-    bool still_pending;
-    {
-      std::lock_guard<std::mutex> lk(c->map_mu);
-      still_pending = c->pending.erase(corr) > 0;
-    }
-    if (still_pending) {
+    if (ClaimPending(corr) == pc) {
       pc->error_code = TRPC_EFAILEDSOCKET;
       pc->error_text = "write failed";
     } else {
-      // ChannelOnSocketFailed already swept the map and may still be
-      // filling pc: wait for its completion flip before touching pc
+      // the failure sweep claimed it and may still be filling pc: wait
+      // for its completion flip before touching pc
       while (butex_value(pc->done).load(std::memory_order_acquire) == 0) {
         butex_wait(pc->done, 0, 1000);
       }
@@ -1444,12 +1605,7 @@ int channel_call(Channel* c, const char* method, const uint8_t* req,
     while (butex_value(pc->done).load(std::memory_order_acquire) == 0) {
       if (butex_wait(pc->done, 0, timeout_us > 0 ? timeout_us : -1) != 0 &&
           errno == ETIMEDOUT) {
-        bool still_pending;
-        {
-          std::lock_guard<std::mutex> lk(c->map_mu);
-          still_pending = c->pending.erase(corr) > 0;
-        }
-        if (still_pending) {
+        if (ClaimPending(corr) == pc) {
           pc->error_code = TRPC_ERPCTIMEDOUT;
           pc->error_text = "rpc timeout";
           break;
@@ -1482,7 +1638,13 @@ int channel_call(Channel* c, const char* method, const uint8_t* req,
   }
   pc->response.clear();
   pc->attachment.clear();
-  ObjectPool<PendingCall>::Return(pc);
+  c->SweepUnlink(pc);
+  // bump the version before returning to the pool: a late response with
+  // this corr can never match the recycled slot
+  uint32_t ver2 = (uint32_t)(pc->vs.load(std::memory_order_relaxed) >> 32);
+  pc->vs.store(((uint64_t)(ver2 + 1) << 32) | PC_FREE,
+               std::memory_order_release);
+  ResourcePool<PendingCall>::Return(slot);
   return result;
 }
 

@@ -53,6 +53,7 @@ int Socket::Create(const SocketOptions& opts, SocketId* id_out) {
   s->http_inflight.store(0, std::memory_order_relaxed);
   s->authed.store(false, std::memory_order_relaxed);
   s->is_h2.store(false, std::memory_order_relaxed);
+  s->corked = opts.corked;
   if (s->epollout_butex == nullptr) {
     s->epollout_butex = butex_create();
   }
@@ -229,6 +230,10 @@ int Socket::Write(IOBuf&& data, Butex* notify) {
     return 0;          // the current writer will pick it up
   }
   req->next.store(nullptr, std::memory_order_relaxed);
+  // corked: skip the inline write; the flush fiber runs after the other
+  // ready fibers, so their writes chain onto the stack and drain as one
+  // writev (single-syscall batching on a shared client connection)
+  if (!corked) {
   // we are the writer: one inline write attempt, then hand off
   if (!failed.load(std::memory_order_acquire)) {
     ssize_t n = req->data.cut_into_fd(fd);
@@ -250,6 +255,7 @@ int Socket::Write(IOBuf&& data, Butex* notify) {
       return 0;
     }
   }
+  }  // !corked
   // leftover data, failure drain, or newer requests: background fiber
   Socket* self = Address(id());  // ref held by the KeepWrite fiber
   if (self == nullptr) {
@@ -305,19 +311,37 @@ void Socket::KeepWriteFiber(void* arg) {
   s->Dereference();
 }
 
-// The writer drain loop: writes FIFO until the queue CASes empty; on
-// failure, discards instead of writing.  Runs on a KeepWrite fiber or
-// inline in Write() when spawning is impossible.
+// The writer drain loop: absorbs the FIFO chain into one merged buffer
+// (zero-copy block-ref splicing) and writes it with as few writev calls
+// as possible; on failure, discards instead of writing.  Writer-ship is
+// held until everything absorbed has been written, so bytes never
+// interleave.  Runs on a KeepWrite fiber or inline in Write() when
+// spawning is impossible.
 void Socket::RunKeepWrite(WriteRequest* req) {
   Socket* s = this;
+  IOBuf merged;
+  std::vector<Butex*> notifies;  // rarely touched: only stream writes
   while (true) {
-    // drain req->data
-    while (!req->data.empty()) {
+    // absorb req and everything already linked behind it (FIFO order)
+    while (true) {
+      merged.append(std::move(req->data));
+      if (req->notify != nullptr) {
+        notifies.push_back(req->notify);
+      }
+      WriteRequest* next = req->next.load(std::memory_order_relaxed);
+      if (next == nullptr) {
+        break;  // req is the newest absorbed; keep it as the CAS anchor
+      }
+      ObjectPool<WriteRequest>::Return(req);
+      req = next;
+    }
+    // drain the merged batch
+    while (!merged.empty()) {
       if (s->failed.load(std::memory_order_acquire)) {
-        req->data.clear();
+        merged.clear();
         break;
       }
-      ssize_t n = req->data.cut_into_fd(s->fd);
+      ssize_t n = merged.cut_into_fd(s->fd);
       if (n > 0) {
         s->bytes_out.fetch_add((uint64_t)n, std::memory_order_relaxed);
         continue;
@@ -336,17 +360,14 @@ void Socket::RunKeepWrite(WriteRequest* req) {
       }
       s->SetFailed(errno != 0 ? errno : EPIPE);
     }
-    if (req->notify != nullptr && !s->failed.load(std::memory_order_acquire)) {
-      butex_value(req->notify).fetch_add(1, std::memory_order_release);
-      butex_wake_all(req->notify);
+    if (!s->failed.load(std::memory_order_acquire)) {
+      for (Butex* b : notifies) {
+        butex_value(b).fetch_add(1, std::memory_order_release);
+        butex_wake_all(b);
+      }
     }
-    WriteRequest* next = req->next.load(std::memory_order_relaxed);
-    if (next != nullptr) {
-      ObjectPool<WriteRequest>::Return(req);
-      req = next;
-      continue;
-    }
-    // req is the last grabbed; if head still == req, queue is empty
+    notifies.clear();
+    // req is the last absorbed; if head still == req, the queue is empty
     WriteRequest* expected = req;
     if (s->write_head.compare_exchange_strong(expected, nullptr,
                                               std::memory_order_acq_rel)) {

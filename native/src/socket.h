@@ -41,6 +41,12 @@ struct SocketOptions {
   EdgeFn edge_fn = nullptr;
   void* user = nullptr;       // owner: Server* / Channel* / Acceptor ctx
   void (*on_failed)(Socket*) = nullptr;  // called once from SetFailed
+  // corked: Write() never writes inline — it enqueues and lets the flush
+  // fiber (scheduled after the currently-ready fibers) drain the queue in
+  // one writev.  Concurrent producers coalesce into one syscall; costs
+  // one fiber hop of latency.  Used by client channels where many caller
+  // fibers share a connection.
+  bool corked = false;
 };
 
 class Socket {
@@ -74,6 +80,7 @@ class Socket {
   // opaque per-connection parser state owned by the protocol layer
   // (rpc.cc: HttpParseState for chunked bodies); freed by on_failed
   void* parse_state = nullptr;
+  bool corked = false;  // see SocketOptions.corked
 
   static int Create(const SocketOptions& opts, SocketId* id_out);
   // +1 ref; nullptr if the id is stale.
