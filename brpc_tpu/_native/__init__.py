@@ -134,6 +134,8 @@ def _declare(L: ctypes.CDLL) -> None:
     L.trpc_server_set_auth.restype = None
     L.trpc_channel_set_auth.argtypes = [c.c_void_p, c.c_char_p, c.c_size_t]
     L.trpc_channel_set_auth.restype = None
+    L.trpc_channel_set_connection_type.argtypes = [c.c_void_p, c.c_int]
+    L.trpc_channel_set_connection_type.restype = None
 
     # introspection
     L.trpc_server_conn_stats.argtypes = [c.c_void_p, c.c_char_p, c.c_size_t]
@@ -141,6 +143,8 @@ def _declare(L: ctypes.CDLL) -> None:
 
     L.trpc_set_usercode_workers.argtypes = [c.c_int]
     L.trpc_set_usercode_workers.restype = None
+    L.trpc_set_event_dispatcher_num.argtypes = [c.c_int]
+    L.trpc_set_event_dispatcher_num.restype = None
 
     # channel
     L.trpc_channel_create.argtypes = [c.c_char_p, c.c_int]

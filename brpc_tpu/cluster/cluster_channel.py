@@ -73,7 +73,8 @@ class ClusterChannel:
             if sub is None:
                 sub = self._subs[node] = self._SubChannel(
                     node.endpoint, self.options.connect_timeout_ms,
-                    getattr(self.options, "auth", None))
+                    getattr(self.options, "auth", None),
+                    getattr(self.options, "connection_type", "single"))
             return sub
 
     def _breaker(self, node: ServerNode) -> CircuitBreaker:

@@ -39,6 +39,11 @@ class ChannelOptions:
     # credential sent in every request meta (≙ ChannelOptions.auth +
     # Authenticator::GenerateCredential); verified natively by the server
     auth: Optional[bytes] = None
+    # "single" (default: one SocketMap-shared connection), "pooled"
+    # (exclusive connection per in-flight call, parked between calls),
+    # "short" (one call per connection)
+    # (≙ ChannelOptions.connection_type, controller.cpp:1112-1114)
+    connection_type: str = "single"
 
 
 class RetryPolicy:
@@ -120,9 +125,12 @@ class SubChannel:
     ≙ the single-server brpc::Channel (SocketMap entry, channel.cpp:317).
     """
 
+    _CONN_TYPES = {"single": 0, "": 0, "pooled": 1, "short": 2}
+
     def __init__(self, endpoint: EndPoint,
                  connect_timeout_ms: float = 500.0,
-                 auth: Optional[bytes] = None):
+                 auth: Optional[bytes] = None,
+                 connection_type: str = "single"):
         self.endpoint = endpoint
         L = lib()
         self._handle = L.trpc_channel_create(
@@ -131,6 +139,11 @@ class SubChannel:
             self._handle, int(connect_timeout_ms * 1000))
         if auth:
             L.trpc_channel_set_auth(self._handle, auth, len(auth))
+        ct = self._CONN_TYPES.get(connection_type)
+        if ct is None:
+            raise ValueError(f"unknown connection_type {connection_type!r}")
+        if ct:
+            L.trpc_channel_set_connection_type(self._handle, ct)
         self._native = _NativeCall(self._handle)
         self._lock = threading.Lock()
         self._drained = threading.Condition(self._lock)
@@ -199,7 +212,8 @@ class Channel:
                 # device endpoints carry the control plane on DCN/TCP
                 ep = EndPoint(ip=ep.ip, port=ep.port)
             self._sub = SubChannel(ep, self.options.connect_timeout_ms,
-                                   self.options.auth)
+                                   self.options.auth,
+                                   self.options.connection_type)
         if Channel._latency is None:
             Channel._latency = bvar.LatencyRecorder()
             Channel._latency.expose("rpc_client")

@@ -26,6 +26,10 @@ from brpc_tpu.rpc.http import (HttpDispatcher, HttpRequest, pack_headers,
                                parse_headers_blob)
 from brpc_tpu.utils import flags, logging as log
 
+flags.define_int32("event_dispatcher_num", 1,
+                   "number of epoll dispatcher threads (the reference's "
+                   "event_dispatcher_num); set before the first "
+                   "server/channel starts")
 flags.define_int32("usercode_workers", 4,
                    "pthreads running Python handlers")
 
@@ -301,6 +305,8 @@ class Server:
         fiber.init(self.options.num_workers)
         lib().trpc_set_usercode_workers(
             int(flags.get_flag("usercode_workers")))
+        lib().trpc_set_event_dispatcher_num(
+            int(flags.get_flag("event_dispatcher_num")))
         if self.options.enable_builtin_services:
             from brpc_tpu.builtin import install_builtin_services
             install_builtin_services(self, self.http)

@@ -9,6 +9,7 @@
 #include "http.h"
 #include "iobuf.h"
 #include "rpc.h"
+#include "socket.h"
 #include "stream.h"
 
 using namespace trpc;
@@ -90,6 +91,10 @@ uint64_t trpc_server_requests(void* s) { return server_requests((Server*)s); }
 
 void trpc_set_usercode_workers(int n) { set_usercode_workers(n); }
 
+void trpc_set_event_dispatcher_num(int n) {
+  g_event_dispatcher_num.store(n, std::memory_order_relaxed);
+}
+
 int trpc_respond(uint64_t token, int32_t error_code, const char* error_text,
                  const uint8_t* data, size_t len, const uint8_t* attach,
                  size_t attach_len) {
@@ -140,6 +145,10 @@ int trpc_redis_respond(uint64_t token, const uint8_t* data, size_t len) {
 
 void trpc_server_set_auth(void* s, const uint8_t* secret, size_t len) {
   server_set_auth((Server*)s, secret, len);
+}
+
+void trpc_channel_set_connection_type(void* c, int t) {
+  channel_set_connection_type((Channel*)c, t);
 }
 
 void trpc_channel_set_auth(void* c, const uint8_t* secret, size_t len) {

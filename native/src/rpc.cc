@@ -245,6 +245,9 @@ struct CallCtx {
   uint64_t req_stream_id = 0;
   uint64_t req_stream_window = 0;
   uint64_t accepted_stream = 0;
+  // pipelining: position of this HTTP/RESP request on its connection;
+  // responses release strictly in sequence (see ConnState)
+  uint64_t pipe_seq = 0;
   uint32_t slot = 0;
   std::atomic<uint32_t> version{1};
 
@@ -358,6 +361,84 @@ class Server {
 
 namespace {
 
+// Per-connection server-side parse + pipelining state, hung off
+// Socket::parse_state and freed by Socket::TryRecycle.  HTTP/1.1 and RESP
+// requests on one connection execute CONCURRENTLY in the usercode pool
+// (≙ the reference processing pipelined requests in parallel,
+// policy/http_rpc_protocol.cpp) while responses are written strictly in
+// request order through the sequencer below.
+struct ConnState {
+  HttpParseState http;  // chunked-body resume state
+  std::mutex mu;
+  uint64_t next_dispatch = 0;  // seq assigned to the next parsed request
+  uint64_t next_release = 0;   // seq whose response may be written next
+  bool parse_capped = false;   // parser paused at kMaxPipelined in flight
+  bool closing = false;        // a Connection: close response was released
+  struct Ready {
+    IOBuf data;
+    bool close_after = false;
+  };
+  std::unordered_map<uint64_t, Ready> ready;  // out-of-order completions
+};
+
+constexpr uint64_t kMaxPipelined = 64;  // per-connection in-flight cap
+
+ConnState* GetConnState(Socket* s) {
+  if (s->parse_state == nullptr) {
+    s->parse_state = new ConnState();
+    s->parse_state_free = [](void* p) { delete (ConnState*)p; };
+  }
+  return (ConnState*)s->parse_state;
+}
+
+void CloseAfterWrite(Socket* s, IOBuf&& resp);  // defined near http_respond
+
+// Hand a sequenced response to the connection: writes it now if it is the
+// next in request order (plus any queued successors), else parks it.
+// Returns with the parser re-armed if it was capped.
+void ReleaseSequenced(Socket* s, uint64_t seq, IOBuf&& data,
+                      bool close_after) {
+  ConnState* cs = (ConnState*)s->parse_state;
+  bool rearm = false;
+  {
+    std::lock_guard<std::mutex> lk(cs->mu);
+    if (cs->closing) {
+      return;  // connection is winding down; drop queued responses
+    }
+    if (seq != cs->next_release) {
+      ConnState::Ready& r = cs->ready[seq];
+      r.data = std::move(data);
+      r.close_after = close_after;
+      return;
+    }
+    // write in order: this one, then every queued successor
+    while (true) {
+      ++cs->next_release;
+      if (close_after) {
+        cs->closing = true;
+        CloseAfterWrite(s, std::move(data));
+        break;
+      }
+      s->Write(std::move(data));
+      auto it = cs->ready.find(cs->next_release);
+      if (it == cs->ready.end()) {
+        break;
+      }
+      data = std::move(it->second.data);
+      close_after = it->second.close_after;
+      cs->ready.erase(it);
+    }
+    if (cs->parse_capped &&
+        cs->next_dispatch - cs->next_release < kMaxPipelined) {
+      cs->parse_capped = false;
+      rearm = true;
+    }
+  }
+  if (rearm) {
+    Socket::StartInputEvent(s->id());
+  }
+}
+
 void SendResponse(SocketId sock_id, uint64_t correlation_id,
                   int32_t error_code, const char* error_text, IOBuf&& payload,
                   IOBuf&& attachment, uint64_t stream_id = 0,
@@ -394,7 +475,15 @@ bool ConstantTimeEq(const std::string& a, const std::string& b) {
 }
 
 // One parsed HTTP request → usercode pool (or immediate error response).
+// Requests pipeline: each takes a sequence slot; handlers run concurrently
+// and ReleaseSequenced writes responses back in request order.
 void DispatchHttp(Socket* s, Server* srv, HttpRequest&& req) {
+  ConnState* cs = GetConnState(s);
+  uint64_t seq;
+  {
+    std::lock_guard<std::mutex> lk(cs->mu);
+    seq = cs->next_dispatch++;
+  }
   if (srv->http_cb == nullptr || !srv->running.load(std::memory_order_acquire)) {
     int status = srv->http_cb == nullptr ? 404 : 503;
     IOBuf resp;
@@ -402,14 +491,10 @@ void DispatchHttp(Socket* s, Server* srv, HttpRequest&& req) {
                                     : "server is stopping\n";
     PackHttpResponse(&resp, status, "Content-Type: text/plain\r\n",
                      (const uint8_t*)msg, strlen(msg), req.keep_alive);
-    s->Write(std::move(resp));
+    ReleaseSequenced(s, seq, std::move(resp), !req.keep_alive);
     return;
   }
   srv->nrequests.fetch_add(1, std::memory_order_relaxed);
-  // block further HTTP parsing on this connection until the response is out
-  // (HTTP/1.1 responses must come back in request order; the usercode pool
-  // is multi-threaded, so concurrent dispatch would race)
-  s->http_inflight.store(1, std::memory_order_release);
   CallCtx* ctx = nullptr;
   uint32_t slot = ResourcePool<CallCtx>::Get(&ctx);
   ctx->slot = slot;
@@ -427,6 +512,7 @@ void DispatchHttp(Socket* s, Server* srv, HttpRequest&& req) {
   ctx->req_stream_id = 0;
   ctx->req_stream_window = 0;
   ctx->accepted_stream = 0;
+  ctx->pipe_seq = seq;
   ctx->hcb = srv->http_cb;
   ctx->user = srv->http_user;
   UsercodePool::Instance().Submit(ctx);
@@ -517,10 +603,10 @@ void ServerOnMessages(Socket* s) {
     // a chunked request body in progress owns the incoming bytes: resume
     // its decode before any protocol sniffing (body bytes are not a new
     // message)
-    HttpParseState* hps = (HttpParseState*)s->parse_state;
-    if (hps != nullptr && hps->active) {
+    ConnState* ccs = (ConnState*)s->parse_state;
+    if (ccs != nullptr && ccs->http.active) {
       HttpRequest hreq;
-      int hrc = ParseHttpRequest(&s->read_buf, &hreq, hps);
+      int hrc = ParseHttpRequest(&s->read_buf, &hreq, &ccs->http);
       if (hrc == 0) {
         break;
       }
@@ -559,10 +645,15 @@ void ServerOnMessages(Socket* s) {
         break;  // rest of the connection handled by the h2 path above
       }
       if (LooksLikeRedis(s->read_buf) && srv->redis_cb != nullptr) {
-        // RESP commands pipeline with ordered replies — same per-
-        // connection gate as HTTP/1.1
-        if (s->http_inflight.load(std::memory_order_acquire) != 0) {
-          break;
+        // RESP commands pipeline: dispatch concurrently up to the cap,
+        // replies release in command order through the sequencer
+        ConnState* cs = GetConnState(s);
+        {
+          std::lock_guard<std::mutex> lk(cs->mu);
+          if (cs->next_dispatch - cs->next_release >= kMaxPipelined) {
+            cs->parse_capped = true;
+            break;
+          }
         }
         std::vector<std::string> argv;
         int rrc = ParseRedisCommand(&s->read_buf, &argv);
@@ -576,7 +667,12 @@ void ServerOnMessages(Socket* s) {
         if (!srv->running.load(std::memory_order_acquire)) {
           IOBuf err;
           err.append("-ERR server is stopping\r\n", 25);
-          s->Write(std::move(err));
+          uint64_t seq;
+          {
+            std::lock_guard<std::mutex> lk(cs->mu);
+            seq = cs->next_dispatch++;
+          }
+          ReleaseSequenced(s, seq, std::move(err), false);
           continue;
         }
         if (srv->has_auth && !s->authed.load(std::memory_order_acquire)) {
@@ -595,11 +691,15 @@ void ServerOnMessages(Socket* s) {
           } else {
             reply.append("-NOAUTH Authentication required.\r\n", 34);
           }
-          s->Write(std::move(reply));
+          uint64_t seq;
+          {
+            std::lock_guard<std::mutex> lk(cs->mu);
+            seq = cs->next_dispatch++;
+          }
+          ReleaseSequenced(s, seq, std::move(reply), false);
           continue;
         }
         srv->nrequests.fetch_add(1, std::memory_order_relaxed);
-        s->http_inflight.store(1, std::memory_order_release);
         CallCtx* rctx = nullptr;
         uint32_t rslot = ResourcePool<CallCtx>::Get(&rctx);
         rctx->slot = rslot;
@@ -613,6 +713,10 @@ void ServerOnMessages(Socket* s) {
         rctx->req_stream_id = 0;
         rctx->req_stream_window = 0;
         rctx->accepted_stream = 0;
+        {
+          std::lock_guard<std::mutex> lk(cs->mu);
+          rctx->pipe_seq = cs->next_dispatch++;
+        }
         rctx->rcb = srv->redis_cb;
         rctx->user = srv->redis_user;
         UsercodePool::Instance().Submit(rctx);
@@ -623,15 +727,16 @@ void ServerOnMessages(Socket* s) {
         s->SetFailed(TRPC_EREQUEST);
         return;
       }
-      if (s->http_inflight.load(std::memory_order_acquire) != 0) {
-        break;  // pipelined request: wait for the in-flight response
-      }
-      if (s->parse_state == nullptr) {
-        s->parse_state = new HttpParseState();
+      ConnState* hcs = GetConnState(s);
+      {
+        std::lock_guard<std::mutex> lk(hcs->mu);
+        if (hcs->next_dispatch - hcs->next_release >= kMaxPipelined) {
+          hcs->parse_capped = true;
+          break;
+        }
       }
       HttpRequest hreq;
-      int hrc = ParseHttpRequest(&s->read_buf, &hreq,
-                                 (HttpParseState*)s->parse_state);
+      int hrc = ParseHttpRequest(&s->read_buf, &hreq, &hcs->http);
       if (hrc == 0) {
         break;
       }
@@ -734,8 +839,9 @@ void ServerOnMessages(Socket* s) {
 }
 
 void ServerConnFailed(Socket* s) {
-  delete (HttpParseState*)s->parse_state;
-  s->parse_state = nullptr;
+  // parse_state (ConnState) is NOT freed here: respond paths holding an
+  // Address ref may still touch it; Socket::TryRecycle frees it via
+  // parse_state_free once the last ref is gone
   H2ConnDestroy(s->id());
   StreamsOnSocketFailed(s->id());
   Server* srv = (Server*)s->user;
@@ -812,11 +918,7 @@ int redis_respond(uint64_t token, const uint8_t* data, size_t len) {
   if (s != nullptr) {
     IOBuf reply;
     reply.append(data, len);
-    s->Write(std::move(reply));
-    // release the ordering gate and re-arm parsing for the next
-    // pipelined command
-    s->http_inflight.store(0, std::memory_order_release);
-    Socket::StartInputEvent(s->id());
+    ReleaseSequenced(s, ctx->pipe_seq, std::move(reply), false);
     s->Dereference();
   }
   ctx->version.fetch_add(1, std::memory_order_release);
@@ -1041,6 +1143,25 @@ void CloseAfterWriteFiber(void* a) {
   delete arg;
 }
 
+// "Connection: close": actively close once the response is on the wire.
+// The wait happens on a fiber (CloseAfterWriteFiber), never on a
+// usercode-pool thread — a slow reader must not stall the handler pool.
+void CloseAfterWrite(Socket* s, IOBuf&& resp) {
+  Butex* done = butex_create();
+  if (s->Write(std::move(resp), done) != 0) {
+    butex_destroy(done);
+    s->SetFailed(TRPC_ESTOP);
+    return;
+  }
+  CloseWaitArg* arg = new CloseWaitArg{s->id(), done};
+  fiber_t f;
+  if (fiber_start(&f, CloseAfterWriteFiber, arg) != 0) {
+    butex_destroy(done);
+    delete arg;
+    s->SetFailed(TRPC_ESTOP);
+  }
+}
+
 }  // namespace
 
 int http_respond2(uint64_t token, int status, const char* headers_blob,
@@ -1080,32 +1201,7 @@ int http_respond2(uint64_t token, int status, const char* headers_blob,
   if (s != nullptr) {
     IOBuf resp;
     PackHttpResponse(&resp, status, headers_blob, body, body_len, keep_alive);
-    if (keep_alive) {
-      s->Write(std::move(resp));
-      // release the per-connection ordering gate and re-arm parsing so a
-      // buffered pipelined request (parse loop broke on http_inflight)
-      // gets dispatched
-      s->http_inflight.store(0, std::memory_order_release);
-      Socket::StartInputEvent(s->id());
-    } else {
-      // "Connection: close": actively close once the response is on the
-      // wire.  The wait happens on a fiber (CloseAfterWriteFiber), never
-      // on this usercode-pool thread — a slow reader must not stall the
-      // shared handler pool.
-      Butex* done = butex_create();
-      if (s->Write(std::move(resp), done) != 0) {
-        butex_destroy(done);
-        s->SetFailed(TRPC_ESTOP);
-      } else {
-        CloseWaitArg* arg = new CloseWaitArg{s->id(), done};
-        fiber_t f;
-        if (fiber_start(&f, CloseAfterWriteFiber, arg) != 0) {
-          butex_destroy(done);
-          delete arg;
-          s->SetFailed(TRPC_ESTOP);
-        }
-      }
-    }
+    ReleaseSequenced(s, ctx->pipe_seq, std::move(resp), !keep_alive);
     s->Dereference();
   }
   ctx->version.fetch_add(1, std::memory_order_release);
@@ -1239,20 +1335,24 @@ PendingCall* ClaimPending(uint64_t corr,
 
 }  // namespace
 
-class Channel {
- public:
-  std::string ip;
-  int port = 0;
-  int64_t connect_timeout_us = 500 * 1000;
-  std::string auth;  // credential riding every request meta (tag 13)
+class Channel;
+
+namespace {
+
+// One client connection: the Socket's `user` object.  Owns the sweep list
+// of in-flight calls riding it.  Shared across channels via the SocketMap
+// (single), checked in/out of a per-channel free list (pooled), or used
+// once (short).  Lifetime: hung off Socket::parse_state, freed by
+// Socket::TryRecycle after the last ref is gone; the SocketMap/pool drop
+// their pointers in the on_failed callback, which runs before recycle.
+struct ClientConn {
   std::mutex sweep_mu;
   PendingCall* sweep_head = nullptr;
-  std::mutex conn_mu;
   SocketId sock = INVALID_SOCKET_ID;
-  bool connected = false;
-  // lock-free fast path for the per-call "is the connection up" check;
-  // source of truth stays under conn_mu
-  std::atomic<SocketId> cached_sock{INVALID_SOCKET_ID};
+  std::string map_key;            // nonempty: registered in the SocketMap
+  ClientConn* pool_next = nullptr;  // pooled free-list linkage
+  Channel* pool_owner = nullptr;    // pooled: owning channel
+  bool short_lived = false;         // short: fail after the call completes
 
   void SweepLink(PendingCall* pc) {
     std::lock_guard<std::mutex> lk(sweep_mu);
@@ -1268,7 +1368,7 @@ class Channel {
   void SweepUnlink(PendingCall* pc) {
     std::lock_guard<std::mutex> lk(sweep_mu);
     if (!pc->linked) {
-      return;  // a failure sweep already detached it
+      return;  // the failure sweep already detached it
     }
     if (pc->sweep_prev != nullptr) {
       pc->sweep_prev->sweep_next = pc->sweep_next;
@@ -1282,44 +1382,79 @@ class Channel {
   }
 };
 
+// SocketMap (≙ the reference socket_map.h:49): dedupes "single"-type
+// connections across channels keyed by (ip, port, auth signature).
+// Entries hold a channel refcount; the last detaching channel fails the
+// connection (≙ SocketMapRemove closing at zero).
+struct SocketMapEntry {
+  ClientConn* conn = nullptr;
+  int channel_refs = 0;
+};
+std::mutex g_socket_map_mu;
+std::unordered_map<std::string, SocketMapEntry> g_socket_map;
+
+}  // namespace
+
+class Channel {
+ public:
+  std::string ip;
+  int port = 0;
+  int64_t connect_timeout_us = 500 * 1000;
+  std::string auth;  // credential riding every request meta (tag 13)
+  int conn_type = 0;  // 0 single (SocketMap-shared), 1 pooled, 2 short
+  // single: lock-free fast path to the live shared connection
+  std::atomic<SocketId> cached_sock{INVALID_SOCKET_ID};
+  std::mutex conn_mu;     // serializes dialing
+  bool map_attached = false;  // this channel holds one SocketMap ref
+  std::string map_key;
+  // pooled: free connections + every socket this channel ever dialed
+  std::mutex pool_mu;
+  ClientConn* pool_free = nullptr;
+  std::vector<SocketId> all_socks;  // for destroy() teardown (ids are safe)
+};
+
 namespace {
 
-// Fail every pending call that rode this connection (connection broke).
-void ChannelOnSocketFailed(Socket* s) {
+// Fail every pending call that rode this connection (connection broke),
+// and drop the SocketMap / pool references so the next call re-dials.
+void ClientConnFailed(Socket* s) {
   StreamsOnSocketFailed(s->id());
-  Channel* c = (Channel*)s->user;
-  SocketId failed_id = s->id();
+  ClientConn* conn = (ClientConn*)s->user;
+  if (!conn->map_key.empty()) {
+    std::lock_guard<std::mutex> lk(g_socket_map_mu);
+    auto it = g_socket_map.find(conn->map_key);
+    if (it != g_socket_map.end() && it->second.conn == conn) {
+      // keep the entry (and its channel_refs!) so attached channels'
+      // accounting survives reconnects; only the dead conn pointer goes
+      it->second.conn = nullptr;
+    }
+  }
+  if (conn->pool_owner != nullptr) {
+    // unlink from the owner's free list if parked there (checked-out conns
+    // are not in the list; their release sees the failed socket)
+    Channel* ch = conn->pool_owner;
+    std::lock_guard<std::mutex> lk(ch->pool_mu);
+    ClientConn** pp = &ch->pool_free;
+    while (*pp != nullptr) {
+      if (*pp == conn) {
+        *pp = conn->pool_next;
+        break;
+      }
+      pp = &(*pp)->pool_next;
+    }
+  }
   // (pc, vs snapshot) pairs: the CAS below must target the exact armed
-  // generation observed here — a slot recycled and re-armed on the new
+  // generation observed here — a slot recycled and re-armed on a newer
   // connection in between must not be spuriously failed
   std::vector<std::pair<PendingCall*, uint64_t>> mine;
   {
-    std::lock_guard<std::mutex> lk(c->sweep_mu);
-    PendingCall* p = c->sweep_head;
-    while (p != nullptr) {
-      PendingCall* next = p->sweep_next;
-      if (p->sock_id == failed_id) {
-        // detach: calls armed on a newer connection stay linked
-        if (p->sweep_prev != nullptr) {
-          p->sweep_prev->sweep_next = p->sweep_next;
-        } else {
-          c->sweep_head = p->sweep_next;
-        }
-        if (p->sweep_next != nullptr) {
-          p->sweep_next->sweep_prev = p->sweep_prev;
-        }
-        p->linked = false;
-        mine.emplace_back(p, p->vs.load(std::memory_order_acquire));
-      }
-      p = next;
+    std::lock_guard<std::mutex> lk(conn->sweep_mu);
+    for (PendingCall* p = conn->sweep_head; p != nullptr;
+         p = p->sweep_next) {
+      p->linked = false;
+      mine.emplace_back(p, p->vs.load(std::memory_order_acquire));
     }
-  }
-  {
-    std::lock_guard<std::mutex> lk(c->conn_mu);
-    if (c->sock == failed_id) {
-      c->connected = false;
-      c->cached_sock.store(INVALID_SOCKET_ID, std::memory_order_release);
-    }
+    conn->sweep_head = nullptr;
   }
   for (auto& [pc, v] : mine) {
     if ((uint32_t)v != PC_ARMED) {
@@ -1392,32 +1527,10 @@ void ChannelOnMessages(Socket* s) {
   }
 }
 
-// Returns an addressed (ref-held) socket for the channel's connection,
-// dialing if needed; nullptr on connect failure (rc_out set).  The fast
-// path is one atomic load + one Address — no lock per call.
-Socket* EnsureConnected(Channel* c, int* rc_out) {
-  SocketId cached = c->cached_sock.load(std::memory_order_acquire);
-  if (cached != INVALID_SOCKET_ID) {
-    Socket* s = Socket::Address(cached);
-    if (s != nullptr && !s->failed.load(std::memory_order_acquire)) {
-      return s;
-    }
-    if (s != nullptr) {
-      s->Dereference();
-    }
-  }
-  std::lock_guard<std::mutex> lk(c->conn_mu);
-  if (c->connected) {
-    Socket* s = Socket::Address(c->sock);
-    if (s != nullptr && !s->failed.load(std::memory_order_acquire)) {
-      return s;
-    }
-    if (s != nullptr) {
-      s->Dereference();
-    }
-    c->connected = false;
-    c->cached_sock.store(INVALID_SOCKET_ID, std::memory_order_release);
-  }
+// Dial a fresh connection to the channel's endpoint.  Returns an
+// addressed (ref-held) socket whose user is a new ClientConn, or nullptr
+// (rc_out set).  The ClientConn is freed by Socket::TryRecycle.
+Socket* DialConn(Channel* c, int* rc_out) {
   int fd = ::socket(AF_INET, SOCK_STREAM | SOCK_CLOEXEC, 0);
   if (fd < 0) {
     *rc_out = -errno;
@@ -1462,23 +1575,175 @@ Socket* EnsureConnected(Channel* c, int* rc_out) {
   }
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  ClientConn* conn = new ClientConn();
   SocketOptions opts;
   opts.fd = fd;
   opts.edge_fn = ChannelOnMessages;
-  opts.user = c;
-  opts.on_failed = ChannelOnSocketFailed;
+  opts.user = conn;
+  opts.on_failed = ClientConnFailed;
   opts.corked = true;  // caller fibers share this connection: batch writes
-  if (Socket::Create(opts, &c->sock) != 0) {
+  SocketId sid;
+  if (Socket::Create(opts, &sid) != 0) {
     ::close(fd);
+    delete conn;
     *rc_out = -ENOMEM;
     return nullptr;
   }
-  Socket* snew = Socket::Address(c->sock);  // ref for the caller
-  EventDispatcher::Instance().AddConsumer(c->sock, fd);
-  c->connected = true;
-  c->cached_sock.store(c->sock, std::memory_order_release);
+  Socket* snew = Socket::Address(sid);
+  conn->sock = sid;
+  snew->parse_state = conn;
+  snew->parse_state_free = [](void* p) { delete (ClientConn*)p; };
+  EventDispatcher::Instance().AddConsumer(sid, fd);
+  if (c->conn_type != 0) {
+    // teardown bookkeeping (single-type teardown goes through the
+    // SocketMap instead); prune recycled ids so a long-lived short-type
+    // channel doesn't accumulate one entry per call
+    std::lock_guard<std::mutex> lk(c->pool_mu);
+    if (c->all_socks.size() >= 64 &&
+        (c->all_socks.size() & (c->all_socks.size() - 1)) == 0) {
+      std::vector<SocketId> live;
+      for (SocketId old : c->all_socks) {
+        Socket* os = Socket::Address(old);
+        if (os != nullptr) {
+          live.push_back(old);
+          os->Dereference();
+        }
+      }
+      c->all_socks.swap(live);
+    }
+    c->all_socks.push_back(sid);
+  }
   *rc_out = 0;
   return snew;
+}
+
+std::string SocketMapKeyOf(const Channel* c) {
+  std::string k = c->ip;
+  k += ':';
+  k += std::to_string(c->port);
+  k += '|';
+  k += c->auth;
+  return k;
+}
+
+// single: shared connection via the SocketMap.  Fast path = one atomic
+// load + one Address; slow path dials under conn_mu and registers the
+// connection for other channels to share.
+Socket* AcquireSingle(Channel* c, int* rc_out) {
+  SocketId cached = c->cached_sock.load(std::memory_order_acquire);
+  if (cached != INVALID_SOCKET_ID) {
+    Socket* s = Socket::Address(cached);
+    if (s != nullptr && !s->failed.load(std::memory_order_acquire)) {
+      return s;
+    }
+    if (s != nullptr) {
+      s->Dereference();
+    }
+  }
+  std::lock_guard<std::mutex> lk(c->conn_mu);
+  std::string key = SocketMapKeyOf(c);
+  {
+    // another channel (or a previous call) may have a live entry
+    std::lock_guard<std::mutex> mlk(g_socket_map_mu);
+    auto it = g_socket_map.find(key);
+    if (it != g_socket_map.end() && it->second.conn != nullptr) {
+      SocketId sid = it->second.conn->sock;
+      Socket* s = Socket::Address(sid);
+      if (s != nullptr && !s->failed.load(std::memory_order_acquire)) {
+        if (!c->map_attached) {
+          it->second.channel_refs++;
+          c->map_attached = true;
+          c->map_key = key;
+        }
+        c->cached_sock.store(sid, std::memory_order_release);
+        return s;
+      }
+      if (s != nullptr) {
+        s->Dereference();
+      }
+      it->second.conn = nullptr;  // dead conn the on_failed has not reaped
+    }
+  }
+  Socket* s = DialConn(c, rc_out);
+  if (s == nullptr) {
+    return nullptr;
+  }
+  ClientConn* conn = (ClientConn*)s->user;
+  conn->map_key = key;
+  {
+    std::lock_guard<std::mutex> mlk(g_socket_map_mu);
+    SocketMapEntry& e = g_socket_map[key];  // persists across reconnects
+    e.conn = conn;
+    if (!c->map_attached) {
+      e.channel_refs++;
+    }
+  }
+  c->map_attached = true;
+  c->map_key = key;
+  c->cached_sock.store(s->id(), std::memory_order_release);
+  return s;
+}
+
+// pooled: exclusive connection per in-flight call, parked in a free list
+// between calls (≙ CONNECTION_TYPE_POOLED, controller.cpp:1112).
+Socket* AcquirePooled(Channel* c, int* rc_out) {
+  while (true) {
+    ClientConn* conn = nullptr;
+    {
+      std::lock_guard<std::mutex> lk(c->pool_mu);
+      conn = c->pool_free;
+      if (conn != nullptr) {
+        c->pool_free = conn->pool_next;
+        conn->pool_next = nullptr;
+      }
+    }
+    if (conn == nullptr) {
+      break;
+    }
+    Socket* s = Socket::Address(conn->sock);
+    if (s != nullptr && !s->failed.load(std::memory_order_acquire)) {
+      return s;
+    }
+    if (s != nullptr) {
+      s->Dereference();
+    }
+    // dead parked conn: drop it and try the next
+  }
+  Socket* s = DialConn(c, rc_out);
+  if (s != nullptr) {
+    ((ClientConn*)s->user)->pool_owner = c;
+  }
+  return s;
+}
+
+// Return a pooled connection after its call completes.  The failed check
+// happens under pool_mu so it is atomic with ClientConnFailed's free-list
+// sweep (same lock): either the failure sweep sees the parked conn, or we
+// see failed and never park it — a dead conn can't linger in the list.
+void ReleasePooled(Channel* c, Socket* s) {
+  ClientConn* conn = (ClientConn*)s->user;
+  std::lock_guard<std::mutex> lk(c->pool_mu);
+  if (s->failed.load(std::memory_order_acquire)) {
+    return;  // broken: recycle path owns it
+  }
+  conn->pool_next = c->pool_free;
+  c->pool_free = conn;
+}
+
+Socket* AcquireConn(Channel* c, int* rc_out) {
+  switch (c->conn_type) {
+    case 1:
+      return AcquirePooled(c, rc_out);
+    case 2: {
+      Socket* s = DialConn(c, rc_out);
+      if (s != nullptr) {
+        ((ClientConn*)s->user)->short_lived = true;
+      }
+      return s;
+    }
+    default:
+      return AcquireSingle(c, rc_out);
+  }
 }
 
 }  // namespace
@@ -1503,25 +1768,54 @@ void set_usercode_workers(int n) {
   g_usercode_workers.store(n, std::memory_order_relaxed);
 }
 
+void channel_set_connection_type(Channel* c, int t) {
+  c->conn_type = t;
+}
+
 void channel_destroy(Channel* c) {
-  SocketId sid = INVALID_SOCKET_ID;
+  // single: drop this channel's SocketMap ref; last one out fails the
+  // shared connection (≙ SocketMapRemove closing at zero)
+  bool fail_single = false;
+  SocketId single_sid = INVALID_SOCKET_ID;
   {
     std::lock_guard<std::mutex> lk(c->conn_mu);
-    if (c->connected) {
-      sid = c->sock;
-      c->connected = false;
-      c->cached_sock.store(INVALID_SOCKET_ID, std::memory_order_release);
+    if (c->map_attached) {
+      std::lock_guard<std::mutex> mlk(g_socket_map_mu);
+      auto it = g_socket_map.find(c->map_key);
+      if (it != g_socket_map.end() && --it->second.channel_refs <= 0) {
+        if (it->second.conn != nullptr) {
+          single_sid = it->second.conn->sock;
+          fail_single = true;
+        }
+        g_socket_map.erase(it);  // last channel out removes the entry
+      }
+      c->map_attached = false;
     }
+    c->cached_sock.store(INVALID_SOCKET_ID, std::memory_order_release);
   }
-  // SetFailed outside conn_mu: its on_failed callback re-locks conn_mu
-  if (sid != INVALID_SOCKET_ID) {
+  // which sockets may we tear down?  single: only the shared one, and
+  // only when this was the last channel ref (another channel may still be
+  // using it).  pooled/short: every socket this channel dialed.
+  std::vector<SocketId> socks;
+  if (c->conn_type == 0) {
+    if (fail_single && single_sid != INVALID_SOCKET_ID) {
+      socks.push_back(single_sid);
+    }
+  } else {
+    std::lock_guard<std::mutex> lk(c->pool_mu);
+    socks = c->all_socks;
+  }
+  for (SocketId sid : socks) {
     Socket* s = Socket::Address(sid);
     if (s != nullptr) {
       s->SetFailed(TRPC_ESTOP);
       s->Dereference();
     }
-    // wait out in-flight dispatcher fibers that still reference this
-    // channel through the socket (Address succeeds until full recycle)
+  }
+  // wait for full recycle so no fiber still references the pool
+  // structures (a checked-out conn's release runs under its socket ref,
+  // which recycle waits out)
+  for (SocketId sid : socks) {
     while (true) {
       Socket* alive = Socket::Address(sid);
       if (alive == nullptr) {
@@ -1539,7 +1833,7 @@ int channel_call(Channel* c, const char* method, const uint8_t* req,
                  int64_t timeout_us, CallResult* out, uint64_t stream,
                  uint8_t compress) {
   int rc = 0;
-  Socket* s = EnsureConnected(c, &rc);
+  Socket* s = AcquireConn(c, &rc);
   if (s == nullptr) {
     if (out != nullptr) {
       out->error_code = TRPC_EFAILEDSOCKET;
@@ -1547,6 +1841,7 @@ int channel_call(Channel* c, const char* method, const uint8_t* req,
     }
     return TRPC_EFAILEDSOCKET;
   }
+  ClientConn* conn = (ClientConn*)s->user;
   SocketId sid = s->id();
   PendingCall* pc = nullptr;
   uint32_t slot = ResourcePool<PendingCall>::Get(&pc);
@@ -1567,7 +1862,7 @@ int channel_call(Channel* c, const char* method, const uint8_t* req,
       (uint32_t)(pc->vs.load(std::memory_order_relaxed) >> 32);
   pc->vs.store(((uint64_t)ver << 32) | PC_ARMED, std::memory_order_release);
   uint64_t corr = ((uint64_t)ver << 32) | slot;
-  c->SweepLink(pc);
+  conn->SweepLink(pc);
   RpcMeta meta;
   meta.method = method;
   meta.correlation_id = corr;
@@ -1586,7 +1881,8 @@ int channel_call(Channel* c, const char* method, const uint8_t* req,
   }
   PackFrame(&frame, meta, std::move(payload), std::move(attachment));
   rc = s->Write(std::move(frame));
-  s->Dereference();
+  // the socket ref is held until after SweepUnlink: it pins `conn`
+  // (freed only at socket recycle, which waits out this ref)
   int result;
   if (rc != 0) {
     if (ClaimPending(corr) == pc) {
@@ -1638,13 +1934,21 @@ int channel_call(Channel* c, const char* method, const uint8_t* req,
   }
   pc->response.clear();
   pc->attachment.clear();
-  c->SweepUnlink(pc);
+  conn->SweepUnlink(pc);
   // bump the version before returning to the pool: a late response with
   // this corr can never match the recycled slot
   uint32_t ver2 = (uint32_t)(pc->vs.load(std::memory_order_relaxed) >> 32);
   pc->vs.store(((uint64_t)(ver2 + 1) << 32) | PC_FREE,
                std::memory_order_release);
   ResourcePool<PendingCall>::Return(slot);
+  if (conn->short_lived && !(stream != 0 && result == 0)) {
+    // one call per connection — unless a stream now rides it (then the
+    // socket lives until the stream closes / channel_destroy)
+    s->SetFailed(TRPC_ESTOP);
+  } else if (c->conn_type == 1) {
+    ReleasePooled(c, s);
+  }
+  s->Dereference();
   return result;
 }
 

@@ -136,6 +136,10 @@ void channel_destroy(Channel* c);
 void channel_set_connect_timeout(Channel* c, int64_t us);
 // Credential attached to every request meta (≙ generate_credential).
 void channel_set_auth(Channel* c, const uint8_t* secret, size_t len);
+// 0 = single (SocketMap-shared, default), 1 = pooled (exclusive conn per
+// in-flight call, parked between calls), 2 = short (one call per conn)
+// (≙ ChannelOptions.connection_type, controller.cpp:1112-1114).
+void channel_set_connection_type(Channel* c, int t);
 
 // size of the pthread pool running Python handlers (before first request)
 void set_usercode_workers(int n);

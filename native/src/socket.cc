@@ -47,10 +47,6 @@ int Socket::Create(const SocketOptions& opts, SocketId* id_out) {
   s->read_buf.clear();
   s->bytes_in.store(0, std::memory_order_relaxed);
   s->bytes_out.store(0, std::memory_order_relaxed);
-  // pooled slot may carry the previous connection's HTTP ordering gate
-  // (left set by a Connection:-close response) — a stale 1 here would make
-  // the new connection's requests sit unparsed forever
-  s->http_inflight.store(0, std::memory_order_relaxed);
   s->authed.store(false, std::memory_order_relaxed);
   s->is_h2.store(false, std::memory_order_relaxed);
   s->corked = opts.corked;
@@ -126,6 +122,13 @@ void Socket::TryRecycle(uint32_t odd_ver) {
     fd = -1;
   }
   read_buf.clear();
+  if (parse_state != nullptr && parse_state_free != nullptr) {
+    // freed here — not in on_failed — because respond paths holding an
+    // Address ref may still be using it; refs are provably gone now
+    parse_state_free(parse_state);
+  }
+  parse_state = nullptr;
+  parse_state_free = nullptr;
   ResourcePool<Socket>::Return(slot);
 }
 
@@ -388,35 +391,59 @@ EventDispatcher& EventDispatcher::Instance() {
   return *d;
 }
 
+// Set before the first socket is registered (≙ the reference's
+// event_dispatcher_num flag, event_dispatcher_epoll.cpp); later calls are
+// ignored once the dispatcher started.
+std::atomic<int> g_event_dispatcher_num{1};
+
 void EventDispatcher::Start(int nthreads) {
   bool expected = false;
   if (!started_.compare_exchange_strong(expected, true)) {
+    // another thread is initializing: wait until the epoll instances are
+    // visible — callers use EpfdFor immediately after Start returns
+    while (!ready_.load(std::memory_order_acquire)) {
+#if defined(__x86_64__)
+      __builtin_ia32_pause();
+#endif
+    }
     return;
   }
-  epfd_ = epoll_create1(EPOLL_CLOEXEC);
   if (nthreads <= 0) {
     nthreads = 1;
   }
+  if (nthreads > kMaxEpollThreads) {
+    nthreads = kMaxEpollThreads;
+  }
+  nepfd_ = nthreads;
   for (int i = 0; i < nthreads; ++i) {
-    std::thread t([this] { Loop(); });
+    epfds_[i] = epoll_create1(EPOLL_CLOEXEC);
+    int epfd = epfds_[i];
+    std::thread t([this, epfd] { Loop(epfd); });
     t.detach();
   }
+  ready_.store(true, std::memory_order_release);
+}
+
+// fd -> epoll instance: deterministic so Remove/Register find the same
+// epfd without a lookup table.
+int EventDispatcher::EpfdFor(int fd) const {
+  return epfds_[(unsigned)fd % (unsigned)nepfd_];
 }
 
 int EventDispatcher::AddConsumer(SocketId id, int fd) {
-  Start(1);
+  Start(g_event_dispatcher_num.load(std::memory_order_relaxed));
   epoll_event ev;
   memset(&ev, 0, sizeof(ev));
   ev.events = EPOLLIN | EPOLLET;
   ev.data.u64 = id;
-  return epoll_ctl(epfd_, EPOLL_CTL_ADD, fd, &ev);
+  return epoll_ctl(EpfdFor(fd), EPOLL_CTL_ADD, fd, &ev);
 }
 
 int EventDispatcher::RemoveConsumer(int fd) {
-  if (epfd_ < 0) {
+  if (nepfd_ == 0) {
     return -1;
   }
-  return epoll_ctl(epfd_, EPOLL_CTL_DEL, fd, nullptr);
+  return epoll_ctl(EpfdFor(fd), EPOLL_CTL_DEL, fd, nullptr);
 }
 
 int EventDispatcher::RegisterEpollOut(SocketId id, int fd) {
@@ -424,7 +451,7 @@ int EventDispatcher::RegisterEpollOut(SocketId id, int fd) {
   memset(&ev, 0, sizeof(ev));
   ev.events = EPOLLIN | EPOLLOUT | EPOLLET;
   ev.data.u64 = id;
-  return epoll_ctl(epfd_, EPOLL_CTL_MOD, fd, &ev);
+  return epoll_ctl(EpfdFor(fd), EPOLL_CTL_MOD, fd, &ev);
 }
 
 int EventDispatcher::UnregisterEpollOut(SocketId id, int fd) {
@@ -432,14 +459,14 @@ int EventDispatcher::UnregisterEpollOut(SocketId id, int fd) {
   memset(&ev, 0, sizeof(ev));
   ev.events = EPOLLIN | EPOLLET;
   ev.data.u64 = id;
-  return epoll_ctl(epfd_, EPOLL_CTL_MOD, fd, &ev);
+  return epoll_ctl(EpfdFor(fd), EPOLL_CTL_MOD, fd, &ev);
 }
 
-void EventDispatcher::Loop() {
+void EventDispatcher::Loop(int epfd) {
   pthread_setname_np(pthread_self(), "trpc_epoll");
   epoll_event evs[256];
   while (true) {
-    int n = epoll_wait(epfd_, evs, 256, -1);
+    int n = epoll_wait(epfd, evs, 256, -1);
     if (n < 0) {
       if (errno == EINTR) {
         continue;

@@ -66,10 +66,6 @@ class Socket {
   // running statistics
   std::atomic<uint64_t> bytes_in{0};
   std::atomic<uint64_t> bytes_out{0};
-  // HTTP/1.1 response ordering: while a pooled HTTP request is in flight
-  // the parse loop must not dispatch the next pipelined request (responses
-  // would race out of order); http_respond clears this and re-arms parsing
-  std::atomic<uint32_t> http_inflight{0};
   // server auth state: set once the first request's credential verifies
   // (≙ brpc verifying auth on a connection's first message); stream frames
   // are only honored on authed connections
@@ -77,9 +73,11 @@ class Socket {
   // set at h2 preface: gates the (mutexed) H2Conn registry lookup so
   // TRPC/HTTP/redis connections never touch the global map on reads
   std::atomic<bool> is_h2{false};
-  // opaque per-connection parser state owned by the protocol layer
-  // (rpc.cc: HttpParseState for chunked bodies); freed by on_failed
+  // opaque per-connection parser/pipelining state owned by the protocol
+  // layer (rpc.cc: ConnState); freed via parse_state_free at recycle time
+  // (after the last Address ref is gone — respond paths may touch it)
   void* parse_state = nullptr;
+  void (*parse_state_free)(void*) = nullptr;
   bool corked = false;  // see SocketOptions.corked
 
   static int Create(const SocketOptions& opts, SocketId* id_out);
@@ -113,9 +111,16 @@ class Socket {
   void TryRecycle(uint32_t odd_ver);
 };
 
-// Global epoll dispatcher threads (flag: event_dispatcher_num).
+// Global epoll dispatcher threads (flag: event_dispatcher_num): N epoll
+// instances, one thread each; sockets map to an instance by fd so all ops
+// for one fd hit the same epoll (≙ event_dispatcher_epoll.cpp's
+// event_dispatcher_num).
+extern std::atomic<int> g_event_dispatcher_num;
+
 class EventDispatcher {
  public:
+  static constexpr int kMaxEpollThreads = 16;
+
   static EventDispatcher& Instance();
   void Start(int nthreads);
   int AddConsumer(SocketId id, int fd);
@@ -125,9 +130,12 @@ class EventDispatcher {
 
  private:
   EventDispatcher() = default;
-  void Loop();
-  int epfd_ = -1;
+  void Loop(int epfd);
+  int EpfdFor(int fd) const;
+  int epfds_[kMaxEpollThreads] = {};
+  int nepfd_ = 0;
   std::atomic<bool> started_{false};
+  std::atomic<bool> ready_{false};  // epfds_/nepfd_ published
 };
 
 }  // namespace trpc

@@ -119,6 +119,34 @@ class TestHttpOrderingAndClose:
         # first response body must be "slow", second "fast"
         assert data.index(b"slow") < data.index(b"fast")
 
+    def test_pipelined_requests_execute_concurrently(self, server):
+        """A slow first request must not serialize the handlers: N
+        pipelined slow requests complete in ~one delay, not N delays
+        (≙ the reference processing pipelined HTTP concurrently and
+        ordering responses on write)."""
+        import socket as pysocket
+        import time
+
+        def slow(req: HttpRequest):
+            time.sleep(0.3)
+            return "s"
+
+        server.register_http("/conc", slow)
+        s = pysocket.create_connection(("127.0.0.1", server.port), timeout=10)
+        t0 = time.time()
+        s.sendall(b"GET /conc HTTP/1.1\r\nHost: x\r\n\r\n" * 4)
+        data = b""
+        while data.count(b"HTTP/1.1 200") < 4 and time.time() - t0 < 8:
+            chunk = s.recv(4096)
+            if not chunk:
+                break
+            data += chunk
+        elapsed = time.time() - t0
+        s.close()
+        assert data.count(b"HTTP/1.1 200") == 4
+        # serial execution would need >= 1.2s; concurrent ~0.3s
+        assert elapsed < 0.9, f"handlers serialized: {elapsed:.2f}s"
+
     def test_chunked_request_body(self, server):
         """RFC 9112 §7.1 chunked request framing, incl. split delivery,
         extensions-free sizes in hex, and a trailer section."""

@@ -174,3 +174,30 @@ class TestRespEncoding:
         assert r.array([r.integer(1), r.bulk(b"x")]) == \
             b"*2\r\n:1\r\n$1\r\nx\r\n"
         assert r.array(None) == b"*-1\r\n"
+
+
+class TestRedisPipelineConcurrency:
+    def test_slow_command_does_not_block_pipeline_execution(self):
+        """Pipelined RESP commands execute concurrently in the handler
+        pool; replies still come back in command order."""
+        import time
+        svc = r.RedisService()
+        def slow(a):
+            time.sleep(0.3)
+            return r.simple("SLOW")
+        svc.register("SLOW", slow)
+        svc.register("FAST", lambda a: r.simple("FAST"))
+        srv = Server()
+        srv.add_redis_service(svc)
+        srv.start("127.0.0.1:0")
+        try:
+            c = r.RedisClient("127.0.0.1", srv.port)
+            t0 = time.time()
+            replies = c.call_pipeline([("SLOW",), ("SLOW",), ("SLOW",),
+                                       ("FAST",)])
+            elapsed = time.time() - t0
+            assert replies == ["SLOW", "SLOW", "SLOW", "FAST"]
+            assert elapsed < 0.8, f"commands serialized: {elapsed:.2f}s"
+            c.close()
+        finally:
+            srv.destroy()

@@ -9,6 +9,7 @@ import pytest
 
 from brpc_tpu.rpc import (Channel, Controller, RpcError, Server,
                           ServerOptions, errors)
+from brpc_tpu.rpc.channel import ChannelOptions
 
 
 @pytest.fixture(scope="module")
@@ -222,3 +223,115 @@ class TestBackupRequest:
         assert resp == b"slow"
         assert cntl.backup_fired
         ch.close()
+
+
+class TestConnectionTypes:
+    """SocketMap sharing + pooled/short connection types
+    (≙ socket_map.h:49 and CONNECTION_TYPE_*, controller.cpp:1112)."""
+
+    @staticmethod
+    def _conn_count(srv):
+        import ctypes
+        from brpc_tpu._native import lib
+        buf = ctypes.create_string_buffer(65536)
+        n = lib().trpc_server_conn_stats(srv._handle, buf, len(buf))
+        return len([l for l in buf.raw[:n].split(b"\n") if l.strip()])
+
+    def test_single_channels_share_one_connection(self):
+        srv = Server()
+        srv.add_echo_service()
+        srv.start("127.0.0.1:0")
+        try:
+            a = Channel(f"127.0.0.1:{srv.port}")
+            b = Channel(f"127.0.0.1:{srv.port}")
+            assert a.call("Echo.echo", b"a") == b"a"
+            assert b.call("Echo.echo", b"b") == b"b"
+            assert self._conn_count(srv) == 1  # SocketMap deduped
+            # closing one channel must not break the other's shared conn
+            a.close()
+            assert b.call("Echo.echo", b"still") == b"still"
+            b.close()
+        finally:
+            srv.destroy()
+
+    def test_single_sharing_survives_reconnect(self):
+        """Regression: after the shared connection fails and is re-dialed,
+        the SocketMap refcount must still track both channels — closing
+        one must not kill the connection the other is using."""
+        srv = Server()
+        srv.add_echo_service()
+        srv.start("127.0.0.1:0")
+        port = srv.port
+        a = Channel(f"127.0.0.1:{port}")
+        b = Channel(f"127.0.0.1:{port}")
+        assert a.call("Echo.echo", b"1") == b"1"
+        assert b.call("Echo.echo", b"2") == b"2"
+        srv.destroy()  # drops the shared connection
+        srv2 = Server()
+        srv2.add_echo_service()
+        srv2.start(f"127.0.0.1:{port}")
+        try:
+            # both channels re-attach through the re-dialed shared conn
+            assert a.call("Echo.echo", b"3") == b"3"
+            assert b.call("Echo.echo", b"4") == b"4"
+            assert self._conn_count(srv2) == 1
+            a.close()
+            assert b.call("Echo.echo", b"5") == b"5"
+            b.close()
+        finally:
+            srv2.destroy()
+
+    def test_pooled_connections_scale_with_concurrency(self):
+        import threading
+        import time
+        ev = threading.Event()
+
+        def slowish(cntl, req):
+            ev.wait(2)
+            return req
+
+        srv = Server()
+        srv.add_service("Slow", slowish)
+        srv.start("127.0.0.1:0")
+        try:
+            ch = Channel(f"127.0.0.1:{srv.port}",
+                         options=ChannelOptions(connection_type="pooled",
+                                                timeout_ms=10000))
+            results = []
+            ts = [threading.Thread(
+                target=lambda: results.append(ch.call("Slow", b"x")))
+                for _ in range(4)]
+            [t.start() for t in ts]
+            time.sleep(0.3)  # all four parked in handlers concurrently
+            n_during = self._conn_count(srv)
+            ev.set()
+            [t.join() for t in ts]
+            assert results == [b"x"] * 4
+            assert n_during >= 2, "pooled type should open >1 connection"
+            # sequential calls afterwards reuse parked connections
+            before = self._conn_count(srv)
+            for _ in range(5):
+                assert ch.call("Slow", b"y") == b"y"
+            assert self._conn_count(srv) <= before
+            ch.close()
+        finally:
+            srv.destroy()
+
+    def test_short_connection_per_call(self):
+        import time
+        srv = Server()
+        srv.add_echo_service()
+        srv.start("127.0.0.1:0")
+        try:
+            ch = Channel(f"127.0.0.1:{srv.port}",
+                         options=ChannelOptions(connection_type="short"))
+            for i in range(3):
+                assert ch.call("Echo.echo", b"s%d" % i) == b"s%d" % i
+            # each call's connection closes after completing
+            deadline = time.time() + 5
+            while self._conn_count(srv) > 0 and time.time() < deadline:
+                time.sleep(0.05)
+            assert self._conn_count(srv) == 0
+            ch.close()
+        finally:
+            srv.destroy()
