@@ -3,6 +3,7 @@
 #include <errno.h>
 #include <linux/futex.h>
 #include <pthread.h>
+#include <signal.h>
 #include <sys/mman.h>
 #include <sys/syscall.h>
 #include <unistd.h>
@@ -17,6 +18,20 @@
 #include "object_pool.h"
 #include "timer_thread.h"
 #include "work_stealing_queue.h"
+
+// Sanitizer support: stackful context switches confuse ASAN's fake-stack
+// and TSAN's happens-before tracking unless each switch is announced via
+// the sanitizer fiber APIs (the reference relies on the same annotations
+// existing for its fcontext asm; butil/third_party/dynamic_annotations is
+// its older analogue).  Enabled automatically under -fsanitize=….
+#if defined(__SANITIZE_ADDRESS__)
+#define TRPC_ASAN 1
+#include <sanitizer/common_interface_defs.h>
+#endif
+#if defined(__SANITIZE_THREAD__)
+#define TRPC_TSAN 1
+#include <sanitizer/tsan_interface.h>
+#endif
 
 namespace trpc {
 
@@ -63,6 +78,13 @@ struct TaskMeta {
   fiber_t tid() const {
     return ((uint64_t)version.load(std::memory_order_relaxed) << 32) | slot;
   }
+
+#if defined(TRPC_ASAN)
+  void* asan_fake_stack = nullptr;  // saved across switches off this stack
+#endif
+#if defined(TRPC_TSAN)
+  void* tsan_fiber = nullptr;  // created per fiber_start, destroyed on exit
+#endif
 };
 
 // ---------------------------------------------------------------------------
@@ -111,7 +133,15 @@ struct TaskGroup {
   TaskMeta* cur = nullptr;
   RemainedCb remained;
   int index = 0;
-  uint64_t nswitch = 0;
+  std::atomic<uint64_t> nswitch{0};  // written by owner, read by stats
+#if defined(TRPC_ASAN)
+  void* main_stack_bottom = nullptr;  // worker pthread stack, for switches
+  size_t main_stack_size = 0;
+  void* main_fake_stack = nullptr;
+#endif
+#if defined(TRPC_TSAN)
+  void* main_tsan_fiber = nullptr;  // the worker thread's own tsan context
+#endif
 
   void set_remained(void (*fn)(void*), void* arg) {
     remained.fn = fn;
@@ -217,8 +247,57 @@ void run_remained(TaskGroup* g) {
 
 void cb_ready_to_run(void* p) { ready_to_run((TaskMeta*)p); }
 
+// --- sanitizer switch annotations (no-ops in normal builds) ---------------
+// Call order around every tctx_jump: san_switch_out on the departing
+// stack immediately before the jump, san_switch_in on the arriving stack
+// immediately after.
+inline void san_switch_to_fiber(TaskGroup* g, TaskMeta* m) {
+#if defined(TRPC_TSAN)
+  __tsan_switch_to_fiber(m->tsan_fiber, 0);
+#endif
+#if defined(TRPC_ASAN)
+  __sanitizer_start_switch_fiber(&g->main_fake_stack, m->stack->base,
+                                 kStackSize);
+#endif
+  (void)g;
+  (void)m;
+}
+
+inline void san_arrive_main(TaskGroup* g) {
+#if defined(TRPC_ASAN)
+  __sanitizer_finish_switch_fiber(g->main_fake_stack, nullptr, nullptr);
+#endif
+  (void)g;
+}
+
+// `dying`: the fiber is exiting for good — ASAN destroys its fake stack.
+inline void san_switch_to_main(TaskGroup* g, TaskMeta* m, bool dying) {
+#if defined(TRPC_TSAN)
+  __tsan_switch_to_fiber(g->main_tsan_fiber, 0);
+#endif
+#if defined(TRPC_ASAN)
+  __sanitizer_start_switch_fiber(dying ? nullptr : &m->asan_fake_stack,
+                                 g->main_stack_bottom, g->main_stack_size);
+#endif
+  (void)g;
+  (void)m;
+  (void)dying;
+}
+
+inline void san_arrive_fiber(TaskMeta* m) {
+#if defined(TRPC_ASAN)
+  __sanitizer_finish_switch_fiber(m->asan_fake_stack, nullptr, nullptr);
+#endif
+  (void)m;
+}
+// --------------------------------------------------------------------------
+
 void cb_finish_fiber(void* p) {
   TaskMeta* m = (TaskMeta*)p;
+#if defined(TRPC_TSAN)
+  __tsan_destroy_fiber(m->tsan_fiber);
+  m->tsan_fiber = nullptr;
+#endif
   ObjectPool<StackMem>::Return(m->stack);
   m->stack = nullptr;
   uint32_t newver = m->version.load(std::memory_order_relaxed) + 1;
@@ -232,6 +311,7 @@ void cb_finish_fiber(void* p) {
 // First frame of every fiber.
 void fiber_entry(void* p) {
   TaskMeta* m = (TaskMeta*)p;
+  san_arrive_fiber(m);
   {
     TaskGroup* g = tls_group;
     run_remained(g);  // remained set by the context that jumped to us
@@ -240,6 +320,7 @@ void fiber_entry(void* p) {
   // exit: recycle on the worker stack after we've switched off this one
   TaskGroup* g = tls_group;  // may differ from entry group
   g->set_remained(cb_finish_fiber, m);
+  san_switch_to_main(g, m, /*dying=*/true);
   tctx_jump(&m->sp, g->main_sp, nullptr);
   __builtin_unreachable();
 }
@@ -252,8 +333,13 @@ void run_fiber(TaskGroup* g, fiber_t tid) {
     return;  // already finished (stale tid)
   }
   g->cur = m;
-  ++g->nswitch;
+  // single-writer counter: plain load+store keeps the lock-prefixed RMW
+  // off the context-switch hot path; stats reads stay race-free
+  g->nswitch.store(g->nswitch.load(std::memory_order_relaxed) + 1,
+                   std::memory_order_relaxed);
+  san_switch_to_fiber(g, m);
   tctx_jump(&g->main_sp, m->sp, m);
+  san_arrive_main(g);
   g->cur = nullptr;
   run_remained(g);
 }
@@ -263,6 +349,18 @@ void worker_main(TaskGroup* g) {
   snprintf(name, sizeof(name), "trpc_w%d", g->index);
   pthread_setname_np(pthread_self(), name);
   tls_group = g;
+#if defined(TRPC_ASAN)
+  {
+    pthread_attr_t attr;
+    pthread_getattr_np(pthread_self(), &attr);
+    pthread_attr_getstack(&attr, &g->main_stack_bottom,
+                          &g->main_stack_size);
+    pthread_attr_destroy(&attr);
+  }
+#endif
+#if defined(TRPC_TSAN)
+  g->main_tsan_fiber = __tsan_get_current_fiber();
+#endif
   while (true) {
     fiber_t tid;
     if (next_task(g, &tid)) {
@@ -282,7 +380,9 @@ void worker_main(TaskGroup* g) {
 // Called on the fiber stack to give up the CPU; resumes when re-run.
 void sched_away(TaskMeta* m) {
   TaskGroup* g = tls_group;
+  san_switch_to_main(g, m, /*dying=*/false);
   tctx_jump(&m->sp, g->main_sp, nullptr);
+  san_arrive_fiber(m);
   // resumed, possibly on a different worker: nothing to do — callers must
   // re-read tls_group themselves.
 }
@@ -292,11 +392,41 @@ void sched_away(TaskMeta* m) {
 // ---------------------------------------------------------------------------
 // Butex
 
+// Waiter-list lock.  A plain atomic spinlock, NOT std::mutex: the fiber
+// wait path locks it on the fiber stack and releases it from the worker's
+// remained callback after the context switch — legal for an atomic, but a
+// cross-context unlock that std::mutex's ownership model (and TSAN)
+// rightly rejects.  Critical sections are a handful of pointer ops.
+class ListLock {
+ public:
+  void lock() {
+    while (locked_.exchange(true, std::memory_order_acquire)) {
+      while (locked_.load(std::memory_order_relaxed)) {
+#if defined(__x86_64__)
+        __builtin_ia32_pause();
+#endif
+      }
+    }
+  }
+  void unlock() { locked_.store(false, std::memory_order_release); }
+
+ private:
+  std::atomic<bool> locked_{false};
+};
+
+// Pthread waiters' private handoff (heavy: pthread mutex + condvar).
+// Lives on the waiting pthread's stack; fiber waiters and the per-Butex
+// sentinel never construct one.
+struct PthreadSync {
+  std::mutex wmu;              // guards signaled
+  std::condition_variable cv;
+  bool signaled = false;
+};
+
 struct ButexWaiter {
   enum Kind { FIBER, PTHREAD } kind = FIBER;
   TaskMeta* meta = nullptr;          // FIBER
-  std::condition_variable cv;        // PTHREAD
-  bool signaled = false;             // PTHREAD
+  PthreadSync* psync = nullptr;      // PTHREAD
   int result = 0;                    // 0 woken; ETIMEDOUT
   ButexWaiter* next = nullptr;
   ButexWaiter* prev = nullptr;
@@ -306,7 +436,7 @@ struct ButexWaiter {
 
 struct Butex {
   std::atomic<int32_t> value{0};
-  std::mutex mu;
+  ListLock mu;
   ButexWaiter head;  // sentinel of doubly-linked ring
 
   Butex() { head.next = head.prev = &head; }
@@ -335,48 +465,64 @@ std::atomic<int32_t>& butex_value(Butex* b) { return b->value; }
 
 namespace {
 
-struct WaitUnlockArg {
-  std::mutex* mu;
-};
-
-void cb_unlock_mutex(void* p) { ((std::mutex*)p)->unlock(); }
+void cb_unlock_listlock(void* p) { ((ListLock*)p)->unlock(); }
 
 void butex_timeout_cb(void* p) {
   ButexWaiter* w = (ButexWaiter*)p;
   Butex* b = w->owner;
-  std::unique_lock<std::mutex> lk(b->mu);
+  b->mu.lock();
   if (!w->linked) {
+    b->mu.unlock();
     return;  // already woken normally
   }
   Butex::unlink(w);
   w->result = ETIMEDOUT;
   TaskMeta* m = w->meta;
-  lk.unlock();
+  b->mu.unlock();
   ready_to_run(m);
 }
 
+// Pthread wait: link under the list lock, then block on the waiter's own
+// mutex+cv.  Liveness of `w` (a stack object) across the unlink race: a
+// waker unlinks under b->mu then sets signaled under w->wmu; the waiter
+// never returns until it either unlinked itself under b->mu or observed
+// signaled — so the waker's accesses always land on a live frame.
 int butex_wait_pthread(Butex* b, int32_t expected, int64_t timeout_us) {
-  std::unique_lock<std::mutex> lk(b->mu);
+  b->mu.lock();
   if (b->value.load(std::memory_order_acquire) != expected) {
+    b->mu.unlock();
     errno = EWOULDBLOCK;
     return -1;
   }
+  PthreadSync ps;
   ButexWaiter w;
   w.kind = ButexWaiter::PTHREAD;
+  w.psync = &ps;
   b->link(&w);
+  b->mu.unlock();
   bool timed_out = false;
-  if (timeout_us < 0) {
-    w.cv.wait(lk, [&] { return w.signaled; });
-  } else {
-    timed_out = !w.cv.wait_for(lk, std::chrono::microseconds(timeout_us),
-                               [&] { return w.signaled; });
+  {
+    std::unique_lock<std::mutex> lk(ps.wmu);
+    if (timeout_us < 0) {
+      ps.cv.wait(lk, [&] { return ps.signaled; });
+    } else {
+      timed_out = !ps.cv.wait_for(lk, std::chrono::microseconds(timeout_us),
+                                  [&] { return ps.signaled; });
+    }
   }
   if (timed_out) {
+    b->mu.lock();
     if (w.linked) {
       Butex::unlink(&w);
+      b->mu.unlock();
+      errno = ETIMEDOUT;
+      return -1;
     }
-    errno = ETIMEDOUT;
-    return -1;
+    b->mu.unlock();
+    // a waker unlinked us between the timeout and the lock: it is about
+    // to signal; wait it out so its notify hits a live frame
+    std::unique_lock<std::mutex> lk(ps.wmu);
+    ps.cv.wait(lk, [&] { return ps.signaled; });
   }
   return 0;
 }
@@ -406,7 +552,7 @@ int butex_wait(Butex* b, int32_t expected, int64_t timeout_us) {
     // completes — so it can never see a half-switched fiber.
     tt = timer_add(monotonic_us() + timeout_us, butex_timeout_cb, &w);
   }
-  g->set_remained(cb_unlock_mutex, &b->mu);
+  g->set_remained(cb_unlock_listlock, &b->mu);
   sched_away(m);
   // Resumed: the waker (or the timeout) unlinked us before ready_to_run.
   if (tt != nullptr) {
@@ -423,26 +569,42 @@ namespace {
 int butex_wake_some(Butex* b, int limit) {
   int woken = 0;
   TaskMeta* to_run[16];
-  int nrun = 0;
-  {
-    std::lock_guard<std::mutex> lk(b->mu);
-    while (woken < limit) {
-      ButexWaiter* w = b->first();
-      if (w == nullptr) {
-        break;
-      }
-      Butex::unlink(w);
-      w->result = 0;
-      if (w->kind == ButexWaiter::PTHREAD) {
-        w->signaled = true;
-        w->cv.notify_one();  // under mu: &w stays valid while linked-or-locked
-      } else if (nrun < 16) {
-        to_run[nrun++] = w->meta;
-      } else {
-        ready_to_run(w->meta);  // overflow: enqueue under lock (rare)
-      }
-      ++woken;
+  ButexWaiter* to_signal[16];
+  int nrun = 0, nsig = 0;
+  b->mu.lock();
+  while (woken < limit) {
+    ButexWaiter* w = b->first();
+    if (w == nullptr) {
+      break;
     }
+    Butex::unlink(w);
+    w->result = 0;
+    if (w->kind == ButexWaiter::PTHREAD) {
+      // signal outside the list lock; the waiter frame stays valid until
+      // signaled is observed (see butex_wait_pthread's liveness note)
+      if (nsig < 16) {
+        to_signal[nsig++] = w;
+      } else {
+        std::lock_guard<std::mutex> g(w->psync->wmu);
+        w->psync->signaled = true;
+        w->psync->cv.notify_one();
+      }
+    } else if (nrun < 16) {
+      to_run[nrun++] = w->meta;
+    } else {
+      ready_to_run(w->meta);  // overflow: enqueue under lock (rare)
+    }
+    ++woken;
+  }
+  b->mu.unlock();
+  for (int i = 0; i < nsig; ++i) {
+    PthreadSync* ps = to_signal[i]->psync;
+    // notify while holding wmu: the waiter can only pass its wait (and
+    // destroy the stack-allocated cv) after acquiring wmu, i.e. after
+    // this signal call has fully completed
+    std::lock_guard<std::mutex> g(ps->wmu);
+    ps->signaled = true;
+    ps->cv.notify_one();
   }
   for (int i = 0; i < nrun; ++i) {
     ready_to_run(to_run[i]);
@@ -462,6 +624,10 @@ int fiber_runtime_init(int num_workers) {
   if (!g_control.started.compare_exchange_strong(expected, true)) {
     return 0;
   }
+  // writes to peers that vanished mid-call must surface as EPIPE, not
+  // kill the process (≙ GlobalInitializeOrDie ignoring SIGPIPE,
+  // global.cpp).  Python hosts already ignore it; native binaries don't.
+  signal(SIGPIPE, SIG_IGN);
   timer_thread_start();
   if (num_workers <= 0) {
     num_workers = (int)std::thread::hardware_concurrency();
@@ -504,6 +670,12 @@ int fiber_start(fiber_t* out, FiberFn fn, void* arg) {
   m->arg = arg;
   m->stack = ObjectPool<StackMem>::Get();
   m->sp = tctx_make(m->stack->base, kStackSize, fiber_entry);
+#if defined(TRPC_ASAN)
+  m->asan_fake_stack = nullptr;  // fresh stack: first finish gets no save
+#endif
+#if defined(TRPC_TSAN)
+  m->tsan_fiber = __tsan_create_fiber(0);
+#endif
   butex_value(m->join_butex)
       .store((int32_t)m->version.load(std::memory_order_relaxed),
              std::memory_order_release);
@@ -568,7 +740,7 @@ FiberRuntimeStats fiber_runtime_stats() {
   s.fibers_created = g_control.nfibers.load(std::memory_order_relaxed);
   uint64_t sw = 0;
   for (auto* g : g_control.groups) {
-    sw += g->nswitch;
+    sw += g->nswitch.load(std::memory_order_relaxed);
   }
   s.context_switches = sw;
   s.steals = g_control.nsteals.load(std::memory_order_relaxed);

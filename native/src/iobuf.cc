@@ -39,25 +39,35 @@ void IOBlock::Unref() {
   }
 }
 
-// Per-thread active tail block.
-static thread_local IOBlock* g_tls_block = nullptr;
+// Per-thread active tail block, dropped at thread exit (short-lived
+// threads must not strand their tail block).
+struct TlsBlockHolder {
+  IOBlock* b = nullptr;
+  ~TlsBlockHolder() {
+    if (b != nullptr) {
+      b->Unref();
+      b = nullptr;
+    }
+  }
+};
+static thread_local TlsBlockHolder g_tls_block;
 
 IOBlock* tls_acquire_block() {
-  IOBlock* b = g_tls_block;
+  IOBlock* b = g_tls_block.b;
   if (b == nullptr || b->spare() == 0) {
     if (b != nullptr) {
       b->Unref();
     }
     b = IOBlock::New();
-    g_tls_block = b;
+    g_tls_block.b = b;
   }
   return b;
 }
 
 void tls_release_block() {
-  if (g_tls_block != nullptr) {
-    g_tls_block->Unref();
-    g_tls_block = nullptr;
+  if (g_tls_block.b != nullptr) {
+    g_tls_block.b->Unref();
+    g_tls_block.b = nullptr;
   }
 }
 
@@ -206,8 +216,10 @@ std::string IOBuf::to_string() const {
 }
 
 // Unused fresh block kept per thread so append_from_fd does not pay a
-// malloc/free round-trip per short read.
-static thread_local IOBlock* g_tls_spare = nullptr;
+// malloc/free round-trip per short read; released at thread exit like
+// the tail block above.
+static thread_local TlsBlockHolder g_tls_spare_holder;
+#define g_tls_spare g_tls_spare_holder.b
 
 ssize_t IOBuf::append_from_fd(int fd, size_t max, bool* eof) {
   if (eof != nullptr) {

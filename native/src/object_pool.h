@@ -40,9 +40,23 @@ class ObjectPool {
   }
 
  private:
+  // thread exit spills the cache back to the global list so short-lived
+  // threads don't strand objects
+  struct TlsCache {
+    std::vector<T*> v;
+    ~TlsCache() {
+      if (v.empty()) {
+        return;
+      }
+      std::lock_guard<std::mutex> lk(mu());
+      auto& g = global();
+      g.insert(g.end(), v.begin(), v.end());
+      v.clear();
+    }
+  };
   static std::vector<T*>& tls_cache() {
-    static thread_local std::vector<T*> c;
-    return c;
+    static thread_local TlsCache c;
+    return c.v;
   }
   // leaked on purpose: runtime threads outlive static destruction
   static std::mutex& mu() {
@@ -138,9 +152,23 @@ class ResourcePool {
     static std::vector<uint32_t>* g = new std::vector<uint32_t>();
     return *g;
   }
+  // thread exit returns cached ids to the global free list (otherwise a
+  // short-lived thread permanently strands up to kTlsMax slots)
+  struct TlsFree {
+    std::vector<uint32_t> v;
+    ~TlsFree() {
+      if (v.empty()) {
+        return;
+      }
+      std::lock_guard<std::mutex> lk(mu());
+      auto& g = global_free();
+      g.insert(g.end(), v.begin(), v.end());
+      v.clear();
+    }
+  };
   static std::vector<uint32_t>& tls_free() {
-    static thread_local std::vector<uint32_t> c;
-    return c;
+    static thread_local TlsFree c;
+    return c.v;
   }
   static uint32_t& nslab() {
     static uint32_t n = 0;

@@ -841,12 +841,13 @@ void ServerOnMessages(Socket* s) {
 void ServerConnFailed(Socket* s) {
   // parse_state (ConnState) is NOT freed here: respond paths holding an
   // Address ref may still touch it; Socket::TryRecycle frees it via
-  // parse_state_free once the last ref is gone
+  // parse_state_free once the last ref is gone.  The id deliberately
+  // STAYS in srv->conns: server_destroy must WaitRecycled every accepted
+  // connection, including ones that failed moments before destroy (their
+  // fibers may still hold refs into Server).  Recycled ids are pruned at
+  // accept time.
   H2ConnDestroy(s->id());
   StreamsOnSocketFailed(s->id());
-  Server* srv = (Server*)s->user;
-  std::lock_guard<std::mutex> lk(srv->conns_mu);
-  srv->conns.erase(s->id());
 }
 
 // edge_fn of the acceptor socket (≙ Acceptor::OnNewConnections,
@@ -874,6 +875,18 @@ void OnNewConnections(Socket* listen_s) {
     {
       std::lock_guard<std::mutex> lk(srv->conns_mu);
       srv->conns[id] = true;
+      // amortized prune of fully-recycled ids so a long-lived server's
+      // table tracks live connections, not history
+      if (srv->conns.size() >= 64 &&
+          (srv->conns.size() & (srv->conns.size() - 1)) == 0) {
+        for (auto it = srv->conns.begin(); it != srv->conns.end();) {
+          if (Socket::IsRecycled(it->first)) {
+            it = srv->conns.erase(it);
+          } else {
+            ++it;
+          }
+        }
+      }
     }
     EventDispatcher::Instance().AddConsumer(id, fd);
   }
@@ -1045,24 +1058,13 @@ void server_destroy(Server* s) {
       cs->Dereference();
     }
   }
+  // Wait for each connection's generation to fully recycle — not merely
+  // for Address() to fail (which happens at SetFailed, while processing
+  // fibers still hold refs and read Server* through socket->user).
   for (SocketId id : conns) {
-    while (true) {
-      Socket* cs = Socket::Address(id);
-      if (cs == nullptr) {
-        break;
-      }
-      cs->Dereference();
-      usleep(1000);
-    }
+    Socket::WaitRecycled(id);
   }
-  while (true) {
-    Socket* ls = Socket::Address(s->listen_sock);
-    if (ls == nullptr) {
-      break;
-    }
-    ls->Dereference();
-    usleep(1000);
-  }
+  Socket::WaitRecycled(s->listen_sock);
   delete s;
 }
 
@@ -1124,14 +1126,12 @@ void CloseAfterWriteFiber(void* a) {
     budget_us -= 100 * 1000;
     Socket* s = Socket::Address(arg->id);
     if (s == nullptr) {
-      butex_destroy(arg->done);
-      delete arg;
-      return;  // already recycled
+      break;  // failed (possibly not yet recycled): close path below
     }
     bool failed = s->failed.load(std::memory_order_acquire);
     s->Dereference();
     if (failed) {
-      break;  // peer already gone; the write notify won't fire
+      break;
     }
   }
   Socket* s = Socket::Address(arg->id);
@@ -1139,6 +1139,11 @@ void CloseAfterWriteFiber(void* a) {
     s->SetFailed(TRPC_ESTOP);
     s->Dereference();
   }
+  // The KeepWrite drain wakes notify butexes on the failure path too, and
+  // it may still be running: it finishes before the socket recycles (it
+  // holds a socket ref), so destroying `done` is only safe after the
+  // generation fully recycles.
+  Socket::WaitRecycled(arg->id);
   butex_destroy(arg->done);
   delete arg;
 }
@@ -1290,7 +1295,11 @@ struct PendingCall {
   PendingCall* sweep_prev = nullptr;
   PendingCall* sweep_next = nullptr;
   bool linked = false;
-  SocketId sock_id = INVALID_SOCKET_ID;  // connection this call rode
+  // connection this call rode; atomic because ClaimPending reads it from
+  // the response fiber concurrently with the caller re-arming the slot
+  // for a new call (the vs version check rejects stale claims, but the
+  // read itself must not be a data race)
+  std::atomic<SocketId> sock_id{INVALID_SOCKET_ID};
   int32_t error_code = 0;
   std::string error_text;
   IOBuf response;
@@ -1319,10 +1328,12 @@ PendingCall* ClaimPending(uint64_t corr,
   if (pc->vs.load(std::memory_order_acquire) != expected) {
     return nullptr;
   }
-  // sock_id is written before the ARMED store (release) and stable while
-  // armed, so this read is ordered; checking before the CAS means a
-  // mismatched claim never transitions the state (no revert race)
-  if (expect_sock != INVALID_SOCKET_ID && pc->sock_id != expect_sock) {
+  // sock_id is stored before the ARMED release-store and stable while
+  // armed, so after the acquire load of vs this value is the armed
+  // generation's; checking before the CAS means a mismatched claim never
+  // transitions the state (no revert race)
+  if (expect_sock != INVALID_SOCKET_ID &&
+      pc->sock_id.load(std::memory_order_relaxed) != expect_sock) {
     return nullptr;
   }
   if (!pc->vs.compare_exchange_strong(
@@ -1350,7 +1361,6 @@ struct ClientConn {
   PendingCall* sweep_head = nullptr;
   SocketId sock = INVALID_SOCKET_ID;
   std::string map_key;            // nonempty: registered in the SocketMap
-  ClientConn* pool_next = nullptr;  // pooled free-list linkage
   Channel* pool_owner = nullptr;    // pooled: owning channel
   bool short_lived = false;         // short: fail after the call completes
 
@@ -1407,9 +1417,12 @@ class Channel {
   std::mutex conn_mu;     // serializes dialing
   bool map_attached = false;  // this channel holds one SocketMap ref
   std::string map_key;
-  // pooled: free connections + every socket this channel ever dialed
+  // pooled: free connections + every socket this channel ever dialed.
+  // The free list holds SocketIds, never ClientConn*: a parked connection
+  // owns no socket ref, so its conn may be freed by socket recycle at any
+  // time — ids stay safe to Address (stale ids just fail the lookup)
   std::mutex pool_mu;
-  ClientConn* pool_free = nullptr;
+  std::vector<SocketId> pool_free;
   std::vector<SocketId> all_socks;  // for destroy() teardown (ids are safe)
 };
 
@@ -1433,14 +1446,15 @@ void ClientConnFailed(Socket* s) {
     // unlink from the owner's free list if parked there (checked-out conns
     // are not in the list; their release sees the failed socket)
     Channel* ch = conn->pool_owner;
+    SocketId sid = conn->sock;
     std::lock_guard<std::mutex> lk(ch->pool_mu);
-    ClientConn** pp = &ch->pool_free;
-    while (*pp != nullptr) {
-      if (*pp == conn) {
-        *pp = conn->pool_next;
+    auto& v = ch->pool_free;
+    for (size_t i = 0; i < v.size(); ++i) {
+      if (v[i] == sid) {
+        v[i] = v.back();
+        v.pop_back();
         break;
       }
-      pp = &(*pp)->pool_next;
     }
   }
   // (pc, vs snapshot) pairs: the CAS below must target the exact armed
@@ -1670,37 +1684,64 @@ Socket* AcquireSingle(Channel* c, int* rc_out) {
   }
   ClientConn* conn = (ClientConn*)s->user;
   conn->map_key = key;
+  // Re-check the map under the lock: another channel (each dials under
+  // its own conn_mu) may have registered a live connection while we were
+  // dialing.  Registering ours on top would orphan theirs — adopt the
+  // winner and discard our dial instead.  SetFailed must run outside
+  // g_socket_map_mu (ClientConnFailed reacquires it).
+  Socket* adopted = nullptr;
   {
     std::lock_guard<std::mutex> mlk(g_socket_map_mu);
     SocketMapEntry& e = g_socket_map[key];  // persists across reconnects
-    e.conn = conn;
+    if (e.conn != nullptr) {
+      Socket* other = Socket::Address(e.conn->sock);
+      if (other != nullptr &&
+          !other->failed.load(std::memory_order_acquire)) {
+        adopted = other;
+      } else {
+        if (other != nullptr) {
+          other->Dereference();
+        }
+        e.conn = conn;  // replace the dead loser
+      }
+    } else {
+      e.conn = conn;
+    }
     if (!c->map_attached) {
       e.channel_refs++;
     }
   }
   c->map_attached = true;
   c->map_key = key;
+  if (adopted != nullptr) {
+    c->cached_sock.store(adopted->id(), std::memory_order_release);
+    s->SetFailed(TRPC_ESTOP);  // close the redundant dial
+    s->Dereference();
+    return adopted;
+  }
   c->cached_sock.store(s->id(), std::memory_order_release);
   return s;
 }
 
 // pooled: exclusive connection per in-flight call, parked in a free list
-// between calls (≙ CONNECTION_TYPE_POOLED, controller.cpp:1112).
+// between calls (≙ CONNECTION_TYPE_POOLED, controller.cpp:1112).  Popping
+// an id and Address()ing it is the only safe order: only once Address
+// succeeds do we hold a ref pinning the conn; a recycled id simply fails
+// the lookup and is dropped.
 Socket* AcquirePooled(Channel* c, int* rc_out) {
   while (true) {
-    ClientConn* conn = nullptr;
+    SocketId sid = INVALID_SOCKET_ID;
     {
       std::lock_guard<std::mutex> lk(c->pool_mu);
-      conn = c->pool_free;
-      if (conn != nullptr) {
-        c->pool_free = conn->pool_next;
-        conn->pool_next = nullptr;
+      if (!c->pool_free.empty()) {
+        sid = c->pool_free.back();
+        c->pool_free.pop_back();
       }
     }
-    if (conn == nullptr) {
+    if (sid == INVALID_SOCKET_ID) {
       break;
     }
-    Socket* s = Socket::Address(conn->sock);
+    Socket* s = Socket::Address(sid);
     if (s != nullptr && !s->failed.load(std::memory_order_acquire)) {
       return s;
     }
@@ -1718,16 +1759,15 @@ Socket* AcquirePooled(Channel* c, int* rc_out) {
 
 // Return a pooled connection after its call completes.  The failed check
 // happens under pool_mu so it is atomic with ClientConnFailed's free-list
-// sweep (same lock): either the failure sweep sees the parked conn, or we
-// see failed and never park it — a dead conn can't linger in the list.
+// sweep (same lock): either the failure sweep sees the parked id, or we
+// see failed and never park it — a dead id can't linger in the list
+// (and even if one did, AcquirePooled's Address check drops it safely).
 void ReleasePooled(Channel* c, Socket* s) {
-  ClientConn* conn = (ClientConn*)s->user;
   std::lock_guard<std::mutex> lk(c->pool_mu);
   if (s->failed.load(std::memory_order_acquire)) {
     return;  // broken: recycle path owns it
   }
-  conn->pool_next = c->pool_free;
-  c->pool_free = conn;
+  c->pool_free.push_back(s->id());
 }
 
 Socket* AcquireConn(Channel* c, int* rc_out) {
@@ -1816,14 +1856,7 @@ void channel_destroy(Channel* c) {
   // structures (a checked-out conn's release runs under its socket ref,
   // which recycle waits out)
   for (SocketId sid : socks) {
-    while (true) {
-      Socket* alive = Socket::Address(sid);
-      if (alive == nullptr) {
-        break;
-      }
-      alive->Dereference();
-      usleep(1000);
-    }
+    Socket::WaitRecycled(sid);
   }
   delete c;
 }
@@ -1857,7 +1890,7 @@ int channel_call(Channel* c, const char* method, const uint8_t* req,
   pc->stream_id = 0;
   pc->stream_window = 0;
   pc->compress_type = 0;
-  pc->sock_id = sid;
+  pc->sock_id.store(sid, std::memory_order_relaxed);
   uint32_t ver =
       (uint32_t)(pc->vs.load(std::memory_order_relaxed) >> 32);
   pc->vs.store(((uint64_t)ver << 32) | PC_ARMED, std::memory_order_release);

@@ -97,6 +97,46 @@ void Socket::Dereference() {
   }
 }
 
+namespace {
+// One global recycle-generation butex: TryRecycle bumps it, teardown
+// waiters (server_destroy/channel_destroy) sleep on it instead of
+// polling.  Global (not per-socket) because waiters are rare and slots
+// recycle constantly.
+Butex* recycle_butex() {
+  static Butex* b = butex_create();  // leaked on purpose
+  return b;
+}
+}  // namespace
+
+bool Socket::IsRecycled(SocketId id) {
+  Socket* s = ResourcePool<Socket>::Address((uint32_t)id);
+  if (s == nullptr) {
+    return false;  // slot never allocated: nothing to wait for
+  }
+  uint32_t idver = (uint32_t)(id >> 32);
+  uint32_t ver =
+      (uint32_t)(s->versioned_ref.load(std::memory_order_acquire) >> 32);
+  // live generation is idver (even), failed-draining is idver|1; anything
+  // else means the generation completed TryRecycle
+  return ver != idver && ver != (idver | 1);
+}
+
+void Socket::WaitRecycled(SocketId id) {
+  if (id == INVALID_SOCKET_ID) {
+    return;
+  }
+  Butex* b = recycle_butex();
+  while (true) {
+    int32_t gen = butex_value(b).load(std::memory_order_acquire);
+    if (IsRecycled(id)) {
+      return;
+    }
+    // 100ms safety timeout guards against a recycle that raced the gen
+    // snapshot; normal wakes arrive via the TryRecycle bump
+    butex_wait(b, gen, 100 * 1000);
+  }
+}
+
 // Only the caller that CASes (odd_ver, count 0) -> (odd_ver+1, count 0)
 // performs the recycle.  Spins out transient stale-Address increments.
 void Socket::TryRecycle(uint32_t odd_ver) {
@@ -130,6 +170,10 @@ void Socket::TryRecycle(uint32_t odd_ver) {
   parse_state = nullptr;
   parse_state_free = nullptr;
   ResourcePool<Socket>::Return(slot);
+  // announce the completed recycle to teardown waiters (WaitRecycled)
+  Butex* b = recycle_butex();
+  butex_value(b).fetch_add(1, std::memory_order_release);
+  butex_wake_all(b);
 }
 
 void Socket::SetFailed(int err) {
@@ -363,11 +407,12 @@ void Socket::RunKeepWrite(WriteRequest* req) {
       }
       s->SetFailed(errno != 0 ? errno : EPIPE);
     }
-    if (!s->failed.load(std::memory_order_acquire)) {
-      for (Butex* b : notifies) {
-        butex_value(b).fetch_add(1, std::memory_order_release);
-        butex_wake_all(b);
-      }
+    // wake notify waiters on success AND failure: a waiter parked on a
+    // write that will never happen (socket failed, batch discarded) must
+    // not stall until its timeout — it observes s->failed after waking
+    for (Butex* b : notifies) {
+      butex_value(b).fetch_add(1, std::memory_order_release);
+      butex_wake_all(b);
     }
     notifies.clear();
     // req is the last absorbed; if head still == req, the queue is empty

@@ -92,6 +92,15 @@ class Socket {
   // Mark broken: wakes writers, runs on_failed once, drops the owner ref.
   void SetFailed(int err);
 
+  // True once the id's generation has fully recycled: every ref is gone,
+  // the fd is closed and parse_state freed.  Safe on stale ids (slot
+  // memory is stable in the ResourcePool slab).
+  static bool IsRecycled(SocketId id);
+  // Event-driven wait for IsRecycled (≙ the reference joining a socket's
+  // refs out during teardown) — no fixed-interval sleep loop; wakes on a
+  // global recycle-generation butex bumped by every TryRecycle.
+  static void WaitRecycled(SocketId id);
+
   // Wait-free write; takes ownership of data.  Returns 0 or -errno.
   int Write(IOBuf&& data, Butex* notify = nullptr);
 
