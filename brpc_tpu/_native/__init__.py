@@ -205,6 +205,35 @@ def _declare(L: ctypes.CDLL) -> None:
     L.trpc_stream_pending_bytes.argtypes = [c.c_uint64]
     L.trpc_stream_pending_bytes.restype = c.c_int64
 
+    # device data plane (native/src/tpu.h: PJRT plugin dlopen'd at runtime)
+    L.trpc_tpu_plane_init.argtypes = [c.c_char_p]
+    L.trpc_tpu_plane_init.restype = c.c_int
+    L.trpc_tpu_plane_available.restype = c.c_int
+    L.trpc_tpu_plane_error.restype = c.c_char_p
+    L.trpc_tpu_plane_platform.restype = c.c_char_p
+    L.trpc_tpu_device_count.restype = c.c_int
+    L.trpc_tpu_h2d.argtypes = [c.c_char_p, c.c_size_t, c.c_int]
+    L.trpc_tpu_h2d.restype = c.c_uint64
+    L.trpc_tpu_buf_wait.argtypes = [c.c_uint64, c.c_int64]
+    L.trpc_tpu_buf_wait.restype = c.c_int
+    L.trpc_tpu_buf_size.argtypes = [c.c_uint64]
+    L.trpc_tpu_buf_size.restype = c.c_int64
+    L.trpc_tpu_d2h.argtypes = [c.c_uint64,
+                               c.POINTER(c.POINTER(c.c_uint8))]
+    L.trpc_tpu_d2h.restype = c.c_int64
+    L.trpc_tpu_buf_release.argtypes = [c.POINTER(c.c_uint8)]
+    L.trpc_tpu_buf_release.restype = None
+    L.trpc_tpu_buf_free.argtypes = [c.c_uint64]
+    L.trpc_tpu_buf_free.restype = None
+    L.trpc_tpu_plane_stats.argtypes = [c.POINTER(c.c_uint64)]
+    L.trpc_tpu_plane_stats.restype = None
+    L.trpc_server_add_hbm_echo.argtypes = [c.c_void_p, c.c_char_p]
+    L.trpc_server_add_hbm_echo.restype = c.c_int
+    L.trpc_channel_request_device_plane.argtypes = [c.c_void_p, c.c_int]
+    L.trpc_channel_request_device_plane.restype = None
+    L.trpc_channel_transport_state.argtypes = [c.c_void_p]
+    L.trpc_channel_transport_state.restype = c.c_int
+
     # bench
     L.trpc_run_echo_bench.argtypes = [c.c_char_p, c.c_int, c.c_int, c.c_int,
                                       c.c_int, c.c_int, c.c_double,

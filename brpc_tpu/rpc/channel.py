@@ -21,6 +21,7 @@ from brpc_tpu._native import lib
 from brpc_tpu.metrics import bvar
 from brpc_tpu.rpc import errors
 from brpc_tpu.rpc.controller import Controller
+from brpc_tpu.utils import logging as log
 from brpc_tpu.utils.endpoint import EndPoint, str2endpoint
 
 
@@ -130,7 +131,8 @@ class SubChannel:
     def __init__(self, endpoint: EndPoint,
                  connect_timeout_ms: float = 500.0,
                  auth: Optional[bytes] = None,
-                 connection_type: str = "single"):
+                 connection_type: str = "single",
+                 device_plane: bool = False):
         self.endpoint = endpoint
         L = lib()
         self._handle = L.trpc_channel_create(
@@ -144,11 +146,20 @@ class SubChannel:
             raise ValueError(f"unknown connection_type {connection_type!r}")
         if ct:
             L.trpc_channel_set_connection_type(self._handle, ct)
+        if device_plane:
+            L.trpc_channel_request_device_plane(self._handle, 1)
         self._native = _NativeCall(self._handle)
         self._lock = threading.Lock()
         self._drained = threading.Condition(self._lock)
         self._inflight = 0
         self._closed = False
+
+    def transport_state(self) -> str:
+        """State of the connection the most recent call rode: "tcp",
+        "handshaking", "device", or "fallback_tcp"."""
+        from brpc_tpu.tpu_plane import TRANSPORT_STATES
+        return TRANSPORT_STATES.get(
+            lib().trpc_channel_transport_state(self._handle), "tcp")
 
     def call_once(self, method: bytes, payload: bytes, attachment: bytes,
                   timeout_us: int, stream_handle: int = 0,
@@ -202,21 +213,36 @@ class Channel:
                  options: Optional[ChannelOptions] = None, **kw):
         self.options = options or ChannelOptions(**kw)
         self._cluster = None
+        self._device_requested = False
         if "://" in address and not address.startswith("tpu://"):
             from brpc_tpu.cluster.cluster_channel import ClusterChannel
             self._cluster = ClusterChannel(address, self.options)
             self._sub = None
         else:
             ep = str2endpoint(address)
+            self._device_requested = ep.is_device
             if ep.is_device:
-                # device endpoints carry the control plane on DCN/TCP
+                # tpu:// endpoint — the control plane rides DCN/TCP and
+                # the connection runs the device-plane handshake on its
+                # first call (≙ RdmaEndpoint's TCP-assisted bring-up with
+                # an EXPLICIT FALLBACK_TCP state, rdma_endpoint.h:95-110;
+                # never a silent downgrade).  Bring the local plane up
+                # eagerly so the handshake can settle into "device".
+                from brpc_tpu import tpu_plane
+                if not tpu_plane.init():
+                    log.LOG(log.LOG_WARNING,
+                            "tpu://%s: local device plane unavailable "
+                            "(%s); connection will settle in fallback_tcp",
+                            address, tpu_plane.error())
                 ep = EndPoint(ip=ep.ip, port=ep.port)
             self._sub = SubChannel(ep, self.options.connect_timeout_ms,
                                    self.options.auth,
-                                   self.options.connection_type)
+                                   self.options.connection_type,
+                                   device_plane=self._device_requested)
         if Channel._latency is None:
             Channel._latency = bvar.LatencyRecorder()
             Channel._latency.expose("rpc_client")
+        self._fallback_warned = False
 
     # -- the client pipeline (≙ Channel::CallMethod, channel.cpp:407) -------
 
@@ -273,6 +299,7 @@ class Channel:
                 if sp is not None:
                     sp.remote_side = cntl.remote_side
                     span_mod.finish_span(sp, 0)
+                self._check_transport_settled()
                 return data
             if attempt >= max_retry or not policy.do_retry(cntl):
                 break
@@ -288,6 +315,33 @@ class Channel:
             sp.remote_side = cntl.remote_side
             span_mod.finish_span(sp, cntl.error_code)
         raise errors.RpcError(cntl.error_code, cntl.error_text)
+
+    @property
+    def transport_state(self) -> str:
+        """Transport of the most recent call's connection: "tcp",
+        "handshaking", "device", or "fallback_tcp" (≙ the RdmaEndpoint
+        state machine's observable states, rdma_endpoint.h:95-110)."""
+        if self._sub is None:
+            return "tcp"
+        return self._sub.transport_state()
+
+    def _check_transport_settled(self) -> None:
+        """tpu:// channels announce (once) when the handshake settled in
+        FALLBACK_TCP — an explicit, logged downgrade."""
+        if (not getattr(self, "_device_requested", False)
+                or self._fallback_warned):
+            return
+        st = self.transport_state
+        if st == "fallback_tcp":
+            self._fallback_warned = True
+            log.LOG(log.LOG_WARNING,
+                    "tpu:// channel settled in FALLBACK_TCP (peer or "
+                    "local device plane unavailable); attachments ride "
+                    "TCP without the device data plane")
+        elif st == "device":
+            self._fallback_warned = True  # settled: stop checking
+            log.LOG(log.LOG_INFO, "tpu:// channel established DEVICE "
+                    "transport (PJRT data plane active on both sides)")
 
     def _call_attempt(self, method: bytes, payload: bytes, attachment: bytes,
                       timeout_us: int, backup_ms: Optional[float],

@@ -98,6 +98,14 @@ class Server:
         benches, ≙ example/echo_c++)."""
         lib().trpc_server_add_echo(self._handle)
 
+    def add_hbm_echo_service(self, name: str = "HbmEcho") -> None:
+        """Device-plane echo: each request's attachment DMAs host->HBM and
+        back into the response, entirely native (≙ example/rdma_performance
+        retargeted at the PJRT data plane — the ici_performance workload).
+        Requires tpu_plane.init(); without it requests fail with EINTERNAL
+        "device plane unavailable" (explicit, never silent)."""
+        lib().trpc_server_add_hbm_echo(self._handle, name.encode())
+
     def add_service(self, name: str, handler: Handler) -> None:
         if self._started:
             raise RuntimeError("add_service after start")

@@ -1,0 +1,130 @@
+"""Device data plane — the PJRT-backed transport under ``tpu://`` endpoints.
+
+Python face of ``native/src/tpu.{h,cc}`` (≙ the reference's RDMA transport,
+``rdma/rdma_endpoint.h`` + ``rdma/block_pool.cpp``, re-designed for TPU):
+
+* ``init()`` dlopens a PJRT C API plugin (``libtpu.so`` on TPU VMs; the
+  plugin path can be forced with ``$TRPC_PJRT_PLUGIN``) and creates a
+  client.  No JAX involvement — the native core talks PJRT directly.
+* ``h2d()/d2h()`` move bytes host↔HBM through single DMA transfers whose
+  completion events store 1 into a butex and wake waiting fibers
+  (the butex↔device-event seam the north star names: a fiber awaiting a
+  device transfer costs no thread).
+* RPC attachments ride this plane zero-copy: a large attachment lands in
+  ONE IOBuf block (Socket::frame_bytes_hint) and that block's memory is
+  the DMA source — ``stats()["zero_copy_sends"]`` counts the pointer-
+  identity transfers, ``gather_copies`` the multi-block sends that needed
+  one gather (never silent).
+* Channels to ``tpu://`` endpoints run an explicit handshake on the
+  connection's first call (meta tag 14) and settle into ``device`` or
+  ``fallback_tcp`` — visible via ``Channel.transport_state``, never a
+  silent downgrade (≙ rdma_endpoint.h:95 FALLBACK_TCP).
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, Optional
+
+from brpc_tpu._native import lib
+
+TRANSPORT_STATES = {0: "tcp", 1: "handshaking", 2: "device",
+                    3: "fallback_tcp"}
+
+
+def init(plugin_path: Optional[str] = None) -> bool:
+    """Bring up the device plane; returns availability.  Idempotent.
+    On failure the reason is in :func:`error` and callers fall back to
+    TCP explicitly."""
+    L = lib()
+    L.trpc_tpu_plane_init(plugin_path.encode() if plugin_path else None)
+    return bool(L.trpc_tpu_plane_available())
+
+
+def available() -> bool:
+    return bool(lib().trpc_tpu_plane_available())
+
+
+def error() -> str:
+    return (lib().trpc_tpu_plane_error() or b"").decode()
+
+
+def platform() -> str:
+    return (lib().trpc_tpu_plane_platform() or b"").decode()
+
+
+def device_count() -> int:
+    return lib().trpc_tpu_device_count()
+
+
+class DeviceBuffer:
+    """A byte buffer resident in HBM.  Handle semantics are versioned
+    (ABA-safe) like SocketIds; ``free()`` is idempotent.
+
+    Holds a reference to the source bytes until ``free()``: the H2D DMA
+    reads host memory asynchronously (kImmutableUntilTransferCompletes),
+    so the source must outlive the transfer even if the caller passed a
+    temporary."""
+
+    __slots__ = ("_id", "_len", "_src")
+
+    def __init__(self, buf_id: int, length: int, src: bytes = b""):
+        self._id = buf_id
+        self._len = length
+        self._src = src  # pins the DMA source (see class docstring)
+
+    def __len__(self) -> int:
+        return self._len
+
+    @property
+    def handle(self) -> int:
+        return self._id
+
+    def wait(self, timeout_s: float = 30.0) -> None:
+        """Block (fiber-friendly) until the buffer is resident in HBM."""
+        rc = lib().trpc_tpu_buf_wait(self._id, int(timeout_s * 1e6))
+        if rc != 0:
+            raise TimeoutError(f"device transfer not ready: rc={rc}")
+
+    def to_host(self) -> bytes:
+        """DMA the buffer back to host memory."""
+        L = lib()
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        n = L.trpc_tpu_d2h(self._id, ctypes.byref(out))
+        if n < 0:
+            raise IOError(f"d2h failed: rc={n} ({error()})")
+        try:
+            return ctypes.string_at(out, n)
+        finally:
+            L.trpc_tpu_buf_release(out)
+
+    def free(self) -> None:
+        lib().trpc_tpu_buf_free(self._id)
+        self._src = b""
+
+    def __enter__(self) -> "DeviceBuffer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.free()
+
+
+def h2d(data: bytes, device: int = 0) -> DeviceBuffer:
+    """DMA ``data`` into HBM; returns immediately (transfer is async —
+    ``wait()`` parks on the completion butex)."""
+    if not available():
+        raise RuntimeError(f"device plane unavailable: {error()}")
+    buf_id = lib().trpc_tpu_h2d(data, len(data), device)
+    if buf_id == 0:
+        raise IOError(f"h2d failed: {error()}")
+    return DeviceBuffer(buf_id, len(data), src=data)
+
+
+def stats() -> Dict[str, int]:
+    """Plane counters (feeds /vars via the native metrics seam)."""
+    out = (ctypes.c_uint64 * 9)()
+    lib().trpc_tpu_plane_stats(out)
+    keys = ("h2d_transfers", "d2h_transfers", "h2d_bytes", "d2h_bytes",
+            "events_fired", "gather_copies", "zero_copy_sends",
+            "live_buffers", "errors")
+    return dict(zip(keys, out))

@@ -1,0 +1,104 @@
+"""ici_performance — device data plane bandwidth benchmark
+(≙ example/rdma_performance/{client,server}.cpp retargeted at TPU:
+throughput of RPC attachments that round-trip host<->HBM through the PJRT
+plane, plus raw plane H2D/D2H bandwidth).
+
+Run on a host with a PJRT plugin (TPU VM, or anywhere TRPC_PJRT_PLUGIN
+points at one).  Without a plugin it reports the explicit FALLBACK_TCP
+path instead of silently degrading.
+
+Usage: python examples/ici_performance.py [--size MB] [--seconds S]
+"""
+import _bootstrap  # noqa: F401
+
+import argparse
+import json
+import time
+
+from brpc_tpu import tpu_plane
+from brpc_tpu.rpc.channel import Channel, ChannelOptions
+from brpc_tpu.rpc.server import Server
+
+
+def bench_raw(size: int, seconds: float) -> dict:
+    """Raw plane H2D+D2H bandwidth (no RPC framing)."""
+    data = bytes(bytearray(range(256)) * (size // 256 + 1))[:size]
+    deadline = time.monotonic() + seconds
+    rounds = 0
+    t0 = time.monotonic()
+    while time.monotonic() < deadline:
+        buf = tpu_plane.h2d(data)
+        buf.wait()
+        back = buf.to_host()
+        buf.free()
+        assert back == data
+        rounds += 1
+    dt = time.monotonic() - t0
+    return {
+        "rounds": rounds,
+        "h2d_gbps": rounds * size / dt / 1e9,
+        "roundtrip_gbps": 2 * rounds * size / dt / 1e9,
+    }
+
+
+def bench_rpc(size: int, seconds: float) -> dict:
+    """Attachment round-trips through a real RPC whose server half DMAs
+    host->HBM->host (the HbmEcho service)."""
+    server = Server()
+    server.add_hbm_echo_service()
+    port = server.start("127.0.0.1:0")
+    ch = Channel(f"tpu://0/0@127.0.0.1:{port}",
+                 ChannelOptions(timeout_ms=60_000, max_retry=0))
+    payload = bytes(size)
+    before = tpu_plane.stats()
+    deadline = time.monotonic() + seconds
+    rounds = 0
+    t0 = time.monotonic()
+    from brpc_tpu.rpc.controller import Controller
+    while time.monotonic() < deadline:
+        cntl = Controller()
+        ch.call("HbmEcho", b"x", attachment=payload, cntl=cntl)
+        assert cntl.response_attachment == payload
+        rounds += 1
+    dt = time.monotonic() - t0
+    after = tpu_plane.stats()
+    state = ch.transport_state
+    ch.close()
+    server.destroy()
+    return {
+        "rounds": rounds,
+        "transport": state,
+        # each round moves size bytes H2D and size bytes D2H on the server
+        "device_gbps": 2 * rounds * size / dt / 1e9,
+        "zero_copy_sends": after["zero_copy_sends"] - before["zero_copy_sends"],
+        "gather_copies": after["gather_copies"] - before["gather_copies"],
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size-mb", type=float, default=8.0)
+    ap.add_argument("--seconds", type=float, default=3.0)
+    args = ap.parse_args()
+    size = int(args.size_mb * 1024 * 1024)
+
+    if not tpu_plane.init():
+        print(json.dumps({
+            "available": False,
+            "fallback": "tcp",
+            "reason": tpu_plane.error(),
+        }))
+        return
+    out = {
+        "available": True,
+        "platform": tpu_plane.platform(),
+        "devices": tpu_plane.device_count(),
+        "size_mb": args.size_mb,
+        "raw": bench_raw(size, args.seconds),
+        "rpc": bench_rpc(size, args.seconds),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -3,6 +3,7 @@
 // (SURVEY.md §2: java/python are TBD placeholders); this surface is new
 // design for the TPU build: Python is the control plane, C++ the data plane.
 #include <cerrno>
+#include <cstdlib>
 #include <cstring>
 
 #include "fiber.h"
@@ -11,6 +12,7 @@
 #include "rpc.h"
 #include "socket.h"
 #include "stream.h"
+#include "tpu.h"
 
 using namespace trpc;
 
@@ -255,6 +257,65 @@ int trpc_stream_remote_closed(uint64_t h) { return stream_remote_closed(h); }
 int trpc_stream_failed(uint64_t h) { return stream_failed(h); }
 int64_t trpc_stream_pending_bytes(uint64_t h) {
   return stream_pending_bytes(h);
+}
+
+// --- device data plane (tpu.h: PJRT-backed, dlopen'd at runtime) -----------
+
+int trpc_tpu_plane_init(const char* plugin_path) {
+  return tpu_plane_init(plugin_path);
+}
+int trpc_tpu_plane_available() { return tpu_plane_available() ? 1 : 0; }
+const char* trpc_tpu_plane_error() { return tpu_plane_error(); }
+const char* trpc_tpu_plane_platform() { return tpu_plane_platform(); }
+int trpc_tpu_device_count() { return tpu_plane_device_count(); }
+
+// H2D from caller memory (one DMA; the bytes are copied by the DMA
+// engine, not by host code).  Returns a buffer handle or 0.
+uint64_t trpc_tpu_h2d(const uint8_t* data, size_t len, int device) {
+  return tpu_h2d(data, len, device, nullptr, nullptr);
+}
+int trpc_tpu_buf_wait(uint64_t id, int64_t timeout_us) {
+  return tpu_buf_wait(id, timeout_us);
+}
+int64_t trpc_tpu_buf_size(uint64_t id) { return tpu_buf_size(id); }
+// D2H into a fresh malloc'd buffer the caller frees with trpc_tpu_buf_release.
+int64_t trpc_tpu_d2h(uint64_t id, uint8_t** out) {
+  char* mem = nullptr;
+  size_t n = 0;
+  int rc = tpu_d2h_raw(id, &mem, &n);
+  if (rc != 0) {
+    return rc;
+  }
+  *out = (uint8_t*)mem;  // the DMA landing zone itself — no second copy
+  return (int64_t)n;
+}
+void trpc_tpu_buf_release(uint8_t* p) { free(p); }
+void trpc_tpu_buf_free(uint64_t id) { tpu_buf_free(id); }
+
+void trpc_tpu_plane_stats(uint64_t out[9]) {
+  TpuPlaneStats s = tpu_plane_stats();
+  out[0] = s.h2d_transfers;
+  out[1] = s.d2h_transfers;
+  out[2] = s.h2d_bytes;
+  out[3] = s.d2h_bytes;
+  out[4] = s.events_fired;
+  out[5] = s.gather_copies;
+  out[6] = s.zero_copy_sends;
+  out[7] = s.live_buffers;
+  out[8] = s.errors;
+}
+
+// HBM echo service (kind=2): attachments round-trip host->HBM->host.
+int trpc_server_add_hbm_echo(void* s, const char* name) {
+  return server_add_service((Server*)s, name, 2, nullptr, nullptr);
+}
+
+// Device-plane handshake on tpu:// channels.
+void trpc_channel_request_device_plane(void* c, int enable) {
+  channel_request_device_plane((Channel*)c, enable);
+}
+int trpc_channel_transport_state(void* c) {
+  return channel_transport_state((Channel*)c);
 }
 
 // --- bench -----------------------------------------------------------------

@@ -457,7 +457,15 @@ struct Butex {
   ButexWaiter* first() { return head.next == &head ? nullptr : head.next; }
 };
 
-Butex* butex_create() { return ObjectPool<Butex>::Get(); }
+Butex* butex_create() {
+  Butex* b = ObjectPool<Butex>::Get();
+  // fresh-butex contract: value starts at 0 (slots recycle through the
+  // pool and would otherwise carry the previous user's counter — a
+  // waiter armed on "value still 0" would wake instantly and read
+  // whatever it was awaiting before it exists)
+  b->value.store(0, std::memory_order_relaxed);
+  return b;
+}
 
 void butex_destroy(Butex* b) { ObjectPool<Butex>::Return(b); }
 

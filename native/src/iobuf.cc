@@ -98,6 +98,18 @@ void IOBuf::push_ref(const BlockRef& r) {
 
 void IOBuf::append(const void* data, size_t n) {
   const char* p = (const char*)data;
+  // Large appends get one dedicated right-sized block instead of a chain
+  // of 8KB pooled blocks: downstream device DMA (tpu_h2d_from_iobuf) and
+  // writev both want contiguity, and a >=16KB payload was never going to
+  // amortize the pooled block anyway.
+  if (n >= kBigBlockThreshold) {
+    IOBlock* big = IOBlock::New((uint32_t)n);
+    memcpy(big->data, p, n);
+    big->size = (uint32_t)n;
+    BlockRef r{big, 0, (uint32_t)n};
+    push_ref(r);  // big's initial ref transfers to this buf
+    return;
+  }
   while (n > 0) {
     IOBlock* b = tls_acquire_block();
     uint32_t copy = b->spare() < n ? b->spare() : (uint32_t)n;
@@ -109,6 +121,69 @@ void IOBuf::append(const void* data, size_t n) {
     p += copy;
     n -= copy;
   }
+}
+
+// Read up to `want` bytes into a single dedicated block (continuing the
+// current tail block when it has spare room), so a large frame's body
+// lands contiguously instead of as ~want/8KB chained pooled blocks.
+// Returns bytes read this call, 0 on EAGAIN-with-nothing, -1 on error.
+ssize_t IOBuf::append_from_fd_big(int fd, size_t want, bool* eof) {
+  if (eof != nullptr) {
+    *eof = false;
+  }
+  size_t total = 0;
+  while (want > 0) {
+    IOBlock* blk = nullptr;
+    bool fresh = false;
+    if (!refs_.empty()) {
+      BlockRef& last = refs_.back();
+      IOBlock* lb = last.block;
+      // continue filling the tail block iff this buf owns its end AND it
+      // is itself a dedicated big block (continuing a pooled 8KB tail
+      // would break the alignment the caller set up)
+      if (lb->spare() > 0 && lb->deleter == nullptr &&
+          lb->cap > IOBlock::kDefaultPayload &&
+          last.offset + last.length == lb->size) {
+        blk = lb;
+      }
+    }
+    if (blk == nullptr) {
+      blk = IOBlock::New((uint32_t)want);
+      fresh = true;
+    }
+    size_t room = blk->spare() < want ? blk->spare() : want;
+    ssize_t n = ::read(fd, blk->data + blk->size, room);
+    if (n < 0) {
+      if (fresh) {
+        blk->Unref();
+      }
+      if (errno == EINTR) {
+        continue;
+      }
+      if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        return (ssize_t)total;
+      }
+      return total > 0 ? (ssize_t)total : -1;
+    }
+    if (n == 0) {
+      if (fresh) {
+        blk->Unref();
+      }
+      if (eof != nullptr) {
+        *eof = true;
+      }
+      return (ssize_t)total;
+    }
+    BlockRef r{blk, blk->size, (uint32_t)n};
+    if (!fresh) {
+      blk->Ref();
+    }
+    blk->size += (uint32_t)n;
+    push_ref(r);
+    total += (size_t)n;
+    want -= (size_t)n;
+  }
+  return (ssize_t)total;
 }
 
 void IOBuf::append(const IOBuf& other) {
@@ -137,6 +212,40 @@ void IOBuf::append_user_data(void* data, size_t n, UserBlockDeleter d,
   IOBlock* b = IOBlock::NewUser(data, (uint32_t)n, d, meta);
   BlockRef r{b, 0, (uint32_t)n};
   push_ref(r);  // b starts with refcount 1 owned by this buf
+}
+
+void IOBuf::realign_tail(size_t off, size_t block_cap) {
+  if (off >= length_) {
+    return;
+  }
+  size_t tail_len = length_ - off;
+  if (block_cap < tail_len) {
+    block_cap = tail_len;
+  }
+  IOBlock* big = IOBlock::New((uint32_t)block_cap);
+  copy_to(big->data, tail_len, off);
+  big->size = (uint32_t)tail_len;
+  // drop the refs covering [off, size)
+  size_t seen = 0;
+  size_t i = 0;
+  for (; i < refs_.size(); ++i) {
+    if (seen + refs_[i].length > off) {
+      break;
+    }
+    seen += refs_[i].length;
+  }
+  size_t first_drop = i;
+  if (i < refs_.size() && off > seen) {
+    refs_[i].length = (uint32_t)(off - seen);  // keep the head of this ref
+    first_drop = i + 1;
+  }
+  for (size_t j = first_drop; j < refs_.size(); ++j) {
+    refs_[j].block->Unref();
+  }
+  refs_.resize(first_drop);
+  length_ = off;
+  BlockRef r{big, 0, (uint32_t)tail_len};
+  push_ref(r);  // big's initial ref transfers to this buf
 }
 
 size_t IOBuf::cutn(IOBuf* out, size_t n) {
@@ -229,13 +338,15 @@ ssize_t IOBuf::append_from_fd(int fd, size_t max, bool* eof) {
   while (total < max) {
     IOBlock* tail = tls_acquire_block();
     iovec vec[2];
+    size_t budget = max - total;
     vec[0].iov_base = tail->data + tail->size;
-    vec[0].iov_len = tail->spare();
+    vec[0].iov_len = tail->spare() < budget ? tail->spare() : budget;
+    budget -= vec[0].iov_len;
     // a second fresh block so big bursts need fewer syscalls
     IOBlock* extra = g_tls_spare != nullptr ? g_tls_spare : IOBlock::New();
     g_tls_spare = nullptr;
     vec[1].iov_base = extra->data;
-    vec[1].iov_len = extra->cap;
+    vec[1].iov_len = extra->cap < budget ? extra->cap : budget;
     ssize_t n = readv(fd, vec, 2);
     if (n < 0) {
       g_tls_spare = extra;

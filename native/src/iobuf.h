@@ -91,10 +91,23 @@ class IOBuf {
   size_t copy_to(void* dst, size_t n, size_t from = 0) const;
   std::string to_string() const;
 
+  // Appends >= this many bytes go into one dedicated right-sized block
+  // (contiguity for device DMA + writev) instead of chained pooled blocks.
+  static constexpr size_t kBigBlockThreshold = 16 * 1024;
+
   // Read from fd until EAGAIN or max bytes; appends to this buffer.
   // Returns total read or -1 on error (errno set).  *eof is set when the
   // peer closed (readv returned 0).
   ssize_t append_from_fd(int fd, size_t max = (size_t)-1, bool* eof = nullptr);
+  // Read up to `want` bytes into a single dedicated block — used when the
+  // protocol layer knows a large frame body is pending (Socket's
+  // frame_bytes_hint) so it lands contiguously for zero-copy DMA.
+  ssize_t append_from_fd_big(int fd, size_t want, bool* eof = nullptr);
+  // Re-home the bytes at [off, size) into one fresh dedicated block of
+  // capacity >= block_cap (append_from_fd_big then continues filling it).
+  // One bounded copy of the already-arrived head of a large attachment,
+  // so the full attachment ends up a single BlockRef.
+  void realign_tail(size_t off, size_t block_cap);
   // writev the first refs to fd; pops what was written.  Returns bytes
   // written or -1 (errno set).
   ssize_t cut_into_fd(int fd, size_t max = (size_t)-1);

@@ -25,6 +25,7 @@
 #include "redis.h"
 #include "stream.h"
 #include "timer_thread.h"
+#include "tpu.h"
 
 namespace trpc {
 
@@ -110,6 +111,9 @@ void EncodeMeta(const RpcMeta& m, MetaWriter* w) {
   if (!m.auth.empty()) {
     w->tlv(13, m.auth.data(), (uint32_t)m.auth.size());
   }
+  if (m.device_caps != 0) {
+    w->tlv_u64(14, m.device_caps);
+  }
 }
 
 bool DecodeMeta(const char* p, size_t n, RpcMeta* m) {
@@ -137,6 +141,7 @@ bool DecodeMeta(const char* p, size_t n, RpcMeta* m) {
       case 11: if (len == 1) m->stream_frame_type = (uint8_t)v[0]; break;
       case 12: if (len == 8) memcpy(&m->feedback_bytes, v, 8); break;
       case 13: m->auth.assign(v, len); break;
+      case 14: if (len == 8) memcpy(&m->device_caps, v, 8); break;
       default: break;  // forward compatibility: skip unknown tags
     }
     i += len;
@@ -165,6 +170,45 @@ void PackFrame(IOBuf* out, const RpcMeta& meta, IOBuf&& payload,
   out->append(w.data(), w.size());
   out->append(std::move(payload));
   out->append(std::move(attachment));
+}
+
+// Layout of the partially-read TRPC frame at the head of buf: *total =
+// its full wire size, *attach_off = offset where its attachment begins
+// (== *total when there is no attachment or the meta isn't decodable
+// yet).  Returns false if not TRPC / insufficient bytes.  Feeds
+// Socket::frame_bytes_hint/frame_attach_hint so a large attachment lands
+// in one dedicated block at exactly its offset — a single-BlockRef
+// zero-copy DMA source (≙ RDMA landing payloads in registered blocks).
+bool PeekFrameLayout(const IOBuf& buf, size_t* total, size_t* attach_off) {
+  if (buf.size() < 12) {
+    return false;
+  }
+  char hdr[12];
+  buf.copy_to(hdr, 12);
+  if (memcmp(hdr, "TRPC", 4) != 0) {
+    return false;
+  }
+  uint32_t meta_size, body_size;
+  memcpy(&meta_size, hdr + 4, 4);
+  memcpy(&body_size, hdr + 8, 4);
+  meta_size = ntohl(meta_size);
+  body_size = ntohl(body_size);
+  if (meta_size > kMaxBodySize || body_size > kMaxBodySize) {
+    return false;
+  }
+  *total = 12 + (size_t)meta_size + body_size;
+  *attach_off = *total;
+  if (buf.size() >= 12 + (size_t)meta_size) {
+    std::string ms;
+    ms.resize(meta_size);
+    buf.copy_to(&ms[0], meta_size, 12);
+    RpcMeta m;
+    if (DecodeMeta(ms.data(), ms.size(), &m) &&
+        m.attachment_size <= body_size) {
+      *attach_off = *total - m.attachment_size;
+    }
+  }
+  return true;
 }
 
 int ParseFrame(IOBuf* buf, RpcMeta* meta, IOBuf* payload, IOBuf* attachment) {
@@ -439,6 +483,13 @@ void ReleaseSequenced(Socket* s, uint64_t seq, IOBuf&& data,
   }
 }
 
+// Server's device-plane caps word for handshake responses (tag 14).
+uint64_t ServerDeviceCaps() {
+  return tpu_plane_available()
+             ? (2 | 1 | ((uint64_t)tpu_plane_device_count() << 8))
+             : 2;  // answered, no plane -> client takes FALLBACK_TCP
+}
+
 void SendResponse(SocketId sock_id, uint64_t correlation_id,
                   int32_t error_code, const char* error_text, IOBuf&& payload,
                   IOBuf&& attachment, uint64_t stream_id = 0,
@@ -450,6 +501,9 @@ void SendResponse(SocketId sock_id, uint64_t correlation_id,
   RpcMeta meta;
   meta.correlation_id = correlation_id;
   meta.error_code = error_code;
+  if (s->advertise_device_caps.load(std::memory_order_acquire)) {
+    meta.device_caps = ServerDeviceCaps();
+  }
   if (error_text != nullptr) {
     meta.error_text = error_text;
   }
@@ -752,6 +806,22 @@ void ServerOnMessages(Socket* s) {
     IOBuf payload, attachment;
     int rc = ParseFrame(&s->read_buf, &meta, &payload, &attachment);
     if (rc == 0) {
+      // arm the contiguity hints once per frame: on later events the
+      // armed hint drives ReadToBuf directly (re-peeking would re-align
+      // — and re-copy — the already-landed attachment head every wake)
+      size_t need = 0, attach_off = 0;
+      if (s->frame_bytes_hint == 0 &&
+          PeekFrameLayout(s->read_buf, &need, &attach_off) &&
+          need >= IOBuf::kBigBlockThreshold) {
+        s->frame_bytes_hint = need;  // large frame: land it contiguously
+        s->frame_attach_hint = attach_off;
+        if (need - attach_off >= IOBuf::kBigBlockThreshold &&
+            s->read_buf.size() > attach_off) {
+          // bounded one-time copy of the attachment head that already
+          // arrived; the remainder streams into the same block
+          s->read_buf.realign_tail(attach_off, need - attach_off);
+        }
+      }
       break;
     }
     if (rc < 0) {
@@ -787,6 +857,10 @@ void ServerOnMessages(Socket* s) {
       }
       s->authed.store(true, std::memory_order_release);
     }
+    if (meta.device_caps & 1) {
+      // device-plane probe: answer on every response of this connection
+      s->advertise_device_caps.store(true, std::memory_order_release);
+    }
     srv->nrequests.fetch_add(1, std::memory_order_relaxed);
     auto it = srv->services.find(meta.method);
     if (it == srv->services.end()) {
@@ -802,6 +876,54 @@ void ServerOnMessages(Socket* s) {
       continue;
     }
     const ServiceHandler& h = it->second;
+    if (h.kind == 2) {
+      // HBM echo (≙ rdma_performance's server loop, retargeted at the
+      // device plane): the attachment DMAs host->HBM, then HBM->host
+      // into the response — the RPC payload round-trips device memory
+      // with no extra host copies (single-block attachments are
+      // pointer-identity DMA sources).  Runs on its own fiber so the
+      // DMA waits park a fiber, not this connection's parse loop.
+      struct HbmEchoArg {
+        SocketId sock;
+        uint64_t corr;
+        IOBuf payload;
+        IOBuf attachment;
+      };
+      auto* a = new HbmEchoArg{s->id(), meta.correlation_id,
+                               std::move(payload), std::move(attachment)};
+      fiber_t f;
+      int frc = fiber_start(&f, [](void* p) {
+        HbmEchoArg* a = (HbmEchoArg*)p;
+        IOBuf resp_attach;
+        int32_t err = 0;
+        const char* etext = nullptr;
+        if (!a->attachment.empty()) {
+          if (!tpu_plane_available()) {
+            err = TRPC_EINTERNAL;
+            etext = "device plane unavailable";
+          } else {
+            TpuBufId id = tpu_h2d_from_iobuf(a->attachment, 0);
+            if (id == 0 || tpu_buf_wait(id, 30 * 1000 * 1000) != 0 ||
+                tpu_d2h_into_iobuf(id, &resp_attach) != 0) {
+              err = TRPC_EINTERNAL;
+              etext = "device transfer failed";
+            }
+            if (id != 0) {
+              tpu_buf_free(id);
+            }
+          }
+        }
+        SendResponse(a->sock, a->corr, err, etext, std::move(a->payload),
+                     std::move(resp_attach));
+        delete a;
+      }, a);
+      if (frc != 0) {
+        delete a;
+        SendResponse(s->id(), meta.correlation_id, TRPC_EINTERNAL,
+                     "no fiber", IOBuf(), IOBuf());
+      }
+      continue;
+    }
     if (h.kind == 0) {
       // native echo: pack the response into the batch buffer; one Write
       // (= one syscall) flushes every response of this read event
@@ -810,6 +932,9 @@ void ServerOnMessages(Socket* s) {
       RpcMeta rmeta;
       rmeta.correlation_id = meta.correlation_id;
       rmeta.flags = 1;  // response
+      if (s->advertise_device_caps.load(std::memory_order_acquire)) {
+        rmeta.device_caps = ServerDeviceCaps();
+      }
       PackFrame(&batched_out, rmeta, std::move(payload),
                 std::move(attachment));
     } else {
@@ -1356,6 +1481,17 @@ namespace {
 // once (short).  Lifetime: hung off Socket::parse_state, freed by
 // Socket::TryRecycle after the last ref is gone; the SocketMap/pool drop
 // their pointers in the on_failed callback, which runs before recycle.
+// Per-connection transport state machine (≙ RdmaEndpoint's
+// UNINIT→…→ESTABLISHED|FALLBACK_TCP, rdma_endpoint.h:95-110).  The
+// handshake rides meta tag 14 on the connection's first call; FALLBACK
+// is an explicit observable state, never a silent downgrade.
+enum TransportState {
+  TS_TCP = 0,          // plain TCP channel, no device plane requested
+  TS_HANDSHAKING = 1,  // probe sent, awaiting the server's caps
+  TS_DEVICE = 2,       // both sides have a live device plane
+  TS_FALLBACK_TCP = 3  // probe answered: peer has no device plane
+};
+
 struct ClientConn {
   std::mutex sweep_mu;
   PendingCall* sweep_head = nullptr;
@@ -1363,6 +1499,8 @@ struct ClientConn {
   std::string map_key;            // nonempty: registered in the SocketMap
   Channel* pool_owner = nullptr;    // pooled: owning channel
   bool short_lived = false;         // short: fail after the call completes
+  std::atomic<int> transport{TS_TCP};
+  std::atomic<uint64_t> peer_device_caps{0};
 
   void SweepLink(PendingCall* pc) {
     std::lock_guard<std::mutex> lk(sweep_mu);
@@ -1412,6 +1550,8 @@ class Channel {
   int64_t connect_timeout_us = 500 * 1000;
   std::string auth;  // credential riding every request meta (tag 13)
   int conn_type = 0;  // 0 single (SocketMap-shared), 1 pooled, 2 short
+  bool device_plane = false;  // tpu:// endpoint: probe for the device plane
+  std::atomic<int> last_transport{TS_TCP};  // of the most recent call's conn
   // single: lock-free fast path to the live shared connection
   std::atomic<SocketId> cached_sock{INVALID_SOCKET_ID};
   std::mutex conn_mu;     // serializes dialing
@@ -1501,6 +1641,17 @@ void ChannelOnMessages(Socket* s) {
     IOBuf payload, attachment;
     int rc = ParseFrame(&s->read_buf, &meta, &payload, &attachment);
     if (rc == 0) {
+      size_t need = 0, attach_off = 0;
+      if (s->frame_bytes_hint == 0 &&  // arm once per frame (see server)
+          PeekFrameLayout(s->read_buf, &need, &attach_off) &&
+          need >= IOBuf::kBigBlockThreshold) {
+        s->frame_bytes_hint = need;  // large response: land contiguously
+        s->frame_attach_hint = attach_off;
+        if (need - attach_off >= IOBuf::kBigBlockThreshold &&
+            s->read_buf.size() > attach_off) {
+          s->read_buf.realign_tail(attach_off, need - attach_off);
+        }
+      }
       break;
     }
     if (rc < 0) {
@@ -1525,6 +1676,23 @@ void ChannelOnMessages(Socket* s) {
         s->Write(std::move(frame));
       }
       continue;
+    }
+    if (meta.device_caps & 2) {
+      // server answered the device probe: settle the connection's state.
+      // TS_TCP is also a valid pre-state — a SocketMap-shared connection
+      // first dialed by a non-tpu:// channel still settles when a tpu://
+      // channel probes over it.
+      ClientConn* conn = (ClientConn*)s->user;
+      conn->peer_device_caps.store(meta.device_caps,
+                                   std::memory_order_release);
+      int settled = (meta.device_caps & 1) && tpu_plane_available()
+                        ? TS_DEVICE
+                        : TS_FALLBACK_TCP;
+      int cur = conn->transport.load(std::memory_order_acquire);
+      while ((cur == TS_TCP || cur == TS_HANDSHAKING) &&
+             !conn->transport.compare_exchange_weak(
+                 cur, settled, std::memory_order_acq_rel)) {
+      }
     }
     pc->error_code = meta.error_code;
     pc->error_text = std::move(meta.error_text);
@@ -1605,6 +1773,9 @@ Socket* DialConn(Channel* c, int* rc_out) {
   }
   Socket* snew = Socket::Address(sid);
   conn->sock = sid;
+  if (c->device_plane) {
+    conn->transport.store(TS_HANDSHAKING, std::memory_order_relaxed);
+  }
   snew->parse_state = conn;
   snew->parse_state_free = [](void* p) { delete (ClientConn*)p; };
   EventDispatcher::Instance().AddConsumer(sid, fd);
@@ -1812,6 +1983,16 @@ void channel_set_connection_type(Channel* c, int t) {
   c->conn_type = t;
 }
 
+void channel_request_device_plane(Channel* c, int enable) {
+  c->device_plane = enable != 0;
+}
+
+// TransportState of the connection the most recent call rode:
+// 0 tcp, 1 handshaking, 2 device, 3 fallback_tcp.
+int channel_transport_state(Channel* c) {
+  return c->last_transport.load(std::memory_order_acquire);
+}
+
 void channel_destroy(Channel* c) {
   // single: drop this channel's SocketMap ref; last one out fails the
   // shared connection (≙ SocketMapRemove closing at zero)
@@ -1901,6 +2082,9 @@ int channel_call(Channel* c, const char* method, const uint8_t* req,
   meta.correlation_id = corr;
   meta.compress_type = compress;
   meta.auth = c->auth;
+  if (c->device_plane) {
+    meta.device_caps = 1;  // probe: answered by every response (tag 14)
+  }
   meta.stream_id = stream;  // client stream handle rides the request
   if (stream != 0) {
     meta.feedback_bytes = stream_window(stream);  // advertise recv window
@@ -1967,6 +2151,8 @@ int channel_call(Channel* c, const char* method, const uint8_t* req,
   }
   pc->response.clear();
   pc->attachment.clear();
+  c->last_transport.store(conn->transport.load(std::memory_order_acquire),
+                          std::memory_order_release);
   conn->SweepUnlink(pc);
   // bump the version before returning to the pool: a late response with
   // this corr can never match the recycled slot

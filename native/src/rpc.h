@@ -45,6 +45,12 @@ struct RpcMeta {
   uint8_t stream_frame_type = 0;  // 0 none, 1 data, 2 close, 3 feedback
   uint64_t feedback_bytes = 0;
   std::string auth;
+  // tag 14 — device-plane handshake (≙ the RDMA TCP-assisted bring-up,
+  // rdma_endpoint.h:95: hello rides the existing byte stream).  Request:
+  // bit0 = client wants the device plane.  Response: bit0 = server plane
+  // up (device count in bits 8+), bit1 = server answered the probe (so
+  // an explicit "no plane" is distinguishable from an old server).
+  uint64_t device_caps = 0;
 
   bool is_response() const { return flags & 1; }
 };
@@ -84,7 +90,10 @@ class Server;
 
 Server* server_create();
 // kind: 0 = native echo (responds inline on the worker fiber);
-//       1 = callback on usercode pthread pool
+//       1 = callback on usercode pthread pool;
+//       2 = HBM echo: the attachment round-trips host->HBM->host through
+//           the device plane (tpu.h) on a fiber — the ici_performance
+//           workload (≙ example/rdma_performance retargeted at TPU)
 int server_add_service(Server* s, const char* name, int kind, HandlerCb cb,
                        void* user);
 // One HTTP dispatcher per server handles every HTTP request on the port.
@@ -140,6 +149,15 @@ void channel_set_auth(Channel* c, const uint8_t* secret, size_t len);
 // in-flight call, parked between calls), 2 = short (one call per conn)
 // (≙ ChannelOptions.connection_type, controller.cpp:1112-1114).
 void channel_set_connection_type(Channel* c, int t);
+
+// tpu:// endpoints: probe the server for a device data plane on every
+// connection's first call; the connection settles into DEVICE or
+// FALLBACK_TCP explicitly (≙ the RdmaEndpoint handshake + FALLBACK_TCP,
+// rdma_endpoint.h:95-110 — never a silent downgrade).
+void channel_request_device_plane(Channel* c, int enable);
+// 0 tcp, 1 handshaking, 2 device, 3 fallback_tcp (state of the conn the
+// most recent completed call rode).
+int channel_transport_state(Channel* c);
 
 // size of the pthread pool running Python handlers (before first request)
 void set_usercode_workers(int n);

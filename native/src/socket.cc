@@ -49,7 +49,9 @@ int Socket::Create(const SocketOptions& opts, SocketId* id_out) {
   s->bytes_out.store(0, std::memory_order_relaxed);
   s->authed.store(false, std::memory_order_relaxed);
   s->is_h2.store(false, std::memory_order_relaxed);
+  s->advertise_device_caps.store(false, std::memory_order_relaxed);
   s->corked = opts.corked;
+  s->frame_bytes_hint = 0;
   if (s->epollout_butex == nullptr) {
     s->epollout_butex = butex_create();
   }
@@ -201,12 +203,42 @@ void Socket::SetFailed(int err) {
 // read path
 
 ssize_t Socket::ReadToBuf(bool* eof) {
+  ssize_t total = 0;
+  if (frame_bytes_hint > read_buf.size()) {
+    // large frame in progress: pre-attachment bytes continue into pooled
+    // blocks, then the attachment lands in one dedicated block aligned
+    // exactly to its start
+    if (frame_attach_hint > read_buf.size()) {
+      size_t head = frame_attach_hint - read_buf.size();
+      ssize_t n = read_buf.append_from_fd(fd, head, eof);
+      if (n < 0) {
+        return -1;
+      }
+      bytes_in.fetch_add((uint64_t)n, std::memory_order_relaxed);
+      total += n;
+      if ((size_t)n < head) {
+        return total;  // EAGAIN or EOF
+      }
+    }
+    size_t want = frame_bytes_hint - read_buf.size();
+    ssize_t n = read_buf.append_from_fd_big(fd, want, eof);
+    if (n < 0) {
+      return -1;
+    }
+    bytes_in.fetch_add((uint64_t)n, std::memory_order_relaxed);
+    total += n;
+    if ((size_t)n < want) {
+      return total;  // EAGAIN or EOF: frame still incomplete
+    }
+    frame_bytes_hint = 0;
+    frame_attach_hint = 0;
+  }
   ssize_t n = read_buf.append_from_fd(fd, (size_t)-1, eof);
   if (n < 0) {
-    return -1;
+    return total > 0 ? total : -1;
   }
   bytes_in.fetch_add((uint64_t)n, std::memory_order_relaxed);
-  return n;
+  return total + n;
 }
 
 void Socket::ProcessEventFiber(void* arg) {

@@ -73,12 +73,24 @@ class Socket {
   // set at h2 preface: gates the (mutexed) H2Conn registry lookup so
   // TRPC/HTTP/redis connections never touch the global map on reads
   std::atomic<bool> is_h2{false};
+  // peer asked for the device plane (meta tag 14): every response on this
+  // connection advertises the server's plane caps back
+  std::atomic<bool> advertise_device_caps{false};
   // opaque per-connection parser/pipelining state owned by the protocol
   // layer (rpc.cc: ConnState); freed via parse_state_free at recycle time
   // (after the last Address ref is gone — respond paths may touch it)
   void* parse_state = nullptr;
   void (*parse_state_free)(void*) = nullptr;
   bool corked = false;  // see SocketOptions.corked
+  // Protocol-layer hints for the partially-read frame at the head of
+  // read_buf (large frames only).  frame_bytes_hint = the frame's total
+  // wire size; frame_attach_hint = offset where its attachment begins.
+  // ReadToBuf reads bytes before the attachment into pooled blocks, then
+  // the attachment into ONE dedicated block starting exactly at its
+  // offset — so the cut attachment is a single BlockRef, a zero-copy
+  // device-DMA source.  Only touched by the socket's processing fiber.
+  size_t frame_bytes_hint = 0;
+  size_t frame_attach_hint = 0;
 
   static int Create(const SocketOptions& opts, SocketId* id_out);
   // +1 ref; nullptr if the id is stale.
