@@ -205,6 +205,24 @@ def _declare(L: ctypes.CDLL) -> None:
     L.trpc_stream_pending_bytes.argtypes = [c.c_uint64]
     L.trpc_stream_pending_bytes.restype = c.c_int64
 
+    L.trpc_set_usercode_max_inflight.argtypes = [c.c_int64]
+    L.trpc_set_usercode_max_inflight.restype = None
+
+    # native metrics seam + profiler (metrics.h, profiler.h)
+    L.trpc_native_metrics_dump.argtypes = [c.c_char_p, c.c_size_t]
+    L.trpc_native_metrics_dump.restype = c.c_size_t
+    L.trpc_profiler_start.argtypes = [c.c_int]
+    L.trpc_profiler_start.restype = c.c_int
+    # void* out-pointer (not c_char_p: ctypes would convert to bytes and
+    # lose the pointer we must pass back to trpc_profiler_free)
+    L.trpc_profiler_stop.argtypes = [c.POINTER(c.c_void_p)]
+    L.trpc_profiler_stop.restype = c.c_size_t
+    L.trpc_profiler_free.argtypes = [c.c_void_p]
+    L.trpc_profiler_free.restype = None
+    L.trpc_profiler_running.restype = c.c_int
+    L.trpc_symbolize.argtypes = [c.c_void_p, c.c_char_p, c.c_size_t]
+    L.trpc_symbolize.restype = c.c_size_t
+
     # device data plane (native/src/tpu.h: PJRT plugin dlopen'd at runtime)
     L.trpc_tpu_plane_init.argtypes = [c.c_char_p]
     L.trpc_tpu_plane_init.restype = c.c_int

@@ -157,6 +157,66 @@ def _hotspots_locked(req: HttpRequest) -> HttpResponse:
     return HttpResponse.text("\n".join(lines) + "\n")
 
 
+def _pprof_profile(req: HttpRequest) -> HttpResponse:
+    """Native CPU profile (≙ /pprof/profile, builtin/pprof_service.cpp:572
+    — re-designed: SIGPROF sampling over ALL threads including the C++
+    core's workers/dispatchers, folded flamegraph text out).  Query:
+    seconds (default 1, max 30), hz (default 99)."""
+    if not _hotspots_gate.acquire(blocking=False):
+        return HttpResponse.text("another profile is running\n", 429)
+    try:
+        from brpc_tpu._native import lib as _lib
+        L = _lib()
+        try:
+            seconds = float(req.query_params().get("seconds", "1"))
+        except ValueError:
+            return HttpResponse.text("bad seconds\n", 400)
+        if not (seconds == seconds):  # NaN
+            return HttpResponse.text("bad seconds\n", 400)
+        seconds = min(max(seconds, 0.1), 30.0)
+        hz = int(req.query_params().get("hz", "99"))
+        rc = L.trpc_profiler_start(hz)
+        if rc != 0:
+            return HttpResponse.text(f"profiler_start failed rc={rc}\n", 500)
+        try:
+            time.sleep(seconds)
+        finally:
+            # the profiler must never outlive the request (a stuck
+            # ITIMER_PROF samples the process forever)
+            out = ctypes.c_void_p()
+            n = L.trpc_profiler_stop(ctypes.byref(out))
+        try:
+            text = ctypes.string_at(out, n).decode(
+                "utf-8", "replace") if n else ""
+        finally:
+            if out:
+                L.trpc_profiler_free(out)
+        return HttpResponse.text(text or "no samples\n")
+    finally:
+        _hotspots_gate.release()
+
+
+def _pprof_symbol(req: HttpRequest) -> HttpResponse:
+    """≙ /pprof/symbol: resolve hex code addresses to symbol names.
+    GET returns a capability marker (num_symbols); POST body is
+    '0xADDR+0xADDR...' and the response maps each to a name."""
+    from brpc_tpu._native import lib as _lib
+    L = _lib()
+    body = (req.body or b"").decode("ascii", "replace").strip()
+    if not body:
+        return HttpResponse.text("num_symbols: 1\n")
+    out_lines = []
+    buf = ctypes.create_string_buffer(512)
+    for tok in body.replace("+", " ").split():
+        try:
+            addr = int(tok, 16)
+        except ValueError:
+            continue
+        n = L.trpc_symbolize(ctypes.c_void_p(addr), buf, len(buf))
+        out_lines.append(f"{tok}\t{buf.raw[:n].decode()}")
+    return HttpResponse.text("\n".join(out_lines) + "\n")
+
+
 def install_builtin_services(server, dispatcher: HttpDispatcher) -> None:
     """Register the portal routes on a server's dispatcher
     (≙ Server::AddBuiltinServices, server.cpp:468-537)."""
@@ -173,6 +233,8 @@ def install_builtin_services(server, dispatcher: HttpDispatcher) -> None:
     d.register("/flags/", lambda r: _flags_service(r, writable),
                prefix=True)
     d.register("/hotspots", _hotspots)
+    d.register("/pprof/profile", _pprof_profile)
+    d.register("/pprof/symbol", _pprof_symbol)
 
     def _status(req: HttpRequest) -> HttpResponse:
         return HttpResponse.json({

@@ -32,6 +32,20 @@ flags.define_int32("event_dispatcher_num", 1,
                    "server/channel starts")
 flags.define_int32("usercode_workers", 4,
                    "pthreads running Python handlers")
+def _push_usercode_cap(value) -> bool:
+    """Flag validator doubling as the live-reload hook: every /flags set
+    propagates straight into the native admission check."""
+    if value < 0:
+        return False
+    lib().trpc_set_usercode_max_inflight(int(value))
+    return True
+
+
+flags.define_int32("usercode_max_inflight", 4096,
+                   "TRPC requests queued+running in the usercode pool "
+                   "before new ones get ELIMIT (0 = uncapped; "
+                   "reloadable; the concurrency-limiter backstop)",
+                   validator=_push_usercode_cap)
 
 _HANDLER_CB = ctypes.CFUNCTYPE(
     None, ctypes.c_uint64, ctypes.c_char_p,
@@ -313,11 +327,17 @@ class Server:
         fiber.init(self.options.num_workers)
         lib().trpc_set_usercode_workers(
             int(flags.get_flag("usercode_workers")))
+        lib().trpc_set_usercode_max_inflight(
+            int(flags.get_flag("usercode_max_inflight")))
         lib().trpc_set_event_dispatcher_num(
             int(flags.get_flag("event_dispatcher_num")))
         if self.options.enable_builtin_services:
             from brpc_tpu.builtin import install_builtin_services
             install_builtin_services(self, self.http)
+        # native core internals become live bvars (write-queue depth,
+        # PendingCall occupancy, sequencer backlog, usercode queue, ...)
+        from brpc_tpu.metrics.native import install_native_metrics
+        install_native_metrics()
         self._install_http()
         if self.options.auth:
             lib().trpc_server_set_auth(self._handle, self.options.auth,

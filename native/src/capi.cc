@@ -9,6 +9,8 @@
 #include "fiber.h"
 #include "http.h"
 #include "iobuf.h"
+#include "metrics.h"
+#include "profiler.h"
 #include "rpc.h"
 #include "socket.h"
 #include "stream.h"
@@ -92,6 +94,9 @@ void trpc_server_destroy(void* s) { server_destroy((Server*)s); }
 uint64_t trpc_server_requests(void* s) { return server_requests((Server*)s); }
 
 void trpc_set_usercode_workers(int n) { set_usercode_workers(n); }
+void trpc_set_usercode_max_inflight(int64_t n) {
+  set_usercode_max_inflight(n);
+}
 
 void trpc_set_event_dispatcher_num(int n) {
   g_event_dispatcher_num.store(n, std::memory_order_relaxed);
@@ -257,6 +262,23 @@ int trpc_stream_remote_closed(uint64_t h) { return stream_remote_closed(h); }
 int trpc_stream_failed(uint64_t h) { return stream_failed(h); }
 int64_t trpc_stream_pending_bytes(uint64_t h) {
   return stream_pending_bytes(h);
+}
+
+// --- native metrics + profiler (metrics.h, profiler.h) ----------------------
+
+// "name value\n" lines of the native core's internals (merged into the
+// Python bvar registry; ≙ the reference's self-instrumenting bvars).
+size_t trpc_native_metrics_dump(char* buf, size_t cap) {
+  return native_metrics_dump(buf, cap);
+}
+
+int trpc_profiler_start(int hz) { return profiler_start(hz); }
+// Folded flamegraph stacks; caller frees with trpc_profiler_free.
+size_t trpc_profiler_stop(char** out) { return profiler_stop(out); }
+void trpc_profiler_free(char* p) { profiler_free(p); }
+int trpc_profiler_running() { return profiler_running() ? 1 : 0; }
+size_t trpc_symbolize(const void* addr, char* buf, size_t cap) {
+  return profiler_symbolize(addr, buf, cap);
 }
 
 // --- device data plane (tpu.h: PJRT-backed, dlopen'd at runtime) -----------

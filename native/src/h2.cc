@@ -1,5 +1,7 @@
 #include "h2.h"
 
+#include "metrics.h"
+
 #include <string.h>
 
 #include <deque>
@@ -394,6 +396,7 @@ bool LooksLikeH2(const IOBuf& buf) {
 }
 
 H2Conn* H2ConnCreate(Socket* s) {
+  native_metrics().h2_connections.fetch_add(1, std::memory_order_relaxed);
   H2Conn* c = new H2Conn();
   c->refs.store(2, std::memory_order_relaxed);  // registry + caller
   s->is_h2.store(true, std::memory_order_release);
@@ -452,6 +455,8 @@ void H2ConnDestroy(SocketId id) {
     if (it != g_conns.end()) {
       c = it->second;
       g_conns.erase(it);
+      native_metrics().h2_connections.fetch_sub(
+          1, std::memory_order_relaxed);
     }
   }
   H2ConnRelease(c);  // drop the registry's reference

@@ -1,0 +1,60 @@
+#include "metrics.h"
+
+#include <stdio.h>
+
+#include "tpu.h"
+
+namespace trpc {
+
+NativeMetrics& native_metrics() {
+  static NativeMetrics* m = new NativeMetrics();  // leaked on purpose
+  return *m;
+}
+
+size_t native_metrics_dump(char* buf, size_t cap) {
+  NativeMetrics& m = native_metrics();
+  TpuPlaneStats t = tpu_plane_stats();
+  size_t off = 0;
+  auto put = [&](const char* name, long long v) {
+    int n = snprintf(buf + off, off < cap ? cap - off : 0, "%s %lld\n",
+                     name, v);
+    if (n > 0) {
+      off += (size_t)n;
+      if (off > cap) {
+        off = cap;
+      }
+    }
+  };
+  auto rel = [](const std::atomic<int64_t>& a) {
+    return (long long)a.load(std::memory_order_relaxed);
+  };
+  auto relu = [](const std::atomic<uint64_t>& a) {
+    return (long long)a.load(std::memory_order_relaxed);
+  };
+  put("native_usercode_queue_depth", rel(m.usercode_queue_depth));
+  put("native_usercode_submitted", relu(m.usercode_submitted));
+  put("native_usercode_running", rel(m.usercode_running));
+  put("native_usercode_rejected", relu(m.usercode_rejected));
+  put("native_pending_calls", rel(m.pending_calls));
+  put("native_write_requests_queued", rel(m.write_requests_queued));
+  put("native_keepwrite_spawns", relu(m.keepwrite_spawns));
+  put("native_inline_write_completes", relu(m.inline_write_completes));
+  put("native_live_sockets", rel(m.live_sockets));
+  put("native_sockets_created", relu(m.sockets_created));
+  put("native_socket_failures", relu(m.socket_failures));
+  put("native_sequencer_parked", rel(m.sequencer_parked));
+  put("native_parse_errors", relu(m.parse_errors));
+  put("native_h2_connections", rel(m.h2_connections));
+  put("tpu_h2d_transfers", (long long)t.h2d_transfers);
+  put("tpu_d2h_transfers", (long long)t.d2h_transfers);
+  put("tpu_h2d_bytes", (long long)t.h2d_bytes);
+  put("tpu_d2h_bytes", (long long)t.d2h_bytes);
+  put("tpu_events_fired", (long long)t.events_fired);
+  put("tpu_gather_copies", (long long)t.gather_copies);
+  put("tpu_zero_copy_sends", (long long)t.zero_copy_sends);
+  put("tpu_live_buffers", (long long)t.live_buffers);
+  put("tpu_errors", (long long)t.errors);
+  return off;
+}
+
+}  // namespace trpc

@@ -1,0 +1,55 @@
+// metrics.h — the native core's observability seam (≙ the reference's
+// bvar self-instrumentation: every layer publishes its internals,
+// task_control.h:120-130, socket.cpp bvars, baidu_rpc_protocol counters).
+// ~All hot-path work happens in this library; these counters make it
+// visible to /vars, /metrics (Prometheus) and /status through the Python
+// bvar registry (brpc_tpu/metrics/bvar.py merges native_metrics_dump()).
+//
+// Write side: single atomic add/sub on already-dirty cache lines (the
+// counters sit next to the code that owns the state).  Read side: one
+// pass formatting every counter — called at human frequency only.
+#pragma once
+
+#include <atomic>
+#include <cstddef>
+#include <cstdint>
+
+namespace trpc {
+
+struct NativeMetrics {
+  // usercode pool (rpc.cc UsercodePool): Python-handler dispatch
+  std::atomic<int64_t> usercode_queue_depth{0};  // submitted, not started
+  std::atomic<uint64_t> usercode_submitted{0};
+  std::atomic<int64_t> usercode_running{0};      // inside a handler now
+  std::atomic<uint64_t> usercode_rejected{0};    // ELIMIT (UsercodeAdmit)
+
+  // client correlation (rpc.cc PendingCall pool)
+  std::atomic<int64_t> pending_calls{0};         // armed, awaiting response
+
+  // socket write path (socket.cc)
+  std::atomic<int64_t> write_requests_queued{0}; // WriteRequests in flight
+  std::atomic<uint64_t> keepwrite_spawns{0};     // background drain fibers
+  std::atomic<uint64_t> inline_write_completes{0};  // drained in Write()
+
+  // sockets (socket.cc)
+  std::atomic<int64_t> live_sockets{0};
+  std::atomic<uint64_t> sockets_created{0};
+  std::atomic<uint64_t> socket_failures{0};
+
+  // server-side pipelining sequencer (rpc.cc ConnState)
+  std::atomic<int64_t> sequencer_parked{0};      // out-of-order responses held
+
+  // protocol errors observed on input (both sides)
+  std::atomic<uint64_t> parse_errors{0};
+
+  // h2 connections (h2.cc registry)
+  std::atomic<int64_t> h2_connections{0};
+};
+
+NativeMetrics& native_metrics();
+
+// Write "name value\n" lines (plus the device-plane counters from tpu.h)
+// into buf; returns bytes written (truncated at cap).
+size_t native_metrics_dump(char* buf, size_t cap);
+
+}  // namespace trpc

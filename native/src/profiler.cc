@@ -1,0 +1,211 @@
+#include "profiler.h"
+
+#include <cxxabi.h>
+#include <dlfcn.h>
+#include <errno.h>
+#include <execinfo.h>
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/time.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace trpc {
+
+namespace {
+
+constexpr int kMaxDepth = 48;
+constexpr int kMaxSamples = 1 << 16;  // ~64k samples ≈ 11 min @99Hz
+
+struct Sample {
+  void* frames[kMaxDepth];
+  int depth;
+};
+
+// Preallocated ring; the handler claims a slot with one fetch_add.
+Sample* g_samples = nullptr;
+std::atomic<int> g_nsamples{0};
+std::atomic<bool> g_running{false};
+std::atomic<uint64_t> g_dropped{0};
+std::mutex g_mu;  // serializes start/stop
+
+void sigprof_handler(int, siginfo_t*, void*) {
+  if (!g_running.load(std::memory_order_acquire)) {
+    return;
+  }
+  int idx = g_nsamples.fetch_add(1, std::memory_order_acq_rel);
+  if (idx >= kMaxSamples) {
+    g_nsamples.store(kMaxSamples, std::memory_order_release);
+    g_dropped.fetch_add(1, std::memory_order_relaxed);
+    return;
+  }
+  Sample& s = g_samples[idx];
+  // backtrace() is not strictly async-signal-safe but is the standard
+  // practice for SIGPROF profilers (gperftools does equivalent unwinds);
+  // the first call in profiler_start preloads libgcc so no malloc
+  // happens here.
+  s.depth = backtrace(s.frames, kMaxDepth);
+}
+
+std::string symbolize(void* addr) {
+  Dl_info info;
+  if (dladdr(addr, &info) != 0 && info.dli_sname != nullptr) {
+    int status = 0;
+    char* dem = abi::__cxa_demangle(info.dli_sname, nullptr, nullptr,
+                                    &status);
+    std::string out;
+    if (status == 0 && dem != nullptr) {
+      out = dem;
+    } else {
+      out = info.dli_sname;
+    }
+    free(dem);
+    // trim template/arg noise for readable flame lines
+    size_t paren = out.find('(');
+    if (paren != std::string::npos) {
+      out.resize(paren);
+    }
+    return out;
+  }
+  char buf[32];
+  snprintf(buf, sizeof(buf), "0x%zx", (size_t)addr);
+  return buf;
+}
+
+}  // namespace
+
+int profiler_start(int hz) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (g_running.load(std::memory_order_acquire)) {
+    return -EBUSY;
+  }
+  if (hz < 1) {
+    hz = 99;
+  }
+  if (hz > 1000) {
+    hz = 1000;
+  }
+  if (g_samples == nullptr) {
+    g_samples = (Sample*)malloc(sizeof(Sample) * kMaxSamples);
+    if (g_samples == nullptr) {
+      return -ENOMEM;
+    }
+  }
+  // zero depths so a slot claimed but not yet written by a straggling
+  // handler reads as depth 0 and is skipped by the reader
+  memset(g_samples, 0, sizeof(Sample) * kMaxSamples);
+  // preload the unwinder's lazy state outside the signal handler
+  void* warm[4];
+  backtrace(warm, 4);
+  g_nsamples.store(0, std::memory_order_release);
+  g_dropped.store(0, std::memory_order_relaxed);
+  struct sigaction sa;
+  memset(&sa, 0, sizeof(sa));
+  sa.sa_sigaction = sigprof_handler;
+  sa.sa_flags = SA_SIGINFO | SA_RESTART;
+  if (sigaction(SIGPROF, &sa, nullptr) != 0) {
+    return -errno;
+  }
+  g_running.store(true, std::memory_order_release);
+  itimerval tv;
+  tv.it_interval.tv_sec = 0;
+  tv.it_interval.tv_usec = 1000000 / hz;
+  tv.it_value = tv.it_interval;
+  if (setitimer(ITIMER_PROF, &tv, nullptr) != 0) {
+    g_running.store(false, std::memory_order_release);
+    return -errno;
+  }
+  return 0;
+}
+
+size_t profiler_stop(char** out) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  *out = nullptr;
+  if (!g_running.exchange(false, std::memory_order_acq_rel)) {
+    return 0;
+  }
+  itimerval off;
+  memset(&off, 0, sizeof(off));
+  setitimer(ITIMER_PROF, &off, nullptr);
+  // a handler may be mid-flight on another thread: its slot claim happened
+  // before it writes frames; give stragglers a moment
+  usleep(2000);
+  int n = g_nsamples.load(std::memory_order_acquire);
+  if (n > kMaxSamples) {
+    n = kMaxSamples;
+  }
+  // fold: addr-stack -> count, then symbolize unique addresses once
+  std::map<std::vector<void*>, int> folded;
+  std::map<void*, std::string> syms;
+  for (int i = 0; i < n; ++i) {
+    const Sample& s = g_samples[i];
+    // depth 0 = straggler slot never finished; clamp against corruption
+    if (s.depth <= 2 || s.depth > kMaxDepth) {
+      continue;
+    }
+    // skip the handler + kernel trampoline frames (top 2)
+    std::vector<void*> key(s.frames + 2, s.frames + s.depth);
+    folded[key]++;
+    for (void* a : key) {
+      syms.emplace(a, std::string());
+    }
+  }
+  for (auto& kv : syms) {
+    kv.second = symbolize(kv.first);
+  }
+  std::string text;
+  text.reserve(folded.size() * 96);
+  for (const auto& [stack, count] : folded) {
+    // flamegraph folded format: root;...;leaf count
+    for (auto it = stack.rbegin(); it != stack.rend(); ++it) {
+      if (it != stack.rbegin()) {
+        text += ';';
+      }
+      text += syms[*it];
+    }
+    char tail[24];
+    snprintf(tail, sizeof(tail), " %d\n", count);
+    text += tail;
+  }
+  uint64_t dropped = g_dropped.load(std::memory_order_relaxed);
+  if (dropped > 0) {
+    char note[64];
+    snprintf(note, sizeof(note), "[profiler_dropped_samples] %llu\n",
+             (unsigned long long)dropped);
+    text += note;
+  }
+  char* mem = (char*)malloc(text.size() + 1);
+  if (mem == nullptr) {
+    return 0;
+  }
+  memcpy(mem, text.data(), text.size());
+  mem[text.size()] = '\0';
+  *out = mem;
+  return text.size();
+}
+
+void profiler_free(char* p) { free(p); }
+
+bool profiler_running() {
+  return g_running.load(std::memory_order_acquire);
+}
+
+size_t profiler_symbolize(const void* addr, char* buf, size_t cap) {
+  if (cap == 0) {
+    return 0;
+  }
+  std::string s = symbolize((void*)addr);
+  size_t n = s.size() < cap - 1 ? s.size() : cap - 1;
+  memcpy(buf, s.data(), n);
+  buf[n] = '\0';
+  return n;
+}
+
+}  // namespace trpc

@@ -1,0 +1,33 @@
+// profiler.h — in-process CPU profiler for the native core (capability of
+// the reference's /pprof/profile + hotspots service,
+// builtin/pprof_service.cpp:572 + hotspots_service.cpp:1240, re-designed:
+// SIGPROF sampling + folded-stack text output instead of gperftools).
+//
+// SIGPROF fires on whichever thread is consuming CPU (ITIMER_PROF is
+// process-wide), so worker fibers, epoll threads, usercode pthreads and
+// PJRT callback threads all get sampled.  The handler captures a raw
+// backtrace into a preallocated lock-free ring; symbolization (dladdr +
+// demangle) happens at stop time, off the signal path.
+#pragma once
+
+#include <cstddef>
+
+namespace trpc {
+
+// Begin sampling at `hz` (49-997 sensible; default 99 avoids lockstep
+// with 100Hz timers).  Returns 0, -EBUSY if already running, or -errno.
+int profiler_start(int hz);
+
+// Stop sampling and render folded stacks ("sym;sym;sym count\n" —
+// flamegraph format, leaf last) into a malloc'd buffer the caller frees
+// with profiler_free().  Returns byte length (0 if never started).
+size_t profiler_stop(char** out);
+void profiler_free(char* p);
+
+bool profiler_running();
+
+// Resolve one code address to a (demangled) symbol name into buf.
+// Returns bytes written ("0x..." hex fallback when unknown).
+size_t profiler_symbolize(const void* addr, char* buf, size_t cap);
+
+}  // namespace trpc

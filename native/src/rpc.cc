@@ -21,6 +21,7 @@
 
 #include "h2.h"
 #include "http.h"
+#include "metrics.h"
 #include "object_pool.h"
 #include "redis.h"
 #include "stream.h"
@@ -304,6 +305,28 @@ struct CallCtx {
 // (the usercode_workers flag, ≙ reference FLAGS_usercode_backup_pool size)
 std::atomic<int> g_usercode_workers{4};
 
+// Backpressure cap on TRPC usercode work in flight (queued + running):
+// beyond it new requests are rejected with ELIMIT instead of growing the
+// queue without bound (≙ ConcurrencyLimiter, concurrency_limiter.h:29-44;
+// HTTP/RESP already cap per-connection at kMaxPipelined).
+std::atomic<int64_t> g_usercode_max_inflight{4096};
+
+bool UsercodeAdmit() {
+  NativeMetrics& nm = native_metrics();
+  int64_t limit = g_usercode_max_inflight.load(std::memory_order_relaxed);
+  if (limit <= 0) {
+    return true;  // 0 = uncapped
+  }
+  int64_t inflight =
+      nm.usercode_queue_depth.load(std::memory_order_relaxed) +
+      nm.usercode_running.load(std::memory_order_relaxed);
+  if (inflight >= limit) {
+    nm.usercode_rejected.fetch_add(1, std::memory_order_relaxed);
+    return false;
+  }
+  return true;
+}
+
 class UsercodePool {
  public:
   static UsercodePool& Instance() {
@@ -313,6 +336,9 @@ class UsercodePool {
 
   void Submit(CallCtx* ctx) {
     EnsureStarted();
+    NativeMetrics& nm = native_metrics();
+    nm.usercode_submitted.fetch_add(1, std::memory_order_relaxed);
+    nm.usercode_queue_depth.fetch_add(1, std::memory_order_relaxed);
     {
       std::lock_guard<std::mutex> lk(mu_);
       q_.push_back(ctx);
@@ -340,12 +366,15 @@ class UsercodePool {
   }
 
   void Run() {
+    NativeMetrics& nm = native_metrics();
     std::unique_lock<std::mutex> lk(mu_);
     while (true) {
       cv_.wait(lk, [this] { return !q_.empty(); });
       CallCtx* ctx = q_.front();
       q_.pop_front();
       lk.unlock();
+      nm.usercode_queue_depth.fetch_sub(1, std::memory_order_relaxed);
+      nm.usercode_running.fetch_add(1, std::memory_order_relaxed);
       if (ctx->is_redis) {
         ctx->rcb(ctx->token(), (const uint8_t*)ctx->payload.data(),
                  ctx->payload.size(), ctx->user);
@@ -362,6 +391,7 @@ class UsercodePool {
                 (const uint8_t*)ctx->attachment.data(),
                 ctx->attachment.size(), ctx->user);
       }
+      nm.usercode_running.fetch_sub(1, std::memory_order_relaxed);
       lk.lock();
     }
   }
@@ -423,6 +453,14 @@ struct ConnState {
     bool close_after = false;
   };
   std::unordered_map<uint64_t, Ready> ready;  // out-of-order completions
+
+  ~ConnState() {
+    // responses still parked when the connection died
+    if (!ready.empty()) {
+      native_metrics().sequencer_parked.fetch_sub(
+          (int64_t)ready.size(), std::memory_order_relaxed);
+    }
+  }
 };
 
 constexpr uint64_t kMaxPipelined = 64;  // per-connection in-flight cap
@@ -443,6 +481,7 @@ void CloseAfterWrite(Socket* s, IOBuf&& resp);  // defined near http_respond
 void ReleaseSequenced(Socket* s, uint64_t seq, IOBuf&& data,
                       bool close_after) {
   ConnState* cs = (ConnState*)s->parse_state;
+  NativeMetrics& nm = native_metrics();
   bool rearm = false;
   {
     std::lock_guard<std::mutex> lk(cs->mu);
@@ -453,6 +492,7 @@ void ReleaseSequenced(Socket* s, uint64_t seq, IOBuf&& data,
       ConnState::Ready& r = cs->ready[seq];
       r.data = std::move(data);
       r.close_after = close_after;
+      nm.sequencer_parked.fetch_add(1, std::memory_order_relaxed);
       return;
     }
     // write in order: this one, then every queued successor
@@ -471,6 +511,7 @@ void ReleaseSequenced(Socket* s, uint64_t seq, IOBuf&& data,
       data = std::move(it->second.data);
       close_after = it->second.close_after;
       cs->ready.erase(it);
+      nm.sequencer_parked.fetch_sub(1, std::memory_order_relaxed);
     }
     if (cs->parse_capped &&
         cs->next_dispatch - cs->next_release < kMaxPipelined) {
@@ -938,6 +979,13 @@ void ServerOnMessages(Socket* s) {
       PackFrame(&batched_out, rmeta, std::move(payload),
                 std::move(attachment));
     } else {
+      if (!UsercodeAdmit()) {
+        // flood of requests into a slow handler pool: reject instead of
+        // queueing unboundedly (≙ ELIMIT from the concurrency limiter)
+        SendResponse(s->id(), meta.correlation_id, TRPC_ELIMIT,
+                     "usercode backlog full", IOBuf(), IOBuf());
+        continue;
+      }
       CallCtx* ctx = nullptr;
       uint32_t slot = ResourcePool<CallCtx>::Get(&ctx);
       ctx->slot = slot;
@@ -1979,6 +2027,10 @@ void set_usercode_workers(int n) {
   g_usercode_workers.store(n, std::memory_order_relaxed);
 }
 
+void set_usercode_max_inflight(int64_t n) {
+  g_usercode_max_inflight.store(n, std::memory_order_relaxed);
+}
+
 void channel_set_connection_type(Channel* c, int t) {
   c->conn_type = t;
 }
@@ -2075,6 +2127,7 @@ int channel_call(Channel* c, const char* method, const uint8_t* req,
   uint32_t ver =
       (uint32_t)(pc->vs.load(std::memory_order_relaxed) >> 32);
   pc->vs.store(((uint64_t)ver << 32) | PC_ARMED, std::memory_order_release);
+  native_metrics().pending_calls.fetch_add(1, std::memory_order_relaxed);
   uint64_t corr = ((uint64_t)ver << 32) | slot;
   conn->SweepLink(pc);
   RpcMeta meta;
@@ -2159,6 +2212,7 @@ int channel_call(Channel* c, const char* method, const uint8_t* req,
   uint32_t ver2 = (uint32_t)(pc->vs.load(std::memory_order_relaxed) >> 32);
   pc->vs.store(((uint64_t)(ver2 + 1) << 32) | PC_FREE,
                std::memory_order_release);
+  native_metrics().pending_calls.fetch_sub(1, std::memory_order_relaxed);
   ResourcePool<PendingCall>::Return(slot);
   if (conn->short_lived && !(stream != 0 && result == 0)) {
     // one call per connection — unless a stream now rides it (then the

@@ -161,6 +161,9 @@ int channel_transport_state(Channel* c);
 
 // size of the pthread pool running Python handlers (before first request)
 void set_usercode_workers(int n);
+// TRPC usercode in-flight cap (queued + running); beyond it requests get
+// ELIMIT (≙ ConcurrencyLimiter).  0 = uncapped.  Reloadable.
+void set_usercode_max_inflight(int64_t n);
 
 struct CallResult {
   int32_t error_code = 0;

@@ -11,6 +11,7 @@
 #include <thread>
 #include <vector>
 
+#include "metrics.h"
 #include "object_pool.h"
 
 namespace trpc {
@@ -52,6 +53,8 @@ int Socket::Create(const SocketOptions& opts, SocketId* id_out) {
   s->advertise_device_caps.store(false, std::memory_order_relaxed);
   s->corked = opts.corked;
   s->frame_bytes_hint = 0;
+  native_metrics().sockets_created.fetch_add(1, std::memory_order_relaxed);
+  native_metrics().live_sockets.fetch_add(1, std::memory_order_relaxed);
   if (s->epollout_butex == nullptr) {
     s->epollout_butex = butex_create();
   }
@@ -171,6 +174,7 @@ void Socket::TryRecycle(uint32_t odd_ver) {
   }
   parse_state = nullptr;
   parse_state_free = nullptr;
+  native_metrics().live_sockets.fetch_sub(1, std::memory_order_relaxed);
   ResourcePool<Socket>::Return(slot);
   // announce the completed recycle to teardown waiters (WaitRecycled)
   Butex* b = recycle_butex();
@@ -185,6 +189,11 @@ void Socket::SetFailed(int err) {
     return;  // only the first failure proceeds
   }
   error_code = err;
+  native_metrics().socket_failures.fetch_add(1, std::memory_order_relaxed);
+  if (err == TRPC_EREQUEST) {
+    // malformed input killed the connection (≙ per-socket parse errors)
+    native_metrics().parse_errors.fetch_add(1, std::memory_order_relaxed);
+  }
   // flip version to odd FIRST: from here no new Address can take a ref,
   // so the count can only drain to zero once
   versioned_ref.fetch_add(1ULL << 32, std::memory_order_acq_rel);
@@ -300,6 +309,8 @@ int Socket::Write(IOBuf&& data, Butex* notify) {
     return -TRPC_EFAILEDSOCKET;
   }
   WriteRequest* req = ObjectPool<WriteRequest>::Get();
+  native_metrics().write_requests_queued.fetch_add(
+      1, std::memory_order_relaxed);
   req->data = std::move(data);
   req->notify = notify;
   req->next.store(UNCONNECTED, std::memory_order_relaxed);
@@ -327,9 +338,13 @@ int Socket::Write(IOBuf&& data, Butex* notify) {
       butex_value(req->notify).fetch_add(1, std::memory_order_release);
       butex_wake_all(req->notify);
     }
+    native_metrics().inline_write_completes.fetch_add(
+        1, std::memory_order_relaxed);
     WriteRequest* expected = req;
     if (write_head.compare_exchange_strong(expected, nullptr,
                                            std::memory_order_acq_rel)) {
+      native_metrics().write_requests_queued.fetch_sub(
+          1, std::memory_order_relaxed);
       ObjectPool<WriteRequest>::Return(req);
       return 0;
     }
@@ -343,6 +358,7 @@ int Socket::Write(IOBuf&& data, Butex* notify) {
     RunKeepWrite(req);
     return -TRPC_EFAILEDSOCKET;
   }
+  native_metrics().keepwrite_spawns.fetch_add(1, std::memory_order_relaxed);
   KeepWriteArg* kw = ObjectPool<KeepWriteArg>::Get();
   kw->id = id();
   kw->req = req;
@@ -411,6 +427,8 @@ void Socket::RunKeepWrite(WriteRequest* req) {
       if (next == nullptr) {
         break;  // req is the newest absorbed; keep it as the CAS anchor
       }
+      native_metrics().write_requests_queued.fetch_sub(
+          1, std::memory_order_relaxed);
       ObjectPool<WriteRequest>::Return(req);
       req = next;
     }
@@ -451,10 +469,14 @@ void Socket::RunKeepWrite(WriteRequest* req) {
     WriteRequest* expected = req;
     if (s->write_head.compare_exchange_strong(expected, nullptr,
                                               std::memory_order_acq_rel)) {
+      native_metrics().write_requests_queued.fetch_sub(
+          1, std::memory_order_relaxed);
       ObjectPool<WriteRequest>::Return(req);
       break;
     }
     WriteRequest* fifo = s->GrabNewer(req);
+    native_metrics().write_requests_queued.fetch_sub(
+        1, std::memory_order_relaxed);
     ObjectPool<WriteRequest>::Return(req);
     req = fifo;
   }

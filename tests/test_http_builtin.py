@@ -397,8 +397,17 @@ class TestRpcz:
             srv.start("127.0.0.1:0")
             try:
                 Channel(f"127.0.0.1:{srv.port}").call("Noted", b"")
-                anns = [a for s in span.recent_spans(10)
-                        for a in s.annotations]
+                # the client unblocks on the native response, which can
+                # land before the server-side Python thread persists the
+                # span — poll briefly instead of racing it
+                import time as _t
+                deadline = _t.monotonic() + 2.0
+                while _t.monotonic() < deadline:
+                    anns = [a for s in span.recent_spans(10)
+                            for a in s.annotations]
+                    if any("inside handler" in a for a in anns):
+                        break
+                    _t.sleep(0.01)
                 assert any("inside handler" in a for a in anns)
             finally:
                 srv.destroy()
