@@ -208,6 +208,33 @@ def _declare(L: ctypes.CDLL) -> None:
     L.trpc_set_usercode_max_inflight.argtypes = [c.c_int64]
     L.trpc_set_usercode_max_inflight.restype = None
 
+    # fiber sync primitives (fiber_sync.h)
+    L.trpc_mutex_create.restype = c.c_void_p
+    L.trpc_mutex_destroy.argtypes = [c.c_void_p]
+    L.trpc_mutex_lock.argtypes = [c.c_void_p]
+    L.trpc_mutex_trylock.argtypes = [c.c_void_p]
+    L.trpc_mutex_trylock.restype = c.c_int
+    L.trpc_mutex_unlock.argtypes = [c.c_void_p]
+    L.trpc_cond_create.restype = c.c_void_p
+    L.trpc_cond_destroy.argtypes = [c.c_void_p]
+    L.trpc_cond_wait.argtypes = [c.c_void_p, c.c_void_p, c.c_int64]
+    L.trpc_cond_wait.restype = c.c_int
+    L.trpc_cond_notify_one.argtypes = [c.c_void_p]
+    L.trpc_cond_notify_all.argtypes = [c.c_void_p]
+    L.trpc_countdown_create.argtypes = [c.c_int]
+    L.trpc_countdown_create.restype = c.c_void_p
+    L.trpc_countdown_destroy.argtypes = [c.c_void_p]
+    L.trpc_countdown_signal.argtypes = [c.c_void_p, c.c_int]
+    L.trpc_countdown_add.argtypes = [c.c_void_p, c.c_int]
+    L.trpc_countdown_wait.argtypes = [c.c_void_p, c.c_int64]
+    L.trpc_countdown_wait.restype = c.c_int
+    L.trpc_rwlock_create.restype = c.c_void_p
+    L.trpc_rwlock_destroy.argtypes = [c.c_void_p]
+    L.trpc_rwlock_rdlock.argtypes = [c.c_void_p]
+    L.trpc_rwlock_rdunlock.argtypes = [c.c_void_p]
+    L.trpc_rwlock_wrlock.argtypes = [c.c_void_p]
+    L.trpc_rwlock_wrunlock.argtypes = [c.c_void_p]
+
     # native metrics seam + profiler (metrics.h, profiler.h)
     L.trpc_native_metrics_dump.argtypes = [c.c_char_p, c.c_size_t]
     L.trpc_native_metrics_dump.restype = c.c_size_t

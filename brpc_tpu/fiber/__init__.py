@@ -122,3 +122,113 @@ class Butex:
 
     def wake_all(self) -> int:
         return lib().trpc_butex_wake_all(self._b)
+
+
+# -- sync primitives on butex (≙ bthread mutex/cond/rwlock/countdown) -------
+# All of these park a fiber without consuming a thread and work equally
+# from plain pthreads (native/src/fiber_sync.h).
+
+
+class Mutex:
+    """≙ bthread_mutex (src/bthread/mutex.cpp): futex-style 0/1/2 states,
+    one CAS on the uncontended path."""
+
+    def __init__(self):
+        init()
+        self._m = lib().trpc_mutex_create()
+
+    def close(self):
+        if self._m:
+            lib().trpc_mutex_destroy(self._m)
+            self._m = None
+
+    def acquire(self) -> None:
+        lib().trpc_mutex_lock(self._m)
+
+    def try_acquire(self) -> bool:
+        return bool(lib().trpc_mutex_trylock(self._m))
+
+    def release(self) -> None:
+        lib().trpc_mutex_unlock(self._m)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+
+class Cond:
+    """≙ bthread_cond (condition_variable.cpp): sequence-counter wait over
+    a Mutex; no missed wakeups."""
+
+    def __init__(self):
+        init()
+        self._c = lib().trpc_cond_create()
+
+    def close(self):
+        if self._c:
+            lib().trpc_cond_destroy(self._c)
+            self._c = None
+
+    def wait(self, mutex: "Mutex", timeout_us: Optional[int] = None) -> bool:
+        """mutex must be held; re-held on return.  False on timeout."""
+        t = -1 if timeout_us is None else timeout_us
+        return lib().trpc_cond_wait(self._c, mutex._m, t) == 0
+
+    def notify_one(self) -> None:
+        lib().trpc_cond_notify_one(self._c)
+
+    def notify_all(self) -> None:
+        lib().trpc_cond_notify_all(self._c)
+
+
+class CountdownEvent:
+    """≙ bthread CountdownEvent (countdown_event.cpp): init N, workers
+    signal(), waiters park until the count reaches zero."""
+
+    def __init__(self, initial: int = 1):
+        init()
+        self._e = lib().trpc_countdown_create(initial)
+
+    def close(self):
+        if self._e:
+            lib().trpc_countdown_destroy(self._e)
+            self._e = None
+
+    def signal(self, n: int = 1) -> None:
+        lib().trpc_countdown_signal(self._e, n)
+
+    def add(self, n: int = 1) -> None:
+        lib().trpc_countdown_add(self._e, n)
+
+    def wait(self, timeout_us: Optional[int] = None) -> bool:
+        """False on timeout."""
+        t = -1 if timeout_us is None else timeout_us
+        return lib().trpc_countdown_wait(self._e, t) == 0
+
+
+class RWLock:
+    """≙ bthread_rwlock: write-preferring reader/writer lock."""
+
+    def __init__(self):
+        init()
+        self._l = lib().trpc_rwlock_create()
+
+    def close(self):
+        if self._l:
+            lib().trpc_rwlock_destroy(self._l)
+            self._l = None
+
+    def rdlock(self) -> None:
+        lib().trpc_rwlock_rdlock(self._l)
+
+    def rdunlock(self) -> None:
+        lib().trpc_rwlock_rdunlock(self._l)
+
+    def wrlock(self) -> None:
+        lib().trpc_rwlock_wrlock(self._l)
+
+    def wrunlock(self) -> None:
+        lib().trpc_rwlock_wrunlock(self._l)

@@ -7,6 +7,7 @@
 #include <cstring>
 
 #include "fiber.h"
+#include "fiber_sync.h"
 #include "http.h"
 #include "iobuf.h"
 #include "metrics.h"
@@ -70,6 +71,44 @@ int trpc_butex_wait(void* b, int32_t expected, int64_t timeout_us) {
 }
 int trpc_butex_wake(void* b) { return butex_wake((Butex*)b); }
 int trpc_butex_wake_all(void* b) { return butex_wake_all((Butex*)b); }
+
+// --- fiber sync primitives (fiber_sync.h ≙ bthread mutex/cond/rwlock/
+// countdown_event) — usable from fibers AND pthreads -----------------------
+
+void* trpc_mutex_create() { return new FiberMutex(); }
+void trpc_mutex_destroy(void* m) { delete (FiberMutex*)m; }
+void trpc_mutex_lock(void* m) { ((FiberMutex*)m)->lock(); }
+int trpc_mutex_trylock(void* m) {
+  return ((FiberMutex*)m)->try_lock() ? 1 : 0;
+}
+void trpc_mutex_unlock(void* m) { ((FiberMutex*)m)->unlock(); }
+
+void* trpc_cond_create() { return new FiberCond(); }
+void trpc_cond_destroy(void* c) { delete (FiberCond*)c; }
+int trpc_cond_wait(void* c, void* m, int64_t timeout_us) {
+  return ((FiberCond*)c)->wait((FiberMutex*)m, timeout_us);
+}
+void trpc_cond_notify_one(void* c) { ((FiberCond*)c)->notify_one(); }
+void trpc_cond_notify_all(void* c) { ((FiberCond*)c)->notify_all(); }
+
+void* trpc_countdown_create(int initial) {
+  return new CountdownEvent(initial);
+}
+void trpc_countdown_destroy(void* e) { delete (CountdownEvent*)e; }
+void trpc_countdown_signal(void* e, int n) {
+  ((CountdownEvent*)e)->signal(n);
+}
+void trpc_countdown_add(void* e, int n) { ((CountdownEvent*)e)->add(n); }
+int trpc_countdown_wait(void* e, int64_t timeout_us) {
+  return ((CountdownEvent*)e)->wait(timeout_us);
+}
+
+void* trpc_rwlock_create() { return new FiberRWLock(); }
+void trpc_rwlock_destroy(void* l) { delete (FiberRWLock*)l; }
+void trpc_rwlock_rdlock(void* l) { ((FiberRWLock*)l)->rdlock(); }
+void trpc_rwlock_rdunlock(void* l) { ((FiberRWLock*)l)->rdunlock(); }
+void trpc_rwlock_wrlock(void* l) { ((FiberRWLock*)l)->wrlock(); }
+void trpc_rwlock_wrunlock(void* l) { ((FiberRWLock*)l)->wrunlock(); }
 
 // --- server ----------------------------------------------------------------
 

@@ -1,0 +1,183 @@
+// execution_queue.h — wait-free MPSC task queue with an on-demand
+// consumer fiber (capability of the reference bthread ExecutionQueue,
+// execution_queue.h:22-25: "execute tasks in-order asynchronously...
+// different from bthread_mutex, the queue is wait-free on the producer
+// side; the consumer bthread is started on demand and exits when all
+// tasks are executed").
+//
+// Producer side: one atomic exchange onto a Treiber stack (the exact
+// pattern of Socket's wait-free write queue).  The producer that turns
+// the queue non-empty spawns the consumer fiber; everyone else returns
+// immediately.  Consumer side: reverse the grabbed segment to FIFO, run
+// each task, re-check for new arrivals, exit when a CAS confirms empty.
+//
+// Used by the h2 response path (concurrent usercode handlers submit
+// responses without contending the connection mutex) and the stream
+// write path (ordered frame emission without a syscall under a lock).
+#pragma once
+
+#include <atomic>
+
+#include "fiber.h"
+#include "object_pool.h"
+
+namespace trpc {
+
+class ExecutionQueue {
+ public:
+  // fn(queue_arg, task_arg): runs on the consumer fiber, strictly in
+  // submission order.
+  typedef void (*ExecFn)(void* queue_arg, void* task_arg);
+
+  ExecutionQueue() = default;
+  // Owner must guarantee no consumer is running (e.g. H2Conn's refcount
+  // pins one ref per consumer run via the Init hooks).
+  ~ExecutionQueue() {
+    if (busy_ != nullptr) {
+      butex_destroy(busy_);
+      busy_ = nullptr;
+    }
+  }
+  ExecutionQueue(const ExecutionQueue&) = delete;
+  ExecutionQueue& operator=(const ExecutionQueue&) = delete;
+
+  // Must be called (once) before the first Submit.  The optional hooks
+  // bracket each consumer run: on_start fires in Submit before the
+  // consumer can run, on_exit after the drain fully ends — the owner of
+  // the queue pins its own lifetime there (e.g. H2Conn takes a ref in
+  // on_start and drops it in on_exit, so a task releasing the last
+  // object ref can never free the queue out from under the drain loop).
+  void Init(ExecFn fn, void* queue_arg,
+            void (*on_start)(void*) = nullptr,
+            void (*on_exit)(void*) = nullptr) {
+    fn_ = fn;
+    queue_arg_ = queue_arg;
+    on_start_ = on_start;
+    on_exit_ = on_exit;
+    if (busy_ == nullptr) {
+      busy_ = butex_create();  // value = active consumers (0 or 1; 2 in
+                               // the brief old-exit/new-start overlap)
+    }
+  }
+
+  // Wait-free enqueue.  The producer that turns the queue non-empty
+  // starts the consumer fiber (draining inline if a fiber can't spawn —
+  // order preserved: only the queue-starting producer can fall back).
+  int Submit(void* task_arg) {
+    Node* n = ObjectPool<Node>::Get();
+    n->task_arg = task_arg;
+    n->next.store(kUnlinked(), std::memory_order_relaxed);
+    Node* prev = head_.exchange(n, std::memory_order_acq_rel);
+    if (prev != nullptr) {
+      // an active consumer (or the producer that created it) will reach us
+      n->next.store(prev, std::memory_order_release);
+      return 0;
+    }
+    n->next.store(nullptr, std::memory_order_relaxed);
+    // a counter, not a flag: an exiting consumer's decrement and a new
+    // starter's increment can interleave either way without losing state
+    butex_value(busy_).fetch_add(1, std::memory_order_acq_rel);
+    if (on_start_ != nullptr) {
+      on_start_(queue_arg_);
+    }
+    starter_node_ = n;  // published before the fiber can run
+    fiber_t f;
+    if (fiber_start(&f, &ExecutionQueue::ConsumerFiber, this) != 0) {
+      Drain(n);  // cannot spawn: drain inline on the caller
+      if (on_exit_ != nullptr) {
+        on_exit_(queue_arg_);
+      }
+    }
+    return 0;
+  }
+
+  // Block (fiber-friendly) until the queue goes idle.
+  void Join() {
+    while (true) {
+      int32_t v = butex_value(busy_).load(std::memory_order_acquire);
+      if (v == 0) {
+        return;
+      }
+      butex_wait(busy_, v, 100 * 1000);
+    }
+  }
+
+  bool idle() const {
+    return butex_value(busy_).load(std::memory_order_acquire) == 0;
+  }
+
+ private:
+  struct Node {
+    void* task_arg = nullptr;
+    std::atomic<Node*> next{nullptr};
+  };
+  static Node* kUnlinked() { return (Node*)(intptr_t)-1; }
+
+  static void ConsumerFiber(void* arg) {
+    ExecutionQueue* q = (ExecutionQueue*)arg;
+    // snapshot hook state first: after Drain the owner may be freed by
+    // on_exit itself, so q must not be touched afterwards
+    void (*on_exit)(void*) = q->on_exit_;
+    void* qarg = q->queue_arg_;
+    q->Drain(q->starter_node_);
+    if (on_exit != nullptr) {
+      on_exit(qarg);
+    }
+  }
+
+  // Reverse [head_ .. anchor) into FIFO order; returns the oldest of the
+  // newer batch (anchor's successor).  Mirrors Socket::GrabNewer.
+  Node* GrabNewer(Node* anchor) {
+    Node* p = head_.load(std::memory_order_acquire);
+    Node* prev = nullptr;
+    while (p != anchor) {
+      Node* nx;
+      while ((nx = p->next.load(std::memory_order_acquire)) ==
+             kUnlinked()) {
+#if defined(__x86_64__)
+        __builtin_ia32_pause();
+#endif
+      }
+      p->next.store(prev, std::memory_order_relaxed);
+      prev = p;
+      p = nx;
+    }
+    return prev;
+  }
+
+  void Drain(Node* n) {
+    while (true) {
+      // run n and everything already linked behind it, FIFO
+      while (true) {
+        fn_(queue_arg_, n->task_arg);
+        Node* next = n->next.load(std::memory_order_relaxed);
+        if (next == nullptr) {
+          break;  // n is the newest executed; keep as CAS anchor
+        }
+        ObjectPool<Node>::Return(n);
+        n = next;
+      }
+      Node* expected = n;
+      if (head_.compare_exchange_strong(expected, nullptr,
+                                        std::memory_order_acq_rel)) {
+        ObjectPool<Node>::Return(n);
+        butex_value(busy_).fetch_sub(1, std::memory_order_acq_rel);
+        butex_wake_all(busy_);
+        return;
+      }
+      Node* fifo = GrabNewer(n);
+      ObjectPool<Node>::Return(n);
+      n = fifo;
+    }
+  }
+
+  ExecFn fn_ = nullptr;
+  void* queue_arg_ = nullptr;
+  void (*on_start_)(void*) = nullptr;
+  void (*on_exit_)(void*) = nullptr;
+  std::atomic<Node*> head_{nullptr};
+  Node* starter_node_ = nullptr;  // handoff to the consumer fiber
+  Butex* busy_ = nullptr;
+};
+
+}  // namespace trpc

@@ -1,0 +1,208 @@
+// fiber_sync.h — synchronization primitives built on butex, usable from
+// fibers AND pthreads interchangeably (capability of the reference
+// bthread mutex/condition_variable/rwlock/countdown_event — all are
+// butex constructions: src/bthread/mutex.cpp, condition_variable.cpp,
+// rwlock.cpp, countdown_event.cpp).  A fiber blocking here parks on the
+// butex (no thread consumed); a pthread blocking here takes the butex's
+// pthread wait path.
+#pragma once
+
+#include <errno.h>
+
+#include <cstdint>
+
+#include "common.h"
+#include "fiber.h"
+
+namespace trpc {
+
+// Classic futex mutex (Drepper): 0 free, 1 locked, 2 locked+contended.
+class FiberMutex {
+ public:
+  FiberMutex() : b_(butex_create()) {}
+  ~FiberMutex() { butex_destroy(b_); }
+  FiberMutex(const FiberMutex&) = delete;
+  FiberMutex& operator=(const FiberMutex&) = delete;
+
+  void lock() {
+    int32_t c = 0;
+    if (butex_value(b_).compare_exchange_strong(
+            c, 1, std::memory_order_acquire)) {
+      return;  // uncontended fast path: one CAS
+    }
+    // Drepper's contended path, verbatim: every acquisition attempt is
+    // the exchange itself — an exchange(2) returning 0 MEANS we own the
+    // lock (value left at 2 so unlock wakes; slightly pessimistic, never
+    // wrong).
+    if (c != 2) {
+      c = butex_value(b_).exchange(2, std::memory_order_acquire);
+    }
+    while (c != 0) {
+      butex_wait(b_, 2, -1);
+      c = butex_value(b_).exchange(2, std::memory_order_acquire);
+    }
+  }
+
+  bool try_lock() {
+    int32_t expected = 0;
+    return butex_value(b_).compare_exchange_strong(
+        expected, 1, std::memory_order_acquire);
+  }
+
+  void unlock() {
+    if (butex_value(b_).exchange(0, std::memory_order_release) == 2) {
+      butex_wake(b_);  // someone advertised contention
+    }
+  }
+
+  Butex* internal_butex() { return b_; }
+
+ private:
+  Butex* b_;
+};
+
+// Condition variable over FiberMutex (sequence-counter design: wait
+// snapshots the counter under the mutex, releases it, parks until the
+// counter moves — no missed wakeups).
+class FiberCond {
+ public:
+  FiberCond() : b_(butex_create()) {}
+  ~FiberCond() { butex_destroy(b_); }
+  FiberCond(const FiberCond&) = delete;
+  FiberCond& operator=(const FiberCond&) = delete;
+
+  // mu must be held; re-held on return.  Returns 0, or ETIMEDOUT.
+  int wait(FiberMutex* mu, int64_t timeout_us = -1) {
+    int32_t seq = butex_value(b_).load(std::memory_order_acquire);
+    mu->unlock();
+    int rc = 0;
+    if (butex_wait(b_, seq, timeout_us) != 0 && errno == ETIMEDOUT) {
+      rc = ETIMEDOUT;
+    }
+    mu->lock();
+    return rc;
+  }
+
+  void notify_one() {
+    butex_value(b_).fetch_add(1, std::memory_order_release);
+    butex_wake(b_);
+  }
+
+  void notify_all() {
+    butex_value(b_).fetch_add(1, std::memory_order_release);
+    butex_wake_all(b_);
+  }
+
+ private:
+  Butex* b_;
+};
+
+// ≙ bthread CountdownEvent: init N, workers count down, waiters park
+// until zero.  add() can raise the count again before it hits zero.
+class CountdownEvent {
+ public:
+  explicit CountdownEvent(int initial = 1) : b_(butex_create()) {
+    butex_value(b_).store(initial, std::memory_order_release);
+  }
+  ~CountdownEvent() { butex_destroy(b_); }
+  CountdownEvent(const CountdownEvent&) = delete;
+  CountdownEvent& operator=(const CountdownEvent&) = delete;
+
+  void signal(int n = 1) {
+    int32_t prev = butex_value(b_).fetch_sub(n, std::memory_order_acq_rel);
+    if (prev - n <= 0) {
+      butex_wake_all(b_);
+    }
+  }
+
+  void add(int n = 1) {
+    butex_value(b_).fetch_add(n, std::memory_order_acq_rel);
+  }
+
+  // Returns 0, or ETIMEDOUT.  The deadline is absolute: value churn that
+  // never reaches zero (signal/add ping-pong) cannot restart the budget.
+  int wait(int64_t timeout_us = -1) {
+    int64_t deadline =
+        timeout_us < 0 ? -1 : monotonic_us() + timeout_us;
+    while (true) {
+      int32_t v = butex_value(b_).load(std::memory_order_acquire);
+      if (v <= 0) {
+        return 0;
+      }
+      int64_t left = -1;
+      if (deadline >= 0) {
+        left = deadline - monotonic_us();
+        if (left <= 0) {
+          return ETIMEDOUT;
+        }
+      }
+      if (butex_wait(b_, v, left) != 0 && errno == ETIMEDOUT) {
+        return ETIMEDOUT;
+      }
+    }
+  }
+
+ private:
+  Butex* b_;
+};
+
+// Write-preferring reader/writer lock (≙ bthread_rwlock).  State word:
+// bit31 = writer held, bits 0..30 = reader count; a separate word counts
+// queued writers so new readers defer to them.
+class FiberRWLock {
+ public:
+  FiberRWLock() : state_(butex_create()) {}
+  ~FiberRWLock() { butex_destroy(state_); }
+  FiberRWLock(const FiberRWLock&) = delete;
+  FiberRWLock& operator=(const FiberRWLock&) = delete;
+
+  void rdlock() {
+    while (true) {
+      int32_t v = butex_value(state_).load(std::memory_order_acquire);
+      if (v >= 0 && waiting_writers_.load(std::memory_order_acquire) == 0) {
+        if (butex_value(state_).compare_exchange_weak(
+                v, v + 1, std::memory_order_acquire)) {
+          return;
+        }
+        continue;
+      }
+      butex_wait(state_, v, 100 * 1000);
+    }
+  }
+
+  void rdunlock() {
+    int32_t prev =
+        butex_value(state_).fetch_sub(1, std::memory_order_acq_rel);
+    if (prev == 1) {
+      butex_wake_all(state_);  // last reader out: writers may proceed
+    }
+  }
+
+  void wrlock() {
+    waiting_writers_.fetch_add(1, std::memory_order_acq_rel);
+    while (true) {
+      int32_t v = butex_value(state_).load(std::memory_order_acquire);
+      if (v == 0) {
+        if (butex_value(state_).compare_exchange_weak(
+                v, kWriter, std::memory_order_acquire)) {
+          waiting_writers_.fetch_sub(1, std::memory_order_acq_rel);
+          return;
+        }
+        continue;
+      }
+      butex_wait(state_, v, 100 * 1000);
+    }
+  }
+
+  void wrunlock() {
+    butex_value(state_).store(0, std::memory_order_release);
+    butex_wake_all(state_);
+  }
+
+ private:
+  static constexpr int32_t kWriter = INT32_MIN;  // bit31
+  Butex* state_;
+  std::atomic<int32_t> waiting_writers_{0};
+};
+
+}  // namespace trpc

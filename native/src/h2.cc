@@ -8,6 +8,7 @@
 #include <mutex>
 #include <unordered_map>
 
+#include "execution_queue.h"
 #include "h2_tables.h"
 
 namespace trpc {
@@ -262,6 +263,11 @@ class H2Conn {
   int64_t conn_send_window = kDefaultWindow;
   int64_t peer_initial_window = kDefaultWindow;
   bool goaway = false;
+  // response path: concurrent usercode handlers submit wait-free; one
+  // consumer fiber encodes frames in order (≙ the reference writing h2
+  // through bthread ExecutionQueue instead of contending the conn lock)
+  SocketId sock_id = INVALID_SOCKET_ID;
+  ExecutionQueue resp_q;
 };
 
 namespace {
@@ -395,10 +401,67 @@ bool LooksLikeH2(const IOBuf& buf) {
   return memcmp(head, kPreface, n) == 0;
 }
 
+namespace {
+
+struct H2RespondTask {
+  H2Conn* c = nullptr;  // the task's own reference
+  uint32_t stream_id = 0;
+  int status = 200;
+  std::string headers;
+  std::string body;
+  std::string trailers;
+  bool has_trailers = false;
+};
+
+void RunRespondTask(void*, void* targ) {
+  H2RespondTask* t = (H2RespondTask*)targ;
+  Socket* s = Socket::Address(t->c->sock_id);
+  if (s != nullptr) {
+    H2Respond(t->c, s, t->stream_id, t->status, t->headers.c_str(),
+              (const uint8_t*)t->body.data(), t->body.size(),
+              t->has_trailers ? t->trailers.c_str() : nullptr);
+    s->Dereference();
+  }
+  H2ConnRelease(t->c);
+  delete t;
+}
+
+// the drain loop itself must outlive any task that drops the last
+// object ref: the queue pins one ref per consumer run via these hooks
+void RespQStart(void* qarg) {
+  ((H2Conn*)qarg)->refs.fetch_add(1, std::memory_order_acq_rel);
+}
+void RespQExit(void* qarg) { H2ConnRelease((H2Conn*)qarg); }
+
+}  // namespace
+
+void H2RespondAsync(H2Conn* c, uint32_t stream_id, int status,
+                    const char* headers_blob, const uint8_t* body,
+                    size_t body_len, const char* trailers_blob) {
+  H2RespondTask* t = new H2RespondTask();
+  c->refs.fetch_add(1, std::memory_order_acq_rel);
+  t->c = c;
+  t->stream_id = stream_id;
+  t->status = status;
+  if (headers_blob != nullptr) {
+    t->headers = headers_blob;
+  }
+  if (body != nullptr && body_len > 0) {
+    t->body.assign((const char*)body, body_len);
+  }
+  if (trailers_blob != nullptr) {
+    t->trailers = trailers_blob;
+    t->has_trailers = true;
+  }
+  c->resp_q.Submit(t);
+}
+
 H2Conn* H2ConnCreate(Socket* s) {
   native_metrics().h2_connections.fetch_add(1, std::memory_order_relaxed);
   H2Conn* c = new H2Conn();
   c->refs.store(2, std::memory_order_relaxed);  // registry + caller
+  c->sock_id = s->id();
+  c->resp_q.Init(RunRespondTask, c, RespQStart, RespQExit);
   s->is_h2.store(true, std::memory_order_release);
   {
     std::lock_guard<std::mutex> lk(g_conns_mu);

@@ -62,4 +62,11 @@ int H2Respond(H2Conn* c, Socket* s, uint32_t stream_id, int status,
               const char* headers_blob, const uint8_t* body,
               size_t body_len, const char* trailers_blob);
 
+// Wait-free async variant: packages the response and submits it to the
+// connection's ExecutionQueue — concurrent handler threads never block
+// on the connection mutex; one consumer fiber encodes in order.
+void H2RespondAsync(H2Conn* c, uint32_t stream_id, int status,
+                    const char* headers_blob, const uint8_t* body,
+                    size_t body_len, const char* trailers_blob);
+
 }  // namespace trpc

@@ -623,9 +623,9 @@ void DispatchH2(Socket* s, Server* srv, H2Request&& req) {
       const char* msg = srv->http_cb == nullptr
                             ? "no HTTP handler registered\n"
                             : "server is stopping\n";
-      H2Respond(c, s, req.stream_id, srv->http_cb == nullptr ? 404 : 503,
-                "content-type: text/plain\r\n", (const uint8_t*)msg,
-                strlen(msg), nullptr);
+      H2RespondAsync(c, req.stream_id, srv->http_cb == nullptr ? 404 : 503,
+                     "content-type: text/plain\r\n", (const uint8_t*)msg,
+                     strlen(msg), nullptr);
       H2ConnRelease(c);
     }
     return;
@@ -1353,16 +1353,14 @@ int http_respond2(uint64_t token, int status, const char* headers_blob,
     return -EINVAL;
   }
   if (ctx->h2_stream != 0) {
-    // HTTP/2: frames multiplex; trailers carry gRPC status
-    Socket* s = Socket::Address(ctx->sock);
-    if (s != nullptr) {
-      H2Conn* c = H2ConnFind(ctx->sock);
-      if (c != nullptr) {
-        H2Respond(c, s, ctx->h2_stream, status, headers_blob, body,
-                  body_len, trailers_blob);
-        H2ConnRelease(c);
-      }
-      s->Dereference();
+    // HTTP/2: frames multiplex; trailers carry gRPC status.  Submitted
+    // to the connection's ExecutionQueue: this (usercode) thread never
+    // blocks on the connection mutex — one consumer fiber encodes.
+    H2Conn* c = H2ConnFind(ctx->sock);
+    if (c != nullptr) {
+      H2RespondAsync(c, ctx->h2_stream, status, headers_blob, body,
+                     body_len, trailers_blob);
+      H2ConnRelease(c);
     }
     ctx->version.fetch_add(1, std::memory_order_release);
     ctx->payload.clear();

@@ -11,6 +11,8 @@
 #include <cstring>
 #include <deque>
 #include <mutex>
+
+#include "execution_queue.h"
 #include <unordered_map>
 #include <vector>
 
@@ -51,6 +53,14 @@ struct Stream {
   // both butexes: value is a bump counter; any state change bumps+wakes
   Butex* ack_butex = nullptr;
   Butex* recv_butex = nullptr;
+
+  // DATA emission rides a per-stream ExecutionQueue: writers reserve
+  // window under mu (bookkeeping only) and submit wait-free; one consumer
+  // fiber emits frames strictly in reservation order, and no socket write
+  // ever happens under the stream mutex (≙ the reference writing stream
+  // frames through bthread ExecutionQueue).  Slot memory is pool-stable,
+  // so pending tasks can never dangle across stream recycling.
+  ExecutionQueue send_q;
 
   uint64_t handle() const {
     return ((uint64_t)version.load(std::memory_order_relaxed) << 32) | slot;
@@ -144,6 +154,22 @@ int wait_bump(Butex* b, int32_t seen, int64_t deadline_us) {
   return 0;
 }
 
+struct StreamSendTask {
+  SocketId sock;
+  uint64_t peer;
+  uint8_t type = STREAM_FRAME_DATA;
+  IOBuf payload;
+};
+
+void RunStreamSend(void*, void* targ) {
+  StreamSendTask* t = (StreamSendTask*)targ;
+  // failure surfaces via the socket's on_failed -> StreamsOnSocketFailed
+  // (writers see sock_failed on their next call), matching the async
+  // write contract
+  send_stream_frame(t->sock, t->peer, t->type, std::move(t->payload), 0);
+  delete t;
+}
+
 }  // namespace
 
 StreamHandle stream_create(uint64_t window_bytes) {
@@ -155,6 +181,7 @@ StreamHandle stream_create(uint64_t window_bytes) {
     st->ack_butex = butex_create();
     st->recv_butex = butex_create();
   }
+  st->send_q.Init(RunStreamSend, st);
   st->sock = INVALID_SOCKET_ID;
   st->remote_id = 0;
   st->window = window_bytes > 0 ? window_bytes : kDefaultWindow;
@@ -235,18 +262,24 @@ int stream_write(StreamHandle h, const uint8_t* data, size_t len,
     // an oversized message may go alone once the pipe is drained
     bool alone = len > st->peer_window && st->bytes_sent == st->bytes_acked;
     if (fits || alone) {
-      // reserve window under mu; the actual socket write happens outside
+      // reserve window under mu, submit AFTER releasing it: Submit's
+      // inline-drain fallback (fiber exhaustion) runs send_stream_frame,
+      // which must never execute under st->mu (SetFailed on a broken
+      // socket re-enters stream_mark_failed -> st->mu).  A single
+      // writer's frames still emit in its call order; ordering across
+      // RACING writers was never defined (same as the reference, where
+      // order is set at socket-queue entry).
       st->bytes_sent += len;
-      SocketId sock = st->sock;
-      uint64_t peer = st->remote_id;
-      st->mu.unlock();
-      IOBuf payload;
+      StreamSendTask* t = new StreamSendTask();
+      t->sock = st->sock;
+      t->peer = st->remote_id;
       if (len > 0) {
-        payload.append(data, len);
+        t->payload.append(data, len);
       }
-      int rc = send_stream_frame(sock, peer, STREAM_FRAME_DATA,
-                                 std::move(payload), 0);
-      return rc == 0 ? 0 : -ECONNRESET;
+      ExecutionQueue* q = &st->send_q;
+      st->mu.unlock();
+      q->Submit(t);
+      return 0;
     }
     Butex* ab = st->ack_butex;
     int32_t seen = butex_value(ab).load(std::memory_order_acquire);
@@ -338,10 +371,18 @@ int stream_close(StreamHandle h) {
   SocketId sock = st->sock;
   uint64_t peer = st->remote_id;
   Butex* ab = st->ack_butex;
+  // CLOSE rides the same ExecutionQueue as DATA so it can never
+  // overtake this thread's earlier writes (submitted outside mu, like
+  // stream_write, so the inline-drain fallback never runs under it)
+  StreamSendTask* t = new StreamSendTask();
+  t->sock = sock;
+  t->peer = peer;
+  t->type = STREAM_FRAME_CLOSE;
+  ExecutionQueue* q = &st->send_q;
   st->mu.unlock();
+  q->Submit(t);
   // wake writers parked on a full window so they observe local_closed
   bump_wake(ab);
-  send_stream_frame(sock, peer, STREAM_FRAME_CLOSE, IOBuf(), 0);
   return 0;
 }
 
@@ -379,6 +420,9 @@ void stream_destroy(StreamHandle h) {
   if (was_bound) {
     unregister_on_socket(sock, h);
   }
+  // drain the send queue before the slot can recycle: a new incarnation's
+  // send_q.Init must never race a previous consumer still in Drain
+  st->send_q.Join();
   ResourcePool<Stream>::Return(slot);
 }
 

@@ -19,12 +19,15 @@
 #include <unistd.h>
 
 #include <atomic>
+#include <deque>
 #include <string>
 #include <thread>
 #include <vector>
 
 #include "common.h"
+#include "execution_queue.h"
 #include "fiber.h"
+#include "fiber_sync.h"
 #include "iobuf.h"
 #include "rpc.h"
 
@@ -328,9 +331,166 @@ static void test_iobuf_sharing() {
   printf("ok iobuf_sharing\n");
 }
 
+// --- fiber sync primitives + ExecutionQueue --------------------------------
+
+static void test_fiber_sync() {
+  // mutex: counter integrity under mixed fiber/pthread contention
+  FiberMutex mu;
+  int64_t counter = 0;
+  struct Arg {
+    FiberMutex* mu;
+    int64_t* counter;
+  } arg{&mu, &counter};
+  auto body = [](void* p) {
+    Arg* a = (Arg*)p;
+    for (int i = 0; i < 2000; ++i) {
+      a->mu->lock();
+      ++*a->counter;
+      a->mu->unlock();
+    }
+  };
+  std::vector<fiber_t> fids(6);
+  for (auto& f : fids) {
+    fiber_start(&f, body, &arg);
+  }
+  std::vector<std::thread> ts;
+  for (int i = 0; i < 3; ++i) {
+    ts.emplace_back([&] { body(&arg); });
+  }
+  for (auto f : fids) fiber_join(f);
+  for (auto& t : ts) t.join();
+  CHECK_TRUE(counter == (6 + 3) * 2000);
+
+  // cond: producer/consumer handoff, no lost wakeups
+  FiberMutex qmu;
+  FiberCond qcv;
+  std::deque<int> q;
+  std::atomic<int64_t> consumed{0};
+  const int kItems = 5000;
+  struct QArg {
+    FiberMutex* mu;
+    FiberCond* cv;
+    std::deque<int>* q;
+    std::atomic<int64_t>* consumed;
+  } qarg{&qmu, &qcv, &q, &consumed};
+  auto consumer = [](void* p) {
+    QArg* a = (QArg*)p;
+    while (true) {
+      a->mu->lock();
+      while (a->q->empty()) {
+        a->cv->wait(a->mu, 50 * 1000);
+      }
+      int v = a->q->front();
+      a->q->pop_front();
+      a->mu->unlock();
+      if (v < 0) {
+        return;  // poison
+      }
+      a->consumed->fetch_add(1);
+    }
+  };
+  std::vector<fiber_t> cons(4);
+  for (auto& f : cons) {
+    fiber_start(&f, consumer, &qarg);
+  }
+  for (int i = 0; i < kItems; ++i) {
+    qmu.lock();
+    q.push_back(i);
+    qmu.unlock();
+    qcv.notify_one();
+  }
+  for (size_t i = 0; i < cons.size(); ++i) {
+    qmu.lock();
+    q.push_back(-1);
+    qmu.unlock();
+    qcv.notify_one();
+  }
+  for (auto f : cons) fiber_join(f);
+  CHECK_TRUE(consumed.load() == kItems);
+
+  // countdown: N workers, one waiter
+  CountdownEvent ev(8);
+  std::vector<std::thread> ws;
+  for (int i = 0; i < 8; ++i) {
+    ws.emplace_back([&] { ev.signal(); });
+  }
+  CHECK_TRUE(ev.wait(2 * 1000 * 1000) == 0);
+  for (auto& t : ws) t.join();
+
+  // rwlock: readers see consistent pair; writer mutates both halves
+  FiberRWLock rw;
+  int64_t a = 0, b = 0;
+  std::atomic<bool> rwstop{false};
+  std::atomic<uint64_t> torn{0};
+  std::vector<std::thread> rts;
+  for (int i = 0; i < 4; ++i) {
+    rts.emplace_back([&] {
+      while (!rwstop.load(std::memory_order_acquire)) {
+        rw.rdlock();
+        if (a != b) {
+          torn.fetch_add(1);
+        }
+        rw.rdunlock();
+      }
+    });
+  }
+  for (int i = 0; i < 3000; ++i) {
+    rw.wrlock();
+    ++a;
+    ++b;
+    rw.wrunlock();
+  }
+  rwstop.store(true, std::memory_order_release);
+  for (auto& t : rts) t.join();
+  CHECK_TRUE(torn.load() == 0);
+  CHECK_TRUE(a == 3000 && b == 3000);
+  printf("ok fiber_sync\n");
+}
+
+static void test_execution_queue() {
+  // many producers, strict global FIFO within each producer + every task
+  // executed exactly once
+  struct EqState {
+    ExecutionQueue q;
+    std::atomic<int64_t> executed{0};
+    std::vector<int64_t> last_seen;  // per-producer last sequence
+    std::atomic<uint64_t> order_violations{0};
+  } st;
+  st.last_seen.assign(8, -1);
+  st.q.Init(
+      [](void* qa, void* ta) {
+        EqState* s = (EqState*)qa;
+        int64_t v = (int64_t)(intptr_t)ta;
+        int producer = (int)(v >> 32);
+        int64_t seq = v & 0xffffffff;
+        if (s->last_seen[producer] >= seq) {
+          s->order_violations.fetch_add(1);
+        }
+        s->last_seen[producer] = seq;
+        s->executed.fetch_add(1);
+      },
+      &st);
+  const int kPer = 20000;
+  std::vector<std::thread> ps;
+  for (int p = 0; p < 8; ++p) {
+    ps.emplace_back([&st, p] {
+      for (int64_t i = 0; i < kPer; ++i) {
+        st.q.Submit((void*)(intptr_t)(((int64_t)p << 32) | i));
+      }
+    });
+  }
+  for (auto& t : ps) t.join();
+  st.q.Join();
+  CHECK_TRUE(st.executed.load() == 8 * kPer);
+  CHECK_TRUE(st.order_violations.load() == 0);
+  printf("ok execution_queue\n");
+}
+
 int main() {
   fiber_runtime_init(4);
   test_butex_churn();
+  test_fiber_sync();
+  test_execution_queue();
   test_fiber_storm();
   test_iobuf_sharing();
   test_call_timeout_races();
