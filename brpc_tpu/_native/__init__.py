@@ -208,6 +208,16 @@ def _declare(L: ctypes.CDLL) -> None:
     L.trpc_set_usercode_max_inflight.argtypes = [c.c_int64]
     L.trpc_set_usercode_max_inflight.restype = None
 
+    # TLS (tls.h)
+    L.trpc_tls_available.restype = c.c_int
+    L.trpc_tls_error.restype = c.c_char_p
+    L.trpc_server_set_tls.argtypes = [c.c_void_p, c.c_char_p, c.c_char_p,
+                                      c.c_char_p]
+    L.trpc_server_set_tls.restype = c.c_int
+    L.trpc_channel_set_tls.argtypes = [c.c_void_p, c.c_int, c.c_char_p,
+                                       c.c_char_p, c.c_char_p]
+    L.trpc_channel_set_tls.restype = c.c_int
+
     # fiber sync primitives (fiber_sync.h)
     L.trpc_mutex_create.restype = c.c_void_p
     L.trpc_mutex_destroy.argtypes = [c.c_void_p]

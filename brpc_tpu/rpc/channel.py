@@ -45,6 +45,15 @@ class ChannelOptions:
     # "short" (one call per connection)
     # (≙ ChannelOptions.connection_type, controller.cpp:1112-1114)
     connection_type: str = "single"
+    # TLS (≙ ChannelOptions.ssl_options): handshake at dial time, before
+    # the first frame.  tls_verify=False accepts any server certificate
+    # (self-signed/test); tls_ca pins the trust root.  tls_cert/tls_key
+    # present a client certificate (mutual TLS).
+    tls: bool = False
+    tls_verify: bool = True
+    tls_ca: Optional[str] = None
+    tls_cert: Optional[str] = None
+    tls_key: Optional[str] = None
 
 
 class RetryPolicy:
@@ -132,7 +141,11 @@ class SubChannel:
                  connect_timeout_ms: float = 500.0,
                  auth: Optional[bytes] = None,
                  connection_type: str = "single",
-                 device_plane: bool = False):
+                 device_plane: bool = False,
+                 tls: bool = False, tls_verify: bool = True,
+                 tls_ca: Optional[str] = None,
+                 tls_cert: Optional[str] = None,
+                 tls_key: Optional[str] = None):
         self.endpoint = endpoint
         L = lib()
         self._handle = L.trpc_channel_create(
@@ -148,6 +161,18 @@ class SubChannel:
             L.trpc_channel_set_connection_type(self._handle, ct)
         if device_plane:
             L.trpc_channel_request_device_plane(self._handle, 1)
+        if tls:
+            rc = L.trpc_channel_set_tls(
+                self._handle, 1 if tls_verify else 0,
+                tls_ca.encode() if tls_ca else None,
+                tls_cert.encode() if tls_cert else None,
+                tls_key.encode() if tls_key else None)
+            if rc != 0:
+                reason = (L.trpc_tls_error() or b"").decode()
+                # the native handle was created above: don't leak it
+                L.trpc_channel_destroy(self._handle)
+                self._handle = None
+                raise OSError(-rc, f"client TLS setup failed: {reason}")
         self._native = _NativeCall(self._handle)
         self._lock = threading.Lock()
         self._drained = threading.Condition(self._lock)
@@ -238,7 +263,12 @@ class Channel:
             self._sub = SubChannel(ep, self.options.connect_timeout_ms,
                                    self.options.auth,
                                    self.options.connection_type,
-                                   device_plane=self._device_requested)
+                                   device_plane=self._device_requested,
+                                   tls=self.options.tls,
+                                   tls_verify=self.options.tls_verify,
+                                   tls_ca=self.options.tls_ca,
+                                   tls_cert=self.options.tls_cert,
+                                   tls_key=self.options.tls_key)
         if Channel._latency is None:
             Channel._latency = bvar.LatencyRecorder()
             Channel._latency.expose("rpc_client")

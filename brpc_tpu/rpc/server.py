@@ -73,6 +73,13 @@ class ServerOptions:
     # default): unauthenticated remote flag mutation is too sharp a tool
     # to expose implicitly — opt in, or set `auth` which gates all HTTP.
     builtin_writable: bool = False
+    # TLS on the shared port (≙ ServerOptions.ssl_options): PEM cert chain
+    # + private key.  Sniffed per connection — TLS and plaintext clients
+    # coexist on the one port.  tls_verify_ca requires client certs
+    # signed by that CA (mutual TLS).
+    tls_cert_file: Optional[str] = None
+    tls_key_file: Optional[str] = None
+    tls_verify_ca: Optional[str] = None
 
 
 class _MethodStatus:
@@ -342,6 +349,14 @@ class Server:
         if self.options.auth:
             lib().trpc_server_set_auth(self._handle, self.options.auth,
                                        len(self.options.auth))
+        if self.options.tls_cert_file:
+            rc = lib().trpc_server_set_tls(
+                self._handle, self.options.tls_cert_file.encode(),
+                (self.options.tls_key_file or "").encode(),
+                (self.options.tls_verify_ca or "").encode() or None)
+            if rc != 0:
+                reason = (lib().trpc_tls_error() or b"").decode()
+                raise OSError(-rc, f"TLS setup failed: {reason}")
         ip, _, port = address.rpartition(":")
         rc = lib().trpc_server_start(self._handle, ip.encode(), int(port))
         if rc != 0:

@@ -15,6 +15,7 @@
 #include "rpc.h"
 #include "socket.h"
 #include "stream.h"
+#include "tls.h"
 #include "tpu.h"
 
 using namespace trpc;
@@ -191,6 +192,19 @@ int trpc_redis_respond(uint64_t token, const uint8_t* data, size_t len) {
 
 void trpc_server_set_auth(void* s, const uint8_t* secret, size_t len) {
   server_set_auth((Server*)s, secret, len);
+}
+
+// --- TLS (tls.h: libssl dlopen'd at runtime) -------------------------------
+
+int trpc_tls_available() { return tls_available() ? 1 : 0; }
+const char* trpc_tls_error() { return tls_error(); }
+int trpc_server_set_tls(void* s, const char* cert, const char* key,
+                        const char* verify_ca) {
+  return server_set_tls((Server*)s, cert, key, verify_ca);
+}
+int trpc_channel_set_tls(void* c, int verify, const char* ca,
+                         const char* cert, const char* key) {
+  return channel_set_tls((Channel*)c, verify, ca, cert, key);
 }
 
 void trpc_channel_set_connection_type(void* c, int t) {

@@ -26,6 +26,7 @@
 #include "redis.h"
 #include "stream.h"
 #include "timer_thread.h"
+#include "tls.h"
 #include "tpu.h"
 
 namespace trpc {
@@ -422,6 +423,10 @@ class Server {
   void* redis_user = nullptr;
   bool has_auth = false;
   std::string auth_secret;
+  // TLS on the shared port: when set, connections whose first byte is a
+  // TLS handshake record (0x16) are wrapped; plaintext connections keep
+  // working beside them (≙ brpc serving SSL and plain on one port)
+  void* tls_ctx = nullptr;
   int listen_fd = -1;
   SocketId listen_sock = INVALID_SOCKET_ID;
   int port = 0;
@@ -662,6 +667,37 @@ void ServerOnMessages(Socket* s) {
   if (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
     s->SetFailed(errno);
     return;
+  }
+  if (!s->tls_checked && srv->tls_ctx != nullptr && s->tls == nullptr &&
+      !s->read_buf.empty()) {
+    // TLS sniff (≙ sniffing SSL before protocols, ssl_helper.cpp): byte
+    // 0x16 = handshake record.  The raw bytes already read re-route
+    // through the fresh engine; everything after decrypts transparently.
+    char b0;
+    s->read_buf.copy_to(&b0, 1);
+    s->tls_checked = true;
+    if ((uint8_t)b0 == 0x16) {
+      TlsState* st = tls_state_create(srv->tls_ctx, 0);
+      if (st == nullptr) {
+        s->SetFailed(EPROTO);
+        return;
+      }
+      s->tls = st;
+      std::string raw = s->read_buf.to_string();
+      s->read_buf.clear();
+      bool hs = false;
+      struct Emit {
+        Socket* s;
+        static void fn(void* arg, IOBuf&& enc) {
+          ((Emit*)arg)->s->WriteRaw(std::move(enc));
+        }
+      } emit{s};
+      if (tls_pump_in(st, (const uint8_t*)raw.data(), raw.size(),
+                      &s->read_buf, Emit::fn, &emit, &hs) != 0) {
+        s->SetFailed(EPROTO);
+        return;
+      }
+    }
   }
   // connections that completed the h2 preface stay h2 for life (is_h2
   // gates the registry mutex off the non-h2 hot path)
@@ -1119,6 +1155,22 @@ void server_set_auth(Server* s, const uint8_t* secret, size_t len) {
   s->has_auth = len > 0;
 }
 
+int server_set_tls(Server* s, const char* cert_file, const char* key_file,
+                   const char* verify_ca_file) {
+  if (s->running.load()) {
+    return -EBUSY;
+  }
+  void* ctx = tls_server_ctx_create(cert_file, key_file, verify_ca_file);
+  if (ctx == nullptr) {
+    return -EPROTO;
+  }
+  if (s->tls_ctx != nullptr) {
+    tls_ctx_destroy(s->tls_ctx);
+  }
+  s->tls_ctx = ctx;
+  return 0;
+}
+
 size_t server_conn_stats(Server* s, char* buf, size_t cap) {
   std::vector<SocketId> conns;
   {
@@ -1215,6 +1267,10 @@ int server_stop(Server* s) {
 
 void server_destroy(Server* s) {
   server_stop(s);
+  if (s->tls_ctx != nullptr) {
+    tls_ctx_destroy(s->tls_ctx);
+    s->tls_ctx = nullptr;
+  }
   // fail live connections and wait for their fibers to drain (they hold
   // Server* through socket->user)
   std::vector<SocketId> conns;
@@ -1598,6 +1654,7 @@ class Channel {
   int conn_type = 0;  // 0 single (SocketMap-shared), 1 pooled, 2 short
   bool device_plane = false;  // tpu:// endpoint: probe for the device plane
   std::atomic<int> last_transport{TS_TCP};  // of the most recent call's conn
+  void* tls_ctx = nullptr;  // client TLS: handshake at dial time
   // single: lock-free fast path to the live shared connection
   std::atomic<SocketId> cached_sock{INVALID_SOCKET_ID};
   std::mutex conn_mu;     // serializes dialing
@@ -1803,6 +1860,21 @@ Socket* DialConn(Channel* c, int* rc_out) {
   }
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  // client TLS: handshake synchronously on the freshly-connected fd
+  // (DialConn's connect path is already blocking; the dispatcher only
+  // sees the socket once the session is up)
+  TlsState* tls_st = nullptr;
+  if (c->tls_ctx != nullptr) {
+    tls_st = tls_state_create(c->tls_ctx, 1);
+    if (tls_st == nullptr ||
+        tls_client_handshake_fd(
+            tls_st, fd, monotonic_us() + c->connect_timeout_us) != 0) {
+      tls_state_free(tls_st);
+      ::close(fd);
+      *rc_out = -EPROTO;
+      return nullptr;
+    }
+  }
   ClientConn* conn = new ClientConn();
   SocketOptions opts;
   opts.fd = fd;
@@ -1813,11 +1885,14 @@ Socket* DialConn(Channel* c, int* rc_out) {
   SocketId sid;
   if (Socket::Create(opts, &sid) != 0) {
     ::close(fd);
+    tls_state_free(tls_st);
     delete conn;
     *rc_out = -ENOMEM;
     return nullptr;
   }
   Socket* snew = Socket::Address(sid);
+  snew->tls = tls_st;
+  snew->tls_checked = true;
   conn->sock = sid;
   if (c->device_plane) {
     conn->transport.store(TS_HANDSHAKING, std::memory_order_relaxed);
@@ -2021,6 +2096,19 @@ void channel_set_auth(Channel* c, const uint8_t* secret, size_t len) {
   c->auth.assign((const char*)secret, len);
 }
 
+int channel_set_tls(Channel* c, int verify, const char* ca_file,
+                    const char* cert_file, const char* key_file) {
+  void* ctx = tls_client_ctx_create(verify, ca_file, cert_file, key_file);
+  if (ctx == nullptr) {
+    return -EPROTO;
+  }
+  if (c->tls_ctx != nullptr) {
+    tls_ctx_destroy(c->tls_ctx);
+  }
+  c->tls_ctx = ctx;
+  return 0;
+}
+
 void set_usercode_workers(int n) {
   g_usercode_workers.store(n, std::memory_order_relaxed);
 }
@@ -2088,6 +2176,9 @@ void channel_destroy(Channel* c) {
   // which recycle waits out)
   for (SocketId sid : socks) {
     Socket::WaitRecycled(sid);
+  }
+  if (c->tls_ctx != nullptr) {
+    tls_ctx_destroy(c->tls_ctx);
   }
   delete c;
 }

@@ -109,6 +109,12 @@ void server_set_redis_handler(Server* s, RedisHandlerCb cb, void* user);
 int redis_respond(uint64_t token, const uint8_t* data, size_t len);
 // Require this credential (meta tag 13) on every TRPC request.
 void server_set_auth(Server* s, const uint8_t* secret, size_t len);
+// TLS on the shared port (PEM cert chain + key; optional client-cert
+// verification CA).  Sniffed per connection: TLS and plaintext coexist
+// on one port (tls.h ≙ ssl_options.h + ssl_helper.cpp).  0 or -errno
+// (-EPROTO: see tls_error()).
+int server_set_tls(Server* s, const char* cert_file, const char* key_file,
+                   const char* verify_ca_file);
 int server_start(Server* s, const char* ip, int port);
 int server_port(Server* s);
 int server_stop(Server* s);
@@ -145,6 +151,11 @@ void channel_destroy(Channel* c);
 void channel_set_connect_timeout(Channel* c, int64_t us);
 // Credential attached to every request meta (≙ generate_credential).
 void channel_set_auth(Channel* c, const uint8_t* secret, size_t len);
+// Dial with TLS (handshake completes before the first frame).  verify=0
+// accepts any server cert (tests/self-signed).  cert/key (optional PEM)
+// present a client certificate for mutual TLS.
+int channel_set_tls(Channel* c, int verify, const char* ca_file,
+                    const char* cert_file, const char* key_file);
 // 0 = single (SocketMap-shared, default), 1 = pooled (exclusive conn per
 // in-flight call, parked between calls), 2 = short (one call per conn)
 // (≙ ChannelOptions.connection_type, controller.cpp:1112-1114).

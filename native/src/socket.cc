@@ -12,6 +12,7 @@
 #include <vector>
 
 #include "metrics.h"
+#include "tls.h"
 #include "object_pool.h"
 
 namespace trpc {
@@ -53,6 +54,9 @@ int Socket::Create(const SocketOptions& opts, SocketId* id_out) {
   s->advertise_device_caps.store(false, std::memory_order_relaxed);
   s->corked = opts.corked;
   s->frame_bytes_hint = 0;
+  s->frame_attach_hint = 0;
+  s->tls = nullptr;
+  s->tls_checked = false;
   native_metrics().sockets_created.fetch_add(1, std::memory_order_relaxed);
   native_metrics().live_sockets.fetch_add(1, std::memory_order_relaxed);
   if (s->epollout_butex == nullptr) {
@@ -174,6 +178,10 @@ void Socket::TryRecycle(uint32_t odd_ver) {
   }
   parse_state = nullptr;
   parse_state_free = nullptr;
+  if (tls != nullptr) {
+    tls_state_free((TlsState*)tls);
+    tls = nullptr;
+  }
   native_metrics().live_sockets.fetch_sub(1, std::memory_order_relaxed);
   ResourcePool<Socket>::Return(slot);
   // announce the completed recycle to teardown waiters (WaitRecycled)
@@ -211,7 +219,61 @@ void Socket::SetFailed(int err) {
 // ---------------------------------------------------------------------------
 // read path
 
+namespace {
+// tls emit sink: enqueue ciphertext via the wait-free write path.  Runs
+// UNDER the TlsState lock so TLS record order matches wire order (records
+// carry sequence numbers; reordering = bad_record_mac at the peer).
+struct TlsEmitCtx {
+  Socket* s;
+  Butex* notify;
+  int rc = 0;
+};
+void tls_emit_to_socket(void* arg, IOBuf&& enc) {
+  TlsEmitCtx* ctx = (TlsEmitCtx*)arg;
+  ctx->rc = ctx->s->WriteRaw(std::move(enc), ctx->notify);
+  ctx->notify = nullptr;  // at most one notify per logical write
+}
+}  // namespace
+
 ssize_t Socket::ReadToBuf(bool* eof) {
+  if (tls != nullptr) {
+    // TLS: raw records from the fd pump through the engine; plaintext
+    // lands in read_buf (the protocol layer is oblivious), handshake /
+    // session bytes go straight back out un-re-encrypted
+    if (eof != nullptr) {
+      *eof = false;
+    }
+    char raw[16 * 1024];
+    ssize_t total = 0;
+    while (true) {
+      ssize_t n = ::read(fd, raw, sizeof(raw));
+      if (n < 0) {
+        if (errno == EINTR) {
+          continue;
+        }
+        if (errno == EAGAIN || errno == EWOULDBLOCK) {
+          break;
+        }
+        return total > 0 ? total : -1;
+      }
+      if (n == 0) {
+        if (eof != nullptr) {
+          *eof = true;
+        }
+        break;
+      }
+      bytes_in.fetch_add((uint64_t)n, std::memory_order_relaxed);
+      total += n;
+      TlsEmitCtx ctx{this, nullptr};
+      bool hs = false;
+      if (tls_pump_in((TlsState*)tls, (const uint8_t*)raw, (size_t)n,
+                      &read_buf, tls_emit_to_socket, &ctx, &hs) != 0) {
+        errno = EPROTO;
+        return -1;
+      }
+    }
+    return total;
+  }
   ssize_t total = 0;
   if (frame_bytes_hint > read_buf.size()) {
     // large frame in progress: pre-attachment bytes continue into pooled
@@ -305,6 +367,29 @@ struct KeepWriteArg {
 };
 
 int Socket::Write(IOBuf&& data, Butex* notify) {
+  if (tls != nullptr) {
+    TlsEmitCtx ctx{this, notify};
+    bool parked = false;
+    if (tls_encrypt_and_emit((TlsState*)tls, data, tls_emit_to_socket, &ctx,
+                             &parked) != 0) {
+      SetFailed(EPROTO);
+      return -TRPC_EFAILEDSOCKET;
+    }
+    if (parked) {
+      // handshake still in flight: plaintext parked in the TLS engine,
+      // flushed by the read pump on completion.  Completion notifies
+      // can't be tied to those bytes; reject such writes explicitly.
+      if (notify != nullptr) {
+        return -TRPC_EFAILEDSOCKET;
+      }
+      return 0;
+    }
+    return ctx.rc;
+  }
+  return WriteRaw(std::move(data), notify);
+}
+
+int Socket::WriteRaw(IOBuf&& data, Butex* notify) {
   if (failed.load(std::memory_order_acquire)) {
     return -TRPC_EFAILEDSOCKET;
   }

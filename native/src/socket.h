@@ -82,6 +82,13 @@ class Socket {
   void* parse_state = nullptr;
   void (*parse_state_free)(void*) = nullptr;
   bool corked = false;  // see SocketOptions.corked
+  // TLS engine (tls.h TlsState*), set by the server sniff (first record
+  // byte 0x16) or the client dial.  When set, ReadToBuf decrypts into
+  // read_buf and Write encrypts before the wait-free queue — every
+  // protocol on the shared port transparently speaks TLS.  Owned; freed
+  // at recycle.  tls_checked: the sniff ran (plaintext conn stays plain).
+  void* tls = nullptr;
+  bool tls_checked = false;
   // Protocol-layer hints for the partially-read frame at the head of
   // read_buf (large frames only).  frame_bytes_hint = the frame's total
   // wire size; frame_attach_hint = offset where its attachment begins.
@@ -114,7 +121,10 @@ class Socket {
   static void WaitRecycled(SocketId id);
 
   // Wait-free write; takes ownership of data.  Returns 0 or -errno.
+  // With TLS active, data is encrypted first (WriteRaw skips that — the
+  // TLS pump uses it to emit already-encrypted handshake bytes).
   int Write(IOBuf&& data, Butex* notify = nullptr);
+  int WriteRaw(IOBuf&& data, Butex* notify = nullptr);
 
   // Called by the dispatcher on EPOLLIN/EPOLLOUT.
   static void StartInputEvent(SocketId id);

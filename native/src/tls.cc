@@ -1,0 +1,552 @@
+#include "tls.h"
+
+#include <dlfcn.h>
+#include <errno.h>
+#include <poll.h>
+#include <stdio.h>
+#include <string.h>
+#include <unistd.h>
+
+#include <mutex>
+#include <string>
+
+#include "common.h"
+
+namespace trpc {
+
+namespace {
+
+// --- minimal stable libssl/libcrypto C ABI (see tls.h header comment) ------
+
+typedef struct ssl_ctx_st SSL_CTX;
+typedef struct ssl_st SSL;
+typedef struct bio_st BIO;
+typedef struct ssl_method_st SSL_METHOD;
+typedef struct bio_method_st BIO_METHOD;
+
+constexpr int kSSL_FILETYPE_PEM = 1;
+constexpr int kSSL_ERROR_WANT_READ = 2;
+constexpr int kSSL_ERROR_WANT_WRITE = 3;
+constexpr int kSSL_ERROR_ZERO_RETURN = 6;
+constexpr int kSSL_VERIFY_NONE = 0;
+constexpr int kSSL_VERIFY_PEER = 1;
+constexpr int kSSL_VERIFY_FAIL_IF_NO_PEER_CERT = 2;
+
+struct Ssl {
+  void* dso = nullptr;
+  void* crypto_dso = nullptr;
+
+  SSL_CTX* (*SSL_CTX_new)(const SSL_METHOD*) = nullptr;
+  void (*SSL_CTX_free)(SSL_CTX*) = nullptr;
+  const SSL_METHOD* (*TLS_server_method)(void) = nullptr;
+  const SSL_METHOD* (*TLS_client_method)(void) = nullptr;
+  int (*SSL_CTX_use_certificate_chain_file)(SSL_CTX*, const char*) = nullptr;
+  int (*SSL_CTX_use_PrivateKey_file)(SSL_CTX*, const char*, int) = nullptr;
+  int (*SSL_CTX_check_private_key)(const SSL_CTX*) = nullptr;
+  int (*SSL_CTX_load_verify_locations)(SSL_CTX*, const char*,
+                                       const char*) = nullptr;
+  int (*SSL_CTX_set_default_verify_paths)(SSL_CTX*) = nullptr;
+  void (*SSL_CTX_set_verify)(SSL_CTX*, int, void*) = nullptr;
+  SSL* (*SSL_new)(SSL_CTX*) = nullptr;
+  void (*SSL_free)(SSL*) = nullptr;
+  void (*SSL_set_accept_state)(SSL*) = nullptr;
+  void (*SSL_set_connect_state)(SSL*) = nullptr;
+  void (*SSL_set_bio)(SSL*, BIO*, BIO*) = nullptr;
+  int (*SSL_do_handshake)(SSL*) = nullptr;
+  int (*SSL_is_init_finished)(const SSL*) = nullptr;
+  int (*SSL_read)(SSL*, void*, int) = nullptr;
+  int (*SSL_write)(SSL*, const void*, int) = nullptr;
+  int (*SSL_get_error)(const SSL*, int) = nullptr;
+  BIO* (*BIO_new)(const BIO_METHOD*) = nullptr;
+  int (*BIO_free)(BIO*) = nullptr;
+  const BIO_METHOD* (*BIO_s_mem)(void) = nullptr;
+  int (*BIO_read)(BIO*, void*, int) = nullptr;
+  int (*BIO_write)(BIO*, const void*, int) = nullptr;
+  size_t (*BIO_ctrl_pending)(BIO*) = nullptr;
+  unsigned long (*ERR_get_error)(void) = nullptr;
+  void (*ERR_error_string_n)(unsigned long, char*, size_t) = nullptr;
+  void (*SSL_CTX_set_alpn_select_cb)(
+      SSL_CTX*,
+      int (*)(SSL*, const unsigned char**, unsigned char*,
+              const unsigned char*, unsigned int, void*),
+      void*) = nullptr;
+
+  std::string error;
+  bool up = false;
+};
+
+// ALPN selection: h2 (gRPC) preferred, then http/1.1; protocols we don't
+// know are un-acked (the client proceeds without ALPN).
+int alpn_select_cb(SSL*, const unsigned char** out, unsigned char* outlen,
+                   const unsigned char* in, unsigned int inlen, void*) {
+  auto pick = [&](const char* p, unsigned char n) -> bool {
+    for (unsigned int i = 0; i + 1 <= inlen;) {
+      unsigned int l = in[i];
+      if (i + 1 + l > inlen) {
+        break;
+      }
+      if (l == n && memcmp(in + i + 1, p, n) == 0) {
+        *out = in + i + 1;
+        *outlen = (unsigned char)l;
+        return true;
+      }
+      i += 1 + l;
+    }
+    return false;
+  };
+  if (pick("h2", 2) || pick("http/1.1", 8)) {
+    return 0;  // SSL_TLSEXT_ERR_OK
+  }
+  return 3;  // SSL_TLSEXT_ERR_NOACK
+}
+
+Ssl& ssl() {
+  static Ssl* s = new Ssl();  // leaked on purpose
+  return *s;
+}
+
+std::mutex& ssl_err_mu() {
+  static std::mutex* m = new std::mutex();
+  return *m;
+}
+
+void set_tls_error(std::string msg) {
+  std::lock_guard<std::mutex> lk(ssl_err_mu());
+  ssl().error = std::move(msg);
+}
+
+std::string openssl_errors() {
+  Ssl& s = ssl();
+  std::string out;
+  if (s.ERR_get_error == nullptr) {
+    return out;
+  }
+  unsigned long e;
+  char buf[256];
+  while ((e = s.ERR_get_error()) != 0) {
+    s.ERR_error_string_n(e, buf, sizeof(buf));
+    if (!out.empty()) {
+      out += "; ";
+    }
+    out += buf;
+  }
+  return out;
+}
+
+bool load_ssl() {
+  Ssl& s = ssl();
+  if (s.up) {
+    return true;
+  }
+  static std::mutex mu;
+  std::lock_guard<std::mutex> lk(mu);
+  if (s.up) {
+    return true;
+  }
+  s.dso = dlopen("libssl.so.3", RTLD_NOW | RTLD_GLOBAL);
+  if (s.dso == nullptr) {
+    s.dso = dlopen("libssl.so", RTLD_NOW | RTLD_GLOBAL);
+  }
+  if (s.dso == nullptr) {
+    set_tls_error("libssl not found");
+    return false;
+  }
+  s.crypto_dso = dlopen("libcrypto.so.3", RTLD_NOW | RTLD_GLOBAL);
+  auto sym = [&](const char* name) -> void* {
+    void* p = dlsym(s.dso, name);
+    if (p == nullptr && s.crypto_dso != nullptr) {
+      p = dlsym(s.crypto_dso, name);
+    }
+    return p;
+  };
+#define LOAD(f)                                      \
+  do {                                               \
+    s.f = (decltype(s.f))sym(#f);                    \
+    if (s.f == nullptr) {                            \
+      set_tls_error("libssl: missing symbol " #f);   \
+      return false;                                  \
+    }                                                \
+  } while (0)
+  LOAD(SSL_CTX_new);
+  LOAD(SSL_CTX_free);
+  LOAD(TLS_server_method);
+  LOAD(TLS_client_method);
+  LOAD(SSL_CTX_use_certificate_chain_file);
+  LOAD(SSL_CTX_use_PrivateKey_file);
+  LOAD(SSL_CTX_check_private_key);
+  LOAD(SSL_CTX_load_verify_locations);
+  LOAD(SSL_CTX_set_default_verify_paths);
+  LOAD(SSL_CTX_set_verify);
+  LOAD(SSL_new);
+  LOAD(SSL_free);
+  LOAD(SSL_set_accept_state);
+  LOAD(SSL_set_connect_state);
+  LOAD(SSL_set_bio);
+  LOAD(SSL_do_handshake);
+  LOAD(SSL_is_init_finished);
+  LOAD(SSL_read);
+  LOAD(SSL_write);
+  LOAD(SSL_get_error);
+  LOAD(BIO_new);
+  LOAD(BIO_free);
+  LOAD(BIO_s_mem);
+  LOAD(BIO_read);
+  LOAD(BIO_write);
+  LOAD(BIO_ctrl_pending);
+  LOAD(ERR_get_error);
+  LOAD(ERR_error_string_n);
+  LOAD(SSL_CTX_set_alpn_select_cb);
+#undef LOAD
+  s.up = true;
+  return true;
+}
+
+}  // namespace
+
+struct TlsState {
+  SSL* conn = nullptr;
+  BIO* rbio = nullptr;  // network -> SSL
+  BIO* wbio = nullptr;  // SSL -> network
+  std::mutex mu;        // SSL objects are not thread-safe
+  bool handshaken = false;
+  // plaintext writes that arrived before the handshake finished; flushed
+  // by the read pump the moment it completes
+  IOBuf pending_plain;
+};
+
+bool tls_available() { return load_ssl(); }
+
+const char* tls_error() {
+  static thread_local std::string* copy = new std::string();
+  std::lock_guard<std::mutex> lk(ssl_err_mu());
+  *copy = ssl().error;
+  return copy->c_str();
+}
+
+void* tls_server_ctx_create(const char* cert_file, const char* key_file,
+                            const char* verify_ca_file) {
+  if (!load_ssl()) {
+    return nullptr;
+  }
+  Ssl& s = ssl();
+  SSL_CTX* ctx = s.SSL_CTX_new(s.TLS_server_method());
+  if (ctx == nullptr) {
+    set_tls_error("SSL_CTX_new: " + openssl_errors());
+    return nullptr;
+  }
+  if (s.SSL_CTX_use_certificate_chain_file(ctx, cert_file) != 1 ||
+      s.SSL_CTX_use_PrivateKey_file(ctx, key_file, kSSL_FILETYPE_PEM) != 1 ||
+      s.SSL_CTX_check_private_key(ctx) != 1) {
+    set_tls_error("cert/key load: " + openssl_errors());
+    s.SSL_CTX_free(ctx);
+    return nullptr;
+  }
+  if (verify_ca_file != nullptr && verify_ca_file[0] != '\0') {
+    if (s.SSL_CTX_load_verify_locations(ctx, verify_ca_file, nullptr) != 1) {
+      set_tls_error("verify CA load: " + openssl_errors());
+      s.SSL_CTX_free(ctx);
+      return nullptr;
+    }
+    s.SSL_CTX_set_verify(
+        ctx, kSSL_VERIFY_PEER | kSSL_VERIFY_FAIL_IF_NO_PEER_CERT, nullptr);
+  }
+  // ALPN: gRPC clients (h2) refuse sessions without it
+  s.SSL_CTX_set_alpn_select_cb(ctx, alpn_select_cb, nullptr);
+  return ctx;
+}
+
+void* tls_client_ctx_create(int verify, const char* ca_file,
+                            const char* cert_file, const char* key_file) {
+  if (!load_ssl()) {
+    return nullptr;
+  }
+  Ssl& s = ssl();
+  SSL_CTX* ctx = s.SSL_CTX_new(s.TLS_client_method());
+  if (ctx == nullptr) {
+    set_tls_error("SSL_CTX_new: " + openssl_errors());
+    return nullptr;
+  }
+  if (cert_file != nullptr && cert_file[0] != '\0') {
+    // mutual TLS: present a client certificate when the server demands one
+    if (s.SSL_CTX_use_certificate_chain_file(ctx, cert_file) != 1 ||
+        s.SSL_CTX_use_PrivateKey_file(ctx, key_file, kSSL_FILETYPE_PEM) !=
+            1 ||
+        s.SSL_CTX_check_private_key(ctx) != 1) {
+      set_tls_error("client cert/key load: " + openssl_errors());
+      s.SSL_CTX_free(ctx);
+      return nullptr;
+    }
+  }
+  if (verify) {
+    if (ca_file != nullptr && ca_file[0] != '\0') {
+      if (s.SSL_CTX_load_verify_locations(ctx, ca_file, nullptr) != 1) {
+        set_tls_error("CA load: " + openssl_errors());
+        s.SSL_CTX_free(ctx);
+        return nullptr;
+      }
+    } else {
+      s.SSL_CTX_set_default_verify_paths(ctx);
+    }
+    s.SSL_CTX_set_verify(ctx, kSSL_VERIFY_PEER, nullptr);
+  } else {
+    s.SSL_CTX_set_verify(ctx, kSSL_VERIFY_NONE, nullptr);
+  }
+  return ctx;
+}
+
+void tls_ctx_destroy(void* ctx) {
+  if (ctx != nullptr && ssl().up) {
+    ssl().SSL_CTX_free((SSL_CTX*)ctx);
+  }
+}
+
+TlsState* tls_state_create(void* ctx, int role) {
+  if (!load_ssl() || ctx == nullptr) {
+    return nullptr;
+  }
+  Ssl& s = ssl();
+  TlsState* st = new TlsState();
+  st->conn = s.SSL_new((SSL_CTX*)ctx);
+  st->rbio = s.BIO_new(s.BIO_s_mem());
+  st->wbio = s.BIO_new(s.BIO_s_mem());
+  if (st->conn == nullptr || st->rbio == nullptr || st->wbio == nullptr) {
+    set_tls_error("SSL_new/BIO_new: " + openssl_errors());
+    // SSL_set_bio was not reached: free each piece individually
+    if (st->rbio != nullptr) {
+      s.BIO_free(st->rbio);
+    }
+    if (st->wbio != nullptr) {
+      s.BIO_free(st->wbio);
+    }
+    if (st->conn != nullptr) {
+      s.SSL_free(st->conn);
+    }
+    delete st;
+    return nullptr;
+  }
+  s.SSL_set_bio(st->conn, st->rbio, st->wbio);  // SSL owns the BIOs
+  if (role == 0) {
+    s.SSL_set_accept_state(st->conn);
+  } else {
+    s.SSL_set_connect_state(st->conn);
+  }
+  return st;
+}
+
+void tls_state_free(TlsState* st) {
+  if (st == nullptr) {
+    return;
+  }
+  if (st->conn != nullptr) {
+    ssl().SSL_free(st->conn);  // frees both BIOs
+  }
+  delete st;
+}
+
+namespace {
+
+// Move everything wbio holds (handshake replies, records) into out.
+void drain_wbio(TlsState* st, IOBuf* out) {
+  Ssl& s = ssl();
+  char buf[16 * 1024];
+  while (s.BIO_ctrl_pending(st->wbio) > 0) {
+    int n = s.BIO_read(st->wbio, buf, sizeof(buf));
+    if (n <= 0) {
+      break;
+    }
+    out->append(buf, (size_t)n);
+  }
+}
+
+// st->mu must be held; st->handshaken must be true.
+int encrypt_locked(TlsState* st, const IOBuf& plain, IOBuf* enc_out) {
+  Ssl& s = ssl();
+  for (size_t i = 0; i < plain.block_count(); ++i) {
+    const BlockRef& r = plain.ref_at(i);
+    const char* p = r.block->data + r.offset;
+    uint32_t left = r.length;
+    while (left > 0) {
+      int n = s.SSL_write(st->conn, p, (int)left);
+      if (n <= 0) {
+        set_tls_error("SSL_write: " + openssl_errors());
+        return -1;
+      }
+      p += n;
+      left -= (uint32_t)n;
+    }
+  }
+  drain_wbio(st, enc_out);
+  return 0;
+}
+
+}  // namespace
+
+namespace {
+
+// flush wbio to the sink; st->mu held (ordering contract, see tls.h).
+void emit_wbio(TlsState* st, TlsEmitFn emit, void* emit_arg) {
+  IOBuf enc;
+  drain_wbio(st, &enc);
+  if (!enc.empty()) {
+    emit(emit_arg, std::move(enc));
+  }
+}
+
+}  // namespace
+
+int tls_pump_in(TlsState* st, const uint8_t* raw, size_t raw_len,
+                IOBuf* plain_out, TlsEmitFn emit, void* emit_arg,
+                bool* handshake_done) {
+  Ssl& s = ssl();
+  std::lock_guard<std::mutex> lk(st->mu);
+  size_t off = 0;
+  while (off < raw_len) {
+    int n = s.BIO_write(st->rbio, raw + off, (int)(raw_len - off));
+    if (n <= 0) {
+      set_tls_error("BIO_write failed");
+      return -1;
+    }
+    off += (size_t)n;
+  }
+  if (!st->handshaken) {
+    int rc = s.SSL_do_handshake(st->conn);
+    emit_wbio(st, emit, emit_arg);
+    if (rc == 1) {
+      st->handshaken = true;
+      if (!st->pending_plain.empty()) {
+        // writes that raced the handshake go out now, in arrival order
+        IOBuf held = std::move(st->pending_plain);
+        IOBuf enc;
+        if (encrypt_locked(st, held, &enc) != 0) {
+          return -1;
+        }
+        if (!enc.empty()) {
+          emit(emit_arg, std::move(enc));
+        }
+      }
+    } else {
+      int err = s.SSL_get_error(st->conn, rc);
+      if (err != kSSL_ERROR_WANT_READ && err != kSSL_ERROR_WANT_WRITE) {
+        set_tls_error("handshake: " + openssl_errors());
+        *handshake_done = false;
+        return -1;
+      }
+    }
+  }
+  if (st->handshaken) {
+    char buf[16 * 1024];
+    while (true) {
+      int n = s.SSL_read(st->conn, buf, sizeof(buf));
+      if (n > 0) {
+        plain_out->append(buf, (size_t)n);
+        continue;
+      }
+      int err = s.SSL_get_error(st->conn, n);
+      if (err == kSSL_ERROR_WANT_READ || err == kSSL_ERROR_WANT_WRITE) {
+        break;  // need more network bytes
+      }
+      if (err == kSSL_ERROR_ZERO_RETURN) {
+        break;  // clean TLS shutdown; EOF surfaces via the socket
+      }
+      set_tls_error("SSL_read: " + openssl_errors());
+      return -1;
+    }
+    emit_wbio(st, emit, emit_arg);  // renegotiation / session tickets
+  }
+  *handshake_done = st->handshaken;
+  return 0;
+}
+
+int tls_encrypt_and_emit(TlsState* st, const IOBuf& plain, TlsEmitFn emit,
+                         void* emit_arg, bool* parked) {
+  std::lock_guard<std::mutex> lk(st->mu);
+  *parked = false;
+  if (!st->handshaken) {
+    // hold plaintext until the read pump completes the handshake
+    st->pending_plain.append(plain);
+    *parked = true;
+    return 0;
+  }
+  IOBuf enc;
+  if (encrypt_locked(st, plain, &enc) != 0) {
+    return -1;
+  }
+  if (!enc.empty()) {
+    emit(emit_arg, std::move(enc));  // under st->mu: records stay in order
+  }
+  return 0;
+}
+
+int tls_client_handshake_fd(TlsState* st, int fd, int64_t deadline_us) {
+  Ssl& s = ssl();
+  std::lock_guard<std::mutex> lk(st->mu);
+  char buf[16 * 1024];
+  while (true) {
+    int rc = s.SSL_do_handshake(st->conn);
+    // flush whatever the handshake produced
+    while (s.BIO_ctrl_pending(st->wbio) > 0) {
+      int n = s.BIO_read(st->wbio, buf, sizeof(buf));
+      if (n <= 0) {
+        break;
+      }
+      int woff = 0;
+      while (woff < n) {
+        ssize_t w = ::write(fd, buf + woff, (size_t)(n - woff));
+        if (w < 0) {
+          if (errno == EINTR) {
+            continue;
+          }
+          if (errno == EAGAIN || errno == EWOULDBLOCK) {
+            pollfd pfd{fd, POLLOUT, 0};
+            poll(&pfd, 1, 100);
+            continue;
+          }
+          set_tls_error("handshake write failed");
+          return -1;
+        }
+        woff += (int)w;
+      }
+    }
+    if (rc == 1) {
+      st->handshaken = true;
+      return 0;
+    }
+    int err = s.SSL_get_error(st->conn, rc);
+    if (err != kSSL_ERROR_WANT_READ && err != kSSL_ERROR_WANT_WRITE) {
+      set_tls_error("client handshake: " + openssl_errors());
+      return -1;
+    }
+    // need peer bytes
+    int64_t left_ms = (deadline_us - monotonic_us()) / 1000;
+    if (left_ms <= 0) {
+      set_tls_error("client handshake timeout");
+      return -1;
+    }
+    pollfd pfd{fd, POLLIN, 0};
+    int pr = poll(&pfd, 1, (int)(left_ms < 100 ? left_ms : 100));
+    if (pr < 0 && errno != EINTR) {
+      set_tls_error("handshake poll failed");
+      return -1;
+    }
+    ssize_t r = ::read(fd, buf, sizeof(buf));
+    if (r > 0) {
+      int boff = 0;
+      while (boff < (int)r) {
+        int bw = s.BIO_write(st->rbio, buf + boff, (int)r - boff);
+        if (bw <= 0) {
+          set_tls_error("BIO_write failed");
+          return -1;
+        }
+        boff += bw;
+      }
+    } else if (r == 0) {
+      set_tls_error("peer closed during handshake");
+      return -1;
+    } else if (errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
+      set_tls_error("handshake read failed");
+      return -1;
+    }
+  }
+}
+
+}  // namespace trpc

@@ -1,0 +1,79 @@
+// tls.h — TLS on the shared port (capability of the reference's SSL
+// support: src/brpc/ssl_options.{h,cpp}, details/ssl_helper.{h,cpp} —
+// server certs, optional client verification, and protocol sniffing
+// preserved: the first record byte 0x16 routes a connection into TLS,
+// after which the SAME port still speaks TRPC/HTTP/h2/RESP over the
+// decrypted stream).
+//
+// Binding: libssl.so.3 is dlopen'd at runtime against a small
+// self-declared C ABI (the image ships OpenSSL 3 runtime libs without
+// headers; these prototypes are the documented stable libssl interface —
+// same technique as the PJRT binding in tpu.cc).  Absent libssl, TLS
+// reports unavailable and configuration fails loudly.
+//
+// Data path: memory-BIO bridge.  Raw socket bytes -> rbio -> SSL_read ->
+// plaintext into Socket::read_buf (the protocol layer is unchanged);
+// plaintext writes -> SSL_write -> wbio -> encrypted bytes onto the
+// wait-free socket write queue.  The SSL object is guarded by a per-
+// connection mutex (reads run on the socket's single processing fiber;
+// writes may come from any thread).
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+#include "iobuf.h"
+
+namespace trpc {
+
+// Runtime libssl availability (dlopen on first use).
+bool tls_available();
+const char* tls_error();  // reason when unavailable / last ctx error
+
+// Server context: certificate chain + private key (PEM files); optional
+// client-certificate verification against ca_file.
+// Returns an opaque ctx or nullptr (see tls_error()).
+void* tls_server_ctx_create(const char* cert_file, const char* key_file,
+                            const char* verify_ca_file);
+void tls_ctx_destroy(void* ctx);
+
+// Client context; verify=0 skips peer verification (tests/self-signed),
+// else peers verify against ca_file (nullptr = system default paths).
+// cert_file/key_file (optional) present a client certificate for mutual
+// TLS against servers configured with verify_ca_file.
+void* tls_client_ctx_create(int verify, const char* ca_file,
+                            const char* cert_file, const char* key_file);
+
+// Per-connection TLS engine.
+struct TlsState;
+// role: 0 = server (accept), 1 = client (connect)
+TlsState* tls_state_create(void* ctx, int role);
+void tls_state_free(TlsState* st);
+
+// Ciphertext sink: called with TLS records to put on the wire.  ALWAYS
+// invoked while the TlsState lock is held — TLS records carry sequence
+// numbers, so the encrypt->enqueue step must be atomic per record batch
+// or concurrent writers could land records out of order (bad_record_mac
+// at the peer).  The sink must therefore be cheap and non-reentrant
+// (Socket::WriteRaw's wait-free enqueue qualifies).
+typedef void (*TlsEmitFn)(void* arg, IOBuf&& enc);
+
+// Feed raw network bytes in; plaintext lands in plain_out, any produced
+// records (handshake replies, session tickets, flushed pre-handshake
+// writes) go to emit under the state lock.  Returns 0, or -1 on a fatal
+// TLS error.  *handshake_done flips once the session is up.
+int tls_pump_in(TlsState* st, const uint8_t* raw, size_t raw_len,
+                IOBuf* plain_out, TlsEmitFn emit, void* emit_arg,
+                bool* handshake_done);
+
+// Encrypt plaintext and emit the records (under the state lock, same
+// ordering guarantee).  Pre-handshake plaintext is parked and flushed by
+// the read pump; *parked flips true in that case (no bytes emitted yet).
+int tls_encrypt_and_emit(TlsState* st, const IOBuf& plain, TlsEmitFn emit,
+                         void* emit_arg, bool* parked);
+
+// Drive a client handshake synchronously over a connected fd (used by
+// DialConn, whose connect path is already blocking).  Returns 0 or -1.
+int tls_client_handshake_fd(TlsState* st, int fd, int64_t deadline_us);
+
+}  // namespace trpc
