@@ -62,7 +62,8 @@ def make_train_step(cfg: ModelConfig, mesh: Optional[Mesh] = None,
     pspecs = param_specs(cfg)
 
     def shard_of(spec):
-        return NamedSharding(mesh, spec)
+        from brpc_tpu.parallel.mesh import prune_spec
+        return NamedSharding(mesh, prune_spec(spec, mesh))
 
     param_sh = jax.tree.map(shard_of, pspecs,
                             is_leaf=lambda x: isinstance(x, P))

@@ -154,10 +154,14 @@ def param_specs(cfg: ModelConfig) -> Dict:
 
 
 def _cs(x, mesh: Optional[Mesh], spec: P):
-    """Sharding constraint; identity when no mesh (single chip)."""
+    """Sharding constraint; identity when no mesh (single chip).  Axes the
+    mesh doesn't define drop to replicated (prune_spec), so the model runs
+    unchanged on partial meshes (e.g. a (dp, sp) ring mesh without tp/ep)."""
     if mesh is None or mesh.empty:
         return x
-    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+    from brpc_tpu.parallel.mesh import prune_spec
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, prune_spec(spec, mesh)))
 
 
 def _layernorm(x, g, b):

@@ -25,9 +25,21 @@ from typing import Dict, Optional, Sequence
 
 import jax
 import numpy as np
-from jax.sharding import Mesh
+from jax.sharding import Mesh, PartitionSpec
 
 AXIS_ORDER = ("pp", "dp", "ep", "sp", "tp")
+
+
+def prune_spec(spec: PartitionSpec, mesh: Mesh) -> PartitionSpec:
+    """Drop PartitionSpec axes the mesh doesn't define (-> replicated), so
+    one spec tree serves every mesh layout (a (dp, sp) ring-attention mesh
+    simply replicates tp/ep dimensions)."""
+    def _ok(a):
+        names = a if isinstance(a, tuple) else (a,)
+        return all(n in mesh.shape for n in names)
+
+    return PartitionSpec(
+        *[a if (a is None or _ok(a)) else None for a in spec])
 
 
 def make_mesh(axes: Dict[str, int],
@@ -71,17 +83,21 @@ def make_mesh(axes: Dict[str, int],
 def auto_mesh(n_devices: Optional[int] = None,
               axis_names: Sequence[str] = ("dp", "sp", "tp")) -> Mesh:
     """Factor n devices into the given axes; tp gets a factor first, then
-    dp, sp, ep, pp (see `priority` below).
+    dp, ep, sp, pp (see `priority` below — ep outranks sp so expert
+    parallelism is never silently degenerate).
 
-    8 devices over (dp, sp, tp) → dp=2, sp=2, tp=2; 4 → tp=2, dp=2, sp=1;
-    prime counts degrade gracefully (leftover axes get size 1).
+    8 devices over (dp, ep, sp, tp) → tp=2, dp=2, ep=2, sp=1;
+    8 over (dp, sp, tp) → all 2; 4 over (dp, tp) → tp=2, dp=2; prime
+    counts degrade gracefully (leftover axes get size 1).
     """
     devs = list(jax.devices())
     n = n_devices if n_devices is not None else len(devs)
     devs = devs[:n]
     # axes that should get device factors first: tp (chattiest, wants ICI
-    # neighbors), then dp (the gradient-psum axis), then sp, ep, pp
-    priority = [a for a in ("tp", "dp", "sp", "ep", "pp") if a in axis_names]
+    # neighbors), then dp (the gradient-psum axis), then ep (the MoE
+    # all-to-all must get a real factor before sp so expert parallelism is
+    # never silently degenerate at 8 devices), then sp, pp
+    priority = [a for a in ("tp", "dp", "ep", "sp", "pp") if a in axis_names]
     priority += [a for a in axis_names if a not in priority]
     sizes = dict.fromkeys(axis_names, 1)
     i = 0

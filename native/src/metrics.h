@@ -36,8 +36,10 @@ struct NativeMetrics {
   std::atomic<uint64_t> sockets_created{0};
   std::atomic<uint64_t> socket_failures{0};
 
-  // server-side pipelining sequencer (rpc.cc ConnState)
-  std::atomic<int64_t> sequencer_parked{0};      // out-of-order responses held
+  // server-side pipelining sequencer (rpc.cc ConnState): responses inside
+  // the sequencer — parked out-of-order OR queued for the drain owner.
+  // Sustained growth means handlers complete far out of request order.
+  std::atomic<int64_t> sequencer_parked{0};
 
   // protocol errors observed on input (both sides)
   std::atomic<uint64_t> parse_errors{0};

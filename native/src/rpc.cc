@@ -458,6 +458,10 @@ struct ConnState {
     bool close_after = false;
   };
   std::unordered_map<uint64_t, Ready> ready;  // out-of-order completions
+  // one releaser at a time owns the drain (KeepWrite-style ownership):
+  // socket writes happen OUTSIDE mu, yet stay in sequence order because
+  // only the owner writes and it re-checks under mu between batches
+  bool writer_active = false;
 
   ~ConnState() {
     // responses still parked when the connection died
@@ -480,50 +484,74 @@ ConnState* GetConnState(Socket* s) {
 
 void CloseAfterWrite(Socket* s, IOBuf&& resp);  // defined near http_respond
 
-// Hand a sequenced response to the connection: writes it now if it is the
-// next in request order (plus any queued successors), else parks it.
-// Returns with the parser re-armed if it was capped.
+// Hand a sequenced response to the connection: parks it, and the first
+// releaser to arrive becomes the drain owner — it writes every
+// consecutive ready response to the socket OUTSIDE cs->mu (a write(2)
+// under the sequencer lock would serialize concurrent handler
+// completions on this connection), re-checking under the lock between
+// batches so order still follows request sequence exactly.
 void ReleaseSequenced(Socket* s, uint64_t seq, IOBuf&& data,
                       bool close_after) {
   ConnState* cs = (ConnState*)s->parse_state;
   NativeMetrics& nm = native_metrics();
   bool rearm = false;
+  std::unique_lock<std::mutex> lk(cs->mu);
+  if (cs->closing) {
+    return;  // connection is winding down; drop queued responses
+  }
   {
-    std::lock_guard<std::mutex> lk(cs->mu);
-    if (cs->closing) {
-      return;  // connection is winding down; drop queued responses
-    }
-    if (seq != cs->next_release) {
-      ConnState::Ready& r = cs->ready[seq];
-      r.data = std::move(data);
-      r.close_after = close_after;
-      nm.sequencer_parked.fetch_add(1, std::memory_order_relaxed);
-      return;
-    }
-    // write in order: this one, then every queued successor
+    ConnState::Ready& r = cs->ready[seq];
+    r.data = std::move(data);
+    r.close_after = close_after;
+    nm.sequencer_parked.fetch_add(1, std::memory_order_relaxed);
+  }
+  if (cs->writer_active) {
+    return;  // the current owner will reach our entry
+  }
+  cs->writer_active = true;
+  while (true) {
+    // collect the consecutive batch under the lock
+    std::vector<ConnState::Ready> batch;
+    bool closing = false;
     while (true) {
-      ++cs->next_release;
-      if (close_after) {
-        cs->closing = true;
-        CloseAfterWrite(s, std::move(data));
-        break;
-      }
-      s->Write(std::move(data));
       auto it = cs->ready.find(cs->next_release);
       if (it == cs->ready.end()) {
         break;
       }
-      data = std::move(it->second.data);
-      close_after = it->second.close_after;
-      cs->ready.erase(it);
+      ++cs->next_release;
       nm.sequencer_parked.fetch_sub(1, std::memory_order_relaxed);
+      closing = it->second.close_after;
+      batch.push_back(std::move(it->second));
+      cs->ready.erase(it);
+      if (closing) {
+        cs->closing = true;
+        break;
+      }
     }
-    if (cs->parse_capped &&
-        cs->next_dispatch - cs->next_release < kMaxPipelined) {
-      cs->parse_capped = false;
-      rearm = true;
+    if (batch.empty()) {
+      cs->writer_active = false;
+      break;
+    }
+    lk.unlock();
+    for (ConnState::Ready& r : batch) {
+      if (r.close_after) {
+        CloseAfterWrite(s, std::move(r.data));
+      } else {
+        s->Write(std::move(r.data));
+      }
+    }
+    lk.lock();
+    if (closing) {
+      cs->writer_active = false;
+      break;
     }
   }
+  if (cs->parse_capped &&
+      cs->next_dispatch - cs->next_release < kMaxPipelined) {
+    cs->parse_capped = false;
+    rearm = true;
+  }
+  lk.unlock();
   if (rearm) {
     Socket::StartInputEvent(s->id());
   }

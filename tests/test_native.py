@@ -50,27 +50,29 @@ def test_cpp_stress_sanitized(flavor):
     build_dir = os.path.join(REPO, "native", "build-" +
                              ("tsan" if flavor == "thread" else "asan"))
     src_dir = os.path.join(REPO, "native")
-    if not os.path.exists(os.path.join(build_dir, "test_stress")):
+    if not os.path.exists(os.path.join(build_dir, "build.ninja")):
         r = subprocess.run(
             ["cmake", "-S", src_dir, "-B", build_dir, "-G", "Ninja",
              f"-DSANITIZE={flavor}"], capture_output=True, text=True)
         if r.returncode != 0:
             pytest.skip(f"no {flavor} sanitizer toolchain: {r.stderr[-200:]}")
-        r = subprocess.run(["ninja", "-C", build_dir, "test_stress"],
-                           capture_output=True, text=True)
-        if r.returncode != 0:
-            blob = r.stdout + r.stderr
-            # configure succeeds even without the runtime libs (the flags
-            # only apply at compile/link); a MISSING RUNTIME looks like a
-            # linker "cannot find" error — anything else is a real build
-            # failure and must fail the test
-            missing = ("cannot find -ltsan" in blob
-                       or "cannot find -lasan" in blob
-                       or "libtsan" in blob and "No such file" in blob
-                       or "libasan" in blob and "No such file" in blob)
-            if missing:
-                pytest.skip(f"no {flavor} sanitizer runtime: {blob[-200:]}")
-            assert r.returncode == 0, blob
+    # ALWAYS run ninja: it is incremental, and a stale instrumented binary
+    # would silently test old code
+    r = subprocess.run(["ninja", "-C", build_dir, "test_stress"],
+                       capture_output=True, text=True)
+    if r.returncode != 0:
+        blob = r.stdout + r.stderr
+        # configure succeeds even without the runtime libs (the flags
+        # only apply at compile/link); a MISSING RUNTIME looks like a
+        # linker "cannot find" error — anything else is a real build
+        # failure and must fail the test
+        missing = ("cannot find -ltsan" in blob
+                   or "cannot find -lasan" in blob
+                   or "libtsan" in blob and "No such file" in blob
+                   or "libasan" in blob and "No such file" in blob)
+        if missing:
+            pytest.skip(f"no {flavor} sanitizer runtime: {blob[-200:]}")
+        assert r.returncode == 0, blob
     exe = os.path.join(build_dir, "test_stress")
     out = subprocess.run([exe], capture_output=True, text=True, timeout=580)
     assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]

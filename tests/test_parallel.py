@@ -45,10 +45,14 @@ def test_make_mesh_factoring():
 
 
 def test_auto_mesh_priority():
+    # ep outranks sp so the MoE all-to-all is never silently degenerate
+    # at 8 devices (VERDICT r3 weak #9)
     m = auto_mesh(8, axis_names=("dp", "ep", "sp", "tp"))
-    assert m.shape["tp"] == 2 and m.shape["dp"] == 2 and m.shape["sp"] == 2
+    assert m.shape["tp"] == 2 and m.shape["dp"] == 2 and m.shape["ep"] == 2
     m = auto_mesh(4, axis_names=("dp", "tp"))
     assert m.shape["tp"] == 2 and m.shape["dp"] == 2
+    m = auto_mesh(8, axis_names=("dp", "sp"))
+    assert m.shape["sp"] >= 2  # ring-attention meshes still get sp
 
 
 def test_all_reduce(mesh8):
