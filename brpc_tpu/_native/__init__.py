@@ -29,6 +29,10 @@ HTTP_FN = ctypes.CFUNCTYPE(
     ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,
     ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t, ctypes.c_void_p)
 
+# Progressive body chunk callback of the HTTP client (user, data, len)
+HTTP_CHUNK_CB = ctypes.CFUNCTYPE(
+    None, ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t)
+
 
 def _build() -> None:
     script = os.path.join(_REPO, "native", "build.sh")
@@ -288,6 +292,27 @@ def _declare(L: ctypes.CDLL) -> None:
     L.trpc_channel_request_device_plane.restype = None
     L.trpc_channel_transport_state.argtypes = [c.c_void_p]
     L.trpc_channel_transport_state.restype = c.c_int
+
+    # HTTP client (the framework's own; rpc/http_client.py)
+    L.trpc_channel_set_http.argtypes = [c.c_void_p, c.c_char_p]
+    L.trpc_channel_set_http.restype = None
+    L.trpc_http_client_call.argtypes = [
+        c.c_void_p, c.c_char_p, c.c_char_p, c.c_char_p, c.c_char_p,
+        c.c_size_t, c.c_int64, HTTP_CHUNK_CB, c.c_void_p,
+        c.POINTER(c.c_void_p)]
+    L.trpc_http_client_call.restype = c.c_int
+    L.trpc_http_result_status.argtypes = [c.c_void_p]
+    L.trpc_http_result_status.restype = c.c_int
+    L.trpc_http_result_error_text.argtypes = [c.c_void_p]
+    L.trpc_http_result_error_text.restype = c.c_char_p
+    L.trpc_http_result_headers.argtypes = [
+        c.c_void_p, c.POINTER(c.POINTER(c.c_uint8))]
+    L.trpc_http_result_headers.restype = c.c_size_t
+    L.trpc_http_result_body.argtypes = [
+        c.c_void_p, c.POINTER(c.POINTER(c.c_uint8))]
+    L.trpc_http_result_body.restype = c.c_size_t
+    L.trpc_http_result_destroy.argtypes = [c.c_void_p]
+    L.trpc_http_result_destroy.restype = None
 
     # bench
     L.trpc_run_echo_bench.argtypes = [c.c_char_p, c.c_int, c.c_int, c.c_int,

@@ -1,0 +1,158 @@
+"""HTTP client — the framework's OWN client (≙ accessing HTTP services
+via brpc::Channel with PROTOCOL_HTTP, docs/en/http_client.md and the
+client half of policy/http_rpc_protocol.cpp; NOT a urllib wrapper).
+
+The data path is native: requests serialize and responses parse in C++
+over the same Socket/EventDispatcher/TLS stack every other protocol
+uses; responses correlate FIFO per connection; `stream=` delivers body
+bytes progressively as they arrive (≙ ProgressiveReader,
+progressive_reader.h:36).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+from brpc_tpu._native import HTTP_CHUNK_CB as _CHUNK_CB, lib
+from brpc_tpu.rpc import errors
+from brpc_tpu.utils.endpoint import str2endpoint
+
+
+class HttpResponse:
+    __slots__ = ("status", "headers", "body")
+
+    def __init__(self, status: int, headers: Dict[str, str], body: bytes):
+        self.status = status
+        self.headers = headers
+        self.body = body
+
+
+class HttpChannel:
+    """Client channel to one HTTP/1.1 server.
+
+    connection_type: "pooled" (default — one exclusive connection per
+    in-flight request, parked between calls), "single" (one shared
+    pipelined connection), or "short".  TLS via tls=True (+ tls_ca /
+    tls_verify / client certs), sharing the channel TLS stack.
+    """
+
+    _CONN_TYPES = {"single": 0, "pooled": 1, "short": 2}
+
+    def __init__(self, address: str, connection_type: str = "pooled",
+                 connect_timeout_ms: float = 1000.0,
+                 host: Optional[str] = None,
+                 tls: bool = False, tls_verify: bool = True,
+                 tls_ca: Optional[str] = None,
+                 tls_cert: Optional[str] = None,
+                 tls_key: Optional[str] = None):
+        ep = str2endpoint(address)
+        L = lib()
+        self._handle = L.trpc_channel_create(ep.ip.encode(), ep.port)
+        L.trpc_channel_set_connect_timeout(
+            self._handle, int(connect_timeout_ms * 1000))
+        ct = self._CONN_TYPES.get(connection_type)
+        if ct is None:
+            raise ValueError(f"unknown connection_type {connection_type!r}")
+        if ct:
+            L.trpc_channel_set_connection_type(self._handle, ct)
+        L.trpc_channel_set_http(self._handle,
+                                host.encode() if host else None)
+        if tls:
+            rc = L.trpc_channel_set_tls(
+                self._handle, 1 if tls_verify else 0,
+                tls_ca.encode() if tls_ca else None,
+                tls_cert.encode() if tls_cert else None,
+                tls_key.encode() if tls_key else None)
+            if rc != 0:
+                reason = (L.trpc_tls_error() or b"").decode()
+                L.trpc_channel_destroy(self._handle)
+                self._handle = None
+                raise OSError(-rc, f"TLS setup failed: {reason}")
+        self._lock = threading.Lock()
+        self._closed = False
+        # ctypes trampolines for in-flight streaming callbacks: the native
+        # side may still deliver chunks after a local timeout (until the
+        # connection sweep runs), so a trampoline must outlive its call —
+        # kept here until the call completes cleanly or the channel closes
+        self._cb_refs: list = []
+
+    def request(self, method: str, target: str = "/",
+                headers: Optional[Dict[str, str]] = None,
+                body: bytes = b"", timeout_ms: float = 10_000.0,
+                stream: Optional[Callable[[bytes], None]] = None
+                ) -> HttpResponse:
+        """One HTTP call.  `stream` (optional) receives body chunks as
+        they arrive; the returned body is then empty."""
+        if self._closed:
+            raise errors.RpcError(errors.EFAILEDSOCKET, "channel closed")
+        L = lib()
+        blob = None
+        if headers:
+            blob = "".join(f"{k}: {v}\r\n" for k, v in headers.items()
+                           ).encode()
+        cb = _CHUNK_CB()  # NULL function pointer (no streaming)
+        keepalive = None
+        if stream is not None:
+            def _cb(_user, data, n):
+                stream(ctypes.string_at(data, n))
+            keepalive = _CHUNK_CB(_cb)
+            cb = keepalive
+            with self._lock:
+                self._cb_refs.append(keepalive)
+        result = ctypes.c_void_p()
+        try:
+            rc = L.trpc_http_client_call(
+                self._handle, method.encode(), target.encode(), blob,
+                body if body else None, len(body), int(timeout_ms * 1000),
+                cb, None, ctypes.byref(result))
+        except BaseException:
+            raise  # trampoline stays in _cb_refs (freed at close())
+        else:
+            if keepalive is not None and rc == 0:
+                # clean completion: the native side is done with the
+                # trampoline (response fully parsed)
+                with self._lock:
+                    try:
+                        self._cb_refs.remove(keepalive)
+                    except ValueError:
+                        pass
+        try:
+            if rc != 0:
+                text = (L.trpc_http_result_error_text(result)
+                        or b"").decode()
+                raise errors.RpcError(rc, text or f"http error {rc}")
+            status = L.trpc_http_result_status(result)
+            p = ctypes.POINTER(ctypes.c_uint8)()
+            n = L.trpc_http_result_headers(result, ctypes.byref(p))
+            hdr_blob = ctypes.string_at(p, n).decode(
+                "latin-1") if n else ""
+            n2 = L.trpc_http_result_body(result, ctypes.byref(p))
+            rbody = ctypes.string_at(p, n2) if n2 else b""
+        finally:
+            L.trpc_http_result_destroy(result)
+        hdrs: Dict[str, str] = {}
+        for line in hdr_blob.splitlines():
+            k, _, v = line.partition(": ")
+            if k:
+                hdrs[k] = v
+        return HttpResponse(status, hdrs, rbody)
+
+    def get(self, target: str = "/", **kw) -> HttpResponse:
+        return self.request("GET", target, **kw)
+
+    def post(self, target: str, body: bytes = b"",
+             **kw) -> HttpResponse:
+        return self.request("POST", target, body=body, **kw)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        # destroy waits out the native connections, after which no chunk
+        # callback can fire — trampolines are safe to drop
+        lib().trpc_channel_destroy(self._handle)
+        self._handle = None
+        self._cb_refs.clear()

@@ -1,6 +1,8 @@
 """parallel_http — mass concurrent HTTP fetcher
 (≙ reference tools/parallel_http: fetch many URLs with bounded
-concurrency and report per-URL outcomes).
+concurrency and report per-URL outcomes).  Drives the FRAMEWORK'S OWN
+HTTP client (rpc/http_client.py — native data path, pooled per host),
+not urllib.
 
     python -m brpc_tpu.tools.parallel_http --url-file urls.txt -c 32
 """
@@ -9,12 +11,14 @@ from __future__ import annotations
 
 import argparse
 import sys
+import threading
 import time
-import urllib.error
-import urllib.request
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
+from urllib.parse import urlsplit
+
+from brpc_tpu.rpc.http_client import HttpChannel
 
 
 @dataclass
@@ -26,24 +30,58 @@ class FetchResult:
     error: str = ""
 
 
+class _ChannelCache:
+    """One HttpChannel per (scheme, host, port) — fetches to one host
+    share its connection pool."""
+
+    def __init__(self, tls_verify: bool = True):
+        self._lock = threading.Lock()
+        self._chans: Dict[Tuple[str, str, int], HttpChannel] = {}
+        self._tls_verify = tls_verify
+
+    def get(self, scheme: str, host: str, port: int) -> HttpChannel:
+        key = (scheme, host, port)
+        with self._lock:
+            ch = self._chans.get(key)
+            if ch is None:
+                ch = HttpChannel(f"{host}:{port}", host=host,
+                                 tls=(scheme == "https"),
+                                 tls_verify=self._tls_verify)
+                self._chans[key] = ch
+            return ch
+
+    def close(self):
+        with self._lock:
+            for ch in self._chans.values():
+                ch.close()
+            self._chans.clear()
+
+
 def fetch_all(urls: List[str], concurrency: int = 16,
-              timeout_s: float = 10.0) -> List[FetchResult]:
+              timeout_s: float = 10.0,
+              tls_verify: bool = True) -> List[FetchResult]:
+    cache = _ChannelCache(tls_verify=tls_verify)
+
     def one(url: str) -> FetchResult:
         t0 = time.monotonic()
         try:
-            with urllib.request.urlopen(url, timeout=timeout_s) as r:
-                body = r.read()
-                return FetchResult(url, r.status, len(body),
-                                   (time.monotonic() - t0) * 1000)
-        except urllib.error.HTTPError as e:
-            return FetchResult(url, e.code, 0,
+            u = urlsplit(url if "//" in url else "http://" + url)
+            port = u.port or (443 if u.scheme == "https" else 80)
+            ch = cache.get(u.scheme or "http", u.hostname or "127.0.0.1",
+                           port)
+            target = (u.path or "/") + (f"?{u.query}" if u.query else "")
+            r = ch.get(target, timeout_ms=timeout_s * 1000)
+            return FetchResult(url, r.status, len(r.body),
                                (time.monotonic() - t0) * 1000)
         except Exception as e:
             return FetchResult(url, -1, 0,
                                (time.monotonic() - t0) * 1000, str(e))
 
-    with ThreadPoolExecutor(max_workers=concurrency) as pool:
-        return list(pool.map(one, urls))
+    try:
+        with ThreadPoolExecutor(max_workers=concurrency) as pool:
+            return list(pool.map(one, urls))
+    finally:
+        cache.close()
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -52,6 +90,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--url-file", help="file with one URL per line")
     ap.add_argument("-c", "--concurrency", type=int, default=16)
     ap.add_argument("--timeout", type=float, default=10.0)
+    ap.add_argument("--insecure", action="store_true",
+                    help="skip TLS certificate verification")
     args = ap.parse_args(argv)
     urls = list(args.urls)
     if args.url_file:
@@ -59,7 +99,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             urls += [ln.strip() for ln in f if ln.strip()]
     if not urls:
         ap.error("no URLs given")
-    results = fetch_all(urls, args.concurrency, args.timeout)
+    results = fetch_all(urls, args.concurrency, args.timeout,
+                        tls_verify=not args.insecure)
     ok = 0
     for r in results:
         mark = "OK " if 200 <= r.status < 300 else "ERR"

@@ -42,8 +42,18 @@ def press(server: str, method: str, payload: bytes, qps: float = 0.0,
           attachment: bytes = b"",
           timeout_ms: float = 1000.0) -> PressResult:
     """Drive `method` at `qps` (0 = as fast as possible) with `concurrency`
-    caller threads for `duration_s`."""
+    caller threads for `duration_s`.
+
+    HTTP mode (≙ rpc_press's http support): a method starting with "GET "
+    or "POST " is an HTTP target ("GET /health") driven through the
+    framework's own HTTP client; anything else is a TRPC method."""
     from brpc_tpu.rpc.channel import Channel, ChannelOptions
+    from brpc_tpu.rpc.http_client import HttpChannel
+
+    http_verb = None
+    http_target = "/"
+    if method.startswith(("GET ", "POST ", "PUT ", "DELETE ", "HEAD ")):
+        http_verb, _, http_target = method.partition(" ")
 
     res = PressResult()
     lock = threading.Lock()
@@ -52,8 +62,24 @@ def press(server: str, method: str, payload: bytes, qps: float = 0.0,
     interval = concurrency / qps if qps > 0 else 0.0
 
     def worker():
-        ch = Channel(server, ChannelOptions(timeout_ms=timeout_ms,
-                                            max_retry=0))
+        if http_verb is not None:
+            hch = HttpChannel(server)
+
+            def call_once():
+                r = hch.request(http_verb, http_target, body=payload,
+                                timeout_ms=timeout_ms)
+                if r.status >= 400:
+                    raise RuntimeError(f"http {r.status}")
+
+            closer = hch.close
+        else:
+            ch = Channel(server, ChannelOptions(timeout_ms=timeout_ms,
+                                                max_retry=0))
+
+            def call_once():
+                ch.call(method, payload, attachment)
+
+            closer = ch.close
         local_lat, local_calls, local_errs = [], 0, 0
         next_at = time.monotonic()
         while not stop.is_set():
@@ -65,12 +91,12 @@ def press(server: str, method: str, payload: bytes, qps: float = 0.0,
                 next_at += interval
             t0 = time.monotonic_ns()
             try:
-                ch.call(method, payload, attachment)
+                call_once()
                 local_lat.append((time.monotonic_ns() - t0) // 1000)
             except Exception:
                 local_errs += 1
             local_calls += 1
-        ch.close()
+        closer()
         with lock:
             res.calls += local_calls
             res.errors += local_errs

@@ -393,6 +393,49 @@ int trpc_channel_transport_state(void* c) {
   return channel_transport_state((Channel*)c);
 }
 
+// --- HTTP client -----------------------------------------------------------
+
+typedef void (*trpc_http_chunk_cb)(void* user, const uint8_t* data,
+                                   size_t len);
+
+void trpc_channel_set_http(void* c, const char* host) {
+  channel_set_http((Channel*)c, host);
+}
+
+// Synchronous HTTP call; the result handle is read with the getters below
+// and freed with trpc_http_result_destroy.  chunk_cb (nullable) streams
+// the body progressively instead of buffering it.
+int trpc_http_client_call(void* c, const char* method, const char* target,
+                          const char* headers_blob, const uint8_t* body,
+                          size_t body_len, int64_t timeout_us,
+                          trpc_http_chunk_cb chunk_cb, void* chunk_user,
+                          void** result) {
+  HttpClientResult* r = new HttpClientResult();
+  int rc = http_client_call((Channel*)c, method, target, headers_blob,
+                            body, body_len, timeout_us, r, chunk_cb,
+                            chunk_user);
+  *result = r;
+  return rc;
+}
+
+int trpc_http_result_status(void* r) {
+  return ((HttpClientResult*)r)->status;
+}
+const char* trpc_http_result_error_text(void* r) {
+  return ((HttpClientResult*)r)->error_text.c_str();
+}
+size_t trpc_http_result_headers(void* r, const uint8_t** p) {
+  HttpClientResult* hr = (HttpClientResult*)r;
+  *p = (const uint8_t*)hr->headers.data();
+  return hr->headers.size();
+}
+size_t trpc_http_result_body(void* r, const uint8_t** p) {
+  HttpClientResult* hr = (HttpClientResult*)r;
+  *p = (const uint8_t*)hr->body.data();
+  return hr->body.size();
+}
+void trpc_http_result_destroy(void* r) { delete (HttpClientResult*)r; }
+
 // --- bench -----------------------------------------------------------------
 
 int trpc_run_echo_bench(const char* ip, int port, int nconn, int concurrency,

@@ -335,4 +335,296 @@ void PackHttpResponse(IOBuf* out, int status, const char* headers_blob,
   }
 }
 
+// ---------------------------------------------------------------------------
+// client side (≙ the client half of policy/http_rpc_protocol.cpp)
+
+namespace {
+
+// deliver body bytes: stream to the progressive callback when armed,
+// else accumulate (≙ ProgressiveReader vs normal response body)
+void resp_body_bytes(HttpRespParseState* st, const char* data, size_t n) {
+  if (st->on_chunk != nullptr) {
+    st->on_chunk(st->on_chunk_user, (const uint8_t*)data, n);
+  } else {
+    st->msg.body.append(data, n);
+  }
+}
+
+// consume up to n buffered bytes into the response body
+void resp_consume(IOBuf* buf, HttpRespParseState* st, size_t n) {
+  char tmp[16 * 1024];
+  while (n > 0) {
+    size_t m = std::min(n, sizeof(tmp));
+    m = std::min(m, buf->size());
+    if (m == 0) {
+      break;
+    }
+    buf->copy_to(tmp, m);
+    buf->pop_front(m);
+    resp_body_bytes(st, tmp, m);
+    n -= m;
+  }
+}
+
+int advance_resp_chunked(IOBuf* buf, HttpRespParseState* st) {
+  char line[kMaxChunkLine + 2];
+  while (true) {
+    switch (st->phase) {
+      case 0: {  // chunk-size line
+        size_t len = find_crlf(*buf, kMaxChunkLine + 2, line);
+        if (len == (size_t)-1) {
+          return buf->size() >= kMaxChunkLine + 2 ? -1 : 0;
+        }
+        if (len == 0 || !isxdigit((unsigned char)line[0]) ||
+            memchr(line, '\0', len) != nullptr) {
+          return -1;
+        }
+        line[len] = '\0';
+        char* end = nullptr;
+        unsigned long long sz = strtoull(line, &end, 16);
+        if (end == line || (*end != '\0' && *end != ';') ||
+            sz > kMaxBodyBytes ||
+            // cumulative cap for buffered bodies (progressive readers
+            // consume as they go and may stream unbounded)
+            (st->on_chunk == nullptr &&
+             st->msg.body.size() + sz > kMaxBodyBytes)) {
+          return -1;
+        }
+        buf->pop_front(len + 2);
+        if (sz == 0) {
+          st->phase = 3;
+        } else {
+          st->remaining = (size_t)sz;
+          st->phase = 1;
+        }
+        break;
+      }
+      case 1: {  // chunk data
+        size_t m = std::min(st->remaining, buf->size());
+        if (m > 0) {
+          resp_consume(buf, st, m);
+          st->remaining -= m;
+        }
+        if (st->remaining > 0) {
+          return 0;
+        }
+        st->phase = 2;
+        break;
+      }
+      case 2: {  // CRLF after data
+        if (buf->size() < 2) {
+          return 0;
+        }
+        char crlf[2];
+        buf->copy_to(crlf, 2);
+        if (crlf[0] != '\r' || crlf[1] != '\n') {
+          return -1;
+        }
+        buf->pop_front(2);
+        st->phase = 0;
+        break;
+      }
+      case 3: {  // trailers until empty line
+        size_t len = find_crlf(*buf, kMaxChunkLine + 2, line);
+        if (len == (size_t)-1) {
+          return buf->size() >= kMaxChunkLine + 2 ? -1 : 0;
+        }
+        buf->pop_front(len + 2);
+        st->trailer_bytes += len;
+        if (st->trailer_bytes > kMaxHeaderBytes) {
+          return -1;
+        }
+        if (len == 0) {
+          return 1;  // response complete
+        }
+        break;
+      }
+    }
+  }
+}
+
+}  // namespace
+
+int ParseHttpResponse(IOBuf* buf, HttpResponseMsg* out,
+                      HttpRespParseState* st, bool eof) {
+  if (!st->active) {
+    size_t scan = std::min(buf->size(), kMaxHeaderBytes);
+    std::string head;
+    head.resize(scan);
+    buf->copy_to(&head[0], scan);
+    size_t hdr_end = head.find("\r\n\r\n");
+    if (hdr_end == std::string::npos) {
+      return buf->size() >= kMaxHeaderBytes ? -1 : 0;
+    }
+    size_t line_end = head.find("\r\n");
+    const std::string line = head.substr(0, line_end);
+    // "HTTP/1.1 200 OK"
+    if (line.size() < 12 || line.compare(0, 7, "HTTP/1.") != 0) {
+      return -1;
+    }
+    bool keep_alive = line[7] == '1';
+    size_t sp1 = line.find(' ');
+    if (sp1 == std::string::npos || sp1 + 4 > line.size()) {
+      return -1;
+    }
+    int status = atoi(line.c_str() + sp1 + 1);
+    if (status < 100 || status > 599) {
+      return -1;
+    }
+    st->msg = HttpResponseMsg();
+    st->msg.status = status;
+    st->body_mode = 2;  // until-close unless a length header says else
+    bool have_cl = false;
+    size_t content_length = 0;
+    size_t pos = line_end + 2;
+    while (pos < hdr_end) {
+      size_t eol = head.find("\r\n", pos);
+      if (eol == std::string::npos || eol > hdr_end) {
+        eol = hdr_end;
+      }
+      std::string hline = head.substr(pos, eol - pos);
+      pos = eol + 2;
+      size_t colon = hline.find(':');
+      if (colon == std::string::npos) {
+        return -1;
+      }
+      std::string key = hline.substr(0, colon);
+      for (char& ch : key) {
+        ch = (char)tolower((unsigned char)ch);
+      }
+      size_t vstart = colon + 1;
+      while (vstart < hline.size() &&
+             (hline[vstart] == ' ' || hline[vstart] == '\t')) {
+        ++vstart;
+      }
+      std::string value = hline.substr(vstart);
+      if (key == "content-length") {
+        have_cl = true;
+        content_length = (size_t)strtoull(value.c_str(), nullptr, 10);
+        if (content_length > kMaxBodyBytes) {
+          return -1;
+        }
+      } else if (key == "transfer-encoding") {
+        std::string v = value;
+        for (char& ch : v) {
+          ch = (char)tolower((unsigned char)ch);
+        }
+        if (v.find("chunked") != std::string::npos) {
+          st->body_mode = 1;
+        }
+      } else if (key == "connection") {
+        std::string v = value;
+        for (char& ch : v) {
+          ch = (char)tolower((unsigned char)ch);
+        }
+        if (v.find("close") != std::string::npos) {
+          keep_alive = false;
+        } else if (v.find("keep-alive") != std::string::npos) {
+          keep_alive = true;
+        }
+      }
+      st->msg.headers += key;
+      st->msg.headers += ": ";
+      st->msg.headers += value;
+      st->msg.headers += '\n';
+    }
+    st->msg.keep_alive = keep_alive;
+    buf->pop_front(hdr_end + 4);
+    if (st->head_request || st->msg.status == 204 ||
+        st->msg.status == 304 || st->msg.status < 200) {
+      // bodiless by definition — even when Content-Length describes the
+      // entity a GET would have returned (HEAD)
+      st->body_mode = 0;
+      st->remaining = 0;
+    } else if (st->body_mode != 1) {
+      if (have_cl) {
+        st->body_mode = 0;
+        st->remaining = content_length;
+      }
+      // else: until-close (mode 2)
+    }
+    st->phase = 0;
+    st->trailer_bytes = 0;
+    st->active = true;
+  }
+  int done = 0;
+  switch (st->body_mode) {
+    case 0: {  // content-length
+      size_t m = std::min(st->remaining, buf->size());
+      if (m > 0) {
+        resp_consume(buf, st, m);
+        st->remaining -= m;
+      }
+      done = st->remaining == 0 ? 1 : 0;
+      break;
+    }
+    case 1:
+      done = advance_resp_chunked(buf, st);
+      break;
+    case 2: {  // until close
+      if (st->msg.body.size() + buf->size() > kMaxBodyBytes) {
+        return -1;
+      }
+      resp_consume(buf, st, buf->size());
+      done = eof ? 1 : 0;
+      break;
+    }
+  }
+  if (done <= 0) {
+    return done;
+  }
+  *out = std::move(st->msg);
+  *st = HttpRespParseState();  // incl. clearing on_chunk/head_request:
+                               // the owner re-arms per response
+  return 1;
+}
+
+void PackHttpRequest(IOBuf* out, const char* method, const char* target,
+                     const char* host, const char* headers_blob,
+                     const uint8_t* body, size_t body_len) {
+  std::string head;
+  head.reserve(256 + (headers_blob ? strlen(headers_blob) : 0));
+  head += method;
+  head += ' ';
+  head += (target != nullptr && target[0] != '\0') ? target : "/";
+  head += " HTTP/1.1\r\n";
+  // Host present iff a header LINE starts with it ("X-Forwarded-Host:"
+  // must not match)
+  auto has_header_line = [&](const char* name) {
+    if (headers_blob == nullptr) {
+      return false;
+    }
+    size_t n = strlen(name);
+    const char* p = headers_blob;
+    while (p != nullptr && *p != '\0') {
+      if (strncasecmp(p, name, n) == 0) {
+        return true;
+      }
+      p = strchr(p, '\n');
+      if (p != nullptr) {
+        ++p;
+      }
+    }
+    return false;
+  };
+  bool has_host = has_header_line("Host:");
+  if (!has_host) {
+    head += "Host: ";
+    head += host != nullptr ? host : "localhost";
+    head += "\r\n";
+  }
+  if (headers_blob != nullptr) {
+    head += headers_blob;
+  }
+  char cl[64];
+  snprintf(cl, sizeof(cl), "Content-Length: %zu\r\n",
+           body_len);
+  head += cl;
+  head += "\r\n";
+  out->append(head.data(), head.size());
+  if (body != nullptr && body_len > 0) {
+    out->append(body, body_len);
+  }
+}
+
 }  // namespace trpc

@@ -58,4 +58,48 @@ void PackHttpResponse(IOBuf* out, int status, const char* headers_blob,
 
 const char* HttpStatusText(int status);
 
+// --- client side (≙ the client half of policy/http_rpc_protocol.cpp) ------
+
+struct HttpResponseMsg {
+  int status = 0;
+  std::string headers;  // "lower-key: value\n" lines (same as requests)
+  std::string body;
+  bool keep_alive = true;
+};
+
+// Incremental response-parse state for one connection.  Supports
+// Content-Length, chunked, and EOF-delimited bodies (RFC 9112 §6.3).
+struct HttpRespParseState {
+  bool active = false;     // status line + headers consumed
+  HttpResponseMsg msg;
+  int body_mode = 0;       // 0 content-length, 1 chunked, 2 until-close
+  int phase = 0;           // chunked: 0 size, 1 data, 2 data-CRLF, 3 trailers
+  size_t remaining = 0;    // content-length left / current chunk left
+  size_t trailer_bytes = 0;
+  // progressive delivery: when set, body bytes stream to the callback as
+  // they arrive instead of accumulating in msg.body
+  // (≙ ProgressiveReader, progressive_reader.h:36).  The owner re-arms
+  // these (and head_request) per response — ParseHttpResponse clears them
+  // on completion.
+  void (*on_chunk)(void* user, const uint8_t* data, size_t len) = nullptr;
+  void* on_chunk_user = nullptr;
+  // the response answers a HEAD request: Content-Length describes the
+  // entity but NO body bytes follow (RFC 9112 §6.3 item 1)
+  bool head_request = false;
+};
+
+// Try to parse one complete response from buf.  Returns 1 parsed (state
+// reset for the next response), 0 need more bytes, -1 malformed.  Pass
+// eof=true when the peer closed: an until-close body then completes.
+int ParseHttpResponse(IOBuf* buf, HttpResponseMsg* out,
+                      HttpRespParseState* st, bool eof);
+
+// Serialize a request.  `target` is the path with optional query;
+// headers_blob is zero or more "Key: Value\r\n" lines (may be nullptr).
+// Host and Content-Length are added here (Host skipped if already in
+// headers_blob).
+void PackHttpRequest(IOBuf* out, const char* method, const char* target,
+                     const char* host, const char* headers_blob,
+                     const uint8_t* body, size_t body_len);
+
 }  // namespace trpc

@@ -1622,6 +1622,29 @@ enum TransportState {
   TS_FALLBACK_TCP = 3  // probe answered: peer has no device plane
 };
 
+// One in-flight HTTP request awaiting its response (responses come back
+// strictly in request order on a connection — FIFO correlation, unlike
+// TRPC's correlation ids).  Refcounted: caller + completer; a timeout
+// abandons by failing the connection, whose sweep completes the entry.
+struct HttpPending {
+  Butex* done = nullptr;
+  std::atomic<int> refs{2};
+  int error = 0;
+  std::string error_text;
+  HttpResponseMsg resp;
+  bool is_head = false;  // HEAD: Content-Length without body bytes
+  // progressive body delivery (≙ ProgressiveReader)
+  void (*chunk_cb)(void*, const uint8_t*, size_t) = nullptr;
+  void* chunk_user = nullptr;
+};
+
+void HttpPendingUnref(HttpPending* p) {
+  if (p->refs.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+    butex_destroy(p->done);
+    delete p;
+  }
+}
+
 struct ClientConn {
   std::mutex sweep_mu;
   PendingCall* sweep_head = nullptr;
@@ -1631,6 +1654,18 @@ struct ClientConn {
   bool short_lived = false;         // short: fail after the call completes
   std::atomic<int> transport{TS_TCP};
   std::atomic<uint64_t> peer_device_caps{0};
+  // HTTP-protocol channels: FIFO of requests awaiting responses + the
+  // connection's incremental response-parse state.  Outbound frames use
+  // the drain-owner pattern (http_out/http_writer): requests enqueue
+  // under http_mu (so wire order == FIFO order even on a shared
+  // connection), but the Socket::Write itself happens OUTSIDE the lock —
+  // a Write-triggered SetFailed re-enters ClientConnFailed, which takes
+  // http_mu, and would self-deadlock otherwise.
+  std::mutex http_mu;
+  std::deque<HttpPending*> http_q;
+  std::deque<IOBuf> http_out;
+  bool http_writer = false;
+  HttpRespParseState hst;
 
   void SweepLink(PendingCall* pc) {
     std::lock_guard<std::mutex> lk(sweep_mu);
@@ -1680,6 +1715,8 @@ class Channel {
   int64_t connect_timeout_us = 500 * 1000;
   std::string auth;  // credential riding every request meta (tag 13)
   int conn_type = 0;  // 0 single (SocketMap-shared), 1 pooled, 2 short
+  int protocol = 0;   // 0 TRPC, 1 HTTP/1.1 (client side)
+  std::string host_header;  // HTTP Host: value (defaults to ip:port)
   bool device_plane = false;  // tpu:// endpoint: probe for the device plane
   std::atomic<int> last_transport{TS_TCP};  // of the most recent call's conn
   void* tls_ctx = nullptr;  // client TLS: handshake at dial time
@@ -1704,6 +1741,21 @@ namespace {
 void ClientConnFailed(Socket* s) {
   StreamsOnSocketFailed(s->id());
   ClientConn* conn = (ClientConn*)s->user;
+  {
+    // HTTP pendings complete with a connection error (FIFO order moot now)
+    std::deque<HttpPending*> q;
+    {
+      std::lock_guard<std::mutex> lk(conn->http_mu);
+      q.swap(conn->http_q);
+    }
+    for (HttpPending* p : q) {
+      p->error = TRPC_EFAILEDSOCKET;
+      p->error_text = "connection failed";
+      butex_value(p->done).store(1, std::memory_order_release);
+      butex_wake_all(p->done);
+      HttpPendingUnref(p);
+    }
+  }
   if (!conn->map_key.empty()) {
     std::lock_guard<std::mutex> lk(g_socket_map_mu);
     auto it = g_socket_map.find(conn->map_key);
@@ -1840,6 +1892,78 @@ void ChannelOnMessages(Socket* s) {
   }
 }
 
+// edge_fn of HTTP-protocol client sockets: parse responses, complete the
+// FIFO head (≙ the client half of http_rpc_protocol.cpp; ProgressiveReader
+// bytes stream out through the head pending's chunk callback).
+void HttpClientOnMessages(Socket* s) {
+  ClientConn* conn = (ClientConn*)s->user;
+  bool eof = false;
+  ssize_t n = s->ReadToBuf(&eof);
+  if (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
+    s->SetFailed(errno);
+    return;
+  }
+  while (true) {
+    // arm the parser from the FIFO head — holding our own reference so a
+    // concurrent timeout sweep can't free it (or the Python callback
+    // trampoline it points at) while we parse
+    HttpPending* head = nullptr;
+    {
+      std::lock_guard<std::mutex> lk(conn->http_mu);
+      if (!conn->http_q.empty()) {
+        head = conn->http_q.front();
+        head->refs.fetch_add(1, std::memory_order_acq_rel);
+      }
+    }
+    if (head == nullptr) {
+      if (!s->read_buf.empty() || eof) {
+        // bytes (or EOF) with nothing outstanding: server misbehaving or
+        // clean idle close
+        s->SetFailed(s->read_buf.empty() ? ECONNRESET : TRPC_ERESPONSE);
+      }
+      return;
+    }
+    conn->hst.on_chunk = head->chunk_cb;
+    conn->hst.on_chunk_user = head->chunk_user;
+    conn->hst.head_request = head->is_head;
+    HttpResponseMsg msg;
+    int rc = ParseHttpResponse(&s->read_buf, &msg, &conn->hst, eof);
+    if (rc == 0) {
+      HttpPendingUnref(head);
+      if (eof) {
+        s->SetFailed(ECONNRESET);  // truncated response
+      }
+      return;
+    }
+    if (rc < 0) {
+      HttpPendingUnref(head);
+      s->SetFailed(TRPC_ERESPONSE);
+      return;
+    }
+    bool keep = msg.keep_alive;
+    bool deliver = false;
+    {
+      std::lock_guard<std::mutex> lk(conn->http_mu);
+      if (!conn->http_q.empty() && conn->http_q.front() == head) {
+        conn->http_q.pop_front();
+        deliver = true;
+      }
+      // else: the sweep raced us and owns completion
+    }
+    if (deliver) {
+      head->resp = std::move(msg);
+      butex_value(head->done).store(1, std::memory_order_release);
+      butex_wake_all(head->done);
+      HttpPendingUnref(head);  // the completer ref we took over
+    }
+    HttpPendingUnref(head);  // our parse-time ref
+    if (!keep) {
+      s->SetFailed(TRPC_ESTOP);  // server asked to close after this one
+      return;
+    }
+  }
+}
+
 // Dial a fresh connection to the channel's endpoint.  Returns an
 // addressed (ref-held) socket whose user is a new ClientConn, or nullptr
 // (rc_out set).  The ClientConn is freed by Socket::TryRecycle.
@@ -1906,7 +2030,7 @@ Socket* DialConn(Channel* c, int* rc_out) {
   ClientConn* conn = new ClientConn();
   SocketOptions opts;
   opts.fd = fd;
-  opts.edge_fn = ChannelOnMessages;
+  opts.edge_fn = c->protocol == 1 ? HttpClientOnMessages : ChannelOnMessages;
   opts.user = conn;
   opts.on_failed = ClientConnFailed;
   opts.corked = true;  // caller fibers share this connection: batch writes
@@ -2334,6 +2458,119 @@ int channel_call(Channel* c, const char* method, const uint8_t* req,
   if (conn->short_lived && !(stream != 0 && result == 0)) {
     // one call per connection — unless a stream now rides it (then the
     // socket lives until the stream closes / channel_destroy)
+    s->SetFailed(TRPC_ESTOP);
+  } else if (c->conn_type == 1) {
+    ReleasePooled(c, s);
+  }
+  s->Dereference();
+  return result;
+}
+
+// ---------------------------------------------------------------------------
+// HTTP client calls (≙ accessing an http server via brpc::Channel,
+// docs/en/http_client.md: the framework's OWN client, not urllib)
+
+void channel_set_http(Channel* c, const char* host_header) {
+  c->protocol = 1;
+  if (host_header != nullptr && host_header[0] != '\0') {
+    c->host_header = host_header;
+  }
+}
+
+int http_client_call(Channel* c, const char* method, const char* target,
+                     const char* headers_blob, const uint8_t* body,
+                     size_t body_len, int64_t timeout_us,
+                     HttpClientResult* out,
+                     void (*chunk_cb)(void*, const uint8_t*, size_t),
+                     void* chunk_user) {
+  int rc = 0;
+  Socket* s = AcquireConn(c, &rc);
+  if (s == nullptr) {
+    out->error = TRPC_EFAILEDSOCKET;
+    out->error_text = "connect failed";
+    return TRPC_EFAILEDSOCKET;
+  }
+  ClientConn* conn = (ClientConn*)s->user;
+  HttpPending* p = new HttpPending();
+  p->done = butex_create();
+  p->is_head = strcmp(method, "HEAD") == 0;
+  p->chunk_cb = chunk_cb;
+  p->chunk_user = chunk_user;
+  std::string host = c->host_header.empty()
+                         ? c->ip + ":" + std::to_string(c->port)
+                         : c->host_header;
+  IOBuf frame;
+  PackHttpRequest(&frame, method, target, host.c_str(), headers_blob, body,
+                  body_len);
+  // FIFO push + outbound enqueue under http_mu keeps wire order == queue
+  // order on shared connections; the actual Socket::Write runs OUTSIDE
+  // the lock via the drain-owner (a Write-triggered SetFailed re-enters
+  // ClientConnFailed, which needs http_mu).
+  bool self_fail = false;
+  {
+    std::unique_lock<std::mutex> lk(conn->http_mu);
+    conn->http_q.push_back(p);
+    conn->http_out.push_back(std::move(frame));
+    if (!conn->http_writer) {
+      conn->http_writer = true;
+      while (!conn->http_out.empty()) {
+        IOBuf f = std::move(conn->http_out.front());
+        conn->http_out.pop_front();
+        lk.unlock();
+        s->Write(std::move(f));  // failure surfaces via the sweep
+        lk.lock();
+      }
+      conn->http_writer = false;
+    }
+    // the socket may have failed before our push (sweep already ran and
+    // will never see us): self-complete in that case.  failed is set
+    // before on_failed runs, so seeing it false here means any later
+    // sweep WILL see our queued entry.
+    if (s->failed.load(std::memory_order_acquire)) {
+      for (auto it = conn->http_q.begin(); it != conn->http_q.end(); ++it) {
+        if (*it == p) {
+          conn->http_q.erase(it);
+          self_fail = true;
+          break;
+        }
+      }
+    }
+  }
+  if (self_fail) {
+    p->error = TRPC_EFAILEDSOCKET;
+    p->error_text = "connection failed";
+    butex_value(p->done).store(1, std::memory_order_release);
+    butex_wake_all(p->done);
+    HttpPendingUnref(p);  // the completer ref: never handed off
+  }
+  // wait for the response or the deadline
+  while (butex_value(p->done).load(std::memory_order_acquire) == 0) {
+    if (butex_wait(p->done, 0, timeout_us > 0 ? timeout_us : -1) != 0 &&
+        errno == ETIMEDOUT) {
+      if (butex_value(p->done).load(std::memory_order_acquire) != 0) {
+        break;
+      }
+      // an HTTP/1.1 response can't be abandoned mid-stream: fail the
+      // connection; its sweep completes us (and everyone queued behind)
+      s->SetFailed(TRPC_ERPCTIMEDOUT);
+      while (butex_value(p->done).load(std::memory_order_acquire) == 0) {
+        butex_wait(p->done, 0, 1000);
+      }
+      if (p->error == TRPC_EFAILEDSOCKET) {
+        p->error = TRPC_ERPCTIMEDOUT;
+        p->error_text = "http call timeout";
+      }
+      break;
+    }
+  }
+  out->error = p->error;
+  out->error_text = p->error_text;
+  out->status = p->resp.status;
+  out->headers = std::move(p->resp.headers);
+  out->body = std::move(p->resp.body);
+  int result = p->error;
+  HttpPendingUnref(p);
+  if (conn->short_lived) {
     s->SetFailed(TRPC_ESTOP);
   } else if (c->conn_type == 1) {
     ReleasePooled(c, s);

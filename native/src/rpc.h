@@ -184,6 +184,34 @@ struct CallResult {
   uint8_t compress_type = 0;  // of the response payload
 };
 
+// --- HTTP client (≙ brpc Channel with PROTOCOL_HTTP: the framework's own
+// client, docs/en/http_client.md) -------------------------------------------
+
+// Make this channel speak HTTP/1.1 (client side).  host_header: Host:
+// value (nullptr = "ip:port").  Combine with channel_set_tls for https
+// and channel_set_connection_type for pooled/short semantics.
+void channel_set_http(Channel* c, const char* host_header);
+
+struct HttpClientResult {
+  int error = 0;            // 0 / TRPC_E*
+  std::string error_text;
+  int status = 0;           // HTTP status
+  std::string headers;      // "lower-key: value\n" lines
+  std::string body;         // empty when a chunk_cb streamed it
+};
+
+// Synchronous HTTP call.  target = path with optional query; headers_blob
+// = "Key: Value\r\n" lines or nullptr.  chunk_cb (optional) streams body
+// bytes as they arrive — the ProgressiveReader path (the returned body is
+// then empty).  Responses correlate FIFO per connection.
+int http_client_call(Channel* c, const char* method, const char* target,
+                     const char* headers_blob, const uint8_t* body,
+                     size_t body_len, int64_t timeout_us,
+                     HttpClientResult* out,
+                     void (*chunk_cb)(void*, const uint8_t*,
+                                      size_t) = nullptr,
+                     void* chunk_user = nullptr);
+
 // Synchronous call (from fiber or pthread).  Returns 0 or error code.
 // `stream` (optional): a stream_create() handle to attach — the streaming
 // handshake rides this RPC (stream.h); on success the stream is bound to
