@@ -20,7 +20,8 @@ from brpc_tpu.cluster.load_balancer import (
     NoServerError,
     create_load_balancer,
 )
-from brpc_tpu.cluster.naming import ServerNode, Watcher, get_naming_thread
+from brpc_tpu.cluster.naming import (ServerNode, Watcher,
+                                     acquire_naming_watcher)
 from brpc_tpu.metrics import bvar
 from brpc_tpu.rpc import errors
 
@@ -48,8 +49,7 @@ class ClusterChannel:
         self._lock = threading.Lock()
         self._health = HealthChecker(on_revive=self._on_revive)
         self._watcher = _LBWatcher(self)
-        self._ns = get_naming_thread(address)
-        self._ns.add_watcher(self._watcher)
+        self._ns = acquire_naming_watcher(address, self._watcher)
         self._ns.wait_first_resolve()
         self._closed = False
 

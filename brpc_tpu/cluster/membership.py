@@ -1,0 +1,74 @@
+"""Membership registry — the server half of push-style naming.
+
+Serves a cluster member list over HTTP with long-poll semantics (the
+protocol `watch://` consumes, ≙ the consul blocking-query contract the
+reference's consul_naming_service speaks):
+
+    GET /members?index=N&wait_s=S
+      200 + body "ip:port [tag]" lines + "x-list-index: M"   (list at
+          version M != N — answered immediately, or the moment the list
+          changes within the wait budget)
+      304  (no change within S seconds)
+
+Install on any Server; publishers call update() and every long-polling
+watcher is answered at once — membership changes reach live load
+balancers without waiting out a poll interval.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterable, List
+
+from brpc_tpu.cluster.naming import ServerNode
+
+
+class MembershipRegistry:
+    def __init__(self, initial: Iterable[ServerNode] = ()):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._nodes: List[ServerNode] = list(initial)
+        self._index = 1
+
+    def update(self, nodes: Iterable[ServerNode]) -> int:
+        """Replace the list; wakes every parked long-poll immediately."""
+        with self._cond:
+            self._nodes = list(nodes)
+            self._index += 1
+            self._cond.notify_all()
+            return self._index
+
+    def nodes(self) -> List[ServerNode]:
+        with self._lock:
+            return list(self._nodes)
+
+    def install(self, server, path: str = "/members",
+                max_wait_s: float = 25.0) -> None:
+        """Register the long-poll endpoint on `server`.
+
+        NOTE: a parked long-poll occupies a usercode-pool thread for up
+        to its wait budget; size usercode_workers for the number of
+        concurrent watchers (the reference's consul agent has the same
+        property per blocking query).
+        """
+        from brpc_tpu.rpc.http import HttpResponse
+
+        def handler(req):
+            q = req.query_params()
+            try:
+                index = int(q.get("index", "0"))
+                wait_s = min(float(q.get("wait_s", "0") or 0), max_wait_s)
+            except ValueError:
+                return HttpResponse.text("bad index/wait_s\n", 400)
+            with self._cond:
+                if index == self._index and wait_s > 0:
+                    self._cond.wait_for(lambda: self._index != index,
+                                        timeout=wait_s)
+                if index == self._index:
+                    return HttpResponse.text("", 304)
+                body = "\n".join(str(n) for n in self._nodes) + "\n"
+                resp = HttpResponse.text(body)
+                resp.headers["x-list-index"] = str(self._index)
+                return resp
+
+        server.register_http(path, handler)

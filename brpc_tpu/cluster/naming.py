@@ -102,10 +102,112 @@ class DNSNamingService(NamingService):
         return nodes
 
 
+class _HttpNamingBase(NamingService):
+    """Shared plumbing for HTTP-backed naming (the framework's own HTTP
+    client underneath): "host:port/path" param parsing and channel
+    lifecycle — close() breaks any in-flight fetch and frees the native
+    channel (called by NamingServiceThread at teardown)."""
+
+    def __init__(self, param: str):
+        super().__init__(param)
+        hostport, slash, path = param.partition("/")
+        self._target = "/" + path if slash else "/"
+        from brpc_tpu.rpc.http_client import HttpChannel
+        self._ch = HttpChannel(hostport, connection_type="pooled")
+
+    def close(self) -> None:
+        self._ch.close()
+
+
+class RemoteFileNamingService(_HttpNamingBase):
+    """remote_file://host:port/path — the membership file lives on an HTTP
+    server and is fetched with the framework's own client
+    (≙ policy/remote_file_naming_service.cpp, which pulls via brpc's
+    http channel)."""
+
+    poll_interval_s = 5.0
+
+    def get_servers(self) -> List[ServerNode]:
+        r = self._ch.get(self._target, timeout_ms=5000)
+        if r.status != 200:
+            raise IOError(f"remote_file fetch: HTTP {r.status}")
+        return self.parse_nodes(r.body.decode().splitlines())
+
+
+class WatchNamingService(_HttpNamingBase):
+    """watch://host:port/path — PUSH-style membership via HTTP long-poll
+    (≙ policy/consul_naming_service.cpp's blocking queries: the server
+    holds the request until the list changes, so updates propagate
+    immediately instead of waiting out a poll interval).
+
+    Protocol (served by cluster.membership.MembershipRegistry):
+      GET /path?index=N&wait_s=S
+        -> 200 with the list + "x-list-index: M" once index != N (or on
+           first call), or 304 if nothing changed within S seconds.
+    """
+
+    # wait budget per long-poll round; the server answers sooner on change
+    wait_s = 20.0
+
+    def __init__(self, param: str):
+        super().__init__(param)
+        self._index = 0
+
+    @staticmethod
+    def _index_of(resp) -> int:
+        """A 200 MUST carry a numeric x-list-index — a server without it
+        (plain file server, header-stripping proxy) would otherwise reset
+        the index and turn the long-poll into a zero-delay busy loop."""
+        raw = resp.headers.get("x-list-index")
+        if raw is None:
+            raise IOError("response missing x-list-index "
+                          "(not a long-poll membership server)")
+        try:
+            return int(raw)
+        except ValueError:
+            raise IOError(f"bad x-list-index {raw!r}")
+
+    def get_servers(self) -> List[ServerNode]:
+        # non-blocking form for the initial resolve
+        r = self._ch.get(f"{self._target}?index=0", timeout_ms=5000)
+        if r.status != 200:
+            raise IOError(f"watch fetch: HTTP {r.status}")
+        self._index = self._index_of(r)
+        return self.parse_nodes(r.body.decode().splitlines())
+
+    def watch(self, emit: Callable[[List[ServerNode]], None],
+              stop) -> None:
+        """Blocking push loop: emit(list) on every change, immediately."""
+        backoff = 0.05
+        while not stop.is_set():
+            try:
+                r = self._ch.get(
+                    f"{self._target}?index={self._index}"
+                    f"&wait_s={self.wait_s}",
+                    timeout_ms=(self.wait_s + 10.0) * 1000)
+                if r.status == 200:
+                    self._index = self._index_of(r)
+                    emit(self.parse_nodes(r.body.decode().splitlines()))
+                    backoff = 0.05
+                elif r.status == 304:
+                    continue  # no change within the wait budget
+                else:
+                    raise IOError(f"HTTP {r.status}")
+            except Exception as e:
+                if stop.is_set():
+                    return
+                log.LOG(log.LOG_WARNING, "watch %s: %s (retry in %.2fs)",
+                        self.param, e, backoff)
+                stop.wait(backoff)
+                backoff = min(backoff * 2, 5.0)
+
+
 _NS_REGISTRY: Dict[str, type] = {
     "list": ListNamingService,
     "file": FileNamingService,
     "dns": DNSNamingService,
+    "remote_file": RemoteFileNamingService,
+    "watch": WatchNamingService,
 }
 
 
@@ -147,6 +249,9 @@ class NamingServiceThread:
         self._thread.start()
 
     def add_watcher(self, w: Watcher) -> None:
+        """Prefer acquire_naming_watcher(): it registers atomically with
+        the shared-thread lookup, closing the window where the last
+        watcher's removal stops the thread a new watcher just got."""
         with self._lock:
             self._watchers.append(w)
             nodes = list(self._nodes)
@@ -154,9 +259,20 @@ class NamingServiceThread:
             w.on_servers(nodes, [], nodes)
 
     def remove_watcher(self, w: Watcher) -> None:
-        with self._lock:
-            if w in self._watchers:
-                self._watchers.remove(w)
+        # under the global lock so it can't interleave with a concurrent
+        # acquire_naming_watcher() on the same URL
+        with _threads_lock:
+            with self._lock:
+                if w in self._watchers:
+                    self._watchers.remove(w)
+                last = not self._watchers
+            if last:
+                # nobody listening: stop the thread (matters for push-
+                # style services, whose watch loop would otherwise
+                # reconnect forever) and let the next lookup start fresh
+                self.stop()
+                if _threads.get(self.url) is self:
+                    del _threads[self.url]
 
     def wait_first_resolve(self, timeout_s: float = 5.0) -> bool:
         return self._resolved_once.wait(timeout_s)
@@ -167,14 +283,17 @@ class NamingServiceThread:
 
     def stop(self) -> None:
         self._stop.set()
+        # break any in-flight fetch/long-poll and free the native channel
+        close = getattr(self.ns, "close", None)
+        if close is not None:
+            try:
+                close()
+            except Exception:
+                pass
 
-    def _poll_once(self) -> None:
-        try:
-            fresh = self.ns.get_servers()
-        except Exception as e:  # naming outage: keep the last good list
-            log.LOG(log.LOG_WARNING, "naming %s failed: %s", self.url, e)
-            self._resolved_once.set()
-            return
+    def _apply(self, fresh: List[ServerNode]) -> None:
+        """Diff a fresh full list against the current one and fan out
+        add/remove batches to watchers (≙ ResetServers)."""
         if self.filter is not None:
             fresh = [n for n in fresh if self.filter(n)]
         with self._lock:
@@ -189,8 +308,23 @@ class NamingServiceThread:
                 w.on_servers(added, removed, fresh)
         self._resolved_once.set()
 
+    def _poll_once(self) -> None:
+        try:
+            fresh = self.ns.get_servers()
+        except Exception as e:  # naming outage: keep the last good list
+            log.LOG(log.LOG_WARNING, "naming %s failed: %s", self.url, e)
+            self._resolved_once.set()
+            return
+        self._apply(fresh)
+
     def _run(self) -> None:
         self._poll_once()
+        if hasattr(self.ns, "watch"):
+            # push-style service: its blocking loop emits every change the
+            # moment the remote side reports it (long-poll / streaming),
+            # no poll interval involved
+            self.ns.watch(self._apply, self._stop)
+            return
         interval = self.ns.poll_interval_s
         if interval <= 0:
             return  # static list
@@ -206,8 +340,26 @@ def get_naming_thread(url: str) -> NamingServiceThread:
     """Shared per URL (≙ GetNamingServiceThread,
     details/naming_service_thread.h:136)."""
     with _threads_lock:
-        t = _threads.get(url)
-        if t is None or not t._thread.is_alive():
-            t = NamingServiceThread(url)
-            _threads[url] = t
-        return t
+        return _get_locked(url)
+
+
+def _get_locked(url: str) -> NamingServiceThread:
+    t = _threads.get(url)
+    if t is None or not t._thread.is_alive() or t._stop.is_set():
+        t = NamingServiceThread(url)
+        _threads[url] = t
+    return t
+
+
+def acquire_naming_watcher(url: str, w: Watcher) -> NamingServiceThread:
+    """Atomically look up (or start) the URL's shared thread AND register
+    the watcher — a concurrent last-watcher removal can't stop the thread
+    in between (both paths hold _threads_lock)."""
+    with _threads_lock:
+        t = _get_locked(url)
+        with t._lock:
+            t._watchers.append(w)
+            nodes = list(t._nodes)
+    if nodes:
+        w.on_servers(nodes, [], nodes)
+    return t

@@ -29,7 +29,8 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
-from brpc_tpu.cluster.naming import ServerNode, Watcher, get_naming_thread
+from brpc_tpu.cluster.naming import (ServerNode, Watcher,
+                                     acquire_naming_watcher)
 from brpc_tpu.rpc import errors
 from brpc_tpu.rpc.controller import Controller
 
@@ -222,9 +223,8 @@ class PartitionChannel:
         self._members: Dict[int, List[ServerNode]] = {}
         self._parts: Dict[int, object] = {}  # index -> rpc.Channel
         self._pc: Optional[ParallelChannel] = None  # persistent fan-out
-        self._ns = get_naming_thread(naming_url)
         self._watcher = _PartitionWatcher(self)
-        self._ns.add_watcher(self._watcher)
+        self._ns = acquire_naming_watcher(naming_url, self._watcher)
         self._ns.wait_first_resolve()
         self._rebuild(self._ns.nodes())
 
@@ -357,9 +357,8 @@ class DynamicPartitionChannel:
         self._timeout_ms = timeout_ms
         self._lock = threading.Lock()
         self._schemes: Dict[int, PartitionChannel] = {}
-        self._ns = get_naming_thread(naming_url)
         self._watcher = _DynWatcher(self)
-        self._ns.add_watcher(self._watcher)
+        self._ns = acquire_naming_watcher(naming_url, self._watcher)
         self._ns.wait_first_resolve()
         self._sync_schemes(self._ns.nodes())
 

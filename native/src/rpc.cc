@@ -1652,6 +1652,10 @@ struct ClientConn {
   std::string map_key;            // nonempty: registered in the SocketMap
   Channel* pool_owner = nullptr;    // pooled: owning channel
   bool short_lived = false;         // short: fail after the call completes
+  // set BEFORE the caller wakes when the peer announced it will close
+  // (HTTP Connection: close / 1.0): Release/Acquire must not reuse a
+  // connection that is about to die, even though failed isn't set yet
+  std::atomic<bool> closing{false};
   std::atomic<int> transport{TS_TCP};
   std::atomic<uint64_t> peer_device_caps{0};
   // HTTP-protocol channels: FIFO of requests awaiting responses + the
@@ -1816,8 +1820,7 @@ void ChannelOnMessages(Socket* s) {
   bool eof = false;
   ssize_t n = s->ReadToBuf(&eof);
   if (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
-    s->SetFailed(errno);
-    return;
+    eof = true;  // dead connection; drain buffered responses first
   }
   while (true) {
     RpcMeta meta;
@@ -1900,8 +1903,11 @@ void HttpClientOnMessages(Socket* s) {
   bool eof = false;
   ssize_t n = s->ReadToBuf(&eof);
   if (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
-    s->SetFailed(errno);
-    return;
+    // the peer reset us (e.g. an HTTP/1.0 server closing right after its
+    // response, sometimes as RST) — but a complete response may already
+    // be buffered and is owed to the caller: parse with eof semantics
+    // first; the failure surfaces below once the buffer is drained
+    eof = true;
   }
   while (true) {
     // arm the parser from the FIFO head — holding our own reference so a
@@ -1941,6 +1947,10 @@ void HttpClientOnMessages(Socket* s) {
       return;
     }
     bool keep = msg.keep_alive;
+    if (!keep) {
+      // before waking the caller: its ReleasePooled must see the mark
+      conn->closing.store(true, std::memory_order_release);
+    }
     bool deliver = false;
     {
       std::lock_guard<std::mutex> lk(conn->http_mu);
@@ -2186,7 +2196,9 @@ Socket* AcquirePooled(Channel* c, int* rc_out) {
       break;
     }
     Socket* s = Socket::Address(sid);
-    if (s != nullptr && !s->failed.load(std::memory_order_acquire)) {
+    if (s != nullptr && !s->failed.load(std::memory_order_acquire) &&
+        !((ClientConn*)s->user)->closing.load(
+            std::memory_order_acquire)) {
       return s;
     }
     if (s != nullptr) {
@@ -2208,8 +2220,9 @@ Socket* AcquirePooled(Channel* c, int* rc_out) {
 // (and even if one did, AcquirePooled's Address check drops it safely).
 void ReleasePooled(Channel* c, Socket* s) {
   std::lock_guard<std::mutex> lk(c->pool_mu);
-  if (s->failed.load(std::memory_order_acquire)) {
-    return;  // broken: recycle path owns it
+  if (s->failed.load(std::memory_order_acquire) ||
+      ((ClientConn*)s->user)->closing.load(std::memory_order_acquire)) {
+    return;  // broken or about to close: never park it
   }
   c->pool_free.push_back(s->id());
 }

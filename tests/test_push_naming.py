@@ -1,0 +1,129 @@
+"""Push-style naming (watch:// long-poll) + remote_file:// naming
+(≙ policy/consul_naming_service.cpp blocking queries +
+policy/remote_file_naming_service.cpp).
+
+The VERDICT criterion: a membership change propagates to a live load
+balancer mid-traffic WITHOUT waiting out a poll interval."""
+
+import threading
+import time
+
+import pytest
+
+from brpc_tpu.cluster.membership import MembershipRegistry
+from brpc_tpu.cluster.naming import ServerNode, WatchNamingService
+from brpc_tpu.rpc.channel import Channel, ChannelOptions
+from brpc_tpu.rpc.server import Server
+from brpc_tpu.utils.endpoint import str2endpoint
+
+
+def _echo_server(name: str) -> Server:
+    srv = Server()
+    srv.add_service("Who", lambda cntl, req, n=name: n.encode())
+    srv.start("127.0.0.1:0")
+    return srv
+
+
+@pytest.fixture
+def cluster():
+    a, b = _echo_server("A"), _echo_server("B")
+    reg_srv = Server()
+    registry = MembershipRegistry(
+        [ServerNode(str2endpoint(f"127.0.0.1:{a.port}"))])
+    registry.install(reg_srv)
+    reg_srv.start("127.0.0.1:0")
+    yield a, b, reg_srv, registry
+    for s in (a, b, reg_srv):
+        s.destroy()
+
+
+def _hit_set(ch, n=24):
+    out = set()
+    for _ in range(n):
+        out.add(ch.call("Who", b"").decode())
+    return out
+
+
+def test_watch_pushes_mid_traffic(cluster):
+    a, b, reg_srv, registry = cluster
+    # wait_s far above the test budget: if propagation relied on polling,
+    # this test would time out — only a push can pass it
+    old_wait = WatchNamingService.wait_s
+    WatchNamingService.wait_s = 30.0
+    try:
+        ch = Channel(f"watch://127.0.0.1:{reg_srv.port}/members",
+                     ChannelOptions(load_balancer="rr", max_retry=1))
+        assert _hit_set(ch) == {"A"}
+
+        registry.update([
+            ServerNode(str2endpoint(f"127.0.0.1:{a.port}")),
+            ServerNode(str2endpoint(f"127.0.0.1:{b.port}")),
+        ])
+        deadline = time.monotonic() + 5.0
+        seen = set()
+        while time.monotonic() < deadline:
+            seen |= _hit_set(ch, 8)
+            if seen == {"A", "B"}:
+                break
+            time.sleep(0.05)
+        assert seen == {"A", "B"}, f"update did not propagate: {seen}"
+
+        # removal propagates just as fast
+        registry.update(
+            [ServerNode(str2endpoint(f"127.0.0.1:{b.port}"))])
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if _hit_set(ch, 12) == {"B"}:
+                break
+            time.sleep(0.05)
+        assert _hit_set(ch, 12) == {"B"}
+        ch.close()
+    finally:
+        WatchNamingService.wait_s = old_wait
+
+
+def test_remote_file_naming(cluster):
+    a, b, reg_srv, registry = cluster
+    ch = Channel(f"remote_file://127.0.0.1:{reg_srv.port}/members",
+                 ChannelOptions(load_balancer="rr", max_retry=1))
+    assert _hit_set(ch) == {"A"}
+    ch.close()
+
+
+def test_membership_long_poll_protocol(cluster):
+    """The wire contract watch:// consumes: 304 on no change within the
+    budget; immediate 200 + new index on change."""
+    a, b, reg_srv, registry = cluster
+    from brpc_tpu.rpc.http_client import HttpChannel
+
+    ch = HttpChannel(f"127.0.0.1:{reg_srv.port}")
+    r = ch.get("/members?index=0")
+    assert r.status == 200
+    idx = int(r.headers["x-list-index"])
+    assert f"127.0.0.1:{a.port}" in r.body.decode()
+
+    # no change: bounded 304
+    t0 = time.monotonic()
+    r = ch.get(f"/members?index={idx}&wait_s=0.3", timeout_ms=5000)
+    assert r.status == 304
+    assert time.monotonic() - t0 >= 0.25
+
+    # change answers a parked poll immediately
+    got = {}
+
+    def poller():
+        rr = ch.get(f"/members?index={idx}&wait_s=10", timeout_ms=15000)
+        got["status"] = rr.status
+        got["latency"] = time.monotonic() - t1
+        got["body"] = rr.body.decode()
+
+    t1 = time.monotonic()
+    t = threading.Thread(target=poller)
+    t.start()
+    time.sleep(0.2)
+    registry.update([ServerNode(str2endpoint(f"127.0.0.1:{b.port}"))])
+    t.join(10)
+    assert got["status"] == 200
+    assert got["latency"] < 2.0, got  # answered at once, not after 10s
+    assert f"127.0.0.1:{b.port}" in got["body"]
+    ch.close()
