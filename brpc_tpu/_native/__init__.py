@@ -133,6 +133,13 @@ def _declare(L: ctypes.CDLL) -> None:
     L.trpc_redis_respond.argtypes = [c.c_uint64, c.c_char_p, c.c_size_t]
     L.trpc_redis_respond.restype = c.c_int
 
+    # framed thrift on the shared port
+    L.trpc_server_set_thrift_handler.argtypes = [c.c_void_p, c.c_void_p,
+                                                 c.c_void_p]
+    L.trpc_server_set_thrift_handler.restype = None
+    L.trpc_thrift_respond.argtypes = [c.c_uint64, c.c_char_p, c.c_size_t]
+    L.trpc_thrift_respond.restype = c.c_int
+
     # auth
     L.trpc_server_set_auth.argtypes = [c.c_void_p, c.c_char_p, c.c_size_t]
     L.trpc_server_set_auth.restype = None

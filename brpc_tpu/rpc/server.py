@@ -176,6 +176,40 @@ class Server:
         lib().trpc_server_set_redis_handler(
             self._handle, ctypes.cast(cb, ctypes.c_void_p), None)
 
+    def add_thrift_service(self, service) -> None:
+        """Make the shared port speak framed thrift (≙ brpc serving
+        PROTOCOL_THRIFT, policy/thrift_protocol.cpp:763).  `service` is a
+        rpc.thrift.ThriftService; frames are sniffed + cut natively and
+        dispatched here on the usercode pool.  A oneway call releases its
+        pipeline slot with an empty respond."""
+        _THRIFT_CB = ctypes.CFUNCTYPE(
+            None, ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_size_t, ctypes.c_void_p)
+
+        def on_message(token, blob_p, blob_len, _user):
+            L = lib()
+            try:
+                frame = ctypes.string_at(blob_p, blob_len) if blob_len else b""
+                reply = service.dispatch(frame)
+            except Exception:
+                log.LOG(log.LOG_ERROR, "thrift dispatch raised:\n%s",
+                        traceback.format_exc())
+                from brpc_tpu.rpc import thrift as tmod
+                exc = tmod.TApplicationException(
+                    tmod.TApplicationException.INTERNAL_ERROR,
+                    "internal dispatch error")
+                reply = tmod.encode_message(
+                    "", tmod.MessageType.EXCEPTION, 0, exc.encode())
+            if reply is None:
+                L.trpc_thrift_respond(token, b"", 0)
+            else:
+                L.trpc_thrift_respond(token, reply, len(reply))
+
+        cb = _THRIFT_CB(on_message)
+        self._cb_keepalive.append(cb)
+        lib().trpc_server_set_thrift_handler(
+            self._handle, ctypes.cast(cb, ctypes.c_void_p), None)
+
     def add_grpc_service(self, service_name: str, methods) -> None:
         """Serve gRPC methods at /<service_name>/<Method> — real gRPC
         clients dial the same port (h2 + gRPC framing handled natively +

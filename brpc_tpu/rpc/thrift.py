@@ -1,0 +1,383 @@
+"""Thrift framed-transport TBinaryProtocol, service + client.
+
+Speaks the strict TBinaryProtocol over the framed transport — the wire
+format Apache Thrift's TFramedTransport + TBinaryProtocol produce — so a
+stock generated client can call a brpc_tpu server and vice versa (≙
+src/brpc/policy/thrift_protocol.cpp:763 ParseThriftMessage +
+src/brpc/thrift_message.h ThriftFramedMessage).  The frame header is
+stripped/added natively (native/src/rpc.cc thrift sniff + thrift_respond);
+this module sees whole TBinaryProtocol messages.
+
+No Thrift IDL compiler: values are described by compact runtime "specs"
+mirroring what generated code carries:
+
+    spec := TType.BOOL | .BYTE | .I16 | .I32 | .I64 | .DOUBLE | .STRING
+          | (TType.LIST, elem_spec)
+          | (TType.SET, elem_spec)
+          | (TType.MAP, key_spec, val_spec)
+          | (TType.STRUCT, {field_id: (name, spec), ...})
+
+Struct values are plain dicts keyed by field name; unknown incoming
+fields are skipped (forward compatibility, like generated readers).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+__all__ = [
+    "TType", "MessageType", "TApplicationException", "ThriftError",
+    "encode_message", "decode_message", "encode_struct", "decode_struct",
+    "ThriftService", "ThriftClient",
+]
+
+
+class TType:
+    STOP = 0
+    VOID = 1
+    BOOL = 2
+    BYTE = 3
+    DOUBLE = 4
+    I16 = 6
+    I32 = 8
+    I64 = 10
+    STRING = 11
+    STRUCT = 12
+    MAP = 13
+    SET = 14
+    LIST = 15
+
+
+class MessageType:
+    CALL = 1
+    REPLY = 2
+    EXCEPTION = 3
+    ONEWAY = 4
+
+
+VERSION_1 = 0x80010000
+
+
+class ThriftError(Exception):
+    pass
+
+
+class TApplicationException(ThriftError):
+    """Server-side failure carried in a MessageType.EXCEPTION reply
+    (standard struct: 1:message string, 2:type i32)."""
+
+    UNKNOWN = 0
+    UNKNOWN_METHOD = 1
+    INTERNAL_ERROR = 6
+
+    def __init__(self, kind: int = UNKNOWN, message: str = ""):
+        super().__init__(message or f"TApplicationException({kind})")
+        self.kind = kind
+        self.message = message
+
+    SPEC = (TType.STRUCT, {1: ("message", TType.STRING),
+                           2: ("type", TType.I32)})
+
+    def encode(self) -> bytes:
+        return encode_struct(
+            {"message": self.message, "type": self.kind}, self.SPEC)
+
+    @classmethod
+    def decode(cls, blob: bytes, off: int = 0) -> "TApplicationException":
+        d, _ = decode_struct(blob, off, cls.SPEC)
+        return cls(d.get("type", cls.UNKNOWN), d.get("message", ""))
+
+
+# ---------------------------------------------------------------------------
+# encoding
+
+def _spec_ttype(spec) -> int:
+    return spec[0] if isinstance(spec, tuple) else spec
+
+
+def _encode_value(out: bytearray, val, spec) -> None:
+    t = _spec_ttype(spec)
+    if t == TType.BOOL:
+        out.append(1 if val else 0)
+    elif t == TType.BYTE:
+        out += struct.pack("!b", val)
+    elif t == TType.I16:
+        out += struct.pack("!h", val)
+    elif t == TType.I32:
+        out += struct.pack("!i", val)
+    elif t == TType.I64:
+        out += struct.pack("!q", val)
+    elif t == TType.DOUBLE:
+        out += struct.pack("!d", val)
+    elif t == TType.STRING:
+        b = val.encode("utf-8") if isinstance(val, str) else bytes(val)
+        out += struct.pack("!i", len(b))
+        out += b
+    elif t == TType.STRUCT:
+        out += encode_struct(val, spec)
+    elif t in (TType.LIST, TType.SET):
+        elem = spec[1]
+        out += struct.pack("!bi", _spec_ttype(elem), len(val))
+        for v in val:
+            _encode_value(out, v, elem)
+    elif t == TType.MAP:
+        kspec, vspec = spec[1], spec[2]
+        out += struct.pack("!bbi", _spec_ttype(kspec), _spec_ttype(vspec),
+                           len(val))
+        for k, v in val.items():
+            _encode_value(out, k, kspec)
+            _encode_value(out, v, vspec)
+    else:
+        raise ThriftError(f"cannot encode ttype {t}")
+
+
+def encode_struct(value: Dict[str, Any], spec) -> bytes:
+    """value: {field_name: python_value}; None fields are omitted
+    (thrift optional semantics)."""
+    assert _spec_ttype(spec) == TType.STRUCT
+    fields = spec[1]
+    out = bytearray()
+    for fid, (name, fspec) in fields.items():
+        v = value.get(name)
+        if v is None:
+            continue
+        out += struct.pack("!bh", _spec_ttype(fspec), fid)
+        _encode_value(out, v, fspec)
+    out.append(TType.STOP)
+    return bytes(out)
+
+
+def encode_message(method: str, mtype: int, seqid: int, body: bytes) -> bytes:
+    """Strict-binary message header + already-encoded struct body."""
+    name = method.encode("utf-8")
+    return (struct.pack("!Ii", VERSION_1 | mtype, len(name)) + name +
+            struct.pack("!i", seqid) + body)
+
+
+# ---------------------------------------------------------------------------
+# decoding
+
+def _decode_value(blob: bytes, off: int, ttype: int,
+                  spec=None) -> Tuple[Any, int]:
+    if ttype == TType.BOOL:
+        return blob[off] != 0, off + 1
+    if ttype == TType.BYTE:
+        return struct.unpack_from("!b", blob, off)[0], off + 1
+    if ttype == TType.I16:
+        return struct.unpack_from("!h", blob, off)[0], off + 2
+    if ttype == TType.I32:
+        return struct.unpack_from("!i", blob, off)[0], off + 4
+    if ttype == TType.I64:
+        return struct.unpack_from("!q", blob, off)[0], off + 8
+    if ttype == TType.DOUBLE:
+        return struct.unpack_from("!d", blob, off)[0], off + 8
+    if ttype == TType.STRING:
+        (n,) = struct.unpack_from("!i", blob, off)
+        off += 4
+        raw = blob[off:off + n]
+        try:
+            return raw.decode("utf-8"), off + n
+        except UnicodeDecodeError:
+            return raw, off + n
+    if ttype == TType.STRUCT:
+        return decode_struct(blob, off, spec)
+    if ttype in (TType.LIST, TType.SET):
+        et, n = struct.unpack_from("!bi", blob, off)
+        off += 5
+        espec = spec[1] if spec is not None else None
+        items = []
+        for _ in range(n):
+            v, off = _decode_value(blob, off, et, espec)
+            items.append(v)
+        return items, off
+    if ttype == TType.MAP:
+        kt, vt, n = struct.unpack_from("!bbi", blob, off)
+        off += 6
+        kspec = spec[1] if spec is not None else None
+        vspec = spec[2] if spec is not None else None
+        d = {}
+        for _ in range(n):
+            k, off = _decode_value(blob, off, kt, kspec)
+            v, off = _decode_value(blob, off, vt, vspec)
+            d[k] = v
+        return d, off
+    raise ThriftError(f"cannot decode ttype {ttype}")
+
+
+def decode_struct(blob: bytes, off: int = 0,
+                  spec=None) -> Tuple[Dict[Any, Any], int]:
+    """Decode one struct.  With a spec, returns {field_name: value} and
+    skips unknown fields; without, returns {field_id: value} schemaless."""
+    fields = spec[1] if spec is not None else None
+    out: Dict[Any, Any] = {}
+    while True:
+        ft = blob[off]
+        off += 1
+        if ft == TType.STOP:
+            return out, off
+        (fid,) = struct.unpack_from("!h", blob, off)
+        off += 2
+        fspec = None
+        name = None
+        if fields is not None and fid in fields:
+            name, fspec = fields[fid]
+            if _spec_ttype(fspec) != ft:
+                name, fspec = None, None  # type mismatch: skip raw
+        v, off = _decode_value(blob, off, ft, fspec)
+        out[name if name is not None else fid] = v
+
+
+def decode_message(blob: bytes) -> Tuple[str, int, int, int]:
+    """Return (method, mtype, seqid, body_offset).  Strict binary only —
+    the native sniffer already guaranteed the 0x80 0x01 version bytes."""
+    (ver,) = struct.unpack_from("!I", blob, 0)
+    if ver & 0xFFFF0000 != VERSION_1:
+        raise ThriftError(f"bad thrift version 0x{ver:08x}")
+    mtype = ver & 0xFF
+    (nlen,) = struct.unpack_from("!i", blob, 4)
+    name = blob[8:8 + nlen].decode("utf-8")
+    (seqid,) = struct.unpack_from("!i", blob, 8 + nlen)
+    return name, mtype, seqid, 12 + nlen
+
+
+# ---------------------------------------------------------------------------
+# service (server side)
+
+class ThriftService:
+    """Dispatches framed-thrift calls on the shared port.
+
+    register("Echo", handler, args_spec=..., result_spec=...) — handler
+    receives the decoded args dict and returns the success value (encoded
+    as field 0 of the standard result struct).  Raising
+    TApplicationException (or anything else) produces an EXCEPTION reply.
+    Specs default to schemaless dicts keyed by field id / value packed
+    with a caller-provided spec.
+    """
+
+    def __init__(self):
+        self._methods: Dict[str, Tuple[Any, Any, Any]] = {}
+
+    def register(self, method: str, handler, args_spec=None,
+                 result_spec=None) -> None:
+        self._methods[method] = (handler, args_spec, result_spec)
+
+    def dispatch(self, frame: bytes) -> Optional[bytes]:
+        """One TBinaryProtocol message in → one out (None for oneway)."""
+        try:
+            method, mtype, seqid, off = decode_message(frame)
+        except Exception as e:
+            # can't even parse the header: synthesize a seqid-0 exception
+            exc = TApplicationException(
+                TApplicationException.INTERNAL_ERROR, f"bad message: {e}")
+            return encode_message("", MessageType.EXCEPTION, 0, exc.encode())
+        oneway = mtype == MessageType.ONEWAY
+        ent = self._methods.get(method)
+        if ent is None:
+            if oneway:
+                return None
+            exc = TApplicationException(
+                TApplicationException.UNKNOWN_METHOD,
+                f"unknown method {method!r}")
+            return encode_message(method, MessageType.EXCEPTION, seqid,
+                                  exc.encode())
+        handler, args_spec, result_spec = ent
+        try:
+            args, _ = decode_struct(frame, off, args_spec)
+            ret = handler(args)
+            if oneway:
+                return None
+            if result_spec is None:
+                body = b"\x00"  # void result: empty struct
+            else:
+                body = encode_struct(
+                    {"success": ret},
+                    (TType.STRUCT, {0: ("success", result_spec)}))
+            return encode_message(method, MessageType.REPLY, seqid, body)
+        except TApplicationException as exc:
+            if oneway:
+                return None
+            return encode_message(method, MessageType.EXCEPTION, seqid,
+                                  exc.encode())
+        except Exception as e:
+            if oneway:
+                return None
+            exc = TApplicationException(
+                TApplicationException.INTERNAL_ERROR, repr(e))
+            return encode_message(method, MessageType.EXCEPTION, seqid,
+                                  exc.encode())
+
+
+# ---------------------------------------------------------------------------
+# client
+
+class ThriftClient:
+    """Framed-transport strict-binary client (≙ a brpc Channel with
+    PROTOCOL_THRIFT, policy/thrift_protocol.cpp client half).  Thread-safe:
+    one in-flight call at a time per connection, guarded by a lock."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 5.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout_s)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    def call(self, method: str, args: Dict[str, Any], args_spec,
+             result_spec=None):
+        """Synchronous call; returns the success value (None for void).
+        Raises TApplicationException on an EXCEPTION reply."""
+        with self._lock:
+            self._seq += 1
+            seqid = self._seq
+            body = encode_struct(args, args_spec) if args_spec is not None \
+                else b"\x00"
+            msg = encode_message(method, MessageType.CALL, seqid, body)
+            self._send_frame(msg)
+            reply = self._recv_frame()
+        rmethod, mtype, rseq, off = decode_message(reply)
+        # EXCEPTION first: server-synthesized failures (unparseable header)
+        # carry seqid 0 and must surface as the real error, not a mismatch
+        if mtype == MessageType.EXCEPTION:
+            raise TApplicationException.decode(reply, off)
+        if rseq != seqid:
+            raise ThriftError(f"seqid mismatch: sent {seqid} got {rseq}")
+        if mtype != MessageType.REPLY:
+            raise ThriftError(f"unexpected message type {mtype}")
+        spec = (TType.STRUCT, {0: ("success", result_spec)}) \
+            if result_spec is not None else None
+        result, _ = decode_struct(reply, off, spec)
+        return result.get("success") if result_spec is not None else None
+
+    def call_oneway(self, method: str, args: Dict[str, Any],
+                    args_spec) -> None:
+        with self._lock:
+            self._seq += 1
+            body = encode_struct(args, args_spec) if args_spec is not None \
+                else b"\x00"
+            self._send_frame(
+                encode_message(method, MessageType.ONEWAY, self._seq, body))
+
+    def _send_frame(self, payload: bytes) -> None:
+        self._sock.sendall(struct.pack("!I", len(payload)) + payload)
+
+    def _recv_frame(self) -> bytes:
+        hdr = self._recv_exact(4)
+        (n,) = struct.unpack("!I", hdr)
+        return self._recv_exact(n)
+
+    def _recv_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ThriftError("connection closed mid-frame")
+            buf += chunk
+        return buf
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

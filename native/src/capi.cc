@@ -188,6 +188,16 @@ int trpc_redis_respond(uint64_t token, const uint8_t* data, size_t len) {
   return redis_respond(token, data, len);
 }
 
+// --- framed thrift on the shared port ---------------------------------------
+
+void trpc_server_set_thrift_handler(void* s, ThriftHandlerCb cb, void* user) {
+  server_set_thrift_handler((Server*)s, cb, user);
+}
+
+int trpc_thrift_respond(uint64_t token, const uint8_t* data, size_t len) {
+  return thrift_respond(token, data, len);
+}
+
 // --- auth ------------------------------------------------------------------
 
 void trpc_server_set_auth(void* s, const uint8_t* secret, size_t len) {

@@ -280,7 +280,8 @@ struct CallCtx {
   bool http_keep_alive = true;
   uint32_t h2_stream = 0;  // nonzero: respond as HTTP/2 frames
   bool is_redis = false;   // respond with raw RESP bytes
-  RedisHandlerCb rcb = nullptr;
+  bool is_thrift = false;  // respond with a framed TBinaryProtocol message
+  RedisHandlerCb rcb = nullptr;  // raw-blob callback (redis AND thrift)
   std::string http_path;
   std::string http_query;
   std::string http_headers;
@@ -376,7 +377,7 @@ class UsercodePool {
       lk.unlock();
       nm.usercode_queue_depth.fetch_sub(1, std::memory_order_relaxed);
       nm.usercode_running.fetch_add(1, std::memory_order_relaxed);
-      if (ctx->is_redis) {
+      if (ctx->is_redis || ctx->is_thrift) {
         ctx->rcb(ctx->token(), (const uint8_t*)ctx->payload.data(),
                  ctx->payload.size(), ctx->user);
       } else if (ctx->is_http) {
@@ -421,6 +422,8 @@ class Server {
   void* http_user = nullptr;
   RedisHandlerCb redis_cb = nullptr;
   void* redis_user = nullptr;
+  ThriftHandlerCb thrift_cb = nullptr;
+  void* thrift_user = nullptr;
   bool has_auth = false;
   std::string auth_secret;
   // TLS on the shared port: when set, connections whose first byte is a
@@ -629,6 +632,7 @@ void DispatchHttp(Socket* s, Server* srv, HttpRequest&& req) {
   ctx->sock = s->id();
   ctx->is_http = true;
   ctx->is_redis = false;
+  ctx->is_thrift = false;
   ctx->h2_stream = 0;
   ctx->http_keep_alive = req.keep_alive;
   ctx->method = std::move(req.method);
@@ -670,6 +674,7 @@ void DispatchH2(Socket* s, Server* srv, H2Request&& req) {
   ctx->sock = s->id();
   ctx->is_http = true;
   ctx->is_redis = false;
+  ctx->is_thrift = false;
   ctx->h2_stream = req.stream_id;
   ctx->http_keep_alive = true;  // h2 connections persist
   ctx->method = std::move(req.method);
@@ -865,6 +870,7 @@ void ServerOnMessages(Socket* s) {
         rctx->sock = s->id();
         rctx->is_http = false;
         rctx->is_redis = true;
+        rctx->is_thrift = false;
         rctx->h2_stream = 0;
         rctx->method = "REDIS";
         rctx->payload = PackRedisArgs(argv);
@@ -879,6 +885,84 @@ void ServerOnMessages(Socket* s) {
         rctx->rcb = srv->redis_cb;
         rctx->user = srv->redis_user;
         UsercodePool::Instance().Submit(rctx);
+        continue;
+      }
+      // Framed thrift TBinaryProtocol (≙ policy/thrift_protocol.cpp:763
+      // ParseThriftMessage): 4-byte BE frame length whose high byte is 0
+      // (frames < 16MB), then the strict-binary version bytes 0x80 0x01.
+      // No other shared-port protocol starts with a NUL byte, so 0x00 is
+      // ours to wait on once a thrift handler is registered.
+      if (srv->thrift_cb != nullptr && (uint8_t)magic[0] == 0x00) {
+        if (s->read_buf.size() < 6) {
+          break;  // not enough to see the version bytes yet
+        }
+        char head[6];
+        s->read_buf.copy_to(head, 6);
+        if ((uint8_t)head[4] != 0x80 || (uint8_t)head[5] != 0x01) {
+          flush();
+          s->SetFailed(TRPC_EREQUEST);
+          return;
+        }
+        if (srv->has_auth && !s->authed.load(std::memory_order_acquire)) {
+          // thrift has no in-band credential slot; a shared-port server
+          // with auth enabled refuses unauthenticated thrift connections
+          flush();
+          s->SetFailed(TRPC_EAUTH);
+          return;
+        }
+        uint32_t flen = ((uint32_t)(uint8_t)head[0] << 24) |
+                        ((uint32_t)(uint8_t)head[1] << 16) |
+                        ((uint32_t)(uint8_t)head[2] << 8) |
+                        (uint32_t)(uint8_t)head[3];
+        // the sniff's leading-NUL requirement already bounds flen below
+        // 16MB; only a too-short frame can still be invalid here
+        if (flen < 12) {
+          flush();
+          s->SetFailed(TRPC_EREQUEST);
+          return;
+        }
+        if (s->read_buf.size() < 4 + (size_t)flen) {
+          break;  // wait for the whole frame
+        }
+        ConnState* tcs = GetConnState(s);
+        {
+          std::lock_guard<std::mutex> lk(tcs->mu);
+          if (tcs->next_dispatch - tcs->next_release >= kMaxPipelined) {
+            tcs->parse_capped = true;
+            break;
+          }
+        }
+        s->read_buf.pop_front(4);
+        IOBuf frame;
+        s->read_buf.cutn(&frame, flen);
+        if (!srv->running.load(std::memory_order_acquire)) {
+          // no generic in-protocol error without the seqid; drop + close
+          flush();
+          s->SetFailed(TRPC_ESTOP);
+          return;
+        }
+        srv->nrequests.fetch_add(1, std::memory_order_relaxed);
+        CallCtx* tctx = nullptr;
+        uint32_t tslot = ResourcePool<CallCtx>::Get(&tctx);
+        tctx->slot = tslot;
+        tctx->sock = s->id();
+        tctx->is_http = false;
+        tctx->is_redis = false;
+        tctx->is_thrift = true;
+        tctx->h2_stream = 0;
+        tctx->method = "THRIFT";
+        tctx->payload = frame.to_string();
+        tctx->attachment.clear();
+        tctx->req_stream_id = 0;
+        tctx->req_stream_window = 0;
+        tctx->accepted_stream = 0;
+        {
+          std::lock_guard<std::mutex> lk(tcs->mu);
+          tctx->pipe_seq = tcs->next_dispatch++;
+        }
+        tctx->rcb = srv->thrift_cb;
+        tctx->user = srv->thrift_user;
+        UsercodePool::Instance().Submit(tctx);
         continue;
       }
       if (!LooksLikeHttp(s->read_buf)) {
@@ -1056,6 +1140,7 @@ void ServerOnMessages(Socket* s) {
       ctx->sock = s->id();
       ctx->is_http = false;
       ctx->is_redis = false;
+      ctx->is_thrift = false;
       ctx->compress_type = meta.compress_type;
       ctx->req_stream_id = meta.stream_id;
       ctx->req_stream_window = meta.feedback_bytes;
@@ -1174,6 +1259,39 @@ int redis_respond(uint64_t token, const uint8_t* data, size_t len) {
   ctx->version.fetch_add(1, std::memory_order_release);
   ctx->payload.clear();
   ctx->is_redis = false;
+  ResourcePool<CallCtx>::Return(slot);
+  return 0;
+}
+
+void server_set_thrift_handler(Server* s, ThriftHandlerCb cb, void* user) {
+  s->thrift_cb = cb;
+  s->thrift_user = user;
+}
+
+int thrift_respond(uint64_t token, const uint8_t* data, size_t len) {
+  uint32_t slot = (uint32_t)token;
+  uint32_t ver = (uint32_t)(token >> 32);
+  CallCtx* ctx = ResourcePool<CallCtx>::Address(slot);
+  if (ctx == nullptr || !ctx->is_thrift ||
+      ctx->version.load(std::memory_order_acquire) != ver) {
+    return -EINVAL;
+  }
+  Socket* s = Socket::Address(ctx->sock);
+  if (s != nullptr) {
+    IOBuf reply;
+    if (len > 0) {
+      uint8_t hdr[4] = {(uint8_t)(len >> 24), (uint8_t)(len >> 16),
+                        (uint8_t)(len >> 8), (uint8_t)len};
+      reply.append(hdr, 4);
+      reply.append(data, len);
+    }
+    // len == 0: a oneway call — release the sequencer slot, write nothing
+    ReleaseSequenced(s, ctx->pipe_seq, std::move(reply), false);
+    s->Dereference();
+  }
+  ctx->version.fetch_add(1, std::memory_order_release);
+  ctx->payload.clear();
+  ctx->is_thrift = false;
   ResourcePool<CallCtx>::Return(slot);
   return 0;
 }

@@ -107,6 +107,16 @@ typedef void (*RedisHandlerCb)(uint64_t token, const uint8_t* blob,
 void server_set_redis_handler(Server* s, RedisHandlerCb cb, void* user);
 // Write raw (already RESP-encoded) reply bytes for a pending command.
 int redis_respond(uint64_t token, const uint8_t* data, size_t len);
+
+// Framed-thrift message handler (≙ policy/thrift_protocol.cpp:763): blob is
+// ONE complete TBinaryProtocol message (frame header already stripped).
+// Responder must call thrift_respond(token, ...) with an encoded message;
+// the 4-byte frame length is prepended natively.  A shared-port server
+// with auth enabled refuses thrift connections (no in-band credential).
+typedef void (*ThriftHandlerCb)(uint64_t token, const uint8_t* blob,
+                                size_t len, void* user);
+void server_set_thrift_handler(Server* s, ThriftHandlerCb cb, void* user);
+int thrift_respond(uint64_t token, const uint8_t* data, size_t len);
 // Require this credential (meta tag 13) on every TRPC request.
 void server_set_auth(Server* s, const uint8_t* secret, size_t len);
 // TLS on the shared port (PEM cert chain + key; optional client-cert

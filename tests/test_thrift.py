@@ -1,0 +1,212 @@
+"""Framed thrift TBinaryProtocol on the shared port (≙
+brpc_thrift_unittest + policy/thrift_protocol.cpp:763).  Wire-format
+conformance is pinned with hand-computed strict-binary byte vectors (no
+Apache Thrift lib in the image), then exercised end-to-end over real
+loopback sockets against the native sniffer."""
+
+import struct
+import threading
+
+import pytest
+
+from brpc_tpu.rpc import thrift as t
+from brpc_tpu.rpc.server import Server
+
+
+# ---------------------------------------------------------------------------
+# codec conformance: strict TBinaryProtocol byte vectors
+
+
+class TestWireFormat:
+    def test_message_header_vector(self):
+        # strict CALL "add" seq 7: 80 01 00 01 | len=3 "add" | seq
+        msg = t.encode_message("add", t.MessageType.CALL, 7, b"\x00")
+        assert msg == bytes.fromhex("80010001") + \
+            struct.pack("!i", 3) + b"add" + struct.pack("!i", 7) + b"\x00"
+        method, mtype, seqid, off = t.decode_message(msg)
+        assert (method, mtype, seqid) == ("add", t.MessageType.CALL, 7)
+        assert msg[off:] == b"\x00"
+
+    def test_struct_vector_scalars(self):
+        # field 1: i32 = 258 -> type 08, id 0001, value 00000102; STOP 00
+        spec = (t.TType.STRUCT, {1: ("a", t.TType.I32)})
+        blob = t.encode_struct({"a": 258}, spec)
+        assert blob == bytes.fromhex("08" "0001" "00000102" "00")
+        out, off = t.decode_struct(blob, 0, spec)
+        assert out == {"a": 258} and off == len(blob)
+
+    def test_struct_vector_string(self):
+        # field 2: string "hi" -> type 0b, id 0002, len 2, bytes
+        spec = (t.TType.STRUCT, {2: ("s", t.TType.STRING)})
+        blob = t.encode_struct({"s": "hi"}, spec)
+        assert blob == bytes.fromhex("0b" "0002" "00000002") + b"hi\x00"
+
+    def test_all_scalar_types_round_trip(self):
+        spec = (t.TType.STRUCT, {
+            1: ("b", t.TType.BOOL), 2: ("y", t.TType.BYTE),
+            3: ("h", t.TType.I16), 4: ("i", t.TType.I32),
+            5: ("l", t.TType.I64), 6: ("d", t.TType.DOUBLE),
+            7: ("s", t.TType.STRING)})
+        v = {"b": True, "y": -7, "h": -300, "i": 1 << 30,
+             "l": -(1 << 60), "d": 2.5, "s": "héllo"}
+        out, _ = t.decode_struct(t.encode_struct(v, spec), 0, spec)
+        assert out == v
+
+    def test_containers_round_trip(self):
+        spec = (t.TType.STRUCT, {
+            1: ("xs", (t.TType.LIST, t.TType.I32)),
+            2: ("m", (t.TType.MAP, t.TType.STRING, t.TType.I64)),
+            3: ("st", (t.TType.SET, t.TType.STRING)),
+            4: ("nested", (t.TType.LIST, (t.TType.MAP, t.TType.I32,
+                                          t.TType.STRING)))})
+        v = {"xs": [1, 2, 3], "m": {"a": 1, "b": 2}, "st": ["x", "y"],
+             "nested": [{1: "one"}, {2: "two"}]}
+        out, _ = t.decode_struct(t.encode_struct(v, spec), 0, spec)
+        assert out == v
+
+    def test_nested_struct(self):
+        inner = (t.TType.STRUCT, {1: ("x", t.TType.I32)})
+        spec = (t.TType.STRUCT, {1: ("in_", inner),
+                                 2: ("tag", t.TType.STRING)})
+        v = {"in_": {"x": 42}, "tag": "ok"}
+        out, _ = t.decode_struct(t.encode_struct(v, spec), 0, spec)
+        assert out == v
+
+    def test_unknown_field_skipped(self):
+        # encode with a field the reader doesn't know: reader skips it
+        wire_spec = (t.TType.STRUCT, {1: ("a", t.TType.I32),
+                                      9: ("zz", (t.TType.LIST,
+                                                 t.TType.STRING))})
+        read_spec = (t.TType.STRUCT, {1: ("a", t.TType.I32)})
+        blob = t.encode_struct({"a": 5, "zz": ["junk", "more"]}, wire_spec)
+        out, _ = t.decode_struct(blob, 0, read_spec)
+        assert out["a"] == 5
+        assert 9 in out  # unknown field decoded schemaless by id
+
+    def test_none_fields_omitted(self):
+        spec = (t.TType.STRUCT, {1: ("a", t.TType.I32),
+                                 2: ("b", t.TType.STRING)})
+        blob = t.encode_struct({"a": None, "b": "x"}, spec)
+        out, _ = t.decode_struct(blob, 0, spec)
+        assert out == {"b": "x"}
+
+
+# ---------------------------------------------------------------------------
+# end-to-end on the shared port
+
+ADD_ARGS = (t.TType.STRUCT, {1: ("a", t.TType.I32), 2: ("b", t.TType.I32)})
+ECHO_ARGS = (t.TType.STRUCT, {1: ("items", (t.TType.LIST, t.TType.STRING))})
+NOTE_ARGS = (t.TType.STRUCT, {1: ("note", t.TType.STRING)})
+
+
+@pytest.fixture
+def thrift_server():
+    svc = t.ThriftService()
+    svc.register("add", lambda a: a["a"] + a["b"],
+                 args_spec=ADD_ARGS, result_spec=t.TType.I64)
+    svc.register("echo_list", lambda a: a["items"],
+                 args_spec=ECHO_ARGS,
+                 result_spec=(t.TType.LIST, t.TType.STRING))
+
+    def fail(_args):
+        raise t.TApplicationException(
+            t.TApplicationException.INTERNAL_ERROR, "deliberate")
+    svc.register("fail", fail, args_spec=None, result_spec=t.TType.I32)
+
+    notes = []
+    done = threading.Event()
+
+    def note(a):
+        notes.append(a["note"])
+        done.set()
+    svc.register("note", note, args_spec=NOTE_ARGS)
+
+    srv = Server()
+    srv.add_echo_service()
+    srv.add_thrift_service(svc)
+    srv.start("127.0.0.1:0")
+    yield srv, notes, done
+    srv.destroy()
+
+
+class TestThriftEndToEnd:
+    def test_call_result(self, thrift_server):
+        srv, _, _ = thrift_server
+        c = t.ThriftClient("127.0.0.1", srv.port)
+        assert c.call("add", {"a": 3, "b": 4}, ADD_ARGS,
+                      result_spec=t.TType.I64) == 7
+        assert c.call("add", {"a": -1, "b": 1}, ADD_ARGS,
+                      result_spec=t.TType.I64) == 0
+        c.close()
+
+    def test_containers_over_wire(self, thrift_server):
+        srv, _, _ = thrift_server
+        c = t.ThriftClient("127.0.0.1", srv.port)
+        items = [f"item-{i}" for i in range(50)]
+        assert c.call("echo_list", {"items": items}, ECHO_ARGS,
+                      result_spec=(t.TType.LIST, t.TType.STRING)) == items
+        c.close()
+
+    def test_unknown_method_raises(self, thrift_server):
+        srv, _, _ = thrift_server
+        c = t.ThriftClient("127.0.0.1", srv.port)
+        with pytest.raises(t.TApplicationException) as ei:
+            c.call("nope", {}, None, result_spec=t.TType.I32)
+        assert ei.value.kind == t.TApplicationException.UNKNOWN_METHOD
+        c.close()
+
+    def test_handler_exception_propagates(self, thrift_server):
+        srv, _, _ = thrift_server
+        c = t.ThriftClient("127.0.0.1", srv.port)
+        with pytest.raises(t.TApplicationException) as ei:
+            c.call("fail", {}, None, result_spec=t.TType.I32)
+        assert ei.value.kind == t.TApplicationException.INTERNAL_ERROR
+        assert "deliberate" in ei.value.message
+        # the connection survives an exception reply
+        assert c.call("add", {"a": 1, "b": 1}, ADD_ARGS,
+                      result_spec=t.TType.I64) == 2
+        c.close()
+
+    def test_oneway_then_call(self, thrift_server):
+        srv, notes, done = thrift_server
+        c = t.ThriftClient("127.0.0.1", srv.port)
+        c.call_oneway("note", {"note": "fire-and-forget"}, NOTE_ARGS)
+        # a regular call on the same connection must not stall behind the
+        # oneway's (empty) pipeline slot
+        assert c.call("add", {"a": 2, "b": 2}, ADD_ARGS,
+                      result_spec=t.TType.I64) == 4
+        assert done.wait(5)
+        assert notes == ["fire-and-forget"]
+        c.close()
+
+    def test_shared_port_with_trpc(self, thrift_server):
+        # TRPC and thrift interleave on one port (the sniffer keys on the
+        # leading NUL of the 4-byte frame length)
+        from brpc_tpu.rpc.channel import Channel
+        srv, _, _ = thrift_server
+        ch = Channel(f"127.0.0.1:{srv.port}")
+        assert ch.call("Echo", b"ping") == b"ping"
+        c = t.ThriftClient("127.0.0.1", srv.port)
+        assert c.call("add", {"a": 10, "b": 20}, ADD_ARGS,
+                      result_spec=t.TType.I64) == 30
+        ch.close()
+        c.close()
+
+    def test_many_sequential_calls(self, thrift_server):
+        srv, _, _ = thrift_server
+        c = t.ThriftClient("127.0.0.1", srv.port)
+        for i in range(200):
+            assert c.call("add", {"a": i, "b": i}, ADD_ARGS,
+                          result_spec=t.TType.I64) == 2 * i
+        c.close()
+
+    def test_garbage_after_nul_rejected(self, thrift_server):
+        # a NUL-led frame without the 0x80 0x01 version bytes must fail
+        # the connection, not hang it
+        import socket as pysock
+        srv, _, _ = thrift_server
+        s = pysock.create_connection(("127.0.0.1", srv.port), timeout=5)
+        s.sendall(b"\x00\x00\x00\x10" + b"garbage!" * 2)
+        s.settimeout(5)
+        assert s.recv(64) == b""  # server closed on us
+        s.close()
