@@ -151,6 +151,21 @@ def _declare(L: ctypes.CDLL) -> None:
     # introspection
     L.trpc_server_conn_stats.argtypes = [c.c_void_p, c.c_char_p, c.c_size_t]
     L.trpc_server_conn_stats.restype = c.c_size_t
+    L.trpc_socket_dump.argtypes = [c.c_char_p, c.c_size_t]
+    L.trpc_socket_dump.restype = c.c_size_t
+    L.trpc_ids_dump.argtypes = [c.c_char_p, c.c_size_t]
+    L.trpc_ids_dump.restype = c.c_size_t
+
+    # snappy codec
+    L.trpc_snappy_max_compressed_length.argtypes = [c.c_size_t]
+    L.trpc_snappy_max_compressed_length.restype = c.c_size_t
+    L.trpc_snappy_compress.argtypes = [c.c_char_p, c.c_size_t, c.c_char_p]
+    L.trpc_snappy_compress.restype = c.c_size_t
+    L.trpc_snappy_uncompressed_length.argtypes = [c.c_char_p, c.c_size_t]
+    L.trpc_snappy_uncompressed_length.restype = c.c_size_t
+    L.trpc_snappy_decompress.argtypes = [c.c_char_p, c.c_size_t, c.c_char_p,
+                                         c.c_size_t]
+    L.trpc_snappy_decompress.restype = c.c_size_t
 
     L.trpc_set_usercode_workers.argtypes = [c.c_int]
     L.trpc_set_usercode_workers.restype = None

@@ -16,6 +16,7 @@ import os
 import sys
 import threading
 import time
+import traceback
 
 from brpc_tpu._native import lib
 from brpc_tpu.metrics import bvar
@@ -250,6 +251,48 @@ def install_builtin_services(server, dispatcher: HttpDispatcher) -> None:
         header = "sockid fd peer bytes_in bytes_out\n"
         return HttpResponse.text(header + buf.raw[:n].decode())
 
+    def _sockets(req: HttpRequest) -> HttpResponse:
+        """Every live socket in the process — servers AND clients (≙
+        builtin/sockets_service.cpp over the whole SocketId space)."""
+        buf = ctypes.create_string_buffer(1 << 20)
+        n = lib().trpc_socket_dump(buf, len(buf))
+        return HttpResponse.text(buf.raw[:n].decode())
+
+    def _ids(req: HttpRequest) -> HttpResponse:
+        """In-flight client correlation ids (≙ builtin/ids_service.cpp
+        dumping live bthread_ids)."""
+        buf = ctypes.create_string_buffer(1 << 20)
+        n = lib().trpc_ids_dump(buf, len(buf))
+        return HttpResponse.text(buf.raw[:n].decode())
+
+    def _threads(req: HttpRequest) -> HttpResponse:
+        """One stack per Python thread plus the native thread census from
+        /proc/self/task (≙ builtin/threads_service.cpp attaching pstack;
+        native frames come from /pprof or /hotspots?native=1)."""
+        py_frames = sys._current_frames()
+        by_ident = {t.ident: t for t in threading.enumerate()}
+        out = []
+        for tid, frame in py_frames.items():
+            t = by_ident.get(tid)
+            name = t.name if t else "?"
+            daemon = " daemon" if t is not None and t.daemon else ""
+            out.append(f"--- thread {tid} [{name}]{daemon}")
+            for entry in traceback.format_stack(frame):
+                out.extend(f"  {ln}" for ln in entry.rstrip().split("\n"))
+        native = []
+        try:
+            for task in sorted(os.listdir("/proc/self/task"), key=int):
+                try:
+                    with open(f"/proc/self/task/{task}/comm") as f:
+                        native.append(f"{task} {f.read().strip()}")
+                except OSError:
+                    continue
+        except OSError:
+            pass
+        out.append(f"--- {len(native)} OS threads (tid name)")
+        out.extend(f"  {ln}" for ln in native)
+        return HttpResponse.text("\n".join(out) + "\n")
+
     def _rpcz(req: HttpRequest) -> HttpResponse:
         from brpc_tpu.rpc import span as _span
         params = req.query_params()
@@ -266,4 +309,7 @@ def install_builtin_services(server, dispatcher: HttpDispatcher) -> None:
 
     d.register("/status", _status)
     d.register("/connections", _connections)
+    d.register("/sockets", _sockets)
+    d.register("/ids", _ids)
+    d.register("/threads", _threads)
     d.register("/rpcz", _rpcz)

@@ -1,0 +1,155 @@
+"""Process-level default variables (≙ bvar/default_variables.cpp:878 —
+rusage, fd count, memory, threads, io — the block every brpc process
+exposes on /vars without registering anything).
+
+install_default_variables() is idempotent and called by Server.start();
+importing applications can also call it directly.  Every variable is a
+PassiveStatus reading /proc/self (this is Linux; TPU hosts are Linux) or
+the `resource` module, so values are live at dump time with zero
+background cost.
+"""
+
+from __future__ import annotations
+
+import os
+import resource
+import threading
+import time
+from typing import Optional
+
+from brpc_tpu.metrics.bvar import PassiveStatus
+
+_installed_lock = threading.Lock()
+_installed = False
+_START_TIME = time.time()
+
+_CLK_TCK = os.sysconf("SC_CLK_TCK")
+_PAGE = os.sysconf("SC_PAGE_SIZE")
+
+
+def _proc_stat_fields():
+    # /proc/self/stat: field 2 is "(comm)" which may contain spaces —
+    # split after the closing paren
+    with open("/proc/self/stat") as f:
+        raw = f.read()
+    return raw[raw.rindex(")") + 2:].split()
+
+
+def _cpu_seconds() -> float:
+    ru_self = resource.getrusage(resource.RUSAGE_SELF)
+    return ru_self.ru_utime + ru_self.ru_stime
+
+
+def _cpu_user_seconds() -> float:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_utime
+
+
+def _cpu_system_seconds() -> float:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_stime
+
+
+def _memory_resident() -> int:
+    # statm field 1 = resident pages
+    with open("/proc/self/statm") as f:
+        return int(f.read().split()[1]) * _PAGE
+
+
+def _memory_virtual() -> int:
+    with open("/proc/self/statm") as f:
+        return int(f.read().split()[0]) * _PAGE
+
+
+def _fd_count() -> int:
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except OSError:
+        return -1
+
+
+def _thread_count() -> int:
+    # field 17 (0-based from after comm) of /proc/self/stat = num_threads
+    return int(_proc_stat_fields()[17])
+
+
+def _io_counter(tag: str) -> int:
+    try:
+        with open("/proc/self/io") as f:
+            for line in f:
+                if line.startswith(tag + ":"):
+                    return int(line.split(":")[1])
+    except OSError:
+        pass
+    return -1
+
+
+def _loadavg_1m() -> float:
+    return os.getloadavg()[0]
+
+
+def _faults_major() -> int:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_majflt
+
+
+def _ctx_switches_voluntary() -> int:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_nvcsw
+
+
+def _ctx_switches_involuntary() -> int:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_nivcsw
+
+
+class _CpuUsage:
+    """process_cpu_usage: cores consumed over the last sampling gap
+    (≙ default_variables.cpp deriving usage from rusage deltas)."""
+
+    def __init__(self):
+        self._last_t = time.monotonic()
+        self._last_cpu = _cpu_seconds()
+        self._value = 0.0
+
+    def __call__(self) -> float:
+        now = time.monotonic()
+        cpu = _cpu_seconds()
+        dt = now - self._last_t
+        if dt >= 0.5:  # keep readings stable under rapid dumps
+            self._value = max(0.0, (cpu - self._last_cpu) / dt)
+            self._last_t = now
+            self._last_cpu = cpu
+        return round(self._value, 4)
+
+
+def install_default_variables() -> None:
+    """Expose the process block.  Idempotent; name collisions with an
+    earlier install are impossible by construction."""
+    global _installed
+    with _installed_lock:
+        if _installed:
+            return
+        _install_locked()
+        # only after every variable registered: a concurrent caller must
+        # not observe a half-installed block, and a failure must retry
+        _installed = True
+
+
+def _install_locked() -> None:
+    PassiveStatus(lambda: round(time.time() - _START_TIME, 1),
+                  "process_uptime_s")
+    PassiveStatus(lambda: os.getpid(), "process_pid")
+    PassiveStatus(_CpuUsage(), "process_cpu_usage")
+    PassiveStatus(lambda: round(_cpu_user_seconds(), 3),
+                  "process_cpu_usage_user_s")
+    PassiveStatus(lambda: round(_cpu_system_seconds(), 3),
+                  "process_cpu_usage_system_s")
+    PassiveStatus(_memory_resident, "process_memory_resident_bytes")
+    PassiveStatus(_memory_virtual, "process_memory_virtual_bytes")
+    PassiveStatus(_fd_count, "process_fd_count")
+    PassiveStatus(_thread_count, "process_thread_count")
+    PassiveStatus(lambda: _io_counter("read_bytes"),
+                  "process_io_read_bytes")
+    PassiveStatus(lambda: _io_counter("write_bytes"),
+                  "process_io_write_bytes")
+    PassiveStatus(_faults_major, "process_faults_major")
+    PassiveStatus(_ctx_switches_voluntary, "process_ctx_switches_voluntary")
+    PassiveStatus(_ctx_switches_involuntary,
+                  "process_ctx_switches_involuntary")
+    PassiveStatus(_loadavg_1m, "system_loadavg_1m")

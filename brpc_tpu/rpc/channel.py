@@ -34,8 +34,8 @@ class ChannelOptions:
     # cluster mode (set via Channel(naming_url, load_balancer=...))
     load_balancer: str = ""
     retry_policy: Optional["RetryPolicy"] = None
-    # request payload compression: 0 none, 1 gzip, 2 zlib (rpc/compress.py;
-    # ≙ ChannelOptions request_compress_type)
+    # request payload compression: 0 none, 1 gzip, 2 zlib, 3 snappy
+    # (rpc/compress.py; ≙ ChannelOptions request_compress_type)
     request_compress_type: int = 0
     # credential sent in every request meta (≙ ChannelOptions.auth +
     # Authenticator::GenerateCredential); verified natively by the server

@@ -5,7 +5,7 @@ The native core carries the compress_type meta tag (rpc.h tag 6) untouched;
 codecs run here, on the usercode side of the boundary — requests are
 compressed before entering the native write path, responses after leaving
 it.  Type ids are part of the wire contract:
-    0 = none    1 = gzip    2 = zlib (deflate)
+    0 = none    1 = gzip    2 = zlib (deflate)    3 = snappy
 New codecs register with :func:`register` (≙ RegisterCompressHandler).
 """
 
@@ -20,6 +20,7 @@ from brpc_tpu.utils import flags
 COMPRESS_NONE = 0
 COMPRESS_GZIP = 1
 COMPRESS_ZLIB = 2
+COMPRESS_SNAPPY = 3
 
 # ≙ FLAGS_max_body_size bounding what a peer can make us materialize —
 # applied to DECOMPRESSED size so a small zip bomb cannot OOM the process
@@ -89,3 +90,33 @@ register(COMPRESS_GZIP, "gzip",
          lambda b: _bounded_inflate(b, 16 + _zlib.MAX_WBITS))
 register(COMPRESS_ZLIB, "zlib", _zlib.compress,
          lambda b: _bounded_inflate(b, _zlib.MAX_WBITS))
+
+
+def _snappy_compress(data: bytes) -> bytes:
+    """Native snappy block format (native/src/snappy.cc ≙ the snappy
+    codec policy/snappy_compress.cpp wires in)."""
+    import ctypes
+    from brpc_tpu._native import lib
+    L = lib()
+    out = ctypes.create_string_buffer(
+        int(L.trpc_snappy_max_compressed_length(len(data))))
+    n = L.trpc_snappy_compress(data, len(data), out)
+    return out.raw[:n]
+
+
+def _snappy_decompress(data: bytes) -> bytes:
+    import ctypes
+    from brpc_tpu._native import lib
+    L = lib()
+    expect = int(L.trpc_snappy_uncompressed_length(data, len(data)))
+    limit = int(flags.get_flag("max_decompressed_size"))
+    if expect == (1 << 64) - 1 or expect > limit:
+        raise ValueError("corrupt snappy stream or size over limit")
+    out = ctypes.create_string_buffer(max(expect, 1))
+    n = int(L.trpc_snappy_decompress(data, len(data), out, expect))
+    if n != expect:
+        raise ValueError("corrupt snappy stream")
+    return out.raw[:n]
+
+
+register(COMPRESS_SNAPPY, "snappy", _snappy_compress, _snappy_decompress)

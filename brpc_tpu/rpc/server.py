@@ -375,6 +375,10 @@ class Server:
         if self.options.enable_builtin_services:
             from brpc_tpu.builtin import install_builtin_services
             install_builtin_services(self, self.http)
+        # the process block every server exposes on /vars (rusage, fds,
+        # memory, threads ≙ bvar/default_variables.cpp:878)
+        from brpc_tpu.metrics.default_vars import install_default_variables
+        install_default_variables()
         # native core internals become live bvars (write-queue depth,
         # PendingCall occupancy, sequencer backlog, usercode queue, ...)
         from brpc_tpu.metrics.native import install_native_metrics

@@ -13,6 +13,7 @@
 #include "metrics.h"
 #include "profiler.h"
 #include "rpc.h"
+#include "snappy.h"
 #include "socket.h"
 #include "stream.h"
 #include "tls.h"
@@ -229,6 +230,34 @@ void trpc_channel_set_auth(void* c, const uint8_t* secret, size_t len) {
 
 size_t trpc_server_conn_stats(void* s, char* buf, size_t cap) {
   return server_conn_stats((Server*)s, buf, cap);
+}
+
+size_t trpc_socket_dump(char* buf, size_t cap) {
+  return socket_dump_all(buf, cap);
+}
+
+size_t trpc_ids_dump(char* buf, size_t cap) {
+  return pending_call_dump(buf, cap);
+}
+
+// --- snappy codec -----------------------------------------------------------
+
+size_t trpc_snappy_max_compressed_length(size_t n) {
+  return snappy_max_compressed_length(n);
+}
+
+size_t trpc_snappy_compress(const uint8_t* in, size_t n, uint8_t* out) {
+  return snappy_compress(in, n, out);
+}
+
+size_t trpc_snappy_uncompressed_length(const uint8_t* in, size_t n) {
+  size_t hdr;
+  return snappy_uncompressed_length(in, n, &hdr);
+}
+
+size_t trpc_snappy_decompress(const uint8_t* in, size_t n, uint8_t* out,
+                              size_t out_cap) {
+  return snappy_decompress(in, n, out, out_cap);
 }
 
 // --- channel ---------------------------------------------------------------

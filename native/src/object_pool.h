@@ -135,6 +135,18 @@ class ResourcePool {
                                         : nullptr;
   }
 
+  // One past the highest slot that can have been handed out — for
+  // diagnostic enumeration (/sockets, /ids).  Slabs are allocated in
+  // order, so the first null entry bounds the scan.
+  static uint32_t CapacityUpperBound() {
+    uint32_t i = 0;
+    while (i < kMaxSlabs &&
+           slabs()[i].load(std::memory_order_acquire) != nullptr) {
+      ++i;
+    }
+    return i << kSlabBits;
+  }
+
  private:
   static constexpr size_t kTransferChunk = 32;
   static constexpr size_t kTlsMax = 96;

@@ -1121,6 +1121,9 @@ void ServerOnMessages(Socket* s) {
       RpcMeta rmeta;
       rmeta.correlation_id = meta.correlation_id;
       rmeta.flags = 1;  // response
+      // the echoed payload is byte-identical, so a compressed request
+      // produces an equally-compressed response: carry the type through
+      rmeta.compress_type = meta.compress_type;
       if (s->advertise_device_caps.load(std::memory_order_acquire)) {
         rmeta.device_caps = ServerDeviceCaps();
       }
@@ -2595,6 +2598,41 @@ int channel_call(Channel* c, const char* method, const uint8_t* req,
   }
   s->Dereference();
   return result;
+}
+
+// /ids: every non-free client-correlation slot (≙ builtin
+// ids_service.cpp dumping live bthread_ids).  Diagnostic racy read: a
+// slot is printed with whatever version/state it holds at the moment.
+size_t pending_call_dump(char* buf, size_t cap) {
+  size_t off = 0;
+  uint32_t bound = ResourcePool<PendingCall>::CapacityUpperBound();
+  static const char* kState[] = {"FREE", "ARMED", "DELIVERING"};
+  for (uint32_t slot = 0; slot < bound; ++slot) {
+    PendingCall* pc = ResourcePool<PendingCall>::Address(slot);
+    if (pc == nullptr) {
+      break;
+    }
+    uint64_t vs = pc->vs.load(std::memory_order_acquire);
+    uint32_t st = (uint32_t)vs;
+    if (st == PC_FREE) {
+      continue;
+    }
+    uint32_t ver = (uint32_t)(vs >> 32);
+    int n = snprintf(
+        buf + off, off < cap ? cap - off : 0,
+        "%llu slot=%u ver=%u state=%s sock=%llu\n",
+        (unsigned long long)(((uint64_t)ver << 32) | slot), slot, ver,
+        st < 3 ? kState[st] : "?",
+        (unsigned long long)pc->sock_id.load(std::memory_order_relaxed));
+    if (n < 0) {
+      break;
+    }
+    off += (size_t)n;
+    if (off >= cap) {
+      return cap;
+    }
+  }
+  return off;
 }
 
 // ---------------------------------------------------------------------------

@@ -136,6 +136,8 @@ uint64_t server_requests(Server* s);
 // Write "sockid fd peer bytes_in bytes_out\n" lines for live connections
 // into buf (≙ the /connections builtin); returns bytes written.
 size_t server_conn_stats(Server* s, char* buf, size_t cap);
+// /ids: live client-correlation slots (≙ builtin ids_service.cpp).
+size_t pending_call_dump(char* buf, size_t cap);
 
 // Respond to a pending call token (thread-safe, any thread).
 int respond(uint64_t token, int32_t error_code, const char* error_text,

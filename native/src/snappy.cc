@@ -1,0 +1,236 @@
+// snappy.cc — see snappy.h.  Compressor: greedy hash-chain-free matcher
+// over 64KB blocks (the classic snappy strategy: one 4-byte hash probe
+// per position, no chains — speed over ratio).
+#include "snappy.h"
+
+#include <cstring>
+
+namespace trpc {
+
+namespace {
+
+constexpr size_t kBlockSize = 1 << 16;  // offsets inside a block fit 16 bits
+constexpr int kHashBits = 14;
+constexpr size_t kHashTableSize = 1 << kHashBits;
+constexpr size_t kMinMatch = 4;
+
+inline uint32_t Load32(const uint8_t* p) {
+  uint32_t v;
+  memcpy(&v, p, 4);
+  return v;
+}
+
+inline uint32_t Hash(uint32_t v) {
+  return (v * 0x1e35a7bdu) >> (32 - kHashBits);
+}
+
+uint8_t* EmitLiteral(uint8_t* out, const uint8_t* lit, size_t len) {
+  size_t n = len - 1;
+  if (n < 60) {
+    *out++ = (uint8_t)(n << 2);
+  } else if (n < (1u << 8)) {
+    *out++ = 60 << 2;
+    *out++ = (uint8_t)n;
+  } else if (n < (1u << 16)) {
+    *out++ = 61 << 2;
+    *out++ = (uint8_t)n;
+    *out++ = (uint8_t)(n >> 8);
+  } else if (n < (1u << 24)) {
+    *out++ = 62 << 2;
+    *out++ = (uint8_t)n;
+    *out++ = (uint8_t)(n >> 8);
+    *out++ = (uint8_t)(n >> 16);
+  } else {
+    *out++ = 63 << 2;
+    *out++ = (uint8_t)n;
+    *out++ = (uint8_t)(n >> 8);
+    *out++ = (uint8_t)(n >> 16);
+    *out++ = (uint8_t)(n >> 24);
+  }
+  memcpy(out, lit, len);
+  return out + len;
+}
+
+// One copy element, length <= 64, offset < 64KB (block-local matches).
+uint8_t* EmitCopyUpTo64(uint8_t* out, size_t offset, size_t len) {
+  if (len < 12 && offset < 2048) {
+    // 01: len 4..11, 11-bit offset
+    *out++ = (uint8_t)(1 | ((len - 4) << 2) | ((offset >> 8) << 5));
+    *out++ = (uint8_t)offset;
+  } else {
+    // 10: len 1..64, 16-bit offset
+    *out++ = (uint8_t)(2 | ((len - 1) << 2));
+    *out++ = (uint8_t)offset;
+    *out++ = (uint8_t)(offset >> 8);
+  }
+  return out;
+}
+
+uint8_t* EmitCopy(uint8_t* out, size_t offset, size_t len) {
+  // long matches split into <=64-byte elements; keep the tail >= 4 so the
+  // final element is always encodable as a copy
+  while (len >= 68) {
+    out = EmitCopyUpTo64(out, offset, 64);
+    len -= 64;
+  }
+  if (len > 64) {
+    out = EmitCopyUpTo64(out, offset, 60);
+    len -= 60;
+  }
+  return EmitCopyUpTo64(out, offset, len);
+}
+
+}  // namespace
+
+size_t snappy_max_compressed_length(size_t n) {
+  // spec: 32 + n + n/6
+  return 32 + n + n / 6;
+}
+
+size_t snappy_compress(const uint8_t* in, size_t n, uint8_t* out) {
+  uint8_t* op = out;
+  // preamble varint
+  size_t len = n;
+  while (len >= 0x80) {
+    *op++ = (uint8_t)(len | 0x80);
+    len >>= 7;
+  }
+  *op++ = (uint8_t)len;
+
+  uint16_t table[kHashTableSize];
+  size_t pos = 0;
+  while (pos < n) {
+    size_t block_end = pos + kBlockSize < n ? pos + kBlockSize : n;
+    const uint8_t* base = in + pos;
+    size_t bn = block_end - pos;
+    if (bn < kMinMatch + 4) {
+      op = EmitLiteral(op, base, bn);
+      pos = block_end;
+      continue;
+    }
+    memset(table, 0, sizeof(table));
+    size_t i = 0;           // cursor within block
+    size_t lit_start = 0;   // first unemitted literal byte
+    // stop probing where a 4-byte load would run past the block
+    size_t probe_limit = bn - kMinMatch;
+    while (i <= probe_limit) {
+      uint32_t h = Hash(Load32(base + i));
+      size_t cand = table[h];
+      table[h] = (uint16_t)i;
+      if (cand < i && Load32(base + cand) == Load32(base + i)) {
+        // extend the match
+        size_t mlen = kMinMatch;
+        while (i + mlen < bn && base[cand + mlen] == base[i + mlen]) {
+          ++mlen;
+        }
+        if (i > lit_start) {
+          op = EmitLiteral(op, base + lit_start, i - lit_start);
+        }
+        op = EmitCopy(op, i - cand, mlen);
+        i += mlen;
+        lit_start = i;
+      } else {
+        ++i;
+      }
+    }
+    if (lit_start < bn) {
+      op = EmitLiteral(op, base + lit_start, bn - lit_start);
+    }
+    pos = block_end;
+  }
+  return (size_t)(op - out);
+}
+
+size_t snappy_uncompressed_length(const uint8_t* in, size_t n,
+                                  size_t* header_len) {
+  size_t result = 0;
+  int shift = 0;
+  for (size_t i = 0; i < n && i < 5; ++i) {
+    result |= (size_t)(in[i] & 0x7f) << shift;
+    if (!(in[i] & 0x80)) {
+      *header_len = i + 1;
+      return result;
+    }
+    shift += 7;
+  }
+  return (size_t)-1;
+}
+
+size_t snappy_decompress(const uint8_t* in, size_t n, uint8_t* out,
+                         size_t out_cap) {
+  size_t hdr;
+  size_t expect = snappy_uncompressed_length(in, n, &hdr);
+  if (expect == (size_t)-1 || expect > out_cap) {
+    return (size_t)-1;
+  }
+  const uint8_t* ip = in + hdr;
+  const uint8_t* ip_end = in + n;
+  uint8_t* op = out;
+  uint8_t* op_end = out + expect;
+  while (ip < ip_end) {
+    uint8_t tag = *ip++;
+    uint32_t kind = tag & 3;
+    if (kind == 0) {  // literal
+      size_t len = (tag >> 2) + 1;
+      if (len > 60) {
+        size_t extra = len - 60;  // 1..4 length bytes
+        if ((size_t)(ip_end - ip) < extra) {
+          return (size_t)-1;
+        }
+        len = 0;
+        for (size_t b = 0; b < extra; ++b) {
+          len |= (size_t)ip[b] << (8 * b);
+        }
+        len += 1;
+        ip += extra;
+      }
+      if ((size_t)(ip_end - ip) < len || (size_t)(op_end - op) < len) {
+        return (size_t)-1;
+      }
+      memcpy(op, ip, len);
+      ip += len;
+      op += len;
+      continue;
+    }
+    size_t len, offset;
+    if (kind == 1) {
+      if (ip >= ip_end) {
+        return (size_t)-1;
+      }
+      len = ((tag >> 2) & 7) + 4;
+      offset = ((size_t)(tag >> 5) << 8) | *ip++;
+    } else if (kind == 2) {
+      if (ip_end - ip < 2) {
+        return (size_t)-1;
+      }
+      len = (tag >> 2) + 1;
+      offset = (size_t)ip[0] | ((size_t)ip[1] << 8);
+      ip += 2;
+    } else {
+      if (ip_end - ip < 4) {
+        return (size_t)-1;
+      }
+      len = (tag >> 2) + 1;
+      offset = (size_t)ip[0] | ((size_t)ip[1] << 8) |
+               ((size_t)ip[2] << 16) | ((size_t)ip[3] << 24);
+      ip += 4;
+    }
+    if (offset == 0 || offset > (size_t)(op - out) ||
+        (size_t)(op_end - op) < len) {
+      return (size_t)-1;
+    }
+    const uint8_t* src = op - offset;
+    if (offset >= len) {
+      memcpy(op, src, len);
+    } else {
+      // overlapping copy is the RLE idiom: must go byte-by-byte
+      for (size_t b = 0; b < len; ++b) {
+        op[b] = src[b];
+      }
+    }
+    op += len;
+  }
+  return op == op_end ? expect : (size_t)-1;
+}
+
+}  // namespace trpc

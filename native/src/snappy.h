@@ -1,0 +1,39 @@
+// snappy.h — snappy block-format codec (≙ the reference compressing RPC
+// payloads with snappy, policy/snappy_compress.cpp; brpc vendors Google
+// snappy, we implement the public format directly: LZ77 with a byte-
+// oriented tag stream — literals + copies with 1/2/4-byte offsets).
+//
+// Format (public spec, format_description.txt):
+//   preamble: uncompressed length, little-endian varint
+//   elements: tag byte, low 2 bits select the kind —
+//     00 literal  (len-1 in high 6 bits; 60..63 mean 1..4 extra LE bytes)
+//     01 copy     (len 4..11 in bits 2..4; 11-bit offset: high 3 in bits
+//                  5..7 + one more byte)
+//     10 copy     (len-1 in high 6 bits; 16-bit LE offset)
+//     11 copy     (len-1 in high 6 bits; 32-bit LE offset)
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+namespace trpc {
+
+// Worst-case compressed size for n input bytes (spec formula).
+size_t snappy_max_compressed_length(size_t n);
+
+// Compress n bytes into out (capacity >= snappy_max_compressed_length(n)).
+// Returns bytes written.
+size_t snappy_compress(const uint8_t* in, size_t n, uint8_t* out);
+
+// Parse the preamble: uncompressed length, or (size_t)-1 on malformed
+// input.  `header_len` receives the varint's size.
+size_t snappy_uncompressed_length(const uint8_t* in, size_t n,
+                                  size_t* header_len);
+
+// Decompress into out (capacity must be >= snappy_uncompressed_length).
+// Returns bytes written, or (size_t)-1 on corrupt input.  Every copy is
+// bounds-checked; a malicious stream cannot read or write out of range.
+size_t snappy_decompress(const uint8_t* in, size_t n, uint8_t* out,
+                         size_t out_cap);
+
+}  // namespace trpc

@@ -1,8 +1,10 @@
 #include "socket.h"
 
+#include <arpa/inet.h>
 #include <errno.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <stdio.h>
 #include <string.h>
 #include <sys/epoll.h>
 #include <sys/socket.h>
@@ -668,6 +670,71 @@ void EventDispatcher::Loop(int epfd) {
       }
     }
   }
+}
+
+// Global live-socket enumeration for /sockets (≙ builtin
+// sockets_service.cpp dumping every Socket via its id space).  Purely
+// diagnostic: races with create/recycle are tolerated — a slot is
+// reported only if its version is even (live) with refs > 0 at the
+// moment of the read.
+size_t socket_dump_all(char* buf, size_t cap) {
+  size_t off = 0;
+  uint32_t bound = ResourcePool<Socket>::CapacityUpperBound();
+  for (uint32_t slot = 0; slot < bound; ++slot) {
+    Socket* s = ResourcePool<Socket>::Address(slot);
+    if (s == nullptr) {
+      break;
+    }
+    uint64_t vref = s->versioned_ref.load(std::memory_order_acquire);
+    uint32_t ver = (uint32_t)(vref >> 32);
+    uint32_t refs = (uint32_t)vref;
+    if ((ver & 1) != 0 || refs == 0) {
+      continue;  // failed-draining or free slot
+    }
+    // take a real reference before touching fd/tls: without it a
+    // concurrent recycle can close the fd and accept() can reuse the
+    // number for a different peer mid-dump
+    SocketId sid = ((uint64_t)(ver & ~1u) << 32) | slot;
+    if (Socket::Address(sid) == nullptr) {
+      continue;  // recycled between the check and the acquire
+    }
+    int fd = s->fd;
+    char peer[64] = "-";
+    if (fd >= 0) {
+      sockaddr_storage sa;
+      socklen_t salen = sizeof(sa);
+      if (getpeername(fd, (sockaddr*)&sa, &salen) == 0) {
+        if (sa.ss_family == AF_INET) {
+          char ip[32];
+          sockaddr_in* in = (sockaddr_in*)&sa;
+          inet_ntop(AF_INET, &in->sin_addr, ip, sizeof(ip));
+          snprintf(peer, sizeof(peer), "%s:%d", ip, ntohs(in->sin_port));
+        } else if (sa.ss_family == AF_UNIX) {
+          snprintf(peer, sizeof(peer), "unix");
+        }
+      }
+    }
+    int n = snprintf(
+        buf + off, off < cap ? cap - off : 0,
+        "%llu fd=%d peer=%s ver=%u refs=%u in=%llu out=%llu wq=%d "
+        "h2=%d tls=%d\n",
+        (unsigned long long)(((uint64_t)(ver & ~1u) << 32) | slot), fd, peer,
+        ver, refs,
+        (unsigned long long)s->bytes_in.load(std::memory_order_relaxed),
+        (unsigned long long)s->bytes_out.load(std::memory_order_relaxed),
+        s->write_head.load(std::memory_order_relaxed) != nullptr ? 1 : 0,
+        s->is_h2.load(std::memory_order_relaxed) ? 1 : 0,
+        s->tls != nullptr ? 1 : 0);
+    s->Dereference();
+    if (n < 0) {
+      break;
+    }
+    off += (size_t)n;
+    if (off >= cap) {
+      return cap;
+    }
+  }
+  return off;
 }
 
 }  // namespace trpc

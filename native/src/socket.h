@@ -169,4 +169,8 @@ class EventDispatcher {
   std::atomic<bool> ready_{false};  // epfds_/nepfd_ published
 };
 
+// Diagnostic text dump of every live socket in the process (clients +
+// servers; ≙ builtin sockets_service.cpp).  Returns bytes written.
+size_t socket_dump_all(char* buf, size_t cap);
+
 }  // namespace trpc

@@ -9,6 +9,7 @@ brpc_http_rpc_protocol_unittest driving protocol combinations).
 
 import http.client
 import json
+import time
 import urllib.error
 import urllib.request
 
@@ -297,12 +298,23 @@ class TestBuiltinServices:
 
 class TestCompression:
     @pytest.mark.parametrize("ctype", [compress.COMPRESS_GZIP,
-                                       compress.COMPRESS_ZLIB])
+                                       compress.COMPRESS_ZLIB,
+                                       compress.COMPRESS_SNAPPY])
     def test_request_compressed(self, server, ctype):
         ch = Channel(f"127.0.0.1:{server.port}",
                      ChannelOptions(request_compress_type=ctype))
         payload = b"abc" * 1000
         assert ch.call("Upper", payload) == payload.upper()
+        ch.close()
+
+    def test_native_echo_carries_compress_type(self, server):
+        # the native (kind 0) echo replies with the request bytes AND the
+        # request's compress_type, so a compressed call round-trips
+        ch = Channel(f"127.0.0.1:{server.port}",
+                     ChannelOptions(
+                         request_compress_type=compress.COMPRESS_SNAPPY))
+        payload = b"pay" * 4000
+        assert ch.call("Echo.echo", payload) == payload
         ch.close()
 
     def test_response_compressed(self, server):
@@ -322,7 +334,8 @@ class TestCompression:
 
     def test_roundtrip_codecs(self):
         data = b"hello world" * 100
-        for ctype in (compress.COMPRESS_GZIP, compress.COMPRESS_ZLIB):
+        for ctype in (compress.COMPRESS_GZIP, compress.COMPRESS_ZLIB,
+                      compress.COMPRESS_SNAPPY):
             assert compress.decompress(
                 compress.compress(data, ctype), ctype) == data
         assert compress.compress(data, compress.COMPRESS_NONE) == data
@@ -420,3 +433,130 @@ class TestRpcz:
         ch.call("Upper", b"x")
         assert span.recent_spans(10) == []
         ch.close()
+
+
+class TestProcessObservability:
+    """Process block + socket/id/thread dumps (≙ default_variables.cpp:878
+    and sockets/ids/threads builtin services)."""
+
+    def test_default_variables_exposed(self, server):
+        body = _get(server.port, "/vars").read().decode()
+        for name in ("process_uptime_s", "process_cpu_usage",
+                     "process_memory_resident_bytes", "process_fd_count",
+                     "process_thread_count", "process_pid",
+                     "system_loadavg_1m"):
+            assert name in body, f"missing {name} in /vars"
+
+    def test_default_variables_values_sane(self, server):
+        import os as _os
+        from brpc_tpu.metrics import bvar as _bvar
+        dump = {k: v for k, v in _bvar.dump_exposed()}  # values stringified
+        assert int(dump["process_pid"]) == _os.getpid()
+        assert int(dump["process_memory_resident_bytes"]) > 1 << 20
+        assert int(dump["process_fd_count"]) > 3
+        assert int(dump["process_thread_count"]) >= 2
+        assert float(dump["process_uptime_s"]) >= 0
+
+    def test_sockets_dump_shows_live_connection(self, server):
+        ch = Channel(f"127.0.0.1:{server.port}")
+        ch.call("Echo.echo", b"x")
+        body = _get(server.port, "/sockets").read().decode()
+        # both ends of the loopback connection live in this process:
+        # at least the server's accepted socket + the portal's own conn
+        lines = [ln for ln in body.splitlines() if "fd=" in ln]
+        assert len(lines) >= 2
+        assert any("peer=127.0.0.1:" in ln for ln in lines)
+        assert all("in=" in ln and "out=" in ln for ln in lines)
+        ch.close()
+
+    def test_ids_dump_during_inflight_call(self):
+        import threading as _threading
+        release = _threading.Event()
+        srv = Server()
+        srv.add_service("Slow", lambda cntl, req:
+                        (release.wait(10), b"done")[1])
+        srv.start("127.0.0.1:0")
+        ch = Channel(f"127.0.0.1:{srv.port}")
+        try:
+            fut = ch.call_async("Slow.run", b"")
+            deadline = time.time() + 5
+            seen = ""
+            while time.time() < deadline:
+                seen = _get(srv.port, "/ids").read().decode()
+                if "ARMED" in seen:
+                    break
+                time.sleep(0.02)
+            assert "ARMED" in seen and "sock=" in seen
+            release.set()
+            assert fut.result(timeout=5) == b"done"
+        finally:
+            release.set()
+            ch.close()
+            srv.destroy()
+
+    def test_threads_dump(self, server):
+        body = _get(server.port, "/threads").read().decode()
+        assert "--- thread" in body
+        assert "OS threads" in body
+        # the native core's named threads are visible in the census
+        assert "trpc" in body or "MainThread" in body
+
+
+class TestSnappyFormat:
+    """Wire-format conformance for the native snappy codec (public block
+    format, pinned with hand-computed vectors ≙ the framing
+    snappy_unittest exercises)."""
+
+    def test_empty_and_tiny_vectors(self):
+        S = compress.COMPRESS_SNAPPY
+        assert compress.compress(b"", S) == b"\x00"
+        # "abc": varint 3, literal tag (3-1)<<2 = 0x08, bytes
+        assert compress.compress(b"abc", S) == b"\x03\x08abc"
+        assert compress.decompress(b"\x03\x08abc", S) == b"abc"
+
+    def test_rle_compresses(self):
+        S = compress.COMPRESS_SNAPPY
+        data = b"a" * 100000
+        packed = compress.compress(data, S)
+        # copies cap at 64 bytes, so best-case RLE is ~3/64 of the input
+        assert len(packed) < len(data) // 18
+        assert compress.decompress(packed, S) == data
+
+    def test_incompressible_bounded(self):
+        import os as _os
+        S = compress.COMPRESS_SNAPPY
+        data = _os.urandom(65536 * 3 + 17)  # spans multiple 64KB blocks
+        packed = compress.compress(data, S)
+        assert len(packed) < 32 + len(data) + len(data) // 6
+        assert compress.decompress(packed, S) == data
+
+    def test_structured_data_round_trip(self):
+        S = compress.COMPRESS_SNAPPY
+        data = (b'{"method": "Echo", "payload": "' + b"x" * 500 + b'"}\n'
+                ) * 2000
+        packed = compress.compress(data, S)
+        assert len(packed) < len(data) // 3
+        assert compress.decompress(packed, S) == data
+
+    def test_corrupt_streams_raise(self):
+        S = compress.COMPRESS_SNAPPY
+        for bad in (
+                b"\xff\xff\xff\xff\xff",       # unterminated varint
+                b"\x05\x08ab",                  # truncated literal
+                b"\x0a\x01\x05",                # copy before any output
+                b"\x64" + b"\x00a" + b"\xfe\xff\xff",  # offset past start
+        ):
+            with pytest.raises(ValueError):
+                compress.decompress(bad, S)
+
+    def test_decompressed_size_limit_enforced(self):
+        from brpc_tpu.utils import flags as _flags
+        S = compress.COMPRESS_SNAPPY
+        old = _flags.get_flag("max_decompressed_size")
+        _flags.set_flag("max_decompressed_size", 1000)
+        try:
+            packed = compress.compress(b"b" * 5000, S)
+            with pytest.raises(ValueError):
+                compress.decompress(packed, S)
+        finally:
+            _flags.set_flag("max_decompressed_size", old)
