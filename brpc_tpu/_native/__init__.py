@@ -67,6 +67,14 @@ def _declare(L: ctypes.CDLL) -> None:
     L.trpc_fiber_start.restype = c.c_int
     L.trpc_fiber_join.argtypes = [c.c_uint64]
     L.trpc_fiber_join.restype = c.c_int
+    L.trpc_fiber_key_create.argtypes = [c.POINTER(c.c_uint64), c.c_void_p]
+    L.trpc_fiber_key_create.restype = c.c_int
+    L.trpc_fiber_key_delete.argtypes = [c.c_uint64]
+    L.trpc_fiber_key_delete.restype = c.c_int
+    L.trpc_fiber_setspecific.argtypes = [c.c_uint64, c.c_void_p]
+    L.trpc_fiber_setspecific.restype = c.c_int
+    L.trpc_fiber_getspecific.argtypes = [c.c_uint64]
+    L.trpc_fiber_getspecific.restype = c.c_void_p
     L.trpc_fiber_yield.restype = None
     L.trpc_fiber_usleep.argtypes = [c.c_int64]
     L.trpc_fiber_usleep.restype = None
@@ -139,6 +147,14 @@ def _declare(L: ctypes.CDLL) -> None:
     L.trpc_server_set_thrift_handler.restype = None
     L.trpc_thrift_respond.argtypes = [c.c_uint64, c.c_char_p, c.c_size_t]
     L.trpc_thrift_respond.restype = c.c_int
+
+    # user-registered protocols on the shared port
+    L.trpc_server_register_protocol.argtypes = [
+        c.c_void_p, c.c_char_p, c.c_char_p, c.c_size_t, c.c_void_p,
+        c.c_void_p, c.c_void_p]
+    L.trpc_server_register_protocol.restype = c.c_int
+    L.trpc_proto_respond.argtypes = [c.c_uint64, c.c_char_p, c.c_size_t]
+    L.trpc_proto_respond.restype = c.c_int
 
     # auth
     L.trpc_server_set_auth.argtypes = [c.c_void_p, c.c_char_p, c.c_size_t]

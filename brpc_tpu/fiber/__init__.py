@@ -232,3 +232,73 @@ class RWLock:
 
     def wrunlock(self) -> None:
         lib().trpc_rwlock_wrunlock(self._l)
+
+
+class FiberLocal:
+    """Fiber-local storage slot (≙ bthread_key_t, bthread/key.cpp).
+
+    Each fiber (or plain thread, via the pthread fallback) sees its own
+    value.  Values are Python objects; the native layer stores an opaque
+    integer token into the fiber's key slot and this class keeps the
+    object alive in a side table until the slot is overwritten, the key
+    closed, or the owning fiber exits (native destructor callback).
+    """
+
+    def __init__(self):
+        import ctypes as _c
+        init()
+        L = lib()
+        # native destructor: drop the side-table reference when a fiber
+        # holding a value exits
+        self._DTOR = _c.CFUNCTYPE(None, _c.c_void_p)(self._on_fiber_exit)
+        self._values = {}
+        self._next_token = 1
+        self._vlock = __import__("threading").Lock()
+        key = _c.c_uint64()
+        rc = L.trpc_fiber_key_create(
+            _c.byref(key), _c.cast(self._DTOR, _c.c_void_p))
+        if rc != 0:
+            raise RuntimeError(f"fiber key space exhausted ({rc})")
+        self._key = key.value
+
+    def _on_fiber_exit(self, token):
+        with self._vlock:
+            self._values.pop(int(token or 0), None)
+
+    def set(self, value) -> None:
+        L = lib()
+        old = int(L.trpc_fiber_getspecific(self._key) or 0)
+        with self._vlock:
+            if old:
+                self._values.pop(old, None)
+            if value is None:
+                token = 0
+            else:
+                token = self._next_token
+                self._next_token += 1
+                self._values[token] = value
+        L.trpc_fiber_setspecific(self._key, token)
+
+    def get(self, default=None):
+        token = int(lib().trpc_fiber_getspecific(self._key) or 0)
+        if not token:
+            return default
+        with self._vlock:
+            return self._values.get(token, default)
+
+    def close(self) -> None:
+        if self._key is not None:
+            lib().trpc_fiber_key_delete(self._key)
+            self._key = None
+            with self._vlock:
+                self._values.clear()
+
+    def __del__(self):
+        # without this, a dropped FiberLocal leaves the native key alive
+        # pointing at a freed ctypes trampoline — the next fiber exit
+        # holding a value would call through it.  key_delete bumps the
+        # version so the native sweep never invokes the dead pointer.
+        try:
+            self.close()
+        except Exception:
+            pass  # interpreter teardown: the library may be gone

@@ -210,6 +210,67 @@ class Server:
         lib().trpc_server_set_thrift_handler(
             self._handle, ctypes.cast(cb, ctypes.c_void_p), None)
 
+    def register_protocol(self, name: str, magic: bytes, parse, process
+                          ) -> None:
+        """Plug a user wire protocol into the shared port's sniffer (≙
+        RegisterProtocol, protocol.h:186).  Must be called before
+        start(); builtins sniff first.  `magic` (1-16 bytes) is a
+        PER-FRAME prefix — every frame must start with it (like "TRPC" /
+        RESP markers), not a one-time connection handshake.
+
+        parse(buf: bytes) -> int: >0 total frame length, 0 incomplete,
+        <0 corrupt (fails the connection).  buf is the buffered head,
+        capped at 64KB — the frame length must be derivable within that.
+        process(frame: bytes) -> bytes|None: raw reply bytes (None =
+        one-way).  Replies release in request order like RESP/thrift
+        pipelining."""
+        if self._started:
+            raise RuntimeError("register_protocol after start")
+        if not 1 <= len(magic) <= 16:
+            raise ValueError("magic must be 1-16 bytes")
+
+        _PARSE_CB = ctypes.CFUNCTYPE(
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_size_t, ctypes.c_void_p)
+        _HANDLER_CB = ctypes.CFUNCTYPE(
+            None, ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_size_t, ctypes.c_void_p)
+
+        def on_parse(data_p, data_len, _user):
+            try:
+                buf = ctypes.string_at(data_p, data_len) if data_len else b""
+                return int(parse(buf))
+            except Exception:
+                log.LOG(log.LOG_ERROR, "protocol %s parse raised:\n%s",
+                        name, traceback.format_exc())
+                return -1
+
+        def on_frame(token, frame_p, frame_len, _user):
+            L = lib()
+            reply = b""
+            try:
+                frame = ctypes.string_at(frame_p, frame_len) \
+                    if frame_len else b""
+                out = process(frame)
+                # coerce inside the try: a handler returning str/list/...
+                # must not wedge the pipeline slot
+                reply = b"" if out is None else bytes(out)
+            except Exception:
+                log.LOG(log.LOG_ERROR, "protocol %s handler raised:\n%s",
+                        name, traceback.format_exc())
+                reply = b""
+            L.trpc_proto_respond(token, reply, len(reply))
+
+        pcb = _PARSE_CB(on_parse)
+        hcb = _HANDLER_CB(on_frame)
+        self._cb_keepalive.extend((pcb, hcb))
+        rc = lib().trpc_server_register_protocol(
+            self._handle, name.encode(), magic, len(magic),
+            ctypes.cast(pcb, ctypes.c_void_p),
+            ctypes.cast(hcb, ctypes.c_void_p), None)
+        if rc != 0:
+            raise RuntimeError(f"register_protocol failed ({rc})")
+
     def add_grpc_service(self, service_name: str, methods) -> None:
         """Serve gRPC methods at /<service_name>/<Method> — real gRPC
         clients dial the same port (h2 + gRPC framing handled natively +

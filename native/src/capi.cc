@@ -46,6 +46,18 @@ int trpc_fiber_start(uint64_t* out, trpc_fiber_fn fn, void* arg) {
 }
 
 int trpc_fiber_join(uint64_t f) { return fiber_join(f); }
+
+// fiber-local storage (≙ bthread_key_t)
+int trpc_fiber_key_create(uint64_t* key, void (*dtor)(void*)) {
+  return fiber_key_create(key, dtor);
+}
+int trpc_fiber_key_delete(uint64_t key) { return fiber_key_delete(key); }
+int trpc_fiber_setspecific(uint64_t key, void* data) {
+  return fiber_setspecific(key, data);
+}
+void* trpc_fiber_getspecific(uint64_t key) {
+  return fiber_getspecific(key);
+}
 void trpc_fiber_yield() { fiber_yield(); }
 void trpc_fiber_usleep(int64_t us) { fiber_usleep(us); }
 int trpc_in_fiber() { return in_fiber() ? 1 : 0; }
@@ -197,6 +209,20 @@ void trpc_server_set_thrift_handler(void* s, ThriftHandlerCb cb, void* user) {
 
 int trpc_thrift_respond(uint64_t token, const uint8_t* data, size_t len) {
   return thrift_respond(token, data, len);
+}
+
+// --- user-registered protocols ----------------------------------------------
+
+int trpc_server_register_protocol(void* s, const char* name,
+                                  const uint8_t* magic, size_t magic_len,
+                                  ProtoParseCb parse, ProtoHandlerCb handler,
+                                  void* user) {
+  return server_register_protocol((Server*)s, name, magic, magic_len, parse,
+                                  handler, user);
+}
+
+int trpc_proto_respond(uint64_t token, const uint8_t* data, size_t len) {
+  return proto_respond(token, data, len);
 }
 
 // --- auth ------------------------------------------------------------------

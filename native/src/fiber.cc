@@ -65,6 +65,18 @@ struct StackMem {
 
 struct TaskGroup;
 
+// Fiber-local key space (≙ bthread_key_t, bthread/key.cpp): fixed number
+// of slots; create/delete cycle a per-slot version so a handle to a
+// deleted key can never read another key's value.
+constexpr int kMaxFiberKeys = 64;
+
+struct FiberKeyInfo {
+  std::atomic<uint32_t> version{1};  // odd = free, even = in use
+  void (*dtor)(void*) = nullptr;
+};
+FiberKeyInfo g_fiber_keys[kMaxFiberKeys];
+std::mutex g_fiber_key_mu;
+
 struct TaskMeta {
   FiberFn fn = nullptr;
   void* arg = nullptr;
@@ -85,6 +97,13 @@ struct TaskMeta {
 #if defined(TRPC_TSAN)
   void* tsan_fiber = nullptr;  // created per fiber_start, destroyed on exit
 #endif
+
+  // fiber-local storage (≙ bthread_key_t / keytable, bthread/key.cpp):
+  // value slots tagged with the key generation that wrote them, so
+  // fiber_key_delete + key reuse can never leak a stale value into a new
+  // key.  Destructors run on the fiber's own stack at exit.
+  void* fls[kMaxFiberKeys] = {};
+  uint32_t fls_ver[kMaxFiberKeys] = {};
 };
 
 // ---------------------------------------------------------------------------
@@ -317,6 +336,31 @@ void fiber_entry(void* p) {
     run_remained(g);  // remained set by the context that jumped to us
   }
   m->fn(m->arg);
+  // fiber-local destructors run on this fiber's own stack, while it can
+  // still yield (≙ KeyTable teardown at bthread task exit); slots are
+  // cleared so the pooled TaskMeta carries nothing into its next fiber.
+  // version+dtor are captured together under the key mutex: a concurrent
+  // key_delete+key_create must never hand this sweep the NEW key's dtor
+  // for the OLD key's value.
+  for (int i = 0; i < kMaxFiberKeys; ++i) {
+    void* v = m->fls[i];
+    if (v == nullptr) {
+      continue;
+    }
+    m->fls[i] = nullptr;
+    void (*dtor)(void*) = nullptr;
+    {
+      std::lock_guard<std::mutex> lk(g_fiber_key_mu);
+      if (m->fls_ver[i] ==
+          g_fiber_keys[i].version.load(std::memory_order_relaxed)) {
+        dtor = g_fiber_keys[i].dtor;
+      }
+    }
+    if (dtor != nullptr) {
+      dtor(v);
+    }
+    m->fls_ver[i] = 0;
+  }
   // exit: recycle on the worker stack after we've switched off this one
   TaskGroup* g = tls_group;  // may differ from entry group
   g->set_remained(cb_finish_fiber, m);
@@ -741,6 +785,117 @@ fiber_t fiber_self() {
 bool in_fiber() {
   TaskGroup* g = tls_group;
   return g != nullptr && g->cur != nullptr;
+}
+
+// ---------------------------------------------------------------------------
+// fiber-local storage (≙ bthread_key_create/getspecific, bthread/key.cpp)
+
+namespace {
+
+// pthread fallback: getspecific/setspecific from a non-fiber thread use
+// thread-local slots with the same key space (≙ bthread keys working in
+// pthreads); destructors run at thread exit.
+struct PthreadFls {
+  void* val[kMaxFiberKeys] = {};
+  uint32_t ver[kMaxFiberKeys] = {};
+  ~PthreadFls() {
+    for (int i = 0; i < kMaxFiberKeys; ++i) {
+      if (val[i] == nullptr) {
+        continue;
+      }
+      // capture version+dtor together (see fiber_entry's sweep)
+      void (*dtor)(void*) = nullptr;
+      {
+        std::lock_guard<std::mutex> lk(g_fiber_key_mu);
+        if (ver[i] ==
+            g_fiber_keys[i].version.load(std::memory_order_relaxed)) {
+          dtor = g_fiber_keys[i].dtor;
+        }
+      }
+      if (dtor != nullptr) {
+        dtor(val[i]);
+      }
+    }
+  }
+};
+thread_local PthreadFls tls_pthread_fls;
+
+inline bool DecodeKey(uint64_t key, int* idx, uint32_t* ver) {
+  *idx = (int)(key & 0xffffffff);
+  *ver = (uint32_t)(key >> 32);
+  return *idx >= 0 && *idx < kMaxFiberKeys;
+}
+
+}  // namespace
+
+int fiber_key_create(uint64_t* key, void (*dtor)(void*)) {
+  std::lock_guard<std::mutex> lk(g_fiber_key_mu);
+  for (int i = 0; i < kMaxFiberKeys; ++i) {
+    uint32_t v = g_fiber_keys[i].version.load(std::memory_order_relaxed);
+    if (v & 1) {  // free
+      g_fiber_keys[i].dtor = dtor;
+      g_fiber_keys[i].version.store(v + 1, std::memory_order_release);
+      *key = ((uint64_t)(v + 1) << 32) | (uint32_t)i;
+      return 0;
+    }
+  }
+  return -EAGAIN;  // key space exhausted
+}
+
+int fiber_key_delete(uint64_t key) {
+  int idx;
+  uint32_t ver;
+  if (!DecodeKey(key, &idx, &ver)) {
+    return -EINVAL;
+  }
+  std::lock_guard<std::mutex> lk(g_fiber_key_mu);
+  uint32_t cur = g_fiber_keys[idx].version.load(std::memory_order_relaxed);
+  if (cur != ver) {
+    return -EINVAL;  // stale handle
+  }
+  // odd again = free; values written under `ver` become unreadable
+  // everywhere at once (destructors do NOT run — matching bthread_key
+  // semantics: delete only invalidates)
+  g_fiber_keys[idx].version.store(cur + 1, std::memory_order_release);
+  g_fiber_keys[idx].dtor = nullptr;
+  return 0;
+}
+
+int fiber_setspecific(uint64_t key, void* data) {
+  int idx;
+  uint32_t ver;
+  if (!DecodeKey(key, &idx, &ver)) {
+    return -EINVAL;
+  }
+  if (g_fiber_keys[idx].version.load(std::memory_order_acquire) != ver) {
+    return -EINVAL;
+  }
+  TaskGroup* g = tls_group;
+  if (g != nullptr && g->cur != nullptr) {
+    g->cur->fls[idx] = data;
+    g->cur->fls_ver[idx] = ver;
+  } else {
+    tls_pthread_fls.val[idx] = data;
+    tls_pthread_fls.ver[idx] = ver;
+  }
+  return 0;
+}
+
+void* fiber_getspecific(uint64_t key) {
+  int idx;
+  uint32_t ver;
+  if (!DecodeKey(key, &idx, &ver)) {
+    return nullptr;
+  }
+  if (g_fiber_keys[idx].version.load(std::memory_order_acquire) != ver) {
+    return nullptr;
+  }
+  TaskGroup* g = tls_group;
+  if (g != nullptr && g->cur != nullptr) {
+    return g->cur->fls_ver[idx] == ver ? g->cur->fls[idx] : nullptr;
+  }
+  return tls_pthread_fls.ver[idx] == ver ? tls_pthread_fls.val[idx]
+                                         : nullptr;
 }
 
 FiberRuntimeStats fiber_runtime_stats() {

@@ -52,6 +52,16 @@ int butex_wait(Butex* b, int32_t expected, int64_t timeout_us);
 int butex_wake(Butex* b);
 int butex_wake_all(Butex* b);
 
+// --- fiber-local storage (≙ bthread_key_t, bthread/key.cpp) ---------------
+// Keys work from fibers AND plain pthreads (thread-local fallback).
+// Destructors run at fiber exit on the fiber's stack / at thread exit;
+// fiber_key_delete only invalidates (no destructor sweep), matching
+// bthread_key_delete semantics.
+int fiber_key_create(uint64_t* key, void (*dtor)(void*));
+int fiber_key_delete(uint64_t key);
+int fiber_setspecific(uint64_t key, void* data);
+void* fiber_getspecific(uint64_t key);
+
 // Runtime introspection (feeds PassiveStatus bvars on the Python side).
 struct FiberRuntimeStats {
   uint64_t fibers_created;

@@ -1,0 +1,185 @@
+// flat_map.h — open-addressing hash map for the native hot paths (≙
+// butil/containers/flat_map.h: brpc keys its service map and socket maps
+// on FlatMap precisely because chained unordered_map costs a pointer
+// chase per lookup; here: linear probing over one contiguous slot array,
+// power-of-two capacity, tombstone-free backward-shift deletion).
+//
+// Deliberately narrower than the reference container: the maps it backs
+// (service registry, socket map) are built once / mutated rarely and
+// read on every request, so the API is insert/find/erase/size/iterate.
+// NOT thread-safe; callers hold their existing locks (the service map is
+// immutable after server_start, the socket map is guarded by its mutex).
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <functional>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace trpc {
+
+inline uint64_t flat_hash_bytes(const char* p, size_t n) {
+  // FNV-1a: short-string friendly, no allocation, good enough spread for
+  // power-of-two masking (service names, "ip:port" keys)
+  uint64_t h = 1469598103934665603ULL;
+  for (size_t i = 0; i < n; ++i) {
+    h ^= (uint8_t)p[i];
+    h *= 1099511628211ULL;
+  }
+  return h;
+}
+
+template <typename K>
+struct FlatHash {
+  uint64_t operator()(const K& k) const { return std::hash<K>()(k); }
+};
+
+template <>
+struct FlatHash<std::string> {
+  uint64_t operator()(const std::string& s) const {
+    return flat_hash_bytes(s.data(), s.size());
+  }
+};
+
+template <typename K, typename V, typename Hash = FlatHash<K>>
+class FlatMap {
+ public:
+  struct Slot {
+    K key;
+    V value;
+    uint8_t state = 0;  // 0 empty, 1 full
+  };
+
+  FlatMap() { slots_.resize(kInitCap); }
+
+  V* find(const K& key) {
+    size_t mask = slots_.size() - 1;
+    size_t i = Hash()(key) & mask;
+    for (size_t probes = 0; probes <= mask; ++probes) {
+      Slot& s = slots_[i];
+      if (s.state == 0) {
+        return nullptr;
+      }
+      if (s.key == key) {
+        return &s.value;
+      }
+      i = (i + 1) & mask;
+    }
+    return nullptr;
+  }
+
+  const V* find(const K& key) const {
+    return const_cast<FlatMap*>(this)->find(key);
+  }
+
+  // Insert or overwrite; returns the stored value.
+  V* insert(const K& key, V value) {
+    if ((size_ + 1) * 4 > slots_.size() * 3) {  // load factor 0.75
+      Rehash(slots_.size() * 2);
+    }
+    size_t mask = slots_.size() - 1;
+    size_t i = Hash()(key) & mask;
+    while (true) {
+      Slot& s = slots_[i];
+      if (s.state == 0) {
+        s.key = key;
+        s.value = std::move(value);
+        s.state = 1;
+        ++size_;
+        return &s.value;
+      }
+      if (s.key == key) {
+        s.value = std::move(value);
+        return &s.value;
+      }
+      i = (i + 1) & mask;
+    }
+  }
+
+  // Backward-shift deletion: no tombstones, probes stay short forever
+  // (the property the reference's FlatMap documents as its advantage
+  // for long-lived maps with churn, e.g. the socket map).
+  bool erase(const K& key) {
+    size_t mask = slots_.size() - 1;
+    size_t i = Hash()(key) & mask;
+    for (size_t probes = 0; probes <= mask; ++probes) {
+      Slot& s = slots_[i];
+      if (s.state == 0) {
+        return false;
+      }
+      if (s.key == key) {
+        // shift the cluster left until a slot is empty or at its home
+        size_t hole = i;
+        size_t j = (i + 1) & mask;
+        while (slots_[j].state == 1) {
+          size_t home = Hash()(slots_[j].key) & mask;
+          // can j's entry legally move into the hole?  yes iff the hole
+          // lies cyclically within [home, j)
+          bool movable = ((j - home) & mask) >= ((j - hole) & mask);
+          if (movable) {
+            slots_[hole] = std::move(slots_[j]);
+            slots_[hole].state = 1;
+            hole = j;
+          }
+          j = (j + 1) & mask;
+        }
+        slots_[hole].state = 0;
+        slots_[hole].key = K();
+        slots_[hole].value = V();
+        --size_;
+        return true;
+      }
+      i = (i + 1) & mask;
+    }
+    return false;
+  }
+
+  size_t size() const { return size_; }
+  bool empty() const { return size_ == 0; }
+
+  // Iterate full slots; fn(key, value).  Mutation during iteration is
+  // undefined — collect keys first if erasing.
+  template <typename Fn>
+  void for_each(Fn fn) {
+    for (Slot& s : slots_) {
+      if (s.state == 1) {
+        fn(s.key, s.value);
+      }
+    }
+  }
+
+  template <typename Fn>
+  void for_each(Fn fn) const {
+    for (const Slot& s : slots_) {
+      if (s.state == 1) {
+        fn(s.key, s.value);
+      }
+    }
+  }
+
+  void clear() {
+    slots_.assign(kInitCap, Slot());
+    size_ = 0;
+  }
+
+ private:
+  static constexpr size_t kInitCap = 16;  // power of two
+
+  void Rehash(size_t ncap) {
+    std::vector<Slot> old = std::move(slots_);
+    slots_.assign(ncap, Slot());
+    size_ = 0;
+    for (Slot& s : old) {
+      if (s.state == 1) {
+        insert(std::move(s.key), std::move(s.value));
+      }
+    }
+  }
+
+  std::vector<Slot> slots_;
+  size_t size_ = 0;
+};
+
+}  // namespace trpc

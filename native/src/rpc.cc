@@ -1,5 +1,7 @@
 #include "rpc.h"
 
+#include "flat_map.h"
+
 #include <arpa/inet.h>
 #include <errno.h>
 #include <fcntl.h>
@@ -281,7 +283,8 @@ struct CallCtx {
   uint32_t h2_stream = 0;  // nonzero: respond as HTTP/2 frames
   bool is_redis = false;   // respond with raw RESP bytes
   bool is_thrift = false;  // respond with a framed TBinaryProtocol message
-  RedisHandlerCb rcb = nullptr;  // raw-blob callback (redis AND thrift)
+  bool is_user_proto = false;  // user-registered protocol frame
+  RedisHandlerCb rcb = nullptr;  // raw-blob cb (redis/thrift/user proto)
   std::string http_path;
   std::string http_query;
   std::string http_headers;
@@ -377,7 +380,7 @@ class UsercodePool {
       lk.unlock();
       nm.usercode_queue_depth.fetch_sub(1, std::memory_order_relaxed);
       nm.usercode_running.fetch_add(1, std::memory_order_relaxed);
-      if (ctx->is_redis || ctx->is_thrift) {
+      if (ctx->is_redis || ctx->is_thrift || ctx->is_user_proto) {
         ctx->rcb(ctx->token(), (const uint8_t*)ctx->payload.data(),
                  ctx->payload.size(), ctx->user);
       } else if (ctx->is_http) {
@@ -417,13 +420,23 @@ struct ServiceHandler {
 
 class Server {
  public:
-  std::unordered_map<std::string, ServiceHandler> services;
+  FlatMap<std::string, ServiceHandler> services;  // hot per-request lookup
   HttpHandlerCb http_cb = nullptr;
   void* http_user = nullptr;
   RedisHandlerCb redis_cb = nullptr;
   void* redis_user = nullptr;
   ThriftHandlerCb thrift_cb = nullptr;
   void* thrift_user = nullptr;
+  // user-registered protocols (≙ RegisterProtocol): registration happens
+  // before start(), the parse loop only reads — no lock needed
+  struct UserProto {
+    std::string name;
+    std::string magic;
+    ProtoParseCb parse = nullptr;
+    ProtoHandlerCb handler = nullptr;
+    void* user = nullptr;
+  };
+  std::vector<UserProto> user_protos;
   bool has_auth = false;
   std::string auth_secret;
   // TLS on the shared port: when set, connections whose first byte is a
@@ -455,6 +468,7 @@ struct ConnState {
   uint64_t next_dispatch = 0;  // seq assigned to the next parsed request
   uint64_t next_release = 0;   // seq whose response may be written next
   bool parse_capped = false;   // parser paused at kMaxPipelined in flight
+  size_t proto_need = 0;       // user-proto frame bytes still awaited
   bool closing = false;        // a Connection: close response was released
   struct Ready {
     IOBuf data;
@@ -633,6 +647,7 @@ void DispatchHttp(Socket* s, Server* srv, HttpRequest&& req) {
   ctx->is_http = true;
   ctx->is_redis = false;
   ctx->is_thrift = false;
+  ctx->is_user_proto = false;
   ctx->h2_stream = 0;
   ctx->http_keep_alive = req.keep_alive;
   ctx->method = std::move(req.method);
@@ -675,6 +690,7 @@ void DispatchH2(Socket* s, Server* srv, H2Request&& req) {
   ctx->is_http = true;
   ctx->is_redis = false;
   ctx->is_thrift = false;
+  ctx->is_user_proto = false;
   ctx->h2_stream = req.stream_id;
   ctx->http_keep_alive = true;  // h2 connections persist
   ctx->method = std::move(req.method);
@@ -871,6 +887,7 @@ void ServerOnMessages(Socket* s) {
         rctx->is_http = false;
         rctx->is_redis = true;
         rctx->is_thrift = false;
+        rctx->is_user_proto = false;
         rctx->h2_stream = 0;
         rctx->method = "REDIS";
         rctx->payload = PackRedisArgs(argv);
@@ -949,6 +966,7 @@ void ServerOnMessages(Socket* s) {
         tctx->is_http = false;
         tctx->is_redis = false;
         tctx->is_thrift = true;
+        tctx->is_user_proto = false;
         tctx->h2_stream = 0;
         tctx->method = "THRIFT";
         tctx->payload = frame.to_string();
@@ -964,6 +982,117 @@ void ServerOnMessages(Socket* s) {
         tctx->user = srv->thrift_user;
         UsercodePool::Instance().Submit(tctx);
         continue;
+      }
+      // user-registered protocols: builtins had their chance, now try
+      // each registered magic prefix (≙ InputMessenger cycling its
+      // registered protocols' Parse fns, input_messenger.cpp:77)
+      if (!srv->user_protos.empty()) {
+        bool consumed = false;
+        bool waiting = false;
+        for (const Server::UserProto& up : srv->user_protos) {
+          size_t have = s->read_buf.size();
+          size_t cmp = have < up.magic.size() ? have : up.magic.size();
+          char head[16];
+          s->read_buf.copy_to(head, cmp);
+          if (memcmp(head, up.magic.data(), cmp) != 0) {
+            continue;  // not this protocol
+          }
+          if (have < up.magic.size()) {
+            waiting = true;  // prefix matches so far: wait for the rest
+            break;
+          }
+          if (srv->has_auth && !s->authed.load(std::memory_order_acquire)) {
+            // same policy as thrift: user protocols have no in-band
+            // credential slot, so an auth-enabled server refuses them
+            flush();
+            s->SetFailed(TRPC_EAUTH);
+            return;
+          }
+          // magic matched: this connection's bytes belong to `up` now
+          ConnState* ucs = GetConnState(s);
+          {
+            std::lock_guard<std::mutex> lk(ucs->mu);
+            if (ucs->next_dispatch - ucs->next_release >= kMaxPipelined) {
+              ucs->parse_capped = true;
+              waiting = true;
+              break;
+            }
+          }
+          // a known frame length from a previous parse short-circuits
+          // the re-parse while the body streams in; the peek that feeds
+          // parse() is bounded so pipelined/large frames don't make each
+          // readable event copy the whole pending buffer (O(n^2))
+          size_t have_now = s->read_buf.size();
+          if (ucs->proto_need > 0 && have_now < ucs->proto_need) {
+            waiting = true;
+            break;
+          }
+          int64_t flen;
+          if (ucs->proto_need > 0) {
+            flen = (int64_t)ucs->proto_need;
+          } else {
+            constexpr size_t kPeekCap = 64 * 1024;  // headers live here
+            size_t peek_n = have_now < kPeekCap ? have_now : kPeekCap;
+            std::string peek;
+            peek.resize(peek_n);
+            s->read_buf.copy_to(&peek[0], peek_n);
+            flen = up.parse((const uint8_t*)peek.data(), peek.size(),
+                            up.user);
+          }
+          if (flen == 0) {
+            waiting = true;
+            break;
+          }
+          if (flen < 0 || flen > (int64_t)(64u << 20)) {
+            flush();
+            s->SetFailed(TRPC_EREQUEST);
+            return;
+          }
+          if ((size_t)flen > have_now) {
+            ucs->proto_need = (size_t)flen;
+            waiting = true;  // parse told us the size; wait for the rest
+            break;
+          }
+          ucs->proto_need = 0;
+          IOBuf frame;
+          s->read_buf.cutn(&frame, (size_t)flen);
+          if (!srv->running.load(std::memory_order_acquire)) {
+            flush();
+            s->SetFailed(TRPC_ESTOP);
+            return;
+          }
+          srv->nrequests.fetch_add(1, std::memory_order_relaxed);
+          CallCtx* uctx = nullptr;
+          uint32_t uslot = ResourcePool<CallCtx>::Get(&uctx);
+          uctx->slot = uslot;
+          uctx->sock = s->id();
+          uctx->is_http = false;
+          uctx->is_redis = false;
+          uctx->is_thrift = false;
+          uctx->is_user_proto = true;
+          uctx->h2_stream = 0;
+          uctx->method = up.name;
+          uctx->payload = frame.to_string();
+          uctx->attachment.clear();
+          uctx->req_stream_id = 0;
+          uctx->req_stream_window = 0;
+          uctx->accepted_stream = 0;
+          {
+            std::lock_guard<std::mutex> lk(ucs->mu);
+            uctx->pipe_seq = ucs->next_dispatch++;
+          }
+          uctx->rcb = (RedisHandlerCb)up.handler;
+          uctx->user = up.user;
+          UsercodePool::Instance().Submit(uctx);
+          consumed = true;
+          break;
+        }
+        if (waiting) {
+          break;
+        }
+        if (consumed) {
+          continue;
+        }
       }
       if (!LooksLikeHttp(s->read_buf)) {
         flush();
@@ -1051,20 +1180,20 @@ void ServerOnMessages(Socket* s) {
       s->advertise_device_caps.store(true, std::memory_order_release);
     }
     srv->nrequests.fetch_add(1, std::memory_order_relaxed);
-    auto it = srv->services.find(meta.method);
-    if (it == srv->services.end()) {
+    ServiceHandler* sh = srv->services.find(meta.method);
+    if (sh == nullptr) {
       // service-level fallback: "Service.Method" -> "Service"
       size_t dot = meta.method.find('.');
       if (dot != std::string::npos) {
-        it = srv->services.find(meta.method.substr(0, dot));
+        sh = srv->services.find(meta.method.substr(0, dot));
       }
     }
-    if (it == srv->services.end()) {
+    if (sh == nullptr) {
       SendResponse(s->id(), meta.correlation_id, TRPC_ENOMETHOD,
                    "no such method", IOBuf(), IOBuf());
       continue;
     }
-    const ServiceHandler& h = it->second;
+    const ServiceHandler& h = *sh;
     if (h.kind == 2) {
       // HBM echo (≙ rdma_performance's server loop, retargeted at the
       // device plane): the attachment DMAs host->HBM, then HBM->host
@@ -1144,6 +1273,7 @@ void ServerOnMessages(Socket* s) {
       ctx->is_http = false;
       ctx->is_redis = false;
       ctx->is_thrift = false;
+      ctx->is_user_proto = false;
       ctx->compress_type = meta.compress_type;
       ctx->req_stream_id = meta.stream_id;
       ctx->req_stream_window = meta.feedback_bytes;
@@ -1230,7 +1360,7 @@ int server_add_service(Server* s, const char* name, int kind, HandlerCb cb,
   h.kind = kind;
   h.cb = cb;
   h.user = user;
-  s->services[name] = h;
+  s->services.insert(name, h);
   return 0;
 }
 
@@ -1269,6 +1399,52 @@ int redis_respond(uint64_t token, const uint8_t* data, size_t len) {
 void server_set_thrift_handler(Server* s, ThriftHandlerCb cb, void* user) {
   s->thrift_cb = cb;
   s->thrift_user = user;
+}
+
+int server_register_protocol(Server* s, const char* name,
+                             const uint8_t* magic, size_t magic_len,
+                             ProtoParseCb parse, ProtoHandlerCb handler,
+                             void* user) {
+  if (s->running.load(std::memory_order_acquire)) {
+    return -EBUSY;  // registration is pre-start only (lock-free reads)
+  }
+  if (magic_len == 0 || magic_len > 16 || parse == nullptr ||
+      handler == nullptr) {
+    return -EINVAL;
+  }
+  Server::UserProto up;
+  up.name = name != nullptr ? name : "user";
+  up.magic.assign((const char*)magic, magic_len);
+  up.parse = parse;
+  up.handler = handler;
+  up.user = user;
+  s->user_protos.push_back(std::move(up));
+  return 0;
+}
+
+int proto_respond(uint64_t token, const uint8_t* data, size_t len) {
+  uint32_t slot = (uint32_t)token;
+  uint32_t ver = (uint32_t)(token >> 32);
+  CallCtx* ctx = ResourcePool<CallCtx>::Address(slot);
+  if (ctx == nullptr || !ctx->is_user_proto ||
+      ctx->version.load(std::memory_order_acquire) != ver) {
+    return -EINVAL;
+  }
+  Socket* s = Socket::Address(ctx->sock);
+  if (s != nullptr) {
+    IOBuf reply;
+    if (len > 0) {
+      reply.append(data, len);
+    }
+    // len == 0 releases the pipeline slot without writing (one-way)
+    ReleaseSequenced(s, ctx->pipe_seq, std::move(reply), false);
+    s->Dereference();
+  }
+  ctx->version.fetch_add(1, std::memory_order_release);
+  ctx->payload.clear();
+  ctx->is_user_proto = false;
+  ResourcePool<CallCtx>::Return(slot);
+  return 0;
 }
 
 int thrift_respond(uint64_t token, const uint8_t* data, size_t len) {
@@ -1829,7 +2005,7 @@ struct SocketMapEntry {
   int channel_refs = 0;
 };
 std::mutex g_socket_map_mu;
-std::unordered_map<std::string, SocketMapEntry> g_socket_map;
+FlatMap<std::string, SocketMapEntry> g_socket_map;
 
 }  // namespace
 
@@ -1883,11 +2059,11 @@ void ClientConnFailed(Socket* s) {
   }
   if (!conn->map_key.empty()) {
     std::lock_guard<std::mutex> lk(g_socket_map_mu);
-    auto it = g_socket_map.find(conn->map_key);
-    if (it != g_socket_map.end() && it->second.conn == conn) {
+    SocketMapEntry* e = g_socket_map.find(conn->map_key);
+    if (e != nullptr && e->conn == conn) {
       // keep the entry (and its channel_refs!) so attached channels'
       // accounting survives reconnects; only the dead conn pointer goes
-      it->second.conn = nullptr;
+      e->conn = nullptr;
     }
   }
   if (conn->pool_owner != nullptr) {
@@ -2234,13 +2410,13 @@ Socket* AcquireSingle(Channel* c, int* rc_out) {
   {
     // another channel (or a previous call) may have a live entry
     std::lock_guard<std::mutex> mlk(g_socket_map_mu);
-    auto it = g_socket_map.find(key);
-    if (it != g_socket_map.end() && it->second.conn != nullptr) {
-      SocketId sid = it->second.conn->sock;
+    SocketMapEntry* me = g_socket_map.find(key);
+    if (me != nullptr && me->conn != nullptr) {
+      SocketId sid = me->conn->sock;
       Socket* s = Socket::Address(sid);
       if (s != nullptr && !s->failed.load(std::memory_order_acquire)) {
         if (!c->map_attached) {
-          it->second.channel_refs++;
+          me->channel_refs++;
           c->map_attached = true;
           c->map_key = key;
         }
@@ -2250,7 +2426,7 @@ Socket* AcquireSingle(Channel* c, int* rc_out) {
       if (s != nullptr) {
         s->Dereference();
       }
-      it->second.conn = nullptr;  // dead conn the on_failed has not reaped
+      me->conn = nullptr;  // dead conn the on_failed has not reaped
     }
   }
   Socket* s = DialConn(c, rc_out);
@@ -2267,7 +2443,11 @@ Socket* AcquireSingle(Channel* c, int* rc_out) {
   Socket* adopted = nullptr;
   {
     std::lock_guard<std::mutex> mlk(g_socket_map_mu);
-    SocketMapEntry& e = g_socket_map[key];  // persists across reconnects
+    SocketMapEntry* ep = g_socket_map.find(key);  // persists across reconnects
+    if (ep == nullptr) {
+      ep = g_socket_map.insert(key, SocketMapEntry());
+    }
+    SocketMapEntry& e = *ep;
     if (e.conn != nullptr) {
       Socket* other = Socket::Address(e.conn->sock);
       if (other != nullptr &&
@@ -2426,13 +2606,13 @@ void channel_destroy(Channel* c) {
     std::lock_guard<std::mutex> lk(c->conn_mu);
     if (c->map_attached) {
       std::lock_guard<std::mutex> mlk(g_socket_map_mu);
-      auto it = g_socket_map.find(c->map_key);
-      if (it != g_socket_map.end() && --it->second.channel_refs <= 0) {
-        if (it->second.conn != nullptr) {
-          single_sid = it->second.conn->sock;
+      SocketMapEntry* de = g_socket_map.find(c->map_key);
+      if (de != nullptr && --de->channel_refs <= 0) {
+        if (de->conn != nullptr) {
+          single_sid = de->conn->sock;
           fail_single = true;
         }
-        g_socket_map.erase(it);  // last channel out removes the entry
+        g_socket_map.erase(c->map_key);  // last channel out removes it
       }
       c->map_attached = false;
     }

@@ -117,6 +117,24 @@ typedef void (*ThriftHandlerCb)(uint64_t token, const uint8_t* blob,
                                 size_t len, void* user);
 void server_set_thrift_handler(Server* s, ThriftHandlerCb cb, void* user);
 int thrift_respond(uint64_t token, const uint8_t* data, size_t len);
+
+// User-registered wire protocols on the shared port (≙ RegisterProtocol,
+// protocol.h:186, giving InputMessenger another Parse/Process pair to
+// try).  Builtins (TRPC/h2/RESP/thrift/HTTP/TLS) sniff first; a user
+// protocol is tried when its magic prefix matches the connection's first
+// bytes.  parse_cb sees the buffered head: return >0 = total frame
+// length, 0 = need more bytes, <0 = corrupt (connection fails).
+// handler_cb gets one whole frame; reply with proto_respond — raw bytes,
+// written in request order (pipelined like RESP/thrift).
+typedef int64_t (*ProtoParseCb)(const uint8_t* data, size_t len,
+                                void* user);
+typedef void (*ProtoHandlerCb)(uint64_t token, const uint8_t* frame,
+                               size_t len, void* user);
+int server_register_protocol(Server* s, const char* name,
+                             const uint8_t* magic, size_t magic_len,
+                             ProtoParseCb parse, ProtoHandlerCb handler,
+                             void* user);
+int proto_respond(uint64_t token, const uint8_t* data, size_t len);
 // Require this credential (meta tag 13) on every TRPC request.
 void server_set_auth(Server* s, const uint8_t* secret, size_t len);
 // TLS on the shared port (PEM cert chain + key; optional client-cert

@@ -11,8 +11,10 @@
 #include <vector>
 
 #include "fiber.h"
+#include "flat_map.h"
 #include "iobuf.h"
 #include "rpc.h"
+#include "snappy.h"
 #include "timer_thread.h"
 
 using namespace trpc;
@@ -253,7 +255,119 @@ static void test_rpc_echo() {
   server_stop(srv);
 }
 
+static void test_flat_map() {
+  FlatMap<std::string, int> m;
+  const int N = 1000;
+  for (int i = 0; i < N; ++i) {
+    m.insert("key-" + std::to_string(i), i);
+  }
+  CHECK_TRUE(m.size() == (size_t)N);
+  for (int i = 0; i < N; ++i) {
+    int* v = m.find("key-" + std::to_string(i));
+    CHECK_TRUE(v != nullptr && *v == i);
+  }
+  CHECK_TRUE(m.find("absent") == nullptr);
+  // overwrite keeps size
+  m.insert("key-0", 42);
+  CHECK_TRUE(m.size() == (size_t)N && *m.find("key-0") == 42);
+  // erase every third key; the rest must stay findable through the
+  // backward-shift compaction
+  for (int i = 0; i < N; i += 3) {
+    CHECK_TRUE(m.erase("key-" + std::to_string(i)));
+  }
+  CHECK_TRUE(!m.erase("key-0"));
+  for (int i = 0; i < N; ++i) {
+    int* v = m.find("key-" + std::to_string(i));
+    if (i % 3 == 0) {
+      CHECK_TRUE(v == nullptr);
+    } else {
+      CHECK_TRUE(v != nullptr && *v == i);
+    }
+  }
+  size_t seen = 0;
+  m.for_each([&](const std::string&, int&) { ++seen; });
+  CHECK_TRUE(seen == m.size());
+  printf("ok flat_map\n");
+}
+
+static void test_snappy_roundtrip() {
+  std::string data;
+  for (int i = 0; i < 50000; ++i) {
+    data += "abcdefgh" + std::to_string(i % 97);
+  }
+  std::vector<uint8_t> out(snappy_max_compressed_length(data.size()));
+  size_t clen = snappy_compress((const uint8_t*)data.data(), data.size(),
+                                out.data());
+  CHECK_TRUE(clen > 0 && clen < data.size());
+  std::vector<uint8_t> back(data.size());
+  size_t dlen = snappy_decompress(out.data(), clen, back.data(),
+                                  back.size());
+  CHECK_TRUE(dlen == data.size());
+  CHECK_TRUE(memcmp(back.data(), data.data(), dlen) == 0);
+  printf("ok snappy_roundtrip\n");
+}
+
+static std::atomic<int> g_fls_dtor_runs{0};
+
+static void test_fiber_local_keys() {
+  fiber_runtime_init(4);
+  uint64_t key;
+  CHECK_TRUE(fiber_key_create(&key, [](void* p) {
+               g_fls_dtor_runs.fetch_add(1);
+               delete (int*)p;
+             }) == 0);
+  // pthread fallback: visible on this plain thread
+  int* main_v = new int(7);
+  CHECK_TRUE(fiber_setspecific(key, main_v) == 0);
+  CHECK_TRUE(fiber_getspecific(key) == main_v);
+  // per-fiber isolation: each fiber sees only its own value
+  const int N = 32;
+  static std::atomic<int> mismatches{0};
+  std::vector<fiber_t> fids(N);
+  struct Arg {
+    uint64_t key;
+    int i;
+  };
+  for (int i = 0; i < N; ++i) {
+    Arg* a = new Arg{key, i};
+    fiber_start(&fids[i], [](void* p) {
+      Arg* a = (Arg*)p;
+      if (fiber_getspecific(a->key) != nullptr) {
+        mismatches.fetch_add(1);  // fresh fiber must start empty
+      }
+      int* v = new int(a->i);
+      fiber_setspecific(a->key, v);
+      fiber_yield();  // migrate/interleave with other fibers
+      int* back = (int*)fiber_getspecific(a->key);
+      if (back != v || *back != a->i) {
+        mismatches.fetch_add(1);
+      }
+      delete a;
+      // value intentionally left set: the exit dtor must reap it
+    }, a);
+  }
+  for (int i = 0; i < N; ++i) {
+    fiber_join(fids[i]);
+  }
+  CHECK_TRUE(mismatches.load() == 0);
+  CHECK_TRUE(g_fls_dtor_runs.load() == N);  // one dtor per exited fiber
+  // delete invalidates the handle and existing values
+  CHECK_TRUE(fiber_key_delete(key) == 0);
+  CHECK_TRUE(fiber_getspecific(key) == nullptr);
+  CHECK_TRUE(fiber_setspecific(key, main_v) == -EINVAL);
+  delete main_v;  // dtor won't run for deleted keys (bthread semantics)
+  // the slot is reusable under a fresh version
+  uint64_t key2;
+  CHECK_TRUE(fiber_key_create(&key2, nullptr) == 0);
+  CHECK_TRUE(fiber_getspecific(key2) == nullptr);
+  fiber_key_delete(key2);
+  printf("ok fiber_local_keys dtors=%d\n", g_fls_dtor_runs.load());
+}
+
 int main() {
+  test_flat_map();
+  test_snappy_roundtrip();
+  test_fiber_local_keys();
   test_iobuf();
   test_fibers_basic();
   test_butex_timeout();

@@ -149,3 +149,64 @@ class TestFiberPython:
         fiber.init()
         names = [n for n, _ in bvar.dump_exposed(lambda n: n.startswith("fiber_"))]
         assert "fiber_context_switches" in names
+
+
+class TestFiberLocal:
+    """Fiber-local storage through the Python surface (≙ bthread_key
+    unittests: isolation per fiber/thread, destructor reaping, delete
+    invalidation)."""
+
+    def test_thread_isolation(self):
+        import threading
+        from brpc_tpu import fiber
+        slot = fiber.FiberLocal()
+        try:
+            slot.set({"who": "main"})
+            seen = {}
+
+            def worker():
+                assert slot.get() is None  # fresh thread: empty
+                slot.set({"who": "worker"})
+                seen["worker"] = slot.get()["who"]
+
+            t = threading.Thread(target=worker)
+            t.start(); t.join()
+            assert seen["worker"] == "worker"
+            assert slot.get()["who"] == "main"  # untouched by the thread
+        finally:
+            slot.close()
+
+    def test_fiber_isolation_and_reap(self):
+        from brpc_tpu import fiber
+        slot = fiber.FiberLocal()
+        try:
+            results = []
+
+            def fib(i):
+                def run():
+                    assert slot.get() is None
+                    slot.set(("fiber", i))
+                    fiber_yielded = slot.get()
+                    results.append(fiber_yielded == ("fiber", i))
+                return run
+
+            fids = [fiber.start(fib(i)) for i in range(8)]
+            for f in fids:
+                fiber.join(f)
+            assert results == [True] * 8
+            # every fiber exited; its value was reaped by the native
+            # destructor so the side table holds nothing
+            assert slot._values == {}
+        finally:
+            slot.close()
+
+    def test_close_invalidates(self):
+        from brpc_tpu import fiber
+        slot = fiber.FiberLocal()
+        slot.set("x")
+        slot.close()
+        slot2 = fiber.FiberLocal()
+        try:
+            assert slot2.get() is None  # reused key space reads empty
+        finally:
+            slot2.close()

@@ -1,0 +1,153 @@
+"""User-registered wire protocols on the shared port (≙ RegisterProtocol,
+protocol.h:186 — brpc letting applications add Parse/Process pairs the
+InputMessenger tries after the builtins).
+
+The test protocol is a tiny length-prefixed format:
+    magic "LP01" + u32 BE body length + body
+Replies use the same framing.
+"""
+
+import socket
+import struct
+import threading
+
+import pytest
+
+from brpc_tpu.rpc.channel import Channel
+from brpc_tpu.rpc.server import Server
+
+MAGIC = b"LP01"
+
+
+def lp_parse(buf: bytes) -> int:
+    if len(buf) < 8:
+        return 0
+    (n,) = struct.unpack_from("!I", buf, 4)
+    if n > 1 << 20:
+        return -1
+    return 8 + n
+
+
+def lp_pack(body: bytes) -> bytes:
+    return MAGIC + struct.pack("!I", len(body)) + body
+
+
+@pytest.fixture
+def lp_server():
+    oneways = []
+    done = threading.Event()
+
+    def process(frame: bytes):
+        body = frame[8:]
+        if body.startswith(b"ONEWAY"):
+            oneways.append(body)
+            done.set()
+            return None
+        return lp_pack(body[::-1])  # reverse-echo
+
+    srv = Server()
+    srv.add_echo_service()
+    srv.register_protocol("lp", MAGIC, lp_parse, process)
+    srv.start("127.0.0.1:0")
+    yield srv, oneways, done
+    srv.destroy()
+
+
+def _recv_frame(s):
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = s.recv(8 - len(hdr))
+        assert chunk, "connection closed"
+        hdr += chunk
+    assert hdr[:4] == MAGIC
+    (n,) = struct.unpack_from("!I", hdr, 4)
+    body = b""
+    while len(body) < n:
+        chunk = s.recv(n - len(body))
+        assert chunk
+        body += chunk
+    return body
+
+
+class TestProtocolRegistry:
+    def test_round_trip(self, lp_server):
+        srv, _, _ = lp_server
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        s.sendall(lp_pack(b"hello"))
+        assert _recv_frame(s) == b"olleh"
+        s.close()
+
+    def test_split_delivery_waits(self, lp_server):
+        # bytes arrive in three pieces — incl. a partial magic — and the
+        # parser must wait, not fail
+        import time
+        srv, _, _ = lp_server
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        full = lp_pack(b"slowly")
+        s.sendall(full[:2])       # half the magic
+        time.sleep(0.05)
+        s.sendall(full[2:9])      # rest of header + 1 body byte
+        time.sleep(0.05)
+        s.sendall(full[9:])
+        assert _recv_frame(s) == b"ylwols"
+        s.close()
+
+    def test_pipelined_in_order(self, lp_server):
+        srv, _, _ = lp_server
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        msgs = [f"msg-{i}".encode() for i in range(20)]
+        s.sendall(b"".join(lp_pack(m) for m in msgs))
+        for m in msgs:
+            assert _recv_frame(s) == m[::-1]
+        s.close()
+
+    def test_oneway_does_not_stall_pipeline(self, lp_server):
+        srv, oneways, done = lp_server
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        s.sendall(lp_pack(b"ONEWAY ping") + lp_pack(b"after"))
+        assert _recv_frame(s) == b"retfa"
+        assert done.wait(5)
+        assert oneways == [b"ONEWAY ping"]
+        s.close()
+
+    def test_builtin_protocols_unaffected(self, lp_server):
+        srv, _, _ = lp_server
+        ch = Channel(f"127.0.0.1:{srv.port}")
+        assert ch.call("Echo.echo", b"still works") == b"still works"
+        ch.close()
+
+    def test_corrupt_frame_fails_connection(self, lp_server):
+        srv, _, _ = lp_server
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        s.sendall(MAGIC + struct.pack("!I", 1 << 24))  # over parse's cap
+        s.settimeout(5)
+        assert s.recv(64) == b""  # server closed
+        s.close()
+
+    def test_register_after_start_rejected(self):
+        srv = Server()
+        srv.add_echo_service()
+        srv.start("127.0.0.1:0")
+        try:
+            with pytest.raises(RuntimeError):
+                srv.register_protocol("x", b"XX", lp_parse, lambda f: None)
+        finally:
+            srv.destroy()
+
+    def test_auth_enabled_server_refuses_user_proto(self):
+        # same policy as thrift: no in-band credential slot, so an
+        # auth-enabled shared port refuses the protocol outright
+        from brpc_tpu.rpc.server import ServerOptions
+        srv = Server(ServerOptions(auth=b"secret"))
+        srv.add_echo_service()
+        srv.register_protocol("lp", MAGIC, lp_parse,
+                              lambda f: lp_pack(b"never"))
+        srv.start("127.0.0.1:0")
+        try:
+            s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+            s.sendall(lp_pack(b"hi"))
+            s.settimeout(5)
+            assert s.recv(64) == b""  # refused, connection closed
+            s.close()
+        finally:
+            srv.destroy()
