@@ -172,6 +172,12 @@ def _declare(L: ctypes.CDLL) -> None:
     L.trpc_ids_dump.argtypes = [c.c_char_p, c.c_size_t]
     L.trpc_ids_dump.restype = c.c_size_t
 
+    # crc32c
+    L.trpc_crc32c_extend.argtypes = [c.c_uint32, c.c_char_p, c.c_size_t]
+    L.trpc_crc32c_extend.restype = c.c_uint32
+    L.trpc_crc32c_hardware.argtypes = []
+    L.trpc_crc32c_hardware.restype = c.c_int
+
     # snappy codec
     L.trpc_snappy_max_compressed_length.argtypes = [c.c_size_t]
     L.trpc_snappy_max_compressed_length.restype = c.c_size_t

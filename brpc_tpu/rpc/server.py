@@ -456,15 +456,28 @@ class Server:
             if rc != 0:
                 reason = (lib().trpc_tls_error() or b"").decode()
                 raise OSError(-rc, f"TLS setup failed: {reason}")
-        ip, _, port = address.rpartition(":")
-        rc = lib().trpc_server_start(self._handle, ip.encode(), int(port))
+        unix_path = None
+        if address.startswith("unix:") or address.startswith("/"):
+            # unix-domain listener (≙ brpc unix-socket EndPoint): the
+            # path travels in the ip argument, port is meaningless
+            unix_path = address[5:] if address.startswith("unix:") \
+                else address
+            if not unix_path:
+                raise ValueError(f"empty unix path in {address!r}")
+            ip, port = unix_path, 0
+        else:
+            ip, _, port = address.rpartition(":")
+            port = int(port)
+        rc = lib().trpc_server_start(self._handle, ip.encode(), port)
         if rc != 0:
             raise OSError(-rc, f"server start failed on {address}")
+        # recorded only on success: destroy() unlinks this path, and a
+        # FAILED bind (EADDRINUSE) must never unlink the live owner's file
+        self._unix_path = unix_path
         self._port = lib().trpc_server_port(self._handle)
         self._started = True
         flags.freeze_nonreloadable()
-        log.LOG(log.LOG_INFO, "Server started on %s:%d", ip or "0.0.0.0",
-                self._port)
+        log.LOG(log.LOG_INFO, "Server started on %s", self.listen_address)
         return self._port
 
     @property
@@ -473,6 +486,9 @@ class Server:
 
     @property
     def listen_address(self) -> str:
+        upath = getattr(self, "_unix_path", None)
+        if upath is not None:
+            return f"unix:{upath}"
         return f"127.0.0.1:{self._port}"
 
     def request_count(self) -> int:
@@ -490,6 +506,13 @@ class Server:
             self.stop()
             lib().trpc_server_destroy(self._handle)
             self._handle = None
+            upath = getattr(self, "_unix_path", None)
+            if upath is not None:
+                import os as _os
+                try:
+                    _os.unlink(upath)
+                except OSError:
+                    pass
         self._dump.close()
         for st in self._method_status.values():
             st.close()

@@ -12,6 +12,7 @@
 #include "iobuf.h"
 #include "metrics.h"
 #include "profiler.h"
+#include "crc32c.h"
 #include "rpc.h"
 #include "snappy.h"
 #include "socket.h"
@@ -267,6 +268,12 @@ size_t trpc_ids_dump(char* buf, size_t cap) {
 }
 
 // --- snappy codec -----------------------------------------------------------
+
+uint32_t trpc_crc32c_extend(uint32_t init, const uint8_t* data, size_t n) {
+  return crc32c_extend(init, data, n);
+}
+
+int trpc_crc32c_hardware() { return crc32c_hardware() ? 1 : 0; }
 
 size_t trpc_snappy_max_compressed_length(size_t n) {
   return snappy_max_compressed_length(n);

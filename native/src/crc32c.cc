@@ -1,0 +1,84 @@
+// crc32c.cc — see crc32c.h.
+#include "crc32c.h"
+
+#if defined(__x86_64__)
+#include <cpuid.h>
+#include <nmmintrin.h>
+#endif
+
+namespace trpc {
+
+namespace {
+
+// software fallback: standard reflected table, generated once
+struct Table {
+  uint32_t t[256];
+  Table() {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k) {
+        c = (c & 1) ? (0x82F63B78u ^ (c >> 1)) : (c >> 1);
+      }
+      t[i] = c;
+    }
+  }
+};
+
+uint32_t SoftExtend(uint32_t crc, const uint8_t* p, size_t n) {
+  static Table table;
+  crc = ~crc;
+  for (size_t i = 0; i < n; ++i) {
+    crc = table.t[(crc ^ p[i]) & 0xff] ^ (crc >> 8);
+  }
+  return ~crc;
+}
+
+#if defined(__x86_64__)
+bool DetectSse42() {
+  unsigned eax, ebx, ecx = 0, edx;
+  if (!__get_cpuid(1, &eax, &ebx, &ecx, &edx)) {
+    return false;
+  }
+  return (ecx & (1u << 20)) != 0;  // SSE4.2
+}
+
+__attribute__((target("sse4.2")))
+uint32_t HwExtend(uint32_t crc, const uint8_t* p, size_t n) {
+  crc = ~crc;
+  while (n >= 8) {
+    uint64_t v;
+    __builtin_memcpy(&v, p, 8);
+    crc = (uint32_t)_mm_crc32_u64(crc, v);
+    p += 8;
+    n -= 8;
+  }
+  while (n > 0) {
+    crc = _mm_crc32_u8(crc, *p);
+    ++p;
+    --n;
+  }
+  return ~crc;
+}
+#endif
+
+}  // namespace
+
+bool crc32c_hardware() {
+#if defined(__x86_64__)
+  static const bool hw = DetectSse42();
+  return hw;
+#else
+  return false;
+#endif
+}
+
+uint32_t crc32c_extend(uint32_t init, const uint8_t* data, size_t n) {
+#if defined(__x86_64__)
+  if (crc32c_hardware()) {
+    return HwExtend(init, data, n);
+  }
+#endif
+  return SoftExtend(init, data, n);
+}
+
+}  // namespace trpc

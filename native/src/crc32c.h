@@ -1,0 +1,25 @@
+// crc32c.h — CRC-32C (Castagnoli, poly 0x1EDC6F41 reflected 0x82F63B78),
+// the checksum butil carries for data integrity (≙ butil/crc32c.{h,cc}:
+// hardware SSE4.2 path + sliced software fallback).  Used for
+// content-addressable integrity of attachments/dumps; matches the
+// widely-deployed iSCSI/ext4 polynomial so values interoperate.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+namespace trpc {
+
+// Extend `init` (0 for a fresh checksum) over data.  Returns the running
+// crc; NOT pre/post-inverted between calls — pass the returned value back
+// to continue streaming.
+uint32_t crc32c_extend(uint32_t init, const uint8_t* data, size_t n);
+
+inline uint32_t crc32c(const uint8_t* data, size_t n) {
+  return crc32c_extend(0, data, n);
+}
+
+// True when the SSE4.2 hardware instruction is in use.
+bool crc32c_hardware();
+
+}  // namespace trpc

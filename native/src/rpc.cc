@@ -10,6 +10,8 @@
 #include <poll.h>
 #include <string.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/un.h>
 #include <unistd.h>
 
 #include <algorithm>
@@ -1540,6 +1542,70 @@ size_t server_conn_stats(Server* s, char* buf, size_t cap) {
 
 int server_start(Server* s, const char* ip, int port) {
   fiber_runtime_init(0);
+  // a leading '/' (or unix: prefix) makes the address a unix-domain
+  // socket path (≙ brpc listening on unix sockets via butil::EndPoint
+  // unix support; §5.8 comm-backend breadth: loopback RPC without the
+  // TCP stack)
+  const char* upath = nullptr;
+  if (ip != nullptr) {
+    if (strncmp(ip, "unix:", 5) == 0) {
+      upath = ip + 5;
+    } else if (ip[0] == '/') {
+      upath = ip;
+    }
+  }
+  if (upath != nullptr) {
+    int fd =
+        ::socket(AF_UNIX, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
+    if (fd < 0) {
+      return -errno;
+    }
+    sockaddr_un ua;
+    memset(&ua, 0, sizeof(ua));
+    ua.sun_family = AF_UNIX;
+    if (strlen(upath) >= sizeof(ua.sun_path)) {
+      ::close(fd);
+      return -ENAMETOOLONG;
+    }
+    strncpy(ua.sun_path, upath, sizeof(ua.sun_path) - 1);
+    // a leftover file from a crashed process is replaced, but a LIVE
+    // listener must get EADDRINUSE (as TCP would) — probe with a
+    // connect: refused/absent = stale, success = someone is serving
+    struct stat st;
+    if (::stat(upath, &st) == 0) {
+      int probe =
+          ::socket(AF_UNIX, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
+      if (probe >= 0) {
+        int crc = ::connect(probe, (sockaddr*)&ua, sizeof(ua));
+        int cerr = errno;
+        ::close(probe);
+        if (crc == 0 || (crc != 0 && cerr == EAGAIN)) {
+          ::close(fd);
+          return -EADDRINUSE;
+        }
+      }
+      ::unlink(upath);  // stale socket file from a previous run
+    }
+    if (bind(fd, (sockaddr*)&ua, sizeof(ua)) != 0 ||
+        listen(fd, 1024) != 0) {
+      int e = errno;
+      ::close(fd);
+      return -e;
+    }
+    s->port = 0;
+    s->listen_fd = fd;
+    SocketOptions opts;
+    opts.fd = fd;
+    opts.edge_fn = OnNewConnections;
+    opts.user = s;
+    if (Socket::Create(opts, &s->listen_sock) != 0) {
+      ::close(fd);
+      return -ENOMEM;
+    }
+    EventDispatcher::Instance().AddConsumer(s->listen_sock, fd);
+    s->running.store(true);
+    return 0;
+  }
   int fd = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
   if (fd < 0) {
     return -errno;
@@ -2275,20 +2341,46 @@ void HttpClientOnMessages(Socket* s) {
 // addressed (ref-held) socket whose user is a new ClientConn, or nullptr
 // (rc_out set).  The ClientConn is freed by Socket::TryRecycle.
 Socket* DialConn(Channel* c, int* rc_out) {
-  int fd = ::socket(AF_INET, SOCK_STREAM | SOCK_CLOEXEC, 0);
+  // unix-domain target: ip carries the path (see server_start)
+  const char* upath = nullptr;
+  if (strncmp(c->ip.c_str(), "unix:", 5) == 0) {
+    upath = c->ip.c_str() + 5;
+  } else if (!c->ip.empty() && c->ip[0] == '/') {
+    upath = c->ip.c_str();
+  }
+  int fd = ::socket(upath != nullptr ? AF_UNIX : AF_INET,
+                    SOCK_STREAM | SOCK_CLOEXEC, 0);
   if (fd < 0) {
     *rc_out = -errno;
     return nullptr;
   }
+  sockaddr_un uaddr;
   sockaddr_in addr;
-  memset(&addr, 0, sizeof(addr));
-  addr.sin_family = AF_INET;
-  addr.sin_port = htons((uint16_t)c->port);
-  addr.sin_addr.s_addr = inet_addr(c->ip.c_str());
+  sockaddr* sa;
+  socklen_t salen;
+  if (upath != nullptr) {
+    memset(&uaddr, 0, sizeof(uaddr));
+    uaddr.sun_family = AF_UNIX;
+    if (strlen(upath) >= sizeof(uaddr.sun_path)) {
+      *rc_out = -ENAMETOOLONG;
+      ::close(fd);
+      return nullptr;
+    }
+    strncpy(uaddr.sun_path, upath, sizeof(uaddr.sun_path) - 1);
+    sa = (sockaddr*)&uaddr;
+    salen = sizeof(uaddr);
+  } else {
+    memset(&addr, 0, sizeof(addr));
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons((uint16_t)c->port);
+    addr.sin_addr.s_addr = inet_addr(c->ip.c_str());
+    sa = (sockaddr*)&addr;
+    salen = sizeof(addr);
+  }
   // non-blocking connect with a deadline (ChannelOptions.connect_timeout_ms)
   int fl = fcntl(fd, F_GETFL, 0);
   fcntl(fd, F_SETFL, fl | O_NONBLOCK);
-  if (connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+  if (connect(fd, sa, salen) != 0) {
     if (errno != EINPROGRESS) {
       *rc_out = -errno;
       ::close(fd);
@@ -2845,9 +2937,14 @@ int http_client_call(Channel* c, const char* method, const char* target,
   p->is_head = strcmp(method, "HEAD") == 0;
   p->chunk_cb = chunk_cb;
   p->chunk_user = chunk_user;
-  std::string host = c->host_header.empty()
-                         ? c->ip + ":" + std::to_string(c->port)
-                         : c->host_header;
+  // unix-socket targets get "localhost" (a path is not a valid Host
+  // value; matches curl/Docker-SDK convention for unix transports)
+  bool is_unix = !c->ip.empty() &&
+                 (c->ip[0] == '/' || strncmp(c->ip.c_str(), "unix:", 5) == 0);
+  std::string host = !c->host_header.empty()
+                         ? c->host_header
+                         : (is_unix ? std::string("localhost")
+                                    : c->ip + ":" + std::to_string(c->port));
   IOBuf frame;
   PackHttpRequest(&frame, method, target, host.c_str(), headers_blob, body,
                   body_len);
