@@ -156,6 +156,15 @@ def _declare(L: ctypes.CDLL) -> None:
     L.trpc_proto_respond.argtypes = [c.c_uint64, c.c_char_p, c.c_size_t]
     L.trpc_proto_respond.restype = c.c_int
 
+    # progressive (chunked) HTTP responses
+    L.trpc_http_respond_progressive.argtypes = [c.c_uint64, c.c_int,
+                                                c.c_char_p]
+    L.trpc_http_respond_progressive.restype = c.c_uint64
+    L.trpc_pa_write.argtypes = [c.c_uint64, c.c_char_p, c.c_size_t]
+    L.trpc_pa_write.restype = c.c_int
+    L.trpc_pa_close.argtypes = [c.c_uint64]
+    L.trpc_pa_close.restype = c.c_int
+
     # auth
     L.trpc_server_set_auth.argtypes = [c.c_void_p, c.c_char_p, c.c_size_t]
     L.trpc_server_set_auth.restype = None

@@ -58,6 +58,57 @@ class HttpResponse:
         return HttpResponse(status, {"Content-Type": "application/json"},
                             json.dumps(obj, indent=1).encode())
 
+    @staticmethod
+    def progressive(status: int = 200,
+                    headers: Optional[Dict[str, str]] = None
+                    ) -> "ProgressiveAttachment":
+        """Chunked streaming response (≙ ProgressiveAttachment,
+        progressive_attachment.h:32): return this from a handler, keep a
+        reference, and write()/close() from any thread — even long after
+        the handler returned (infinite responses)."""
+        return ProgressiveAttachment(status, dict(headers or {}))
+
+
+class ProgressiveAttachment:
+    """Server half of a chunked stream.  The HTTP dispatch layer binds it
+    to the native PaState right after the handler returns; write() blocks
+    until then, so background writer threads can start immediately."""
+
+    def __init__(self, status: int, headers: Dict[str, str]):
+        import threading as _t
+        self.status = status
+        self.headers = headers
+        self._handle = None
+        self._bound = _t.Event()
+        self._closed = False
+
+    def _bind(self, handle: int) -> None:
+        self._handle = handle  # 0 = native setup failed; write() raises
+        self._bound.set()
+
+    def write(self, data: bytes) -> None:
+        """One chunk onto the wire.  Raises BrokenPipeError once the
+        peer is gone, so infinite writers terminate."""
+        if not self._bound.wait(timeout=30):
+            raise RuntimeError("progressive response never bound")
+        if self._closed or not self._handle:
+            raise BrokenPipeError("progressive response closed")
+        from brpc_tpu._native import lib
+        rc = lib().trpc_pa_write(self._handle, data, len(data))
+        if rc != 0:
+            self._closed = True
+            raise BrokenPipeError(f"chunk write failed ({rc})")
+
+    def close(self) -> None:
+        """Final chunk; the connection closes after it flushes."""
+        if not self._bound.wait(timeout=30):
+            return
+        if self._closed or not self._handle:
+            return
+        self._closed = True
+        from brpc_tpu._native import lib
+        lib().trpc_pa_close(self._handle)
+
 
 # A handler returns HttpResponse | str (text/plain) | bytes (octet-stream) |
 # dict/list (JSON).
@@ -66,7 +117,7 @@ HttpHandler = Callable[[HttpRequest], Union[HttpResponse, str, bytes, dict,
 
 
 def _coerce(out) -> HttpResponse:
-    if isinstance(out, HttpResponse):
+    if isinstance(out, (HttpResponse, ProgressiveAttachment)):
         return out
     if isinstance(out, str):
         return HttpResponse.text(out)
@@ -141,7 +192,19 @@ class HttpDispatcher:
         cntl.method = method
         is_json = "json" in req.headers.get("content-type", "")
         body = req.body
-        if is_json and body:
+        # pb-typed methods transcode JSON⇄message (≙ json2pb giving pb
+        # services an HTTP+JSON face); raw/proto bodies pass through.
+        # The invoke/error path below is shared; only the body decode
+        # here and the response encode at the end differ.
+        pb_spec = getattr(self._server, "_pb_specs", {}).get(method)
+        if pb_spec is not None and is_json:
+            from brpc_tpu.rpc.pb_service import json_to_pb
+            try:
+                body = json_to_pb(body or b"{}",
+                                  pb_spec[0]).SerializeToString()
+            except Exception as e:
+                return HttpResponse.text(f"bad JSON request: {e}\n", 400)
+        elif is_json and body:
             # JSON envelope: {"payload": "...", ...} or raw string body
             try:
                 obj = json.loads(body)
@@ -163,6 +226,16 @@ class HttpDispatcher:
         if cntl.failed():
             return HttpResponse.json({"error_code": cntl.error_code,
                                       "error_text": cntl.error_text}, 500)
+        if pb_spec is not None:
+            if is_json:
+                from brpc_tpu.rpc.pb_service import pb_to_json
+                msg = pb_spec[1]()
+                msg.ParseFromString(resp)
+                return HttpResponse(200,
+                                    {"Content-Type": "application/json"},
+                                    pb_to_json(msg))
+            return HttpResponse(200, {"Content-Type": "application/proto"},
+                                resp)
         if is_json:
             return HttpResponse.json(
                 {"payload": resp.decode("utf-8", "replace")})

@@ -1,0 +1,92 @@
+"""Protobuf-typed services + JSON⇄pb transcoding (≙ json2pb,
+SURVEY.md §2.5: json_to_pb.cpp / pb_to_json.cpp powering HTTP+JSON
+access to pb services through http_rpc_protocol.cpp).
+
+A pb service registers methods with their request/response message
+classes.  Three access paths share one handler:
+
+  * TRPC:       payload is the serialized request message; the response
+                payload is the serialized response message.
+  * HTTP JSON:  POST /rpc/<Service>.<Method> with a JSON body — fields
+                transcode through google.protobuf.json_format exactly
+                like the reference's rapidjson bridge.
+  * HTTP pb:    POST with content-type application/proto(buf) passes
+                serialized bytes straight through.
+
+Handlers: handler(cntl, request_msg) -> response_msg.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple, Type
+
+from google.protobuf import json_format
+from google.protobuf.message import Message
+
+__all__ = ["add_pb_service", "json_to_pb", "pb_to_json"]
+
+
+def json_to_pb(data: bytes, msg_cls: Type[Message],
+               ignore_unknown_fields: bool = False) -> Message:
+    """JSON bytes -> message (≙ json_to_pb.cpp JsonToProtoMessage)."""
+    msg = msg_cls()
+    json_format.Parse(data.decode("utf-8"), msg,
+                      ignore_unknown_fields=ignore_unknown_fields)
+    return msg
+
+
+def pb_to_json(msg: Message, always_print_fields_with_no_presence=False
+               ) -> bytes:
+    """Message -> JSON bytes (≙ pb_to_json.cpp ProtoMessageToJson)."""
+    return json_format.MessageToJson(
+        msg,
+        always_print_fields_with_no_presence=(
+            always_print_fields_with_no_presence),
+        preserving_proto_field_name=True).encode("utf-8")
+
+
+def add_pb_service(server, service_name: str,
+                   methods: Dict[str, Tuple]) -> None:
+    """Register a pb-typed service on `server`.
+
+    methods: {method_name: (handler, RequestCls, ResponseCls)} with
+    handler(cntl, request_msg) -> response_msg.  Each method serves as
+    TRPC "<Service>.<Method>" and via the /rpc JSON bridge; the bridge
+    learns the message types through server._pb_specs.
+    """
+    specs = getattr(server, "_pb_specs", None)
+    if specs is None:
+        specs = server._pb_specs = {}
+
+    for method, (handler, req_cls, resp_cls) in methods.items():
+        full = f"{service_name}.{method}"
+        if not (isinstance(req_cls, type) and
+                issubclass(req_cls, Message) and
+                isinstance(resp_cls, type) and
+                issubclass(resp_cls, Message)):
+            raise TypeError(f"{full}: request/response must be pb classes")
+        specs[full] = (req_cls, resp_cls)
+
+        def wire_handler(cntl, payload, _h=handler, _rq=req_cls,
+                         _rs=resp_cls, _full=full):
+            req = _rq()
+            req.ParseFromString(payload)
+            resp = _h(cntl, req)
+            if not isinstance(resp, _rs):
+                raise TypeError(
+                    f"{_full} handler returned {type(resp).__name__}, "
+                    f"expected {_rs.__name__}")
+            return resp.SerializeToString()
+
+        server.add_service(full, wire_handler)
+
+
+def pb_call(channel, method: str, request: Message,
+            resp_cls: Type[Message], **kwargs) -> Message:
+    """Typed client call: serialize request, call over the channel,
+    parse the response (≙ a generated stub's CallMethod through
+    Channel)."""
+    raw = channel.call(method, request.SerializeToString(), **kwargs)
+    resp = resp_cls()
+    resp.ParseFromString(raw)
+    return resp

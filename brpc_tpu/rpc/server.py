@@ -271,6 +271,15 @@ class Server:
         if rc != 0:
             raise RuntimeError(f"register_protocol failed ({rc})")
 
+    def add_pb_service(self, service_name: str, methods) -> None:
+        """Protobuf-typed service (≙ a pb Service on a brpc server, with
+        json2pb HTTP+JSON access): methods = {name: (handler, ReqCls,
+        RespCls)}, handler(cntl, req_msg) -> resp_msg.  Callable via TRPC
+        ("<Service>.<name>", pb payloads, see pb_service.pb_call) and
+        POST /rpc/<Service>.<name> with a JSON body."""
+        from brpc_tpu.rpc.pb_service import add_pb_service
+        add_pb_service(self, service_name, methods)
+
     def add_grpc_service(self, service_name: str, methods) -> None:
         """Serve gRPC methods at /<service_name>/<Method> — real gRPC
         clients dial the same port (h2 + gRPC framing handled natively +
@@ -404,6 +413,23 @@ class Server:
                                             b"unauthorized\n", 13)
                         return
                 resp = dispatcher.dispatch(req)
+                from brpc_tpu.rpc.http import ProgressiveAttachment
+                if isinstance(resp, ProgressiveAttachment):
+                    # chunked stream: headers go out now (sequenced), the
+                    # handler's writer keeps the pa and streams chunks
+                    handle = L.trpc_http_respond_progressive(
+                        token, resp.status, pack_headers(resp.headers))
+                    resp._bind(int(handle))
+                    if not handle:
+                        # h2 request or dead connection: the client must
+                        # still get an answer, not a hung stream
+                        log.LOG(log.LOG_ERROR,
+                                "progressive respond failed (h2 or dead "
+                                "conn), %s", req.path)
+                        msg = b"progressive responses require HTTP/1.1\n"
+                        L.trpc_http_respond(token, 505, None, msg,
+                                            len(msg))
+                    return
                 body = b"" if req.method == "HEAD" else resp.body
                 if resp.trailers:
                     L.trpc_http_respond_trailers(
@@ -477,7 +503,13 @@ class Server:
         self._port = lib().trpc_server_port(self._handle)
         self._started = True
         flags.freeze_nonreloadable()
-        log.LOG(log.LOG_INFO, "Server started on %s", self.listen_address)
+        if unix_path is not None:
+            log.LOG(log.LOG_INFO, "Server started on unix:%s", unix_path)
+        else:
+            # log the REAL bind address (0.0.0.0 vs loopback matters when
+            # diagnosing reachability); listen_address stays dialable
+            log.LOG(log.LOG_INFO, "Server started on %s:%d",
+                    ip or "0.0.0.0", self._port)
         return self._port
 
     @property

@@ -1,0 +1,53 @@
+"""Protobuf-typed service: pb over TRPC + JSON over HTTP, one handler
+(≙ example/echo_c++'s pb EchoService + json2pb HTTP access)."""
+import _bootstrap  # noqa: F401
+
+import json
+import urllib.request
+
+from google.protobuf import proto_builder
+from google.protobuf.descriptor_pb2 import FieldDescriptorProto as F
+
+from brpc_tpu.rpc.channel import Channel
+from brpc_tpu.rpc.pb_service import pb_call
+from brpc_tpu.rpc.server import Server
+
+EchoRequest = proto_builder.MakeSimpleProtoClass(
+    {"message": F.TYPE_STRING}, full_name="example.EchoRequest")
+EchoResponse = proto_builder.MakeSimpleProtoClass(
+    {"message": F.TYPE_STRING, "length": F.TYPE_INT32},
+    full_name="example.EchoResponse")
+
+
+def main():
+    def echo(cntl, req):
+        resp = EchoResponse()
+        resp.message = req.message
+        resp.length = len(req.message)
+        return resp
+
+    server = Server()
+    server.add_pb_service("EchoService",
+                          {"Echo": (echo, EchoRequest, EchoResponse)})
+    port = server.start("127.0.0.1:0")
+
+    # typed pb call over TRPC
+    ch = Channel(f"127.0.0.1:{port}")
+    req = EchoRequest()
+    req.message = "hello pb"
+    resp = pb_call(ch, "EchoService.Echo", req, EchoResponse)
+    print("pb over TRPC  ->", resp.message, f"(length={resp.length})")
+    ch.close()
+
+    # the same method over HTTP with a JSON body (json2pb transcoding)
+    hreq = urllib.request.Request(
+        f"http://127.0.0.1:{port}/rpc/EchoService.Echo",
+        data=json.dumps({"message": "hello json"}).encode(),
+        headers={"Content-Type": "application/json"})
+    print("json over HTTP->",
+          json.load(urllib.request.urlopen(hreq, timeout=5)))
+    server.destroy()
+
+
+if __name__ == "__main__":
+    main()

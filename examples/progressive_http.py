@@ -1,0 +1,44 @@
+"""Progressive (chunked) HTTP response — server keeps writing after the
+handler returns (≙ ProgressiveAttachment, progressive_attachment.h:32 +
+example/http's streaming mode), read by the framework's own progressive
+client (≙ ProgressiveReader)."""
+import _bootstrap  # noqa: F401
+
+import threading
+import time
+
+from brpc_tpu.rpc.http import HttpResponse
+from brpc_tpu.rpc.http_client import HttpChannel
+from brpc_tpu.rpc.server import Server
+
+
+def main():
+    def stream(req):
+        pa = HttpResponse.progressive(
+            200, {"Content-Type": "text/event-stream"})
+
+        def writer():
+            try:
+                for i in range(4):
+                    pa.write(f"data: chunk {i}\n\n".encode())
+                    time.sleep(0.05)
+            finally:
+                pa.close()
+
+        threading.Thread(target=writer, daemon=True).start()
+        return pa  # handler is done; the writer streams on
+
+    server = Server()
+    server.register_http("/events", stream)
+    port = server.start("127.0.0.1:0")
+
+    c = HttpChannel(f"127.0.0.1:{port}")
+    resp = c.request("GET", "/events",
+                     stream=lambda b: print("<-", b.decode().strip()))
+    print("status:", resp.status)
+    c.close()
+    server.destroy()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,45 @@
+"""Framed-thrift service + client on the shared port (≙
+example/thrift_extension): the same listener speaks TRPC and thrift
+side by side."""
+import _bootstrap  # noqa: F401
+
+from brpc_tpu.rpc import thrift as t
+from brpc_tpu.rpc.channel import Channel
+from brpc_tpu.rpc.server import Server
+
+ECHO_ARGS = (t.TType.STRUCT, {1: ("message", t.TType.STRING)})
+
+
+def main():
+    svc = t.ThriftService()
+    svc.register("Echo", lambda a: a["message"],
+                 args_spec=ECHO_ARGS, result_spec=t.TType.STRING)
+
+    def fails(_a):
+        raise t.TApplicationException(
+            t.TApplicationException.INTERNAL_ERROR, "as requested")
+    svc.register("Fail", fails, args_spec=None, result_spec=t.TType.I32)
+
+    server = Server()
+    server.add_echo_service()
+    server.add_thrift_service(svc)
+    port = server.start("127.0.0.1:0")
+
+    c = t.ThriftClient("127.0.0.1", port)
+    print("thrift Echo ->", c.call("Echo", {"message": "hello thrift"},
+                                   ECHO_ARGS, result_spec=t.TType.STRING))
+    try:
+        c.call("Fail", {}, None, result_spec=t.TType.I32)
+    except t.TApplicationException as e:
+        print("thrift Fail ->", f"TApplicationException({e.message})")
+
+    # TRPC lives on the very same port
+    ch = Channel(f"127.0.0.1:{port}")
+    print("TRPC Echo   ->", ch.call("Echo.echo", b"same port"))
+    ch.close()
+    c.close()
+    server.destroy()
+
+
+if __name__ == "__main__":
+    main()

@@ -6,7 +6,10 @@ cd "$(dirname "$0")"
 mkdir -p build
 cmake -S . -B build -G Ninja -DCMAKE_BUILD_TYPE=Release >/dev/null
 ninja -C build
-cp build/libbrpc_tpu_core.so ../brpc_tpu/_native/
+# atomic install: running processes keep their mapped copy (an in-place
+# cp would rewrite the inode under them and crash mid-run test suites)
+cp build/libbrpc_tpu_core.so ../brpc_tpu/_native/.libbrpc_tpu_core.so.tmp
+mv ../brpc_tpu/_native/.libbrpc_tpu_core.so.tmp ../brpc_tpu/_native/libbrpc_tpu_core.so
 if [[ "${1:-}" == "--test" ]]; then
   ./build/test_core
 fi

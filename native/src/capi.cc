@@ -226,6 +226,19 @@ int trpc_proto_respond(uint64_t token, const uint8_t* data, size_t len) {
   return proto_respond(token, data, len);
 }
 
+// --- progressive (chunked) HTTP responses -----------------------------------
+
+uint64_t trpc_http_respond_progressive(uint64_t token, int status,
+                                       const char* headers_blob) {
+  return http_respond_progressive(token, status, headers_blob);
+}
+
+int trpc_pa_write(uint64_t pa, const uint8_t* data, size_t len) {
+  return pa_write(pa, data, len);
+}
+
+int trpc_pa_close(uint64_t pa) { return pa_close(pa); }
+
 // --- auth ------------------------------------------------------------------
 
 void trpc_server_set_auth(void* s, const uint8_t* secret, size_t len) {

@@ -464,6 +464,9 @@ namespace {
 // (≙ the reference processing pipelined requests in parallel,
 // policy/http_rpc_protocol.cpp) while responses are written strictly in
 // request order through the sequencer below.
+void PaOnHeadersSent(uint64_t pa_token);  // defined with PaState below
+void PaAbort(uint64_t pa_token);         // idem — dead conn, wake writers
+
 struct ConnState {
   HttpParseState http;  // chunked-body resume state
   std::mutex mu;
@@ -475,6 +478,11 @@ struct ConnState {
   struct Ready {
     IOBuf data;
     bool close_after = false;
+    // nonzero: this entry opens a progressive (chunked) response — after
+    // its headers reach the wire the connection belongs to the
+    // ProgressiveAttachment (pa_token identifies it; the drain signals
+    // its butex and stops serving later pipelined responses)
+    uint64_t pa_token = 0;
   };
   std::unordered_map<uint64_t, Ready> ready;  // out-of-order completions
   // one releaser at a time owns the drain (KeepWrite-style ownership):
@@ -487,6 +495,14 @@ struct ConnState {
     if (!ready.empty()) {
       native_metrics().sequencer_parked.fetch_sub(
           (int64_t)ready.size(), std::memory_order_relaxed);
+      for (auto& kv : ready) {
+        if (kv.second.pa_token != 0) {
+          // a progressive response died parked: its writer threads are
+          // blocked on headers_sent — wake them into failure, or they
+          // spin forever and the PaState slot leaks
+          PaAbort(kv.second.pa_token);
+        }
+      }
     }
   }
 };
@@ -509,19 +525,23 @@ void CloseAfterWrite(Socket* s, IOBuf&& resp);  // defined near http_respond
 // under the sequencer lock would serialize concurrent handler
 // completions on this connection), re-checking under the lock between
 // batches so order still follows request sequence exactly.
-void ReleaseSequenced(Socket* s, uint64_t seq, IOBuf&& data,
-                      bool close_after) {
+void ReleaseSequencedEntry(Socket* s, uint64_t seq,
+                           ConnState::Ready&& entry) {
   ConnState* cs = (ConnState*)s->parse_state;
   NativeMetrics& nm = native_metrics();
   bool rearm = false;
   std::unique_lock<std::mutex> lk(cs->mu);
   if (cs->closing) {
-    return;  // connection is winding down; drop queued responses
+    // connection is winding down; drop queued responses — but a dropped
+    // progressive open must still release its writers
+    if (entry.pa_token != 0) {
+      PaAbort(entry.pa_token);
+    }
+    return;
   }
   {
     ConnState::Ready& r = cs->ready[seq];
-    r.data = std::move(data);
-    r.close_after = close_after;
+    r = std::move(entry);
     nm.sequencer_parked.fetch_add(1, std::memory_order_relaxed);
   }
   if (cs->writer_active) {
@@ -539,7 +559,9 @@ void ReleaseSequenced(Socket* s, uint64_t seq, IOBuf&& data,
       }
       ++cs->next_release;
       nm.sequencer_parked.fetch_sub(1, std::memory_order_relaxed);
-      closing = it->second.close_after;
+      // a progressive entry hands the connection to its attachment: no
+      // later pipelined response may follow on this socket
+      closing = it->second.close_after || it->second.pa_token != 0;
       batch.push_back(std::move(it->second));
       cs->ready.erase(it);
       if (closing) {
@@ -553,7 +575,10 @@ void ReleaseSequenced(Socket* s, uint64_t seq, IOBuf&& data,
     }
     lk.unlock();
     for (ConnState::Ready& r : batch) {
-      if (r.close_after) {
+      if (r.pa_token != 0) {
+        s->Write(std::move(r.data));
+        PaOnHeadersSent(r.pa_token);
+      } else if (r.close_after) {
         CloseAfterWrite(s, std::move(r.data));
       } else {
         s->Write(std::move(r.data));
@@ -574,6 +599,14 @@ void ReleaseSequenced(Socket* s, uint64_t seq, IOBuf&& data,
   if (rearm) {
     Socket::StartInputEvent(s->id());
   }
+}
+
+void ReleaseSequenced(Socket* s, uint64_t seq, IOBuf&& data,
+                      bool close_after) {
+  ConnState::Ready r;
+  r.data = std::move(data);
+  r.close_after = close_after;
+  ReleaseSequencedEntry(s, seq, std::move(r));
 }
 
 // Server's device-plane caps word for handshake responses (tag 14).
@@ -1841,6 +1874,186 @@ int http_respond(uint64_t token, int status, const char* headers_blob,
                  const uint8_t* body, size_t body_len) {
   return http_respond2(token, status, headers_blob, body, body_len,
                        nullptr);
+}
+
+// ---------------------------------------------------------------------------
+// ProgressiveAttachment (≙ progressive_attachment.h:32): the server keeps
+// writing chunks after the response headers.  HTTP/1.1 wire form:
+// Transfer-Encoding: chunked with Connection: close — once a response
+// goes progressive the connection belongs to it (the sequencer stops
+// serving later pipelined responses; see ReleaseSequencedEntry).
+
+namespace {
+
+struct PaState {
+  SocketId sock = INVALID_SOCKET_ID;
+  Butex* headers_sent = nullptr;  // 0 -> 1 when headers hit the wire
+  std::atomic<bool> closed{false};
+  uint32_t slot = 0;
+  std::atomic<uint32_t> version{1};
+
+  uint64_t token() const {
+    return ((uint64_t)version.load(std::memory_order_relaxed) << 32) | slot;
+  }
+};
+
+PaState* PaAddress(uint64_t token) {
+  PaState* pa = ResourcePool<PaState>::Address((uint32_t)token);
+  if (pa == nullptr ||
+      pa->version.load(std::memory_order_acquire) != (uint32_t)(token >> 32)) {
+    return nullptr;
+  }
+  return pa;
+}
+
+void PackChunk(IOBuf* out, const uint8_t* data, size_t len) {
+  char hdr[20];
+  int n = snprintf(hdr, sizeof(hdr), "%zx\r\n", len);
+  out->append(hdr, (size_t)n);
+  out->append(data, len);
+  out->append("\r\n", 2);
+}
+
+}  // namespace
+
+namespace {
+void PaOnHeadersSent(uint64_t pa_token) {
+  PaState* pa = PaAddress(pa_token);
+  if (pa == nullptr) {
+    return;
+  }
+  butex_value(pa->headers_sent).store(1, std::memory_order_release);
+  butex_wake_all(pa->headers_sent);
+}
+
+void PaAbort(uint64_t pa_token) {
+  PaState* pa = PaAddress(pa_token);
+  if (pa == nullptr) {
+    return;
+  }
+  bool already_closed = pa->closed.exchange(true);
+  // -1 releases any writer parked on headers_sent even when pa_close
+  // won the exchange and is itself waiting for the headers
+  butex_value(pa->headers_sent).store(-1, std::memory_order_release);
+  butex_wake_all(pa->headers_sent);
+  if (!already_closed) {
+    pa->version.fetch_add(1, std::memory_order_release);
+    ResourcePool<PaState>::Return(pa->slot);
+  }
+}
+}  // namespace
+
+uint64_t http_respond_progressive(uint64_t token, int status,
+                                  const char* headers_blob) {
+  uint32_t slot = (uint32_t)token;
+  uint32_t ver = (uint32_t)(token >> 32);
+  CallCtx* ctx = ResourcePool<CallCtx>::Address(slot);
+  if (ctx == nullptr || !ctx->is_http ||
+      ctx->version.load(std::memory_order_acquire) != ver) {
+    return 0;
+  }
+  if (ctx->h2_stream != 0) {
+    return 0;  // h1-only for now (h2 would use open DATA streams)
+  }
+  PaState* pa = nullptr;
+  uint32_t pa_slot = ResourcePool<PaState>::Get(&pa);
+  pa->slot = pa_slot;
+  pa->sock = ctx->sock;
+  pa->closed.store(false, std::memory_order_relaxed);
+  if (pa->headers_sent == nullptr) {
+    pa->headers_sent = butex_create();
+  }
+  butex_value(pa->headers_sent).store(0, std::memory_order_relaxed);
+  uint64_t pa_token = pa->token();
+
+  Socket* s = Socket::Address(ctx->sock);
+  if (s == nullptr) {
+    pa->version.fetch_add(1, std::memory_order_release);
+    ResourcePool<PaState>::Return(pa_slot);
+    return 0;
+  }
+  IOBuf head;
+  std::string h = "HTTP/1.1 " + std::to_string(status) + " ";
+  h += HttpStatusText(status);
+  h += "\r\n";
+  if (headers_blob != nullptr) {
+    h += headers_blob;
+  }
+  h += "Transfer-Encoding: chunked\r\nConnection: close\r\n\r\n";
+  head.append(h.data(), h.size());
+  ConnState::Ready entry;
+  entry.data = std::move(head);
+  entry.pa_token = pa_token;
+  ReleaseSequencedEntry(s, ctx->pipe_seq, std::move(entry));
+  s->Dereference();
+
+  ctx->version.fetch_add(1, std::memory_order_release);
+  ctx->payload.clear();
+  ctx->http_path.clear();
+  ctx->http_query.clear();
+  ctx->http_headers.clear();
+  ctx->is_http = false;
+  ResourcePool<CallCtx>::Return(slot);
+  return pa_token;
+}
+
+int pa_write(uint64_t pa_token, const uint8_t* data, size_t len) {
+  if (len == 0) {
+    // a zero-length chunk IS the stream terminator on the wire; framing
+    // one here would silently end the response mid-stream
+    return 0;
+  }
+  PaState* pa = PaAddress(pa_token);
+  if (pa == nullptr || pa->closed.load(std::memory_order_acquire)) {
+    return -EINVAL;
+  }
+  // chunks must not pass the headers (which the sequencer may still be
+  // holding until earlier pipelined responses flush)
+  while (butex_value(pa->headers_sent).load(std::memory_order_acquire) ==
+         0) {
+    butex_wait(pa->headers_sent, 0, 1000000);
+    if (PaAddress(pa_token) != pa) {
+      return -EINVAL;
+    }
+  }
+  if (butex_value(pa->headers_sent).load(std::memory_order_acquire) < 0) {
+    return -TRPC_EFAILEDSOCKET;  // aborted: connection died pre-headers
+  }
+  Socket* s = Socket::Address(pa->sock);
+  if (s == nullptr) {
+    return -TRPC_EFAILEDSOCKET;  // peer went away mid-stream
+  }
+  IOBuf chunk;
+  PackChunk(&chunk, data, len);
+  int rc = s->Write(std::move(chunk));
+  s->Dereference();
+  return rc;
+}
+
+int pa_close(uint64_t pa_token) {
+  PaState* pa = PaAddress(pa_token);
+  if (pa == nullptr || pa->closed.exchange(true)) {
+    return -EINVAL;
+  }
+  while (butex_value(pa->headers_sent).load(std::memory_order_acquire) ==
+         0) {
+    butex_wait(pa->headers_sent, 0, 1000000);
+    if (PaAddress(pa_token) != pa) {
+      return -EINVAL;
+    }
+  }
+  if (butex_value(pa->headers_sent).load(std::memory_order_acquire) >= 0) {
+    Socket* s = Socket::Address(pa->sock);
+    if (s != nullptr) {
+      IOBuf fin;
+      fin.append("0\r\n\r\n", 5);
+      CloseAfterWrite(s, std::move(fin));
+      s->Dereference();
+    }
+  }  // aborted: nothing to finalize, just release the state
+  pa->version.fetch_add(1, std::memory_order_release);
+  ResourcePool<PaState>::Return(pa->slot);
+  return 0;
 }
 
 int token_compress_type(uint64_t token) {

@@ -13,7 +13,9 @@ _EXAMPLES_DIR = os.path.join(os.path.dirname(os.path.dirname(
 _HOST = ["echo", "asynchronous_echo", "multi_threaded_echo",
          "parallel_echo", "partition_echo", "dynamic_partition_echo",
          "selective_echo", "cascade_echo", "backup_request",
-         "auto_concurrency_limiter", "streaming_echo", "http_server"]
+         "auto_concurrency_limiter", "streaming_echo", "http_server",
+         "thrift_echo", "pb_echo", "session_data_and_thread_local",
+         "progressive_http", "memcache_client"]
 _MESH = ["mesh_collectives", "long_context_ring"]
 
 

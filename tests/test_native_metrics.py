@@ -65,20 +65,30 @@ def test_pprof_profile_sees_native_frames():
     ts = [threading.Thread(target=hammer) for _ in range(2)]
     [t.start() for t in ts]
     try:
-        prof = urllib.request.urlopen(
-            f"http://127.0.0.1:{port}/pprof/profile?seconds=1.5",
-            timeout=30).read().decode()
+        # sample attribution on a 1-core host shares the CPU with
+        # whatever else the suite left running; allow a few attempts
+        # before declaring the native frames invisible
+        share, total, prof = 0.0, 0, ""
+        for _ in range(3):
+            prof = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/pprof/profile?seconds=1.5",
+                timeout=30).read().decode()
+            lines = [l for l in prof.splitlines()
+                     if l and not l.startswith("[")]
+            total = sum(int(l.rsplit(" ", 1)[1]) for l in lines)
+            native = sum(int(l.rsplit(" ", 1)[1]) for l in lines
+                         if "trpc::" in l)
+            share = native / total if total else 0.0
+            if total > 10 and share > 0.25:
+                break
     finally:
         stop.set()
         [t.join() for t in ts]
     srv.destroy()
-    lines = [l for l in prof.splitlines() if l and not l.startswith("[")]
-    total = sum(int(l.rsplit(" ", 1)[1]) for l in lines)
     assert total > 10, prof[:500]
-    native = sum(int(l.rsplit(" ", 1)[1]) for l in lines if "trpc::" in l)
     # echo load runs almost entirely in the native core; a meaningful
     # share of samples must carry its (demangled) frame names
-    assert native / total > 0.25, prof[:1000]
+    assert share > 0.25, prof[:1000]
 
 
 def test_usercode_flood_gets_elimit():

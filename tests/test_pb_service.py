@@ -1,0 +1,113 @@
+"""Protobuf-typed services + json2pb transcoding (≙ SURVEY.md §2.5:
+json_to_pb/pb_to_json powering HTTP+JSON access to pb services, and
+brpc_protobuf_json_unittest).  Message classes are built at test time
+with google.protobuf.proto_builder — no checked-in generated code."""
+
+import json
+import urllib.request
+
+import pytest
+from google.protobuf import proto_builder
+from google.protobuf.descriptor_pb2 import FieldDescriptorProto as F
+
+from brpc_tpu.rpc.channel import Channel
+from brpc_tpu.rpc.pb_service import json_to_pb, pb_call, pb_to_json
+from brpc_tpu.rpc.server import Server
+
+AddRequest = proto_builder.MakeSimpleProtoClass(
+    {"a": F.TYPE_INT64, "b": F.TYPE_INT64},
+    full_name="brpc_tpu.test.AddRequest")
+AddResponse = proto_builder.MakeSimpleProtoClass(
+    {"sum": F.TYPE_INT64},
+    full_name="brpc_tpu.test.AddResponse")
+EchoMsg = proto_builder.MakeSimpleProtoClass(
+    {"text": F.TYPE_STRING, "times": F.TYPE_INT32},
+    full_name="brpc_tpu.test.EchoMsg")
+
+
+@pytest.fixture
+def pb_server():
+    def add(cntl, req):
+        resp = AddResponse()
+        resp.sum = req.a + req.b
+        return resp
+
+    def shout(cntl, req):
+        out = EchoMsg()
+        out.text = req.text.upper() * max(req.times, 1)
+        out.times = req.times
+        return out
+
+    srv = Server()
+    srv.add_pb_service("Calc", {"Add": (add, AddRequest, AddResponse)})
+    srv.add_pb_service("Echo2", {"Shout": (shout, EchoMsg, EchoMsg)})
+    srv.start("127.0.0.1:0")
+    yield srv
+    srv.destroy()
+
+
+class TestJson2Pb:
+    def test_round_trip(self):
+        m = EchoMsg()
+        m.text = "héllo"
+        m.times = 3
+        j = pb_to_json(m)
+        back = json_to_pb(j, EchoMsg)
+        assert back.text == "héllo" and back.times == 3
+
+    def test_unknown_field_strictness(self):
+        blob = json.dumps({"text": "x", "bogus": 1}).encode()
+        with pytest.raises(Exception):
+            json_to_pb(blob, EchoMsg)  # strict by default (≙ json2pb)
+        m = json_to_pb(blob, EchoMsg, ignore_unknown_fields=True)
+        assert m.text == "x"
+
+
+class TestPbOverTrpc:
+    def test_typed_call(self, pb_server):
+        ch = Channel(f"127.0.0.1:{pb_server.port}")
+        req = AddRequest()
+        req.a, req.b = 20, 22
+        resp = pb_call(ch, "Calc.Add", req, AddResponse)
+        assert resp.sum == 42
+        ch.close()
+
+    def test_two_services_coexist(self, pb_server):
+        ch = Channel(f"127.0.0.1:{pb_server.port}")
+        m = EchoMsg()
+        m.text = "ab"
+        m.times = 2
+        out = pb_call(ch, "Echo2.Shout", m, EchoMsg)
+        assert out.text == "ABAB"
+        ch.close()
+
+
+class TestPbOverHttpJson:
+    def test_json_request_response(self, pb_server):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{pb_server.port}/rpc/Calc.Add",
+            data=json.dumps({"a": 1, "b": 2}).encode(),
+            headers={"Content-Type": "application/json"})
+        out = json.load(urllib.request.urlopen(req, timeout=5))
+        assert int(out["sum"]) == 3
+
+    def test_bad_json_is_400(self, pb_server):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{pb_server.port}/rpc/Calc.Add",
+            data=b"{not json",
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=5)
+        assert ei.value.code == 400
+
+    def test_proto_body_passthrough(self, pb_server):
+        m = AddRequest()
+        m.a, m.b = 5, 6
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{pb_server.port}/rpc/Calc.Add",
+            data=m.SerializeToString(),
+            headers={"Content-Type": "application/proto"})
+        raw = urllib.request.urlopen(req, timeout=5).read()
+        resp = AddResponse()
+        resp.ParseFromString(raw)
+        assert resp.sum == 11

@@ -1,0 +1,143 @@
+"""Server-side ProgressiveAttachment (≙ progressive_attachment.h:32 +
+brpc's http streaming docs): a handler returns HttpResponse.progressive()
+and keeps writing chunks — from another thread, after the handler
+returned — until close().  Read back with a raw socket (chunked-framing
+assertions) and with the framework's own progressive HttpClient."""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from brpc_tpu.rpc.http import HttpResponse
+from brpc_tpu.rpc.http_client import HttpChannel
+from brpc_tpu.rpc.server import Server
+
+
+@pytest.fixture
+def streaming_server():
+    state = {}
+
+    def slow_stream(req):
+        pa = HttpResponse.progressive(
+            200, {"Content-Type": "text/event-stream"})
+
+        def writer():
+            try:
+                for i in range(5):
+                    pa.write(f"event-{i}\n".encode())
+                    time.sleep(0.03)
+            finally:
+                pa.close()
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        state["writer"] = t
+        return pa
+
+    def infinite(req):
+        pa = HttpResponse.progressive(200)
+        stop = threading.Event()
+        state["stop"] = stop
+
+        def writer():
+            i = 0
+            try:
+                while not stop.is_set():
+                    pa.write(f"tick-{i};".encode())
+                    i += 1
+                    time.sleep(0.01)
+            except BrokenPipeError:
+                state["broke"] = True  # client went away: writer exits
+            finally:
+                pa.close()
+
+        threading.Thread(target=writer, daemon=True).start()
+        return pa
+
+    srv = Server()
+    srv.add_echo_service()
+    srv.register_http("/stream", slow_stream)
+    srv.register_http("/infinite", infinite)
+    srv.start("127.0.0.1:0")
+    yield srv, state
+    if "stop" in state:
+        state["stop"].set()
+    srv.destroy()
+
+
+def _read_all(sock, deadline_s=8.0):
+    sock.settimeout(deadline_s)
+    data = b""
+    try:
+        while True:
+            chunk = sock.recv(4096)
+            if not chunk:
+                return data
+            data += chunk
+    except socket.timeout:
+        return data
+
+
+class TestProgressiveAttachment:
+    def test_chunked_framing_on_the_wire(self, streaming_server):
+        srv, _ = streaming_server
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        s.sendall(b"GET /stream HTTP/1.1\r\nHost: x\r\n\r\n")
+        raw = _read_all(s)
+        s.close()
+        head, _, body = raw.partition(b"\r\n\r\n")
+        assert b"HTTP/1.1 200" in head
+        assert b"transfer-encoding: chunked" in head.lower()
+        assert b"connection: close" in head.lower()
+        # spec chunked framing: "8\r\nevent-0\n\r\n" ... "0\r\n\r\n"
+        for i in range(5):
+            assert f"event-{i}\n".encode() in body
+        assert body.endswith(b"0\r\n\r\n")
+
+    def test_framework_client_streams_chunks(self, streaming_server):
+        srv, _ = streaming_server
+        c = HttpChannel(f"127.0.0.1:{srv.port}")
+        got = []
+        resp = c.request("GET", "/stream", stream=got.append)
+        assert resp.status == 200
+        joined = b"".join(got)
+        assert joined == b"".join(f"event-{i}\n".encode()
+                                  for i in range(5))
+        c.close()
+
+    def test_writer_outlives_handler(self, streaming_server):
+        # chunks keep arriving well after the handler returned — the
+        # defining property of a ProgressiveAttachment
+        srv, state = streaming_server
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        s.sendall(b"GET /infinite HTTP/1.1\r\nHost: x\r\n\r\n")
+        s.settimeout(5)
+        data = b""
+        deadline = time.time() + 5
+        while data.count(b"tick-") < 10 and time.time() < deadline:
+            data += s.recv(4096)
+        assert data.count(b"tick-") >= 10
+        state["stop"].set()
+        s.close()
+
+    def test_disconnect_terminates_infinite_writer(self, streaming_server):
+        srv, state = streaming_server
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        s.sendall(b"GET /infinite HTTP/1.1\r\nHost: x\r\n\r\n")
+        s.recv(64)  # headers started
+        s.close()   # client vanishes mid-stream
+        deadline = time.time() + 8
+        while "broke" not in state and time.time() < deadline:
+            time.sleep(0.05)
+        assert state.get("broke"), \
+            "writer should get BrokenPipeError after client disconnect"
+        state["stop"].set()
+
+    def test_normal_responses_unaffected(self, streaming_server):
+        srv, _ = streaming_server
+        c = HttpChannel(f"127.0.0.1:{srv.port}")
+        r = c.get("/health")
+        assert r.status == 200 and r.body == b"OK\n"
+        c.close()
