@@ -156,6 +156,25 @@ def _declare(L: ctypes.CDLL) -> None:
     L.trpc_proto_respond.argtypes = [c.c_uint64, c.c_char_p, c.c_size_t]
     L.trpc_proto_respond.restype = c.c_int
 
+    # HTTP/2 client
+    L.trpc_h2_client_create.argtypes = [c.c_char_p, c.c_int, c.c_int64,
+                                        c.POINTER(c.c_int)]
+    L.trpc_h2_client_create.restype = c.c_void_p
+    L.trpc_h2_client_call.argtypes = [c.c_void_p, c.c_char_p, c.c_char_p,
+                                      c.c_char_p, c.c_char_p, c.c_size_t,
+                                      c.c_int64, c.POINTER(c.c_void_p)]
+    L.trpc_h2_client_call.restype = c.c_int
+    L.trpc_h2_result_status.argtypes = [c.c_void_p]
+    L.trpc_h2_result_status.restype = c.c_int
+    for f in ("headers", "body", "trailers"):
+        fn = getattr(L, f"trpc_h2_result_{f}")
+        fn.argtypes = [c.c_void_p, c.POINTER(c.POINTER(c.c_uint8))]
+        fn.restype = c.c_size_t
+    L.trpc_h2_result_destroy.argtypes = [c.c_void_p]
+    L.trpc_h2_result_destroy.restype = None
+    L.trpc_h2_client_destroy.argtypes = [c.c_void_p]
+    L.trpc_h2_client_destroy.restype = None
+
     # progressive (chunked) HTTP responses
     L.trpc_http_respond_progressive.argtypes = [c.c_uint64, c.c_int,
                                                 c.c_char_p]

@@ -1,0 +1,137 @@
+"""HTTP/2 client + gRPC client (≙ the client half of
+policy/http2_rpc_protocol.cpp and grpc.h:208 semantics).
+
+The connection (native h2.cc client section) multiplexes concurrent
+calls over one socket with HPACK request encoding and send-side flow
+control; gRPC layers its 5-byte message framing and grpc-status
+trailers on top — so brpc_tpu services exposed via add_grpc_service are
+callable without grpcio.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import struct
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+from brpc_tpu._native import lib
+from brpc_tpu.rpc import errors
+
+__all__ = ["H2Response", "H2Channel", "GrpcError", "GrpcChannel"]
+
+
+@dataclass
+class H2Response:
+    status: int
+    headers: Dict[str, str] = field(default_factory=dict)
+    body: bytes = b""
+    trailers: Dict[str, str] = field(default_factory=dict)
+
+
+def _parse_lines(blob: bytes) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for line in blob.decode("latin-1").splitlines():
+        k, _, v = line.partition(": ")
+        if k:
+            out[k] = v
+    return out
+
+
+class H2Channel:
+    """h2c (prior-knowledge) client connection.  Calls are thread-safe
+    and multiplex concurrently on one socket."""
+
+    def __init__(self, target: str, connect_timeout_ms: float = 1000.0):
+        import socket as _socket
+        host, _, port = target.rpartition(":")
+        # the native side takes IPv4 literals only; resolve names here
+        ip = _socket.gethostbyname(host or "127.0.0.1")
+        rc = ctypes.c_int()
+        self._handle = lib().trpc_h2_client_create(
+            ip.encode(), int(port), int(connect_timeout_ms * 1000),
+            ctypes.byref(rc))
+        if not self._handle:
+            raise errors.RpcError(rc.value, f"h2 connect to {target} failed")
+
+    def request(self, method: str, path: str,
+                headers: Optional[Dict[str, str]] = None,
+                body: bytes = b"",
+                timeout_ms: float = 10_000.0) -> H2Response:
+        if self._handle is None:
+            raise errors.RpcError(errors.EFAILEDSOCKET, "channel closed")
+        L = lib()
+        blob = None
+        if headers:
+            blob = "".join(f"{k}: {v}\r\n"
+                           for k, v in headers.items()).encode()
+        result = ctypes.c_void_p()
+        rc = L.trpc_h2_client_call(
+            self._handle, method.encode(), path.encode(), blob,
+            body if body else None, len(body), int(timeout_ms * 1000),
+            ctypes.byref(result))
+        try:
+            if rc != 0:
+                raise errors.RpcError(rc, f"h2 call failed ({rc})")
+            status = L.trpc_h2_result_status(result)
+            p = ctypes.POINTER(ctypes.c_uint8)()
+            n = L.trpc_h2_result_headers(result, ctypes.byref(p))
+            hdrs = _parse_lines(ctypes.string_at(p, n) if n else b"")
+            n = L.trpc_h2_result_body(result, ctypes.byref(p))
+            rbody = ctypes.string_at(p, n) if n else b""
+            n = L.trpc_h2_result_trailers(result, ctypes.byref(p))
+            trls = _parse_lines(ctypes.string_at(p, n) if n else b"")
+        finally:
+            L.trpc_h2_result_destroy(result)
+        return H2Response(status, hdrs, rbody, trls)
+
+    def get(self, path: str, **kw) -> H2Response:
+        return self.request("GET", path, **kw)
+
+    def post(self, path: str, body: bytes = b"", **kw) -> H2Response:
+        return self.request("POST", path, body=body, **kw)
+
+    def close(self) -> None:
+        if self._handle is not None:
+            lib().trpc_h2_client_destroy(self._handle)
+            self._handle = None
+
+
+class GrpcError(Exception):
+    def __init__(self, code: int, message: str = ""):
+        super().__init__(f"grpc-status {code}: {message}")
+        self.code = code
+        self.message = message
+
+
+class GrpcChannel:
+    """gRPC unary calls over the framework's own h2 client (no grpcio):
+    POST /<Service>/<Method>, content-type application/grpc, 5-byte
+    length-prefixed messages, grpc-status in the trailers."""
+
+    def __init__(self, target: str, **kw):
+        self._h2 = H2Channel(target, **kw)
+
+    def call(self, service: str, method: str, request: bytes,
+             timeout_ms: float = 10_000.0) -> bytes:
+        framed = b"\x00" + struct.pack("!I", len(request)) + request
+        resp = self._h2.post(
+            f"/{service}/{method}", body=framed,
+            headers={"content-type": "application/grpc", "te": "trailers"},
+            timeout_ms=timeout_ms)
+        status_map = dict(resp.trailers)
+        if "grpc-status" not in status_map:
+            status_map.update(resp.headers)  # trailers-only responses
+        code = int(status_map.get("grpc-status", "2"))
+        if code != 0:
+            raise GrpcError(code, status_map.get("grpc-message", ""))
+        if len(resp.body) < 5:
+            return b""
+        compressed, mlen = resp.body[0], struct.unpack("!I",
+                                                       resp.body[1:5])[0]
+        if compressed:
+            raise GrpcError(12, "compressed grpc frames unsupported")
+        return resp.body[5:5 + mlen]
+
+    def close(self) -> None:
+        self._h2.close()

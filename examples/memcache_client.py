@@ -5,13 +5,9 @@ bytes on the wire are exactly what stock memcached speaks."""
 import _bootstrap  # noqa: F401
 
 import os
-import socket
-import struct
 import sys
-import threading
 
-from brpc_tpu.rpc.memcache import MemcacheClient, _HDR, _REQ_MAGIC, \
-    _RES_MAGIC, Op, Status
+from brpc_tpu.rpc.memcache import MemcacheClient
 
 sys.path.insert(0, os.path.join(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))), "tests"))

@@ -8,6 +8,7 @@
 
 #include "fiber.h"
 #include "fiber_sync.h"
+#include "h2.h"
 #include "http.h"
 #include "iobuf.h"
 #include "metrics.h"
@@ -238,6 +239,47 @@ int trpc_pa_write(uint64_t pa, const uint8_t* data, size_t len) {
 }
 
 int trpc_pa_close(uint64_t pa) { return pa_close(pa); }
+
+// --- HTTP/2 client ----------------------------------------------------------
+
+void* trpc_h2_client_create(const char* ip, int port,
+                            int64_t connect_timeout_us, int* rc_out) {
+  return h2_client_create(ip, port, connect_timeout_us, rc_out);
+}
+
+int trpc_h2_client_call(void* conn, const char* method, const char* path,
+                        const char* headers_blob, const uint8_t* body,
+                        size_t body_len, int64_t timeout_us, void** result) {
+  H2ClientResult* r = new H2ClientResult();
+  int rc = h2_client_call(conn, method, path, headers_blob, body, body_len,
+                          timeout_us, r);
+  *result = r;
+  return rc;
+}
+
+int trpc_h2_result_status(void* r) { return ((H2ClientResult*)r)->status; }
+
+size_t trpc_h2_result_headers(void* r, const uint8_t** p) {
+  H2ClientResult* res = (H2ClientResult*)r;
+  *p = (const uint8_t*)res->headers.data();
+  return res->headers.size();
+}
+
+size_t trpc_h2_result_body(void* r, const uint8_t** p) {
+  H2ClientResult* res = (H2ClientResult*)r;
+  *p = (const uint8_t*)res->body.data();
+  return res->body.size();
+}
+
+size_t trpc_h2_result_trailers(void* r, const uint8_t** p) {
+  H2ClientResult* res = (H2ClientResult*)r;
+  *p = (const uint8_t*)res->trailers.data();
+  return res->trailers.size();
+}
+
+void trpc_h2_result_destroy(void* r) { delete (H2ClientResult*)r; }
+
+void trpc_h2_client_destroy(void* conn) { h2_client_destroy(conn); }
 
 // --- auth ------------------------------------------------------------------
 

@@ -2,7 +2,12 @@
 
 #include "metrics.h"
 
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
 #include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
 
 #include <deque>
 #include <mutex>
@@ -865,6 +870,533 @@ int H2Respond(H2Conn* c, Socket* s, uint32_t stream_id, int status,
   FlushPending(c, s, stream_id, &st, &frames);
   write_frames(s, frames);
   return 0;
+}
+
+// ---------------------------------------------------------------------------
+// HTTP/2 client (see h2.h).  Same frame machinery as the server half,
+// mirrored: odd stream ids originate here, HEADERS carry the request
+// pseudo-headers, and responses flow back through the edge_fn below.
+
+namespace {
+
+constexpr int64_t kClientConnWindow = 1 << 30;  // opened wide at create
+
+struct H2ClientStream {
+  Butex* done = nullptr;  // 0 -> 1 when the stream completes/fails
+  int error = 0;          // -TRPC_* when failed
+  bool headers_done = false;
+  H2ClientResult result;
+  // CONTINUATION accumulation for this stream's current header block
+  std::string hdr_block;
+  bool hdr_end_stream = false;
+};
+
+struct H2ClientConn {
+  SocketId sock = INVALID_SOCKET_ID;
+  std::mutex mu;
+  Hpack hpack_rx;  // decodes response header blocks
+  uint32_t next_stream = 1;
+  std::unordered_map<uint32_t, H2ClientStream*> streams;
+  // send flow control (peer's receive budget)
+  int64_t conn_send_window = 65535;
+  int64_t peer_initial_window = 65535;
+  std::unordered_map<uint32_t, int64_t> stream_send_window;
+  uint32_t peer_max_frame = 16384;
+  Butex* window_butex = nullptr;  // bumped whenever windows grow
+  // receive replenishment
+  int64_t consumed_since_update = 0;
+  uint32_t continuation_stream = 0;
+  std::atomic<bool> failed{false};
+};
+
+void H2ClientCompleteLocked(H2ClientConn* c, uint32_t sid,
+                            H2ClientStream* st, int error) {
+  st->error = error;
+  c->streams.erase(sid);
+  c->stream_send_window.erase(sid);
+  butex_value(st->done).store(1, std::memory_order_release);
+  butex_wake_all(st->done);
+}
+
+void H2ClientFailAllLocked(H2ClientConn* c, int error) {
+  for (auto& kv : c->streams) {
+    H2ClientStream* st = kv.second;
+    st->error = error;
+    butex_value(st->done).store(1, std::memory_order_release);
+    butex_wake_all(st->done);
+  }
+  c->streams.clear();
+  c->stream_send_window.clear();
+}
+
+void H2ClientOnFailed(Socket* s) {
+  H2ClientConn* c = (H2ClientConn*)s->user;
+  if (c == nullptr) {
+    return;
+  }
+  c->failed.store(true, std::memory_order_release);
+  std::lock_guard<std::mutex> lk(c->mu);
+  H2ClientFailAllLocked(c, -TRPC_EFAILEDSOCKET);
+  butex_value(c->window_butex).fetch_add(1, std::memory_order_release);
+  butex_wake_all(c->window_butex);
+}
+
+// Decode one complete header block into st->result (headers, then
+// trailers on the second block).  Returns false on HPACK corruption.
+bool H2ClientHeaderBlock(H2ClientConn* c, H2ClientStream* st,
+                         const std::string& block) {
+  std::vector<std::pair<std::string, std::string>> hs;
+  if (!c->hpack_rx.decode_block((const uint8_t*)block.data(), block.size(),
+                                &hs)) {
+    return false;
+  }
+  std::string* sink =
+      st->headers_done ? &st->result.trailers : &st->result.headers;
+  for (auto& kv : hs) {
+    if (kv.first == ":status") {
+      st->result.status = atoi(kv.second.c_str());
+    } else if (!kv.first.empty() && kv.first[0] != ':') {
+      *sink += kv.first;
+      *sink += ": ";
+      *sink += kv.second;
+      *sink += "\n";
+    }
+  }
+  st->headers_done = true;
+  return true;
+}
+
+void H2ClientOnMessages(Socket* s) {
+  H2ClientConn* c = (H2ClientConn*)s->user;
+  bool eof = false;
+  ssize_t r = s->ReadToBuf(&eof);
+  bool dead = eof || (r < 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
+                      errno != EINTR);
+  std::unique_lock<std::mutex> lk(c->mu);
+  std::string reply;
+  bool window_grew = false;
+  while (true) {
+    if (s->read_buf.size() < 9) {
+      break;
+    }
+    uint8_t hdr[9];
+    s->read_buf.copy_to(hdr, 9);
+    uint32_t len = ((uint32_t)hdr[0] << 16) | ((uint32_t)hdr[1] << 8) |
+                   hdr[2];
+    uint8_t type = hdr[3];
+    uint8_t flags = hdr[4];
+    uint32_t sid = (((uint32_t)hdr[5] & 0x7f) << 24) |
+                   ((uint32_t)hdr[6] << 16) | ((uint32_t)hdr[7] << 8) |
+                   hdr[8];
+    if (len > kMaxFrameAccept) {
+      lk.unlock();
+      s->SetFailed(EPROTO);
+      return;
+    }
+    if (s->read_buf.size() < 9 + (size_t)len) {
+      break;
+    }
+    s->read_buf.pop_front(9);
+    std::string payload;
+    payload.resize(len);
+    if (len > 0) {
+      s->read_buf.copy_to(&payload[0], len);
+      s->read_buf.pop_front(len);
+    }
+    const uint8_t* p = (const uint8_t*)payload.data();
+    size_t n = payload.size();
+
+    if (c->continuation_stream != 0 &&
+        (type != F_CONTINUATION || sid != c->continuation_stream)) {
+      lk.unlock();
+      s->SetFailed(EPROTO);
+      return;
+    }
+
+    switch (type) {
+      case F_SETTINGS: {
+        if (flags & FLAG_ACK) break;
+        for (size_t i = 0; i + 6 <= n; i += 6) {
+          uint16_t id = ((uint16_t)p[i] << 8) | p[i + 1];
+          uint32_t v = ((uint32_t)p[i + 2] << 24) |
+                       ((uint32_t)p[i + 3] << 16) |
+                       ((uint32_t)p[i + 4] << 8) | p[i + 5];
+          if (id == 0x4) {
+            int64_t delta = (int64_t)v - c->peer_initial_window;
+            c->peer_initial_window = (int64_t)v;
+            for (auto& kv : c->stream_send_window) {
+              kv.second += delta;
+            }
+            window_grew = window_grew || delta > 0;
+          } else if (id == 0x5 && v >= 16384 && v <= (1u << 24)) {
+            c->peer_max_frame = v;
+          }
+        }
+        put_frame_header(&reply, 0, F_SETTINGS, FLAG_ACK, 0);
+        break;
+      }
+      case F_PING: {
+        if (!(flags & FLAG_ACK) && n == 8) {
+          put_frame_header(&reply, 8, F_PING, FLAG_ACK, 0);
+          reply.append(payload);
+        }
+        break;
+      }
+      case F_WINDOW_UPDATE: {
+        if (n != 4) break;
+        uint32_t inc = (((uint32_t)p[0] & 0x7f) << 24) |
+                       ((uint32_t)p[1] << 16) | ((uint32_t)p[2] << 8) |
+                       p[3];
+        if (sid == 0) {
+          c->conn_send_window += (int64_t)inc;
+        } else {
+          auto it = c->stream_send_window.find(sid);
+          if (it != c->stream_send_window.end()) {
+            it->second += (int64_t)inc;
+          }
+        }
+        window_grew = true;
+        break;
+      }
+      case F_HEADERS:
+      case F_CONTINUATION: {
+        auto it = c->streams.find(sid);
+        if (it == c->streams.end()) {
+          break;  // late frames for a timed-out stream
+        }
+        H2ClientStream* st = it->second;
+        size_t off = 0;
+        if (type == F_HEADERS) {
+          size_t pad = 0;
+          if (flags & FLAG_PADDED) {
+            if (n < 1) break;
+            pad = p[0];
+            off += 1;
+          }
+          if (flags & FLAG_PRIORITY) {
+            off += 5;
+          }
+          if (off + pad > n) {  // malformed padding/priority lengths
+            lk.unlock();
+            s->SetFailed(EPROTO);
+            return;
+          }
+          st->hdr_block.assign((const char*)p + off, n - off - pad);
+          st->hdr_end_stream = (flags & FLAG_END_STREAM) != 0;
+        } else {
+          st->hdr_block.append((const char*)p, n);
+        }
+        if (flags & FLAG_END_HEADERS) {
+          c->continuation_stream = 0;
+          if (!H2ClientHeaderBlock(c, st, st->hdr_block)) {
+            lk.unlock();
+            s->SetFailed(EPROTO);
+            return;
+          }
+          st->hdr_block.clear();
+          if (st->hdr_end_stream) {
+            H2ClientCompleteLocked(c, sid, st, 0);
+          }
+        } else {
+          c->continuation_stream = sid;
+        }
+        break;
+      }
+      case F_DATA: {
+        size_t off = 0;
+        size_t dlen = n;
+        if (flags & FLAG_PADDED) {
+          if (n < 1 || (size_t)p[0] + 1 > n) {  // pad exceeds payload
+            lk.unlock();
+            s->SetFailed(EPROTO);
+            return;
+          }
+          off = 1;
+          dlen = n - 1 - p[0];
+        }
+        c->consumed_since_update += (int64_t)n;
+        auto it = c->streams.find(sid);
+        if (it != c->streams.end()) {
+          H2ClientStream* st = it->second;
+          st->result.body.append((const char*)p + off, dlen);
+          if (flags & FLAG_END_STREAM) {
+            H2ClientCompleteLocked(c, sid, st, 0);
+          }
+        }
+        // replenish the connection window in 1MB slabs (streams got a
+        // 1GB initial window via SETTINGS and don't need per-stream
+        // updates for bodies under that)
+        if (c->consumed_since_update >= (1 << 20)) {
+          put_frame_header(&reply, 4, F_WINDOW_UPDATE, 0, 0);
+          uint32_t inc = (uint32_t)c->consumed_since_update;
+          reply.push_back((char)((inc >> 24) & 0x7f));
+          reply.push_back((char)(inc >> 16));
+          reply.push_back((char)(inc >> 8));
+          reply.push_back((char)inc);
+          c->consumed_since_update = 0;
+        }
+        break;
+      }
+      case F_RST: {
+        auto it = c->streams.find(sid);
+        if (it != c->streams.end()) {
+          H2ClientCompleteLocked(c, sid, it->second, -TRPC_EINTERNAL);
+        }
+        break;
+      }
+      case F_GOAWAY: {
+        H2ClientFailAllLocked(c, -TRPC_ESTOP);
+        break;
+      }
+      default:
+        break;  // PRIORITY, PUSH (we never enable push): ignore
+    }
+  }
+  if (window_grew) {
+    butex_value(c->window_butex).fetch_add(1, std::memory_order_release);
+    butex_wake_all(c->window_butex);
+  }
+  lk.unlock();
+  if (!reply.empty()) {
+    write_frames(s, reply);
+  }
+  if (dead) {
+    s->SetFailed(errno != 0 ? errno : ECONNRESET);
+  }
+}
+
+}  // namespace
+
+void* h2_client_create(const char* ip, int port, int64_t connect_timeout_us,
+                       int* rc_out) {
+  fiber_runtime_init(0);
+  int fd = ::socket(AF_INET, SOCK_STREAM | SOCK_CLOEXEC, 0);
+  if (fd < 0) {
+    *rc_out = -errno;
+    return nullptr;
+  }
+  sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  if (inet_pton(AF_INET, ip, &addr.sin_addr) != 1) {
+    // hostnames resolve on the Python side; a bad literal must not turn
+    // into a connect to 255.255.255.255
+    *rc_out = -EINVAL;
+    ::close(fd);
+    return nullptr;
+  }
+  // bounded blocking connect
+  timeval tv;
+  tv.tv_sec = connect_timeout_us / 1000000;
+  tv.tv_usec = connect_timeout_us % 1000000;
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  if (connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+    *rc_out = -errno;
+    ::close(fd);
+    return nullptr;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+
+  H2ClientConn* c = new H2ClientConn();
+  c->window_butex = butex_create();
+  SocketOptions opts;
+  opts.fd = fd;
+  opts.edge_fn = H2ClientOnMessages;
+  opts.user = c;
+  opts.on_failed = H2ClientOnFailed;
+  if (Socket::Create(opts, &c->sock) != 0) {
+    ::close(fd);
+    butex_destroy(c->window_butex);
+    delete c;
+    *rc_out = -ENOMEM;
+    return nullptr;
+  }
+  // preface + SETTINGS (huge initial stream window) + a wide connection
+  // window, all in one write
+  std::string hello = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+  std::string settings;
+  settings.push_back(0x00);
+  settings.push_back(0x04);  // INITIAL_WINDOW_SIZE
+  settings.push_back((char)((kClientConnWindow >> 24) & 0xff));
+  settings.push_back((char)((kClientConnWindow >> 16) & 0xff));
+  settings.push_back((char)((kClientConnWindow >> 8) & 0xff));
+  settings.push_back((char)(kClientConnWindow & 0xff));
+  put_frame_header(&hello, (uint32_t)settings.size(), F_SETTINGS, 0, 0);
+  hello += settings;
+  uint32_t winc = (uint32_t)(kClientConnWindow - 65535);
+  put_frame_header(&hello, 4, F_WINDOW_UPDATE, 0, 0);
+  hello.push_back((char)((winc >> 24) & 0x7f));
+  hello.push_back((char)(winc >> 16));
+  hello.push_back((char)(winc >> 8));
+  hello.push_back((char)winc);
+  Socket* s = Socket::Address(c->sock);
+  if (s != nullptr) {
+    write_frames(s, hello);
+    EventDispatcher::Instance().AddConsumer(c->sock, fd);
+    s->Dereference();
+  }
+  *rc_out = 0;
+  return c;
+}
+
+int h2_client_call(void* conn, const char* method, const char* path,
+                   const char* headers_blob, const uint8_t* body,
+                   size_t body_len, int64_t timeout_us,
+                   H2ClientResult* out) {
+  H2ClientConn* c = (H2ClientConn*)conn;
+  if (c->failed.load(std::memory_order_acquire)) {
+    return -TRPC_EFAILEDSOCKET;
+  }
+  int64_t deadline = monotonic_us() + timeout_us;
+  H2ClientStream st;
+  st.done = butex_create();
+  butex_value(st.done).store(0, std::memory_order_relaxed);
+
+  uint32_t sid;
+  {
+    std::lock_guard<std::mutex> lk(c->mu);
+    sid = c->next_stream;
+    c->next_stream += 2;
+    c->streams[sid] = &st;
+    c->stream_send_window[sid] = c->peer_initial_window;
+  }
+
+  Socket* s = Socket::Address(c->sock);
+  if (s == nullptr) {
+    std::lock_guard<std::mutex> lk(c->mu);
+    c->streams.erase(sid);
+    c->stream_send_window.erase(sid);
+    butex_destroy(st.done);
+    return -TRPC_EFAILEDSOCKET;
+  }
+
+  // HEADERS: pseudo-headers first, then the caller's blob
+  std::string block;
+  hpack_literal(&block, ":method", method);
+  hpack_literal(&block, ":scheme", "http");
+  hpack_literal(&block, ":path", path);
+  hpack_literal(&block, ":authority", "localhost");
+  encode_blob(&block, headers_blob);
+  std::string frames;
+  bool end_stream = body_len == 0;
+  {
+    // split the header block across CONTINUATION frames when it exceeds
+    // the peer's max frame size (the server enforces it with a GOAWAY)
+    size_t maxf;
+    {
+      std::lock_guard<std::mutex> lk(c->mu);
+      maxf = c->peer_max_frame;
+    }
+    size_t off = 0;
+    bool first = true;
+    do {
+      size_t chunk = block.size() - off;
+      if (chunk > maxf) chunk = maxf;
+      bool last = off + chunk == block.size();
+      uint8_t type = first ? F_HEADERS : F_CONTINUATION;
+      uint8_t flags = (last ? FLAG_END_HEADERS : 0) |
+                      (first && end_stream ? FLAG_END_STREAM : 0);
+      put_frame_header(&frames, (uint32_t)chunk, type, flags, sid);
+      frames.append(block, off, chunk);
+      off += chunk;
+      first = false;
+    } while (off < block.size());
+  }
+  write_frames(s, frames);
+
+  // DATA respecting the peer's windows
+  size_t sent = 0;
+  int rc = 0;
+  while (sent < body_len && rc == 0) {
+    size_t want = body_len - sent;
+    std::unique_lock<std::mutex> lk(c->mu);
+    int64_t avail = c->conn_send_window;
+    auto it = c->stream_send_window.find(sid);
+    if (it == c->stream_send_window.end()) {
+      rc = st.error != 0 ? st.error : -TRPC_EINTERNAL;
+      break;  // stream died under us
+    }
+    avail = avail < it->second ? avail : it->second;
+    if (avail <= 0) {
+      int32_t seq =
+          butex_value(c->window_butex).load(std::memory_order_acquire);
+      lk.unlock();
+      int64_t left = deadline - monotonic_us();
+      if (left <= 0 || butex_wait(c->window_butex, seq, left) != 0) {
+        if (errno == ETIMEDOUT || left <= 0) {
+          rc = -TRPC_ERPCTIMEDOUT;
+        }
+      }
+      if (c->failed.load(std::memory_order_acquire)) {
+        rc = -TRPC_EFAILEDSOCKET;
+      }
+      continue;
+    }
+    size_t chunk = want;
+    if ((int64_t)chunk > avail) chunk = (size_t)avail;
+    if (chunk > c->peer_max_frame) chunk = c->peer_max_frame;
+    c->conn_send_window -= (int64_t)chunk;
+    it->second -= (int64_t)chunk;
+    bool last = sent + chunk == body_len;
+    lk.unlock();
+    std::string df;
+    put_frame_header(&df, (uint32_t)chunk, F_DATA,
+                     last ? FLAG_END_STREAM : 0, sid);
+    df.append((const char*)body + sent, chunk);
+    write_frames(s, df);
+    sent += chunk;
+  }
+
+  // await completion
+  if (rc == 0) {
+    while (butex_value(st.done).load(std::memory_order_acquire) == 0) {
+      int64_t left = deadline - monotonic_us();
+      if (left <= 0) {
+        rc = -TRPC_ERPCTIMEDOUT;
+        break;
+      }
+      butex_wait(st.done, 0, left);
+    }
+  }
+  if (rc == 0) {
+    rc = st.error;
+  }
+
+  bool still_registered;
+  {
+    std::lock_guard<std::mutex> lk(c->mu);
+    still_registered = c->streams.erase(sid) > 0;
+    c->stream_send_window.erase(sid);
+  }
+  if (still_registered) {
+    // timed out / failed before the peer finished: reset the stream so
+    // late frames can't touch our stack-allocated state
+    std::string rst;
+    put_frame_header(&rst, 4, F_RST, 0, sid);
+    rst.append("\x00\x00\x00\x08", 4);  // CANCEL
+    write_frames(s, rst);
+  }
+  s->Dereference();
+  if (rc == 0 && out != nullptr) {
+    *out = std::move(st.result);
+  }
+  butex_destroy(st.done);
+  return rc;
+}
+
+void h2_client_destroy(void* conn) {
+  H2ClientConn* c = (H2ClientConn*)conn;
+  Socket* s = Socket::Address(c->sock);
+  if (s != nullptr) {
+    s->SetFailed(TRPC_ESTOP);
+    s->Dereference();
+  }
+  // after recycle no edge_fn / on_failed can be running against c
+  Socket::WaitRecycled(c->sock);
+  butex_destroy(c->window_butex);
+  delete c;
 }
 
 }  // namespace trpc

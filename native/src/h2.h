@@ -69,4 +69,27 @@ void H2RespondAsync(H2Conn* c, uint32_t stream_id, int status,
                     const char* headers_blob, const uint8_t* body,
                     size_t body_len, const char* trailers_blob);
 
+// --- HTTP/2 client (h2c prior knowledge; the client half of
+// policy/http2_rpc_protocol.cpp) ------------------------------------------
+// One connection multiplexes concurrent calls on odd stream ids; send
+// flow control honors the peer's windows, receive windows are opened
+// wide up front and replenished at the connection level.
+
+struct H2ClientResult {
+  int status = 0;
+  std::string headers;   // "lower-key: value\n" lines
+  std::string body;
+  std::string trailers;  // trailing HEADERS block, same shape
+};
+
+// Dial + preface + SETTINGS.  nullptr on connect failure (rc_out set).
+void* h2_client_create(const char* ip, int port, int64_t connect_timeout_us,
+                       int* rc_out);
+// One call; blocks the calling thread/fiber until the stream completes
+// or timeout_us passes (stream is then RST).  0 or -TRPC_*/-errno.
+int h2_client_call(void* conn, const char* method, const char* path,
+                   const char* headers_blob, const uint8_t* body,
+                   size_t body_len, int64_t timeout_us, H2ClientResult* out);
+void h2_client_destroy(void* conn);
+
 }  // namespace trpc

@@ -1887,8 +1887,13 @@ namespace {
 
 struct PaState {
   SocketId sock = INVALID_SOCKET_ID;
-  Butex* headers_sent = nullptr;  // 0 -> 1 when headers hit the wire
+  Butex* headers_sent = nullptr;  // 0 -> 1 headers on wire; -1 aborted
   std::atomic<bool> closed{false};
+  // concurrent writers inside pa_write/pa_close: the slot returns to the
+  // pool only when the last one leaves, so a recycled slot can never be
+  // read by a writer that entered under the old generation
+  std::atomic<int32_t> writers{0};
+  std::atomic<bool> finalized{false};
   uint32_t slot = 0;
   std::atomic<uint32_t> version{1};
 
@@ -1904,6 +1909,42 @@ PaState* PaAddress(uint64_t token) {
     return nullptr;
   }
   return pa;
+}
+
+void PaMaybeFree(PaState* pa) {
+  if (pa->closed.load(std::memory_order_acquire) &&
+      pa->writers.load(std::memory_order_acquire) == 0 &&
+      !pa->finalized.exchange(true)) {
+    // the generation dies HERE, not at close: PaAbort must still be able
+    // to address the state by its token to wake a closer waiting for
+    // headers on a connection that just died
+    pa->version.fetch_add(1, std::memory_order_release);
+    ResourcePool<PaState>::Return(pa->slot);
+  }
+}
+
+// Enter as a writer under the token's generation; false if the pa is
+// gone/closed.  On success the slot cannot recycle until PaExitWriter.
+bool PaEnterWriter(uint64_t token, PaState** out) {
+  PaState* pa = ResourcePool<PaState>::Address((uint32_t)token);
+  if (pa == nullptr) {
+    return false;
+  }
+  pa->writers.fetch_add(1, std::memory_order_acq_rel);
+  if (pa->version.load(std::memory_order_acquire) !=
+          (uint32_t)(token >> 32) ||
+      pa->closed.load(std::memory_order_acquire)) {
+    pa->writers.fetch_sub(1, std::memory_order_acq_rel);
+    PaMaybeFree(pa);
+    return false;
+  }
+  *out = pa;
+  return true;
+}
+
+void PaExitWriter(PaState* pa) {
+  pa->writers.fetch_sub(1, std::memory_order_acq_rel);
+  PaMaybeFree(pa);
 }
 
 void PackChunk(IOBuf* out, const uint8_t* data, size_t len) {
@@ -1931,15 +1972,12 @@ void PaAbort(uint64_t pa_token) {
   if (pa == nullptr) {
     return;
   }
-  bool already_closed = pa->closed.exchange(true);
+  pa->closed.store(true, std::memory_order_release);
   // -1 releases any writer parked on headers_sent even when pa_close
   // won the exchange and is itself waiting for the headers
   butex_value(pa->headers_sent).store(-1, std::memory_order_release);
   butex_wake_all(pa->headers_sent);
-  if (!already_closed) {
-    pa->version.fetch_add(1, std::memory_order_release);
-    ResourcePool<PaState>::Return(pa->slot);
-  }
+  PaMaybeFree(pa);
 }
 }  // namespace
 
@@ -1959,6 +1997,8 @@ uint64_t http_respond_progressive(uint64_t token, int status,
   uint32_t pa_slot = ResourcePool<PaState>::Get(&pa);
   pa->slot = pa_slot;
   pa->sock = ctx->sock;
+  pa->writers.store(0, std::memory_order_relaxed);
+  pa->finalized.store(false, std::memory_order_relaxed);
   pa->closed.store(false, std::memory_order_relaxed);
   if (pa->headers_sent == nullptr) {
     pa->headers_sent = butex_create();
@@ -2003,46 +2043,57 @@ int pa_write(uint64_t pa_token, const uint8_t* data, size_t len) {
     // one here would silently end the response mid-stream
     return 0;
   }
-  PaState* pa = PaAddress(pa_token);
-  if (pa == nullptr || pa->closed.load(std::memory_order_acquire)) {
+  PaState* pa;
+  if (!PaEnterWriter(pa_token, &pa)) {
     return -EINVAL;
   }
   // chunks must not pass the headers (which the sequencer may still be
-  // holding until earlier pipelined responses flush)
-  while (butex_value(pa->headers_sent).load(std::memory_order_acquire) ==
-         0) {
+  // holding until earlier pipelined responses flush); the writer ref
+  // pins the slot, so only the butex value matters here
+  int32_t hv;
+  while ((hv = butex_value(pa->headers_sent)
+                   .load(std::memory_order_acquire)) == 0) {
     butex_wait(pa->headers_sent, 0, 1000000);
-    if (PaAddress(pa_token) != pa) {
+    if (pa->closed.load(std::memory_order_acquire)) {
+      PaExitWriter(pa);
       return -EINVAL;
     }
   }
-  if (butex_value(pa->headers_sent).load(std::memory_order_acquire) < 0) {
-    return -TRPC_EFAILEDSOCKET;  // aborted: connection died pre-headers
+  int rc;
+  if (hv < 0) {
+    rc = -TRPC_EFAILEDSOCKET;  // aborted: connection died pre-headers
+  } else {
+    Socket* s = Socket::Address(pa->sock);
+    if (s == nullptr) {
+      rc = -TRPC_EFAILEDSOCKET;  // peer went away mid-stream
+    } else {
+      IOBuf chunk;
+      PackChunk(&chunk, data, len);
+      rc = s->Write(std::move(chunk));
+      s->Dereference();
+    }
   }
-  Socket* s = Socket::Address(pa->sock);
-  if (s == nullptr) {
-    return -TRPC_EFAILEDSOCKET;  // peer went away mid-stream
-  }
-  IOBuf chunk;
-  PackChunk(&chunk, data, len);
-  int rc = s->Write(std::move(chunk));
-  s->Dereference();
+  PaExitWriter(pa);
   return rc;
 }
 
 int pa_close(uint64_t pa_token) {
-  PaState* pa = PaAddress(pa_token);
-  if (pa == nullptr || pa->closed.exchange(true)) {
+  PaState* pa;
+  if (!PaEnterWriter(pa_token, &pa)) {
     return -EINVAL;
   }
-  while (butex_value(pa->headers_sent).load(std::memory_order_acquire) ==
-         0) {
-    butex_wait(pa->headers_sent, 0, 1000000);
-    if (PaAddress(pa_token) != pa) {
-      return -EINVAL;
-    }
+  if (pa->closed.exchange(true)) {
+    PaExitWriter(pa);
+    return -EINVAL;  // lost to a concurrent close/abort
   }
-  if (butex_value(pa->headers_sent).load(std::memory_order_acquire) >= 0) {
+  // we are the closer: closed blocks new writers; the generation dies
+  // in PaMaybeFree when the last writer — possibly us — exits
+  int32_t hv;
+  while ((hv = butex_value(pa->headers_sent)
+                   .load(std::memory_order_acquire)) == 0) {
+    butex_wait(pa->headers_sent, 0, 1000000);
+  }
+  if (hv >= 0) {
     Socket* s = Socket::Address(pa->sock);
     if (s != nullptr) {
       IOBuf fin;
@@ -2050,9 +2101,8 @@ int pa_close(uint64_t pa_token) {
       CloseAfterWrite(s, std::move(fin));
       s->Dereference();
     }
-  }  // aborted: nothing to finalize, just release the state
-  pa->version.fetch_add(1, std::memory_order_release);
-  ResourcePool<PaState>::Return(pa->slot);
+  }  // aborted: nothing to finalize
+  PaExitWriter(pa);
   return 0;
 }
 
