@@ -200,6 +200,12 @@ def _declare(L: ctypes.CDLL) -> None:
     L.trpc_ids_dump.argtypes = [c.c_char_p, c.c_size_t]
     L.trpc_ids_dump.restype = c.c_size_t
 
+    # io_uring transport
+    L.trpc_set_io_uring.argtypes = [c.c_int]
+    L.trpc_set_io_uring.restype = None
+    L.trpc_io_uring_available.argtypes = []
+    L.trpc_io_uring_available.restype = c.c_int
+
     # crc32c
     L.trpc_crc32c_extend.argtypes = [c.c_uint32, c.c_char_p, c.c_size_t]
     L.trpc_crc32c_extend.restype = c.c_uint32

@@ -33,3 +33,92 @@ class PerSecondBudget:
                 return False
             self._budget -= 1
             return True
+
+
+class Collected:
+    """Base for objects that want asynchronous, rate-limited processing
+    (≙ bvar::Collected, collector.h:81): call ``submit()`` on the hot
+    path; ``on_collected()`` runs later on the collector thread."""
+
+    def on_collected(self) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def submit(self, collector: "Collector" = None) -> bool:
+        """Queue for background processing.  False = over budget or the
+        queue is saturated (the sample is simply dropped, matching the
+        reference's shed-on-overload behavior)."""
+        return (collector or global_collector()).submit(self)
+
+
+class Collector:
+    """Background sampling service (≙ bvar::Collector, collector.cpp:75:
+    grab-all consumer loop + COLLECTOR_SAMPLING_BASE global speed limit).
+
+    The hot path pays one budget check and one deque append; processing
+    (on_collected) happens on a single daemon thread.  The budget flag is
+    shared by every sample type routed through this collector, like the
+    reference's global sampling speed."""
+
+    MAX_PENDING = 4096  # backstop if on_collected stalls
+
+    def __init__(self, budget_flag: str = "collector_max_samples_per_second"):
+        self._budget = PerSecondBudget(budget_flag)
+        self._lock = threading.Lock()
+        self._pending = []
+        self._wake = threading.Condition(self._lock)
+        self._thread = None
+        self.collected = 0   # processed samples (observable via bvar)
+        self.dropped = 0     # budget/queue sheds
+
+    def submit(self, obj: "Collected") -> bool:
+        if not self._budget.try_take():
+            with self._lock:
+                self.dropped += 1
+            return False
+        with self._wake:
+            if len(self._pending) >= self.MAX_PENDING:
+                self.dropped += 1
+                return False
+            self._pending.append(obj)
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, name="bvar_collector", daemon=True)
+                self._thread.start()
+            self._wake.notify()
+        return True
+
+    def _run(self) -> None:
+        while True:
+            with self._wake:
+                while not self._pending:
+                    self._wake.wait()
+                batch, self._pending = self._pending, []
+            for obj in batch:  # grab-all then process outside the lock
+                try:
+                    obj.on_collected()
+                except Exception:
+                    pass  # a broken sample must not kill the collector
+                with self._lock:
+                    self.collected += 1
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"collected": self.collected, "dropped": self.dropped,
+                    "pending": len(self._pending)}
+
+
+flags.define_int32("collector_max_samples_per_second", 16384,
+                   "global budget shared by samples routed through the "
+                   "default Collector (≙ COLLECTOR_SAMPLING_BASE)")
+
+_global = None
+_global_lock = threading.Lock()
+
+
+def global_collector() -> Collector:
+    global _global
+    if _global is None:
+        with _global_lock:
+            if _global is None:
+                _global = Collector()
+    return _global

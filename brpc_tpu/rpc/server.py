@@ -32,6 +32,10 @@ flags.define_int32("event_dispatcher_num", 1,
                    "server/channel starts")
 flags.define_int32("usercode_workers", 4,
                    "pthreads running Python handlers")
+flags.define_bool("use_io_uring", False,
+                  "serve accepts + reads through io_uring (FORK "
+                  "RingListener \u2259 socket.h:360); falls back to epoll "
+                  "when the kernel refuses the ring")
 def _push_usercode_cap(value) -> bool:
     """Flag validator doubling as the live-reload hook: every /flags set
     propagates straight into the native admission check."""
@@ -459,6 +463,8 @@ class Server:
             int(flags.get_flag("usercode_max_inflight")))
         lib().trpc_set_event_dispatcher_num(
             int(flags.get_flag("event_dispatcher_num")))
+        lib().trpc_set_io_uring(
+            1 if flags.get_flag("use_io_uring") else 0)
         if self.options.enable_builtin_services:
             from brpc_tpu.builtin import install_builtin_services
             install_builtin_services(self, self.http)

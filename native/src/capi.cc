@@ -20,6 +20,7 @@
 #include "stream.h"
 #include "tls.h"
 #include "tpu.h"
+#include "uring.h"
 
 using namespace trpc;
 
@@ -156,6 +157,11 @@ void trpc_set_usercode_max_inflight(int64_t n) {
 void trpc_set_event_dispatcher_num(int n) {
   g_event_dispatcher_num.store(n, std::memory_order_relaxed);
 }
+
+// io_uring transport (FORK RingListener ≙ socket.h:360): opt-in; falls
+// back to epoll transparently when the kernel refuses the ring.
+void trpc_set_io_uring(int on) { uring_set_enabled(on != 0); }
+int trpc_io_uring_available() { return uring_available() ? 1 : 0; }
 
 int trpc_respond(uint64_t token, int32_t error_code, const char* error_text,
                  const uint8_t* data, size_t len, const uint8_t* attach,

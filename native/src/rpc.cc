@@ -1,6 +1,7 @@
 #include "rpc.h"
 
 #include "flat_map.h"
+#include "uring.h"
 
 #include <arpa/inet.h>
 #include <errno.h>
@@ -446,6 +447,7 @@ class Server {
   // working beside them (≙ brpc serving SSL and plain on one port)
   void* tls_ctx = nullptr;
   int listen_fd = -1;
+  bool ring_acceptor = false;  // accepts flow through the io_uring engine
   SocketId listen_sock = INVALID_SOCKET_ID;
   int port = 0;
   std::atomic<bool> running{false};
@@ -1342,6 +1344,54 @@ void ServerConnFailed(Socket* s) {
 
 // edge_fn of the acceptor socket (≙ Acceptor::OnNewConnections,
 // acceptor.cpp:253): accept until EAGAIN, one connection Socket each.
+// One accepted fd -> a connection Socket wired to the parse path.  The
+// epoll acceptor AND the io_uring RingListener both land here; only the
+// readiness plumbing differs (AddConsumer vs multishot RECV).
+void ServerAdoptConnection(Server* srv, int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  SocketOptions opts;
+  opts.fd = fd;
+  opts.edge_fn = ServerOnMessages;
+  opts.user = srv;
+  opts.on_failed = ServerConnFailed;
+  SocketId id;
+  if (Socket::Create(opts, &id) != 0) {
+    ::close(fd);
+    return;
+  }
+  {
+    std::lock_guard<std::mutex> lk(srv->conns_mu);
+    srv->conns[id] = true;
+    // amortized prune of fully-recycled ids so a long-lived server's
+    // table tracks live connections, not history
+    if (srv->conns.size() >= 64 &&
+        (srv->conns.size() & (srv->conns.size() - 1)) == 0) {
+      for (auto it = srv->conns.begin(); it != srv->conns.end();) {
+        if (Socket::IsRecycled(it->first)) {
+          it = srv->conns.erase(it);
+        } else {
+          ++it;
+        }
+      }
+    }
+  }
+  // TLS connections stay on the epoll path: the TLS engine pumps raw
+  // records straight off the fd, which the ring's staged feed would
+  // bypass.  Large-attachment zero-copy alignment (frame_bytes_hint)
+  // also only exists on the fd path — in ring mode big payloads
+  // reassemble from 16KB provided buffers (documented opt-in tradeoff).
+  if (srv->tls_ctx == nullptr && uring_enabled() &&
+      uring_add_recv(id, fd) == 0) {
+    return;  // ring receives feed this socket; no epoll registration
+  }
+  EventDispatcher::Instance().AddConsumer(id, fd);
+}
+
+void RingOnAccept(void* user, int fd) {
+  ServerAdoptConnection((Server*)user, fd);
+}
+
 void OnNewConnections(Socket* listen_s) {
   while (true) {
     int fd = accept4(listen_s->fd, nullptr, nullptr,
@@ -1349,36 +1399,7 @@ void OnNewConnections(Socket* listen_s) {
     if (fd < 0) {
       return;  // EAGAIN or error: either way, wait for the next edge
     }
-    int one = 1;
-    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-    SocketOptions opts;
-    opts.fd = fd;
-    opts.edge_fn = ServerOnMessages;
-    opts.user = listen_s->user;  // Server*
-    opts.on_failed = ServerConnFailed;
-    SocketId id;
-    if (Socket::Create(opts, &id) != 0) {
-      ::close(fd);
-      continue;
-    }
-    Server* srv = (Server*)listen_s->user;
-    {
-      std::lock_guard<std::mutex> lk(srv->conns_mu);
-      srv->conns[id] = true;
-      // amortized prune of fully-recycled ids so a long-lived server's
-      // table tracks live connections, not history
-      if (srv->conns.size() >= 64 &&
-          (srv->conns.size() & (srv->conns.size() - 1)) == 0) {
-        for (auto it = srv->conns.begin(); it != srv->conns.end();) {
-          if (Socket::IsRecycled(it->first)) {
-            it = srv->conns.erase(it);
-          } else {
-            ++it;
-          }
-        }
-      }
-    }
-    EventDispatcher::Instance().AddConsumer(id, fd);
+    ServerAdoptConnection((Server*)listen_s->user, fd);
   }
 }
 
@@ -1669,6 +1690,14 @@ int server_start(Server* s, const char* ip, int port) {
     ::close(fd);
     return -ENOMEM;
   }
+  if (uring_enabled() &&
+      uring_add_acceptor(s->listen_sock, fd, RingOnAccept, s) == 0) {
+    // RingListener mode: multishot ACCEPT completions adopt connections;
+    // the listen Socket exists only for stop/teardown bookkeeping
+    s->ring_acceptor = true;
+    s->running.store(true);
+    return 0;
+  }
   EventDispatcher::Instance().AddConsumer(s->listen_sock, fd);
   s->running.store(true);
   return 0;
@@ -1679,6 +1708,13 @@ int server_port(Server* s) { return s->port; }
 int server_stop(Server* s) {
   if (!s->running.exchange(false)) {
     return 0;
+  }
+  if (s->ring_acceptor) {
+    // synchronous: the armed multishot ACCEPT holds a file reference
+    // (the port would stay bound past close) and its completions carry
+    // this Server* — neither may outlive stop
+    uring_remove_acceptor(s->listen_fd);
+    s->ring_acceptor = false;
   }
   Socket* ls = Socket::Address(s->listen_sock);
   if (ls != nullptr) {

@@ -15,6 +15,7 @@
 
 #include "metrics.h"
 #include "tls.h"
+#include "uring.h"
 #include "object_pool.h"
 
 namespace trpc {
@@ -180,6 +181,12 @@ void Socket::TryRecycle(uint32_t odd_ver) {
   }
   parse_state = nullptr;
   parse_state_free = nullptr;
+  if (ring_feed != nullptr) {
+    // same lifetime rule: the ring engine only touches the feed while
+    // holding an Address ref, so nothing can be inside it now
+    ring_feed_release(ring_feed);
+    ring_feed = nullptr;
+  }
   if (tls != nullptr) {
     tls_state_free((TlsState*)tls);
     tls = nullptr;
@@ -199,6 +206,9 @@ void Socket::SetFailed(int err) {
     return;  // only the first failure proceeds
   }
   error_code = err;
+  if (ring_feed != nullptr) {
+    uring_cancel(id());  // stop the multishot recv promptly
+  }
   native_metrics().socket_failures.fetch_add(1, std::memory_order_relaxed);
   if (err == TRPC_EREQUEST) {
     // malformed input killed the connection (≙ per-socket parse errors)
@@ -238,6 +248,11 @@ void tls_emit_to_socket(void* arg, IOBuf&& enc) {
 }  // namespace
 
 ssize_t Socket::ReadToBuf(bool* eof) {
+  if (ring_feed != nullptr) {
+    // io_uring mode: the ring thread already received the bytes into the
+    // staging feed; drain it instead of touching the fd
+    return ring_feed_drain(this, eof);
+  }
   if (tls != nullptr) {
     // TLS: raw records from the fd pump through the engine; plaintext
     // lands in read_buf (the protocol layer is oblivious), handshake /

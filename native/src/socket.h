@@ -77,6 +77,9 @@ class Socket {
   // connection advertises the server's plane caps back
   std::atomic<bool> advertise_device_caps{false};
   // opaque per-connection parser/pipelining state owned by the protocol
+  // io_uring staging (uring.h RingFeed): when non-null, ReadToBuf drains
+  // it instead of calling recv(2); freed at recycle time
+  void* ring_feed = nullptr;
   // layer (rpc.cc: ConnState); freed via parse_state_free at recycle time
   // (after the last Address ref is gone — respond paths may touch it)
   void* parse_state = nullptr;

@@ -1,0 +1,613 @@
+// uring.cc — see uring.h.  Raw-syscall io_uring: setup + two mmaps (SQ
+// incl. SQE array, CQ), a provided-buffer ring for multishot RECV, and a
+// single engine thread that owns the submission queue.  Cross-thread op
+// requests queue behind a mutex and the thread is woken through an
+// eventfd that is itself read via the ring.
+#include "uring.h"
+
+#include <errno.h>
+#include <linux/io_uring.h>
+#include <string.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <sys/mman.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "metrics.h"
+
+namespace trpc {
+
+namespace {
+
+int sys_io_uring_setup(unsigned entries, struct io_uring_params* p) {
+  return (int)syscall(__NR_io_uring_setup, entries, p);
+}
+
+int sys_io_uring_enter(int fd, unsigned to_submit, unsigned min_complete,
+                       unsigned flags) {
+  return (int)syscall(__NR_io_uring_enter, fd, to_submit, min_complete,
+                      flags, nullptr, 0);
+}
+
+int sys_io_uring_register(int fd, unsigned opcode, void* arg,
+                          unsigned nr_args) {
+  return (int)syscall(__NR_io_uring_register, fd, opcode, arg, nr_args);
+}
+
+// user_data tags
+constexpr uint64_t kTagWake = 1ULL << 62;
+constexpr uint64_t kTagAccept = 2ULL << 62;
+constexpr uint64_t kTagRecv = 3ULL << 62;
+constexpr uint64_t kTagMask = 3ULL << 62;
+
+constexpr unsigned kEntries = 256;
+constexpr int kBufGroup = 7;
+constexpr unsigned kNumBufs = 256;   // provided buffers
+constexpr size_t kBufSize = 16384;
+
+struct PendingOp {
+  int kind;  // 0 accept, 1 recv, 2 cancel-recv, 3 remove-acceptor
+  SocketId id = INVALID_SOCKET_ID;
+  int fd = -1;
+  void (*on_accept)(void*, int) = nullptr;
+  void* user = nullptr;
+};
+
+struct Acceptor {
+  void (*on_accept)(void*, int);
+  void* user;
+  int fd;
+};
+
+class RingEngine {
+ public:
+  static RingEngine* Instance() {
+    static RingEngine* e = new RingEngine();  // leaked on purpose
+    return e;
+  }
+
+  bool ok() const { return ring_fd_ >= 0; }
+
+  int Add(PendingOp op) {
+    if (!ok()) {
+      return -ENOSYS;
+    }
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      pending_.push_back(op);
+      ++ops_enqueued_;
+    }
+    uint64_t one = 1;
+    (void)!write(event_fd_, &one, sizeof(one));
+    return 0;
+  }
+
+  // Wait until every op enqueued before this call has been processed by
+  // the engine thread (teardown barrier: after it, no acceptor callback
+  // can fire for a removed listener).
+  void Quiesce() {
+    if (!ok()) {
+      return;
+    }
+    uint64_t target;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      target = ops_enqueued_;
+    }
+    while (ops_done_.load(std::memory_order_acquire) < target) {
+      usleep(200);
+    }
+  }
+
+ private:
+  RingEngine() {
+    struct io_uring_params p;
+    memset(&p, 0, sizeof(p));
+    int fd = sys_io_uring_setup(kEntries, &p);
+    if (fd < 0) {
+      return;
+    }
+    // required: buffer selection (5.7+), multishot accept/recv (5.19/6.0)
+    if (!(p.features & IORING_FEAT_FAST_POLL)) {
+      close(fd);
+      return;
+    }
+    size_t sq_sz = p.sq_off.array + p.sq_entries * sizeof(uint32_t);
+    size_t cq_sz = p.cq_off.cqes + p.cq_entries * sizeof(io_uring_cqe);
+    if (p.features & IORING_FEAT_SINGLE_MMAP) {
+      sq_sz = cq_sz = sq_sz > cq_sz ? sq_sz : cq_sz;
+    }
+    sq_ptr_ = mmap(nullptr, sq_sz, PROT_READ | PROT_WRITE,
+                   MAP_SHARED | MAP_POPULATE, fd, IORING_OFF_SQ_RING);
+    if (sq_ptr_ == MAP_FAILED) {
+      close(fd);
+      return;
+    }
+    cq_ptr_ = (p.features & IORING_FEAT_SINGLE_MMAP)
+                  ? sq_ptr_
+                  : mmap(nullptr, cq_sz, PROT_READ | PROT_WRITE,
+                         MAP_SHARED | MAP_POPULATE, fd, IORING_OFF_CQ_RING);
+    if (cq_ptr_ == MAP_FAILED) {
+      close(fd);
+      return;
+    }
+    sqes_ = (io_uring_sqe*)mmap(
+        nullptr, p.sq_entries * sizeof(io_uring_sqe),
+        PROT_READ | PROT_WRITE, MAP_SHARED | MAP_POPULATE, fd,
+        IORING_OFF_SQES);
+    if (sqes_ == MAP_FAILED) {
+      close(fd);
+      return;
+    }
+    sq_head_ = (std::atomic<uint32_t>*)((char*)sq_ptr_ + p.sq_off.head);
+    sq_tail_ = (std::atomic<uint32_t>*)((char*)sq_ptr_ + p.sq_off.tail);
+    sq_mask_ = *(uint32_t*)((char*)sq_ptr_ + p.sq_off.ring_mask);
+    sq_array_ = (uint32_t*)((char*)sq_ptr_ + p.sq_off.array);
+    cq_head_ = (std::atomic<uint32_t>*)((char*)cq_ptr_ + p.cq_off.head);
+    cq_tail_ = (std::atomic<uint32_t>*)((char*)cq_ptr_ + p.cq_off.tail);
+    cq_mask_ = *(uint32_t*)((char*)cq_ptr_ + p.cq_off.ring_mask);
+    cqes_ = (io_uring_cqe*)((char*)cq_ptr_ + p.cq_off.cqes);
+
+    // provided-buffer ring for multishot RECV
+    size_t br_sz = kNumBufs * sizeof(io_uring_buf);
+    buf_ring_ = (io_uring_buf_ring*)mmap(
+        nullptr, br_sz, PROT_READ | PROT_WRITE,
+        MAP_ANONYMOUS | MAP_PRIVATE, -1, 0);
+    buf_base_ = (char*)mmap(nullptr, kNumBufs * kBufSize,
+                            PROT_READ | PROT_WRITE,
+                            MAP_ANONYMOUS | MAP_PRIVATE, -1, 0);
+    if (buf_ring_ == MAP_FAILED || buf_base_ == MAP_FAILED) {
+      close(fd);
+      return;
+    }
+    // fault the pages in BEFORE registration: pinning a never-written
+    // private anonymous page can pin the shared zero page, and later
+    // stores COW onto a page the kernel no longer reads
+    memset(buf_ring_, 0, br_sz);
+    memset(buf_base_, 0, kNumBufs * kBufSize);
+    struct io_uring_buf_reg reg;
+    memset(&reg, 0, sizeof(reg));
+    reg.ring_addr = (uint64_t)(uintptr_t)buf_ring_;
+    reg.ring_entries = kNumBufs;
+    reg.bgid = kBufGroup;
+    int rrc = sys_io_uring_register(fd, IORING_REGISTER_PBUF_RING, &reg, 1);
+    if (getenv("TRPC_URING_DEBUG"))
+      fprintf(stderr, "[uring] pbuf register rc=%d on fd=%d ring_addr=%p\n",
+              rrc, fd, (void*)buf_ring_);
+    if (rrc != 0) {
+      close(fd);
+      return;
+    }
+    br_tail_ = 0;
+    for (unsigned i = 0; i < kNumBufs; ++i) {
+      AddProvidedBuf(i);
+    }
+    PublishBufTail();
+
+    event_fd_ = eventfd(0, EFD_CLOEXEC);
+    if (event_fd_ < 0) {
+      close(fd);
+      return;  // engine unusable without its wake channel
+    }
+    // self-test: a multishot RECV with buffer selection must actually
+    // work on THIS kernel (feature bits alone don't prove 6.0+ multishot
+    // recv; on older kernels it fails -EINVAL and we must fall back to
+    // epoll instead of killing every connection)
+    {
+      int sv[2];
+      if (socketpair(AF_UNIX, SOCK_STREAM, 0, sv) != 0) {
+        close(fd);
+        return;
+      }
+      io_uring_sqe* sqe = &sqes_[sq_tail_local_ & sq_mask_];
+      memset(sqe, 0, sizeof(*sqe));
+      sq_array_[sq_tail_local_ & sq_mask_] = sq_tail_local_ & sq_mask_;
+      sqe->opcode = IORING_OP_RECV;
+      sqe->fd = sv[0];
+      sqe->ioprio = IORING_RECV_MULTISHOT;
+      sqe->flags = IOSQE_BUFFER_SELECT;
+      sqe->buf_group = kBufGroup;
+      sqe->user_data = kTagWake | 1;
+      ++sq_tail_local_;
+      sq_tail_->store(sq_tail_local_, std::memory_order_release);
+      (void)!write(sv[1], "x", 1);
+      sys_io_uring_enter(fd, 1, 1, IORING_ENTER_GETEVENTS);
+      bool self_ok = false;
+      uint32_t h = cq_head_->load(std::memory_order_acquire);
+      uint32_t t = cq_tail_->load(std::memory_order_acquire);
+      while (h != t) {
+        io_uring_cqe* cqe = &cqes_[h & cq_mask_];
+        if (cqe->user_data == (kTagWake | 1)) {
+          self_ok = cqe->res == 1 &&
+                    (cqe->flags & IORING_CQE_F_BUFFER) != 0;
+          if (self_ok) {
+            AddProvidedBuf(cqe->flags >> IORING_CQE_BUFFER_SHIFT);
+            PublishBufTail();
+          }
+        }
+        ++h;
+        cq_head_->store(h, std::memory_order_release);
+        t = cq_tail_->load(std::memory_order_acquire);
+      }
+      close(sv[0]);
+      close(sv[1]);
+      if (!self_ok) {
+        close(fd);
+        return;
+      }
+    }
+    ring_fd_ = fd;
+    std::thread t([this] {
+      pthread_setname_np(pthread_self(), "trpc_uring");
+      Loop();
+    });
+    t.detach();
+  }
+
+  void AddProvidedBuf(unsigned bid) {
+    // NOT buf_ring_->bufs[]: __DECLARE_FLEX_ARRAY pads the flex member
+    // to offset 8 under C++, while the kernel reads entries from offset
+    // 0 with a 16-byte stride (entry 0's tail bytes alias the header)
+    io_uring_buf* entries = (io_uring_buf*)buf_ring_;
+    io_uring_buf* b = &entries[br_tail_ & (kNumBufs - 1)];
+    b->addr = (uint64_t)(uintptr_t)(buf_base_ + (size_t)bid * kBufSize);
+    b->len = kBufSize;
+    b->bid = (uint16_t)bid;
+    ++br_tail_;
+  }
+
+  void PublishBufTail() {
+    __atomic_store_n(&buf_ring_->tail, (uint16_t)br_tail_,
+                     __ATOMIC_RELEASE);
+  }
+
+  io_uring_sqe* GetSqe() {
+    uint32_t head = sq_head_->load(std::memory_order_acquire);
+    if (sq_tail_local_ - head >= kEntries) {
+      Submit();  // ring full: flush what we have
+    }
+    uint32_t idx = sq_tail_local_ & sq_mask_;
+    io_uring_sqe* sqe = &sqes_[idx];
+    memset(sqe, 0, sizeof(*sqe));
+    sq_array_[idx] = idx;
+    ++sq_tail_local_;
+    ++unsubmitted_;
+    return sqe;
+  }
+
+  void Submit() {
+    if (unsubmitted_ == 0) {
+      return;
+    }
+    sq_tail_->store(sq_tail_local_, std::memory_order_release);
+    sys_io_uring_enter(ring_fd_, unsubmitted_, 0, 0);
+    unsubmitted_ = 0;
+  }
+
+  void ArmWake() {
+    io_uring_sqe* sqe = GetSqe();
+    sqe->opcode = IORING_OP_READ;
+    sqe->fd = event_fd_;
+    sqe->addr = (uint64_t)(uintptr_t)&wake_buf_;
+    sqe->len = sizeof(wake_buf_);
+    sqe->user_data = kTagWake;
+  }
+
+  void ArmAccept(int fd) {
+    io_uring_sqe* sqe = GetSqe();
+    sqe->opcode = IORING_OP_ACCEPT;
+    sqe->fd = fd;
+    sqe->ioprio = IORING_ACCEPT_MULTISHOT;
+    sqe->accept_flags = SOCK_NONBLOCK | SOCK_CLOEXEC;
+    sqe->user_data = kTagAccept | (uint64_t)(uint32_t)fd;
+  }
+
+  // 2 tag bits + 30 truncated generation bits + 32 slot bits: a late
+  // CQE from a recycled slot can never be mistaken for the slot's new
+  // occupant (the stored user_data differs in the generation field)
+  static uint64_t RecvUserData(SocketId id) {
+    return kTagRecv | (((id >> 32) & 0x3fffffffULL) << 32) |
+           (uint64_t)(uint32_t)id;
+  }
+
+  void ArmRecv(SocketId id, int fd) {
+    io_uring_sqe* sqe = GetSqe();
+    sqe->opcode = IORING_OP_RECV;
+    sqe->fd = fd;
+    sqe->ioprio = IORING_RECV_MULTISHOT;
+    sqe->flags = IOSQE_BUFFER_SELECT;
+    sqe->buf_group = kBufGroup;
+    sqe->user_data = RecvUserData(id);
+  }
+
+  void Drain() {
+    std::vector<PendingOp> ops;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      ops.swap(pending_);
+    }
+    for (PendingOp& op : ops) {
+      if (op.kind == 0) {
+        acceptors_[op.fd] = Acceptor{op.on_accept, op.user, op.fd};
+        ArmAccept(op.fd);
+      } else if (op.kind == 1) {
+        recv_uds_[(uint32_t)op.id] = RecvEntry{op.id, RecvUserData(op.id)};
+        ArmRecv(op.id, op.fd);
+      } else if (op.kind == 2) {
+        io_uring_sqe* sqe = GetSqe();
+        sqe->opcode = IORING_OP_ASYNC_CANCEL;
+        sqe->addr = RecvUserData(op.id);
+        sqe->user_data = kTagWake | 2;  // completion ignored
+        auto rit = recv_uds_.find((uint32_t)op.id);
+        if (rit != recv_uds_.end() &&
+            rit->second.ud == RecvUserData(op.id)) {
+          recv_uds_.erase(rit);
+        }
+      } else {  // remove-acceptor: no accept callback may fire after this
+        io_uring_sqe* sqe = GetSqe();
+        sqe->opcode = IORING_OP_ASYNC_CANCEL;
+        sqe->addr = kTagAccept | (uint64_t)(uint32_t)op.fd;
+        sqe->user_data = kTagWake | 2;
+        acceptors_.erase(op.fd);
+      }
+      ops_done_.fetch_add(1, std::memory_order_release);
+    }
+  }
+
+  void OnRecvCqe(io_uring_cqe* cqe) {
+    uint32_t slot = (uint32_t)cqe->user_data;
+    auto it = recv_uds_.find(slot);
+    int32_t res = cqe->res;
+    bool has_buf = (cqe->flags & IORING_CQE_F_BUFFER) != 0;
+    unsigned bid =
+        has_buf ? (cqe->flags >> IORING_CQE_BUFFER_SHIFT) : 0;
+    if (it == recv_uds_.end() || it->second.ud != cqe->user_data) {
+      // stale completion from a canceled/recycled generation: recycle
+      // the buffer and nothing else — the slot may already belong to a
+      // NEW connection this CQE must not touch
+      if (has_buf) {
+        AddProvidedBuf(bid);
+        PublishBufTail();
+      }
+      return;
+    }
+    SocketId sid = it->second.id;
+    Socket* s = Socket::Address(sid);
+    if (s != nullptr && s->ring_feed != nullptr) {
+      RingFeed* f = (RingFeed*)s->ring_feed;
+      {
+        std::lock_guard<std::mutex> lk(f->mu);
+        if (res > 0 && has_buf) {
+          f->staged.append(buf_base_ + (size_t)bid * kBufSize,
+                           (size_t)res);
+        } else if (res == 0) {
+          f->eof = true;
+        } else if (res < 0 && res != -ENOBUFS) {
+          f->err = -res;
+          f->eof = true;
+        }
+      }
+      Socket::StartInputEvent(sid);
+    }
+    if (has_buf) {
+      AddProvidedBuf(bid);
+      PublishBufTail();
+    }
+    if (!(cqe->flags & IORING_CQE_F_MORE)) {
+      // multishot terminated.  EOF (res 0) and real errors are terminal;
+      // everything else (ENOBUFS, benign kernel retirement with data)
+      // re-arms — a silently un-armed live connection would stall
+      bool terminal = res == 0 || (res < 0 && res != -ENOBUFS);
+      if (!terminal && s != nullptr) {
+        ArmRecv(sid, s->fd);
+      } else {
+        recv_uds_.erase(slot);
+      }
+    }
+    if (s != nullptr) {
+      s->Dereference();
+    }
+  }
+
+  void Loop() {
+    if (getenv("TRPC_URING_DEBUG")) debug_ = true;
+    if (debug_) fprintf(stderr, "[uring] loop start ring_fd=%d\n", ring_fd_);
+    ArmWake();
+    Submit();
+    while (true) {
+      sys_io_uring_enter(ring_fd_, 0, 1, IORING_ENTER_GETEVENTS);
+      uint32_t head = cq_head_->load(std::memory_order_acquire);
+      uint32_t tail = cq_tail_->load(std::memory_order_acquire);
+      bool rearm_wake = false;
+      while (head != tail) {
+        io_uring_cqe* cqe = &cqes_[head & cq_mask_];
+        uint64_t tag = cqe->user_data & kTagMask;
+        if (debug_) fprintf(stderr, "[uring] cqe ud=%llx res=%d flags=%x\n",
+                            (unsigned long long)cqe->user_data, cqe->res,
+                            cqe->flags);
+        if (tag == kTagWake) {
+          if (cqe->user_data == kTagWake) {
+            rearm_wake = true;
+          }
+        } else if (tag == kTagAccept) {
+          int lfd = (int)(uint32_t)cqe->user_data;
+          auto it = acceptors_.find(lfd);
+          if (it != acceptors_.end()) {
+            if (cqe->res >= 0) {
+              it->second.on_accept(it->second.user, cqe->res);
+            }
+            if (!(cqe->flags & IORING_CQE_F_MORE)) {
+              if (cqe->res >= 0) {
+                ArmAccept(lfd);  // kernel dropped multishot benignly
+              } else {
+                // canceled or listener closed: re-arming a dead fd
+                // would spin -EBADF completions forever
+                acceptors_.erase(it);
+              }
+            }
+          } else if (cqe->res >= 0) {
+            close(cqe->res);  // accepted for a gone listener
+          }
+        } else if (tag == kTagRecv) {
+          OnRecvCqe(cqe);
+        }
+        ++head;
+        cq_head_->store(head, std::memory_order_release);
+        tail = cq_tail_->load(std::memory_order_acquire);
+      }
+      Drain();
+      if (rearm_wake) {
+        ArmWake();
+      }
+      Submit();
+    }
+  }
+
+  int ring_fd_ = -1;
+  int event_fd_ = -1;
+  uint64_t wake_buf_ = 0;
+  void* sq_ptr_ = nullptr;
+  void* cq_ptr_ = nullptr;
+  io_uring_sqe* sqes_ = nullptr;
+  std::atomic<uint32_t>* sq_head_ = nullptr;
+  std::atomic<uint32_t>* sq_tail_ = nullptr;
+  uint32_t sq_mask_ = 0;
+  uint32_t* sq_array_ = nullptr;
+  uint32_t sq_tail_local_ = 0;
+  unsigned unsubmitted_ = 0;
+  std::atomic<uint32_t>* cq_head_ = nullptr;
+  std::atomic<uint32_t>* cq_tail_ = nullptr;
+  uint32_t cq_mask_ = 0;
+  io_uring_cqe* cqes_ = nullptr;
+  io_uring_buf_ring* buf_ring_ = nullptr;
+  char* buf_base_ = nullptr;
+  uint32_t br_tail_ = 0;
+
+  bool debug_ = false;
+  std::mutex mu_;
+  std::vector<PendingOp> pending_;
+  // engine-thread-only state
+  std::unordered_map<int, Acceptor> acceptors_;
+  struct RecvEntry {
+    SocketId id;
+    uint64_t ud;  // the exact user_data armed for this generation
+  };
+  std::unordered_map<uint32_t, RecvEntry> recv_uds_;
+  uint64_t ops_enqueued_ = 0;               // guarded by mu_
+  std::atomic<uint64_t> ops_done_{0};
+};
+
+std::atomic<bool> g_uring_enabled{false};
+
+}  // namespace
+
+bool uring_available() {
+  static bool avail = [] {
+    struct io_uring_params p;
+    memset(&p, 0, sizeof(p));
+    int fd = sys_io_uring_setup(4, &p);
+    if (fd < 0) {
+      return false;
+    }
+    close(fd);
+    // multishot recv + pbuf rings landed by 6.0; gate on the feature
+    // bits we can see plus a kernel new enough to have EXT_ARG
+    return (p.features & IORING_FEAT_FAST_POLL) != 0 &&
+           (p.features & IORING_FEAT_EXT_ARG) != 0;
+  }();
+  return avail;
+}
+
+void uring_set_enabled(bool on) {
+  g_uring_enabled.store(on, std::memory_order_release);
+}
+
+bool uring_enabled() {
+  return g_uring_enabled.load(std::memory_order_acquire) &&
+         uring_available() && RingEngine::Instance()->ok();
+}
+
+void ring_feed_release(void* feed) { delete (RingFeed*)feed; }
+
+ssize_t ring_feed_drain(Socket* s, bool* eof) {
+  RingFeed* f = (RingFeed*)s->ring_feed;
+  std::lock_guard<std::mutex> lk(f->mu);
+  size_t n = f->staged.size();
+  if (n > 0) {
+    IOBuf tmp;
+    f->staged.cutn(&tmp, n);
+    s->read_buf.append(std::move(tmp));
+    s->bytes_in.fetch_add((uint64_t)n, std::memory_order_relaxed);
+  }
+  if (n == 0 && f->err != 0) {
+    // staged data drains first; a recv error then surfaces exactly like
+    // the epoll path: -1 with errno (NOT a clean EOF)
+    errno = f->err;
+    return -1;
+  }
+  if (f->eof) {
+    *eof = true;
+  }
+  if (n == 0 && !f->eof) {
+    errno = EAGAIN;
+    return -1;
+  }
+  return (ssize_t)n;
+}
+
+int uring_add_acceptor(SocketId id, int fd, void (*on_accept)(void*, int),
+                       void* user) {
+  (void)id;
+  PendingOp op;
+  op.kind = 0;
+  op.fd = fd;
+  op.on_accept = on_accept;
+  op.user = user;
+  return RingEngine::Instance()->Add(op);
+}
+
+int uring_add_recv(SocketId id, int fd) {
+  Socket* s = Socket::Address(id);
+  if (s == nullptr) {
+    return -EINVAL;
+  }
+  if (s->ring_feed == nullptr) {
+    s->ring_feed = new RingFeed();
+  }
+  s->Dereference();
+  PendingOp op;
+  op.kind = 1;
+  op.id = id;
+  op.fd = fd;
+  return RingEngine::Instance()->Add(op);
+}
+
+void uring_cancel(SocketId id) {
+  PendingOp op;
+  op.kind = 2;
+  op.id = id;
+  RingEngine::Instance()->Add(op);
+}
+
+void uring_remove_acceptor(int fd) {
+  PendingOp op;
+  op.kind = 3;
+  op.fd = fd;
+  RingEngine* e = RingEngine::Instance();
+  if (e->Add(op) == 0) {
+    // barrier: when this returns, no accept callback can fire for fd —
+    // the Server that owned it may be freed right after
+    e->Quiesce();
+  }
+}
+
+}  // namespace trpc

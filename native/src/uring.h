@@ -1,0 +1,63 @@
+// uring.h — io_uring acceptor + receive engine (the reference FORK's
+// RingListener + InputMessenger::OnNewMessagesFromRing, socket.h:360 /
+// input_messenger.cpp:398 — re-designed on raw syscalls: no liburing in
+// the image).
+//
+// Opt-in (flag use_io_uring / TRPC_USE_IO_URING): when enabled and the
+// kernel grants io_uring_setup, a single ring thread
+//   * accepts connections with multishot ACCEPT on listening fds, and
+//   * receives bytes with multishot RECV + a provided-buffer ring,
+// staging them into per-socket RingFeed buffers.  Socket::ReadToBuf
+// drains the staging instead of calling recv(2) — the parse path above
+// it (ServerOnMessages etc.) is unchanged.  Sockets fall back to the
+// epoll EventDispatcher transparently when the ring is unavailable.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <mutex>
+
+#include "iobuf.h"
+#include "socket.h"
+
+namespace trpc {
+
+// One-time probe: io_uring_setup succeeds and the features needed for
+// multishot + provided buffers are present.
+bool uring_available();
+
+// Global enable switch (set from the Python flag before server_start).
+void uring_set_enabled(bool on);
+bool uring_enabled();  // enabled AND available
+
+// Staging between the ring thread and Socket::ReadToBuf.
+struct RingFeed {
+  std::mutex mu;
+  IOBuf staged;
+  bool eof = false;
+  int err = 0;
+};
+
+// Drain helper called by Socket::ReadToBuf when ring_feed is set.
+ssize_t ring_feed_drain(Socket* s, bool* eof);
+
+// Free a RingFeed at socket recycle time (opaque to socket.cc).
+void ring_feed_release(void* feed);
+
+// Register a LISTENING socket: multishot-accept; each new fd is handed
+// to on_accept(user, fd).  Returns 0 or -errno.
+int uring_add_acceptor(SocketId id, int fd, void (*on_accept)(void*, int),
+                       void* user);
+
+// Register a CONNECTION socket for ring receives.  Allocates the
+// socket's RingFeed (freed on socket recycle).  Returns 0 or -errno.
+int uring_add_recv(SocketId id, int fd);
+
+// Cancel outstanding ops for this user_data owner (socket failed).
+void uring_cancel(SocketId id);
+
+// Tear down a listener's multishot accept.  Synchronous: on return no
+// accept callback can fire for this fd (safe to free its Server).
+void uring_remove_acceptor(int fd);
+
+}  // namespace trpc

@@ -162,3 +162,70 @@ class TestWindow:
         ps = bvar.PerSecond(a, 10)
         a.add(100)
         assert ps.get_value() == 0
+
+
+class TestCollector:
+    """Background sampling service (≙ bvar::Collector, collector.cpp
+    grab-all loop + global speed limit)."""
+
+    def test_samples_processed_async(self):
+        import threading
+        from brpc_tpu.metrics.collector import Collected, Collector
+
+        done = threading.Event()
+        seen = []
+
+        class S(Collected):
+            def __init__(self, i):
+                self.i = i
+
+            def on_collected(self):
+                seen.append(self.i)
+                if len(seen) == 10:
+                    done.set()
+
+        c = Collector()
+        for i in range(10):
+            assert S(i).submit(c)
+        assert done.wait(5)
+        assert sorted(seen) == list(range(10))
+        st = c.stats()
+        assert st["collected"] == 10 and st["dropped"] == 0
+
+    def test_budget_sheds(self):
+        from brpc_tpu.metrics.collector import Collected, Collector
+        from brpc_tpu.utils import flags
+
+        old = flags.get_flag("collector_max_samples_per_second")
+        flags.set_flag("collector_max_samples_per_second", 5)
+        try:
+            class S(Collected):
+                def on_collected(self):
+                    pass
+
+            c = Collector()
+            grants = sum(1 for _ in range(50) if S().submit(c))
+            # one second's budget only; the rest shed
+            assert grants <= 5
+            assert c.stats()["dropped"] >= 45
+        finally:
+            flags.set_flag("collector_max_samples_per_second", old)
+
+    def test_broken_sample_does_not_kill_collector(self):
+        import threading
+        from brpc_tpu.metrics.collector import Collected, Collector
+
+        done = threading.Event()
+
+        class Bad(Collected):
+            def on_collected(self):
+                raise RuntimeError("boom")
+
+        class Good(Collected):
+            def on_collected(self):
+                done.set()
+
+        c = Collector()
+        Bad().submit(c)
+        Good().submit(c)
+        assert done.wait(5)  # processing continued past the bad sample
