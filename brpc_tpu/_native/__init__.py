@@ -67,6 +67,15 @@ def _declare(L: ctypes.CDLL) -> None:
     L.trpc_fiber_start.restype = c.c_int
     L.trpc_fiber_join.argtypes = [c.c_uint64]
     L.trpc_fiber_join.restype = c.c_int
+    L.trpc_fiber_start_bound.argtypes = [c.c_int, c.POINTER(c.c_uint64),
+                                         FIBER_FN, c.c_void_p]
+    L.trpc_fiber_start_bound.restype = c.c_int
+    L.trpc_fiber_jump_group.argtypes = [c.c_int]
+    L.trpc_fiber_jump_group.restype = c.c_int
+    L.trpc_fiber_worker_index.argtypes = []
+    L.trpc_fiber_worker_index.restype = c.c_int
+    L.trpc_fiber_register_worker_hook.argtypes = [c.c_void_p, c.c_void_p]
+    L.trpc_fiber_register_worker_hook.restype = c.c_int
     L.trpc_fiber_key_create.argtypes = [c.POINTER(c.c_uint64), c.c_void_p]
     L.trpc_fiber_key_create.restype = c.c_int
     L.trpc_fiber_key_delete.argtypes = [c.c_uint64]

@@ -60,26 +60,56 @@ _live_callbacks: Dict[int, object] = {}
 _cb_seq = [0]
 
 
-def start(fn: Callable[[], None]) -> int:
-    """Run fn() on a fiber.  For tests/tools — handlers on the RPC hot path
-    are dispatched natively, not through here."""
+def _start_impl(starter, what: str) -> int:
+    """Shared trampoline/keepalive/error plumbing for the start variants:
+    the ctypes callback must outlive its fiber, and a failed native start
+    must not leak the keepalive entry."""
     init()
     key = _cb_seq[0] = _cb_seq[0] + 1
+    holder = {}
 
     def tramp(_arg):
         try:
-            fn()
+            holder["fn"]()
         finally:
             _live_callbacks.pop(key, None)
 
     cfn = FIBER_FN(tramp)
     _live_callbacks[key] = cfn
     fid = ctypes.c_uint64()
-    rc = lib().trpc_fiber_start(ctypes.byref(fid), cfn, None)
+    rc = starter(holder, ctypes.byref(fid), cfn)
     if rc != 0:
         _live_callbacks.pop(key, None)
-        raise OSError(rc, "fiber_start failed")
+        raise OSError(rc, f"{what} failed")
     return fid.value
+
+
+def start(fn: Callable[[], None]) -> int:
+    """Run fn() on a fiber.  For tests/tools — handlers on the RPC hot path
+    are dispatched natively, not through here."""
+    def starter(holder, fid_ref, cfn):
+        holder["fn"] = fn
+        return lib().trpc_fiber_start(fid_ref, cfn, None)
+    return _start_impl(starter, "fiber_start")
+
+
+def start_bound(group: int, fn: Callable[[], None]) -> int:
+    """Run fn() on a fiber PINNED to worker `group` — never stolen (≙
+    the fork's bound task queues / start_from_dispatcher).  Per-core
+    state needs no locks inside such fibers.
+
+    Note: the fork's jump_group (mid-fiber migration) is NATIVE-ONLY
+    (fiber_jump_group in fiber.h): a Python frame cannot move between
+    OS threads under the GIL, so no Python facade exists for it."""
+    def starter(holder, fid_ref, cfn):
+        holder["fn"] = fn
+        return lib().trpc_fiber_start_bound(group, fid_ref, cfn, None)
+    return _start_impl(starter, "fiber_start_bound")
+
+
+def worker_index() -> int:
+    """Worker running the caller, or -1 off-worker."""
+    return int(lib().trpc_fiber_worker_index())
 
 
 def join(fid: int) -> None:

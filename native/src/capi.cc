@@ -50,6 +50,17 @@ int trpc_fiber_start(uint64_t* out, trpc_fiber_fn fn, void* arg) {
 
 int trpc_fiber_join(uint64_t f) { return fiber_join(f); }
 
+// FORK scheduling surface (bound queues / jump_group / worker hooks)
+int trpc_fiber_start_bound(int group, uint64_t* out, trpc_fiber_fn fn,
+                           void* arg) {
+  return fiber_start_bound(group, (fiber_t*)out, fn, arg);
+}
+int trpc_fiber_jump_group(int target) { return fiber_jump_group(target); }
+int trpc_fiber_worker_index() { return fiber_worker_index(); }
+int trpc_fiber_register_worker_hook(void (*fn)(void*, int), void* user) {
+  return fiber_register_worker_hook(fn, user);
+}
+
 // fiber-local storage (≙ bthread_key_t)
 int trpc_fiber_key_create(uint64_t* key, void (*dtor)(void*)) {
   return fiber_key_create(key, dtor);

@@ -87,6 +87,14 @@ struct TaskMeta {
   Butex* join_butex = nullptr;       // value mirrors version
   Butex* sleep_butex = nullptr;      // private, for usleep
 
+  // FORK scheduling surface (≙ slicesteak bound task queues +
+  // jump_group): a bound fiber always re-enqueues on home_group's bound
+  // queue and is never stolen; jump_target carries a one-shot migration
+  // request consumed by cb_jump_group
+  bool bound = false;
+  int home_group = -1;
+  int jump_target = -1;
+
   fiber_t tid() const {
     return ((uint64_t)version.load(std::memory_order_relaxed) << 32) | slot;
   }
@@ -148,6 +156,13 @@ struct TaskGroup {
   WorkStealingQueue<fiber_t> rq{4096};
   std::mutex remote_mu;
   std::deque<fiber_t> remote_rq;
+  // bound fibers: owner-only queue, invisible to steal_task (FORK
+  // "bound task queues" — work pinned to a worker, e.g. per-core state).
+  // nbound lets the dispatch hot path skip the lock entirely when no
+  // bound work exists (the common case for the whole RPC path)
+  std::mutex bound_mu;
+  std::deque<fiber_t> bound_rq;
+  std::atomic<uint32_t> nbound{0};
   void* main_sp = nullptr;
   TaskMeta* cur = nullptr;
   RemainedCb remained;
@@ -176,6 +191,16 @@ struct TaskControl {
   std::atomic<uint64_t> nfibers{0};
   std::atomic<uint64_t> nsteals{0};
   std::atomic<uint64_t> nparks{0};
+  // worker poll hooks (≙ the fork's EloqModule has_task/poll worker
+  // integration): external event sources polled by idle workers before
+  // they park.  Registered rarely; read lock-free via the count.
+  struct WorkerHook {
+    void (*fn)(void*, int);
+    void* user;
+  };
+  std::mutex hook_mu;
+  WorkerHook hooks[8];
+  std::atomic<int> nhooks{0};
 };
 
 // leaked on purpose: workers scan control().groups forever
@@ -219,6 +244,15 @@ bool steal_task(TaskGroup* self, fiber_t* out) {
 }
 
 bool next_task(TaskGroup* g, fiber_t* out) {
+  if (g->nbound.load(std::memory_order_acquire) > 0) {
+    std::lock_guard<std::mutex> lk(g->bound_mu);
+    if (!g->bound_rq.empty()) {
+      *out = g->bound_rq.front();
+      g->bound_rq.pop_front();
+      g->nbound.fetch_sub(1, std::memory_order_release);
+      return true;
+    }
+  }
   if (g->rq.Pop(out)) {
     return true;
   }
@@ -236,6 +270,21 @@ bool next_task(TaskGroup* g, fiber_t* out) {
 // Push a runnable fiber; called from workers, foreign pthreads, timer
 // callbacks, and (via the C API) PJRT host callbacks.
 void ready_to_run(TaskMeta* m) {
+  if (m->bound && m->home_group >= 0 &&
+      (size_t)m->home_group < g_control.groups.size()) {
+    TaskGroup* home = g_control.groups[m->home_group];
+    {
+      std::lock_guard<std::mutex> lk(home->bound_mu);
+      home->bound_rq.push_back(m->tid());
+      home->nbound.fetch_add(1, std::memory_order_release);
+    }
+    // wake EVERY parked worker: a single wake can be consumed by a
+    // worker that cannot see home's bound queue, stranding the pinned
+    // fiber (the fork fixes this with per-group parking; wake-all is
+    // the simple correct equivalent for the rare bound push)
+    g_control.pl.Signal((int)g_control.groups.size());
+    return;
+  }
   TaskGroup* g = tls_group;
   if (g != nullptr) {
     if (TRPC_UNLIKELY(!g->rq.Push(m->tid()))) {
@@ -410,6 +459,13 @@ void worker_main(TaskGroup* g) {
     if (next_task(g, &tid)) {
       run_fiber(g, tid);
       continue;
+    }
+    // out of tasks: give registered external sources one poll before
+    // parking (≙ EloqModule::poll from idle workers) — a hook that
+    // readies a fiber bumps the lot state, so Wait returns immediately
+    int nh = g_control.nhooks.load(std::memory_order_acquire);
+    for (int h = 0; h < nh; ++h) {
+      g_control.hooks[h].fn(g_control.hooks[h].user, g->index);
     }
     int32_t st = g_control.pl.GetState();
     if (next_task(g, &tid)) {  // recheck after snapshotting lot state
@@ -704,14 +760,15 @@ bool fiber_runtime_started() {
   return g_control.started.load(std::memory_order_acquire);
 }
 
-int fiber_start(fiber_t* out, FiberFn fn, void* arg) {
-  if (TRPC_UNLIKELY(!fiber_runtime_started())) {
-    fiber_runtime_init(0);
-  }
+namespace {
+// Shared TaskMeta construction for both start variants: slot, butexes,
+// stack, sanitizer state, version publish.  Enqueueing is the caller's
+// choice (plain vs bound routing via ready_to_run).
+TaskMeta* fiber_create_common(FiberFn fn, void* arg) {
   TaskMeta* m = nullptr;
   uint32_t slot = ResourcePool<TaskMeta>::Get(&m);
   if (m == nullptr) {
-    return ENOMEM;
+    return nullptr;
   }
   m->slot = slot;
   if (m->join_butex == nullptr) {
@@ -720,6 +777,9 @@ int fiber_start(fiber_t* out, FiberFn fn, void* arg) {
   }
   m->fn = fn;
   m->arg = arg;
+  m->bound = false;
+  m->home_group = -1;
+  m->jump_target = -1;
   m->stack = ObjectPool<StackMem>::Get();
   m->sp = tctx_make(m->stack->base, kStackSize, fiber_entry);
 #if defined(TRPC_ASAN)
@@ -732,10 +792,107 @@ int fiber_start(fiber_t* out, FiberFn fn, void* arg) {
       .store((int32_t)m->version.load(std::memory_order_relaxed),
              std::memory_order_release);
   g_control.nfibers.fetch_add(1, std::memory_order_relaxed);
+  return m;
+}
+}  // namespace
+
+int fiber_start(fiber_t* out, FiberFn fn, void* arg) {
+  if (TRPC_UNLIKELY(!fiber_runtime_started())) {
+    fiber_runtime_init(0);
+  }
+  TaskMeta* m = fiber_create_common(fn, arg);
+  if (m == nullptr) {
+    return ENOMEM;
+  }
   if (out != nullptr) {
     *out = m->tid();
   }
   ready_to_run(m);
+  return 0;
+}
+
+int fiber_start_bound(int group_idx, fiber_t* out, FiberFn fn, void* arg) {
+  if (TRPC_UNLIKELY(!fiber_runtime_started())) {
+    fiber_runtime_init(0);
+  }
+  if (group_idx < 0 || (size_t)group_idx >= g_control.groups.size()) {
+    return EINVAL;
+  }
+  TaskMeta* m = fiber_create_common(fn, arg);
+  if (m == nullptr) {
+    return ENOMEM;
+  }
+  m->bound = true;
+  m->home_group = group_idx;
+  if (out != nullptr) {
+    *out = m->tid();
+  }
+  ready_to_run(m);  // bound: routes to home_group's bound queue
+  return 0;
+}
+
+namespace {
+void cb_jump_group(void* p) {
+  TaskMeta* m = (TaskMeta*)p;
+  int t = m->jump_target;
+  m->jump_target = -1;
+  if (t < 0 || (size_t)t >= g_control.groups.size()) {
+    ready_to_run(m);  // defensive: bad target degrades to a yield
+    return;
+  }
+  TaskGroup* target = g_control.groups[t];
+  if (m->bound) {
+    m->home_group = t;  // migration moves the pin
+    std::lock_guard<std::mutex> lk(target->bound_mu);
+    target->bound_rq.push_back(m->tid());
+    target->nbound.fetch_add(1, std::memory_order_release);
+  } else {
+    std::lock_guard<std::mutex> lk(target->remote_mu);
+    target->remote_rq.push_back(m->tid());
+  }
+  // same stranding hazard as ready_to_run's bound push: only one
+  // specific worker (bound) can run this fiber — wake them all
+  g_control.pl.Signal((int)g_control.groups.size());
+}
+}  // namespace
+
+int fiber_jump_group(int target_idx) {
+  TaskGroup* g = tls_group;
+  if (g == nullptr || g->cur == nullptr) {
+    return EINVAL;  // only a fiber can migrate itself
+  }
+  if (target_idx < 0 ||
+      (size_t)target_idx >= g_control.groups.size()) {
+    return EINVAL;
+  }
+  if (g->index == target_idx) {
+    return 0;  // already there
+  }
+  TaskMeta* m = g->cur;
+  m->jump_target = target_idx;
+  g->set_remained(cb_jump_group, m);
+  sched_away(m);
+  // resumed: now running on (or stolen from — unbound fibers may still
+  // migrate onward) the target group
+  return 0;
+}
+
+int fiber_worker_index() {
+  TaskGroup* g = tls_group;
+  return g != nullptr ? g->index : -1;
+}
+
+int fiber_register_worker_hook(void (*fn)(void*, int), void* user) {
+  std::lock_guard<std::mutex> lk(g_control.hook_mu);
+  int n = g_control.nhooks.load(std::memory_order_relaxed);
+  if (n >= 8) {
+    return ENOSPC;
+  }
+  g_control.hooks[n].fn = fn;
+  g_control.hooks[n].user = user;
+  g_control.nhooks.store(n + 1, std::memory_order_release);
+  // a hook may already have events pending: nudge every parked worker
+  g_control.pl.Signal(1000);
   return 0;
 }
 

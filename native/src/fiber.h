@@ -30,6 +30,21 @@ bool fiber_runtime_started();
 
 // Start a fiber; runnable on any worker (≙ bthread_start_background).
 int fiber_start(fiber_t* out, FiberFn fn, void* arg);
+
+// --- FORK scheduling surface (≙ slicesteak bound task queues,
+// jump_group, start_from_dispatcher, EloqModule worker hooks) ----------
+// Start a fiber PINNED to worker `group_idx`: it runs only there and is
+// never stolen (per-core state without locks).
+int fiber_start_bound(int group_idx, fiber_t* out, FiberFn fn, void* arg);
+// Migrate the CURRENT fiber to worker `target_idx` (bound fibers move
+// their pin; unbound fibers resume there but may be stolen onward).
+int fiber_jump_group(int target_idx);
+// Index of the worker running the caller, -1 off-worker.
+int fiber_worker_index();
+// Register fn(user, worker_idx), polled by idle workers before they
+// park — external event sources integrate without their own threads.
+// Max 8 hooks, never unregistered (process-lifetime modules).
+int fiber_register_worker_hook(void (*fn)(void*, int), void* user);
 // Wait until fiber finishes (callable from fibers and plain pthreads).
 int fiber_join(fiber_t f);
 void fiber_yield();

@@ -364,10 +364,76 @@ static void test_fiber_local_keys() {
   printf("ok fiber_local_keys dtors=%d\n", g_fls_dtor_runs.load());
 }
 
+static void test_bound_and_jump() {
+  fiber_runtime_init(4);
+  // bound fibers always observe their pinned worker, across yields that
+  // would otherwise let the stealer move them
+  static std::atomic<int> wrong{0};
+  const int N = 24;
+  std::vector<fiber_t> fids(N);
+  struct Arg {
+    int want;
+  };
+  for (int i = 0; i < N; ++i) {
+    Arg* a = new Arg{i % 4};
+    CHECK_TRUE(fiber_start_bound(i % 4, &fids[i], [](void* p) {
+                 Arg* a = (Arg*)p;
+                 for (int k = 0; k < 50; ++k) {
+                   if (fiber_worker_index() != a->want) {
+                     wrong.fetch_add(1);
+                   }
+                   fiber_yield();
+                 }
+                 delete a;
+               }, a) == 0);
+  }
+  for (int i = 0; i < N; ++i) {
+    fiber_join(fids[i]);
+  }
+  CHECK_TRUE(wrong.load() == 0);
+
+  // jump_group: a fiber lands on the exact worker it asked for
+  static std::atomic<int> jump_fail{0};
+  fiber_t jf;
+  fiber_start_bound(0, &jf, [](void*) {
+    for (int t = 0; t < 4; ++t) {
+      if (fiber_jump_group(t) != 0 || fiber_worker_index() != t) {
+        jump_fail.fetch_add(1);
+      }
+    }
+  }, nullptr);
+  fiber_join(jf);
+  CHECK_TRUE(jump_fail.load() == 0);
+  printf("ok bound_and_jump\n");
+}
+
+static void test_worker_hooks() {
+  fiber_runtime_init(4);
+  // a registered hook runs on idle workers and can inject work
+  static std::atomic<int> polls{0};
+  CHECK_TRUE(fiber_register_worker_hook(
+                 [](void*, int) { polls.fetch_add(1); }, nullptr) == 0);
+  // drive some load so workers cycle through idle
+  for (int r = 0; r < 3; ++r) {
+    std::vector<fiber_t> f(8);
+    for (int i = 0; i < 8; ++i) {
+      fiber_start(&f[i], [](void*) { fiber_usleep(1000); }, nullptr);
+    }
+    for (int i = 0; i < 8; ++i) {
+      fiber_join(f[i]);
+    }
+  }
+  usleep(20 * 1000);
+  CHECK_TRUE(polls.load() > 0);
+  printf("ok worker_hooks polls=%d\n", polls.load());
+}
+
 int main() {
   test_flat_map();
   test_snappy_roundtrip();
   test_fiber_local_keys();
+  test_bound_and_jump();
+  test_worker_hooks();
   test_iobuf();
   test_fibers_basic();
   test_butex_timeout();

@@ -486,11 +486,63 @@ static void test_execution_queue() {
   printf("ok execution_queue\n");
 }
 
+// Bound-queue + jump_group storm: pinned fibers must stay pinned under
+// concurrent stealers, and migrations must always land (the wake-all on
+// bound pushes is load-bearing: a consumed-by-the-wrong-worker wake
+// would strand a pinned fiber forever).
+static void test_bound_jump_storm() {
+  static std::atomic<int> wrong{0};
+  const int kBound = 16;
+  const int kFree = 32;
+  std::vector<fiber_t> fids;
+  fids.reserve(kBound + kFree);
+  struct BArg {
+    int pin;
+  };
+  for (int i = 0; i < kBound; ++i) {
+    fiber_t f;
+    BArg* a = new BArg{i % 4};
+    fiber_start_bound(i % 4, &f, [](void* p) {
+      BArg* a = (BArg*)p;
+      for (int k = 0; k < 200; ++k) {
+        if (fiber_worker_index() != a->pin) {
+          wrong.fetch_add(1);
+        }
+        if (k % 50 == 49) {
+          int next = (a->pin + 1) % 4;
+          if (fiber_jump_group(next) == 0) {
+            a->pin = next;  // migration moved the pin with us
+          }
+        } else {
+          fiber_yield();
+        }
+      }
+      delete a;
+    }, a);
+    fids.push_back(f);
+  }
+  for (int i = 0; i < kFree; ++i) {
+    fiber_t f;
+    fiber_start(&f, [](void*) {
+      for (int k = 0; k < 200; ++k) {
+        fiber_yield();  // stealer chum around the pinned fibers
+      }
+    }, nullptr);
+    fids.push_back(f);
+  }
+  for (fiber_t f : fids) {
+    fiber_join(f);
+  }
+  CHECK_TRUE(wrong.load() == 0);
+  printf("ok bound_jump_storm\n");
+}
+
 int main() {
   fiber_runtime_init(4);
   test_butex_churn();
   test_fiber_sync();
   test_execution_queue();
+  test_bound_jump_storm();
   test_fiber_storm();
   test_iobuf_sharing();
   test_call_timeout_races();

@@ -210,3 +210,35 @@ class TestFiberLocal:
             assert slot2.get() is None  # reused key space reads empty
         finally:
             slot2.close()
+
+
+class TestForkScheduling:
+    """Bound task queues + jump_group + worker hooks (the fork's
+    scheduler surface ≙ slicesteak start_from_dispatcher/jump_group;
+    deeper coverage in native test_core/test_stress under sanitizers)."""
+
+    def test_bound_fiber_stays_pinned(self):
+        from brpc_tpu import fiber
+        fiber.init(4)
+        if fiber.workers() < 2:
+            import pytest as _pytest
+            _pytest.skip("needs >=2 workers")
+        seen = []
+
+        def pinned():
+            for _ in range(20):
+                seen.append(fiber.worker_index())
+        fid = fiber.start_bound(1, pinned)
+        fiber.join(fid)
+        assert set(seen) == {1}, set(seen)
+
+    def test_worker_index_off_worker(self):
+        from brpc_tpu import fiber
+        fiber.init(2)
+        assert fiber.worker_index() == -1  # plain thread
+
+    def test_jump_group_is_native_only(self):
+        # jump_group migrates the C stack across OS threads — illegal
+        # under the GIL, so the Python facade deliberately omits it
+        from brpc_tpu import fiber
+        assert not hasattr(fiber, "jump_group")
