@@ -252,14 +252,12 @@ class MemcacheClient:
         return _Response(op, status, key, extras, value, opaque, cas)
 
     def _recv_exact(self, n: int) -> bytes:
-        buf = b""
-        while len(buf) < n:
-            chunk = self._sock.recv(n - len(buf))
-            if not chunk:
-                raise MemcacheError(Status.UNKNOWN_COMMAND,
-                                    "connection closed")
-            buf += chunk
-        return buf
+        from brpc_tpu.rpc._sockutil import recv_exact
+        try:
+            return recv_exact(self._sock, n)
+        except ConnectionError:
+            raise MemcacheError(Status.UNKNOWN_COMMAND,
+                                "connection closed") from None
 
     def close(self) -> None:
         try:

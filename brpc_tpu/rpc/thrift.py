@@ -368,13 +368,11 @@ class ThriftClient:
         return self._recv_exact(n)
 
     def _recv_exact(self, n: int) -> bytes:
-        buf = b""
-        while len(buf) < n:
-            chunk = self._sock.recv(n - len(buf))
-            if not chunk:
-                raise ThriftError("connection closed mid-frame")
-            buf += chunk
-        return buf
+        from brpc_tpu.rpc._sockutil import recv_exact
+        try:
+            return recv_exact(self._sock, n)
+        except ConnectionError:
+            raise ThriftError("connection closed mid-frame") from None
 
     def close(self) -> None:
         try:
