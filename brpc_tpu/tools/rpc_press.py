@@ -40,13 +40,15 @@ class PressResult:
 def press(server: str, method: str, payload: bytes, qps: float = 0.0,
           concurrency: int = 4, duration_s: float = 5.0,
           attachment: bytes = b"",
-          timeout_ms: float = 1000.0) -> PressResult:
+          timeout_ms: float = 1000.0, protocol: str = "trpc") -> PressResult:
     """Drive `method` at `qps` (0 = as fast as possible) with `concurrency`
     caller threads for `duration_s`.
 
-    HTTP mode (≙ rpc_press's http support): a method starting with "GET "
-    or "POST " is an HTTP target ("GET /health") driven through the
-    framework's own HTTP client; anything else is a TRPC method."""
+    protocol: "trpc" (default), "h2" (method = "VERB /path" over the
+    native HTTP/2 client) or "grpc" (method = "Service/Method", payload =
+    serialized request).  For HTTP/1.1, a method starting with "GET " /
+    "POST " etc. is an HTTP target ("GET /health") driven through the
+    framework's own client (≙ rpc_press's multi-protocol support)."""
     from brpc_tpu.rpc.channel import Channel, ChannelOptions
     from brpc_tpu.rpc.http_client import HttpChannel
 
@@ -62,7 +64,30 @@ def press(server: str, method: str, payload: bytes, qps: float = 0.0,
     interval = concurrency / qps if qps > 0 else 0.0
 
     def worker():
-        if http_verb is not None:
+        if protocol == "h2":
+            from brpc_tpu.rpc.h2_client import H2Channel
+            h2 = H2Channel(server)
+            verb, _, target = method.partition(" ")
+            if not target:
+                verb, target = "GET", method
+
+            def call_once():
+                r = h2.request(verb, target, body=payload,
+                               timeout_ms=timeout_ms)
+                if r.status >= 400:
+                    raise RuntimeError(f"h2 {r.status}")
+
+            closer = h2.close
+        elif protocol == "grpc":
+            from brpc_tpu.rpc.h2_client import GrpcChannel
+            g = GrpcChannel(server)
+            service, _, meth = method.rpartition("/")
+
+            def call_once():
+                g.call(service, meth, payload, timeout_ms=timeout_ms)
+
+            closer = g.close
+        elif http_verb is not None:
             hch = HttpChannel(server)
 
             def call_once():
@@ -125,13 +150,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("-q", "--qps", type=float, default=0.0,
                     help="target qps (0 = unlimited)")
     ap.add_argument("-c", "--concurrency", type=int, default=4)
+    ap.add_argument("-p", "--protocol", default="trpc",
+                    choices=["trpc", "h2", "grpc"],
+                    help="wire protocol (HTTP/1.1 via 'GET /path' methods)")
     ap.add_argument("-t", "--time", type=float, default=5.0,
                     help="duration seconds")
     args = ap.parse_args(argv)
     payload = (open(args.file, "rb").read() if args.file
                else args.data.encode())
     res = press(args.server, args.method, payload, args.qps,
-                args.concurrency, args.time)
+                args.concurrency, args.time, protocol=args.protocol)
     print(res.summary())
     return 1 if res.errors and not res.calls - res.errors else 0
 

@@ -118,6 +118,23 @@ class TestTools:
         assert res.calls > 10 and res.errors == 0
         assert res.percentile(0.5) > 0
 
+    def test_rpc_press_grpc_mode(self, server):
+        # ≙ rpc_press pressing a gRPC service through the framework's own
+        # h2 client (no grpcio)
+        from brpc_tpu.rpc.server import Server
+        from brpc_tpu.tools.rpc_press import press
+        srv = Server()
+        srv.add_grpc_service("press.Echo",
+                             {"Hit": lambda cntl, req: req})
+        srv.start("127.0.0.1:0")
+        try:
+            res = press(f"127.0.0.1:{srv.port}", "press.Echo/Hit", b"pp",
+                        qps=0, concurrency=2, duration_s=0.5,
+                        protocol="grpc")
+            assert res.calls > 5 and res.errors == 0
+        finally:
+            srv.destroy()
+
     def test_rpc_press_paced(self, server):
         from brpc_tpu.tools.rpc_press import press
         res = press(f"127.0.0.1:{server.port}", "Echo.echo", b"x",
