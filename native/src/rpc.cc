@@ -2749,7 +2749,12 @@ Socket* DialConn(Channel* c, int* rc_out) {
   }
   snew->parse_state = conn;
   snew->parse_state_free = [](void* p) { delete (ClientConn*)p; };
-  EventDispatcher::Instance().AddConsumer(sid, fd);
+  // client responses ride the ring too (same TLS carve-out as the
+  // server side: the TLS engine needs the fd)
+  if (tls_st != nullptr || !uring_enabled() ||
+      uring_add_recv(sid, fd) != 0) {
+    EventDispatcher::Instance().AddConsumer(sid, fd);
+  }
   if (c->conn_type != 0) {
     // teardown bookkeeping (single-type teardown goes through the
     // SocketMap instead); prune recycled ids so a long-lived short-type
