@@ -45,6 +45,11 @@ size_t native_metrics_dump(char* buf, size_t cap) {
   put("native_sequencer_parked", rel(m.sequencer_parked));
   put("native_parse_errors", relu(m.parse_errors));
   put("native_h2_connections", rel(m.h2_connections));
+  put("native_uring_recv_completions", relu(m.uring_recv_completions));
+  put("native_uring_recv_bytes", relu(m.uring_recv_bytes));
+  put("native_uring_accepts", relu(m.uring_accepts));
+  put("native_uring_rearms", relu(m.uring_rearms));
+  put("native_uring_active_recvs", rel(m.uring_active_recvs));
   put("tpu_h2d_transfers", (long long)t.h2d_transfers);
   put("tpu_d2h_transfers", (long long)t.d2h_transfers);
   put("tpu_h2d_bytes", (long long)t.h2d_bytes);

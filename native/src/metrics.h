@@ -46,6 +46,13 @@ struct NativeMetrics {
 
   // h2 connections (h2.cc registry)
   std::atomic<int64_t> h2_connections{0};
+
+  // io_uring engine (uring.cc): ring-fed receive path
+  std::atomic<uint64_t> uring_recv_completions{0};
+  std::atomic<uint64_t> uring_recv_bytes{0};
+  std::atomic<uint64_t> uring_accepts{0};
+  std::atomic<uint64_t> uring_rearms{0};       // multishot re-issues
+  std::atomic<int64_t> uring_active_recvs{0};  // armed connections
 };
 
 NativeMetrics& native_metrics();

@@ -2197,6 +2197,13 @@ namespace {
 // ABA-free RPC correlation, id.h:46-60).  The tiny per-channel doubly-
 // linked list exists only so a broken connection can sweep its in-flight
 // calls; its lock guards ~4 pointer ops.
+//
+// Per-retry distinctness (what the reference's RANGED versions buy,
+// id.h:146 "version_range"): not needed here by construction — every
+// attempt (first call, retries, the backup request) arms a FRESH
+// PendingCall slot with its own correlation id, so a late response from
+// attempt N can never claim attempt N+1; it fails the version CAS and is
+// dropped (tests/test_rpc.py backup/retry coverage pins this).
 enum PcState : uint32_t {
   PC_FREE = 0,       // in pool
   PC_ARMED = 1,      // caller waiting; response/timeout may claim

@@ -341,6 +341,8 @@ class RingEngine {
         ArmAccept(op.fd);
       } else if (op.kind == 1) {
         recv_uds_[(uint32_t)op.id] = RecvEntry{op.id, RecvUserData(op.id)};
+        native_metrics().uring_active_recvs.fetch_add(
+            1, std::memory_order_relaxed);
         ArmRecv(op.id, op.fd);
       } else if (op.kind == 2) {
         io_uring_sqe* sqe = GetSqe();
@@ -351,6 +353,8 @@ class RingEngine {
         if (rit != recv_uds_.end() &&
             rit->second.ud == RecvUserData(op.id)) {
           recv_uds_.erase(rit);
+          native_metrics().uring_active_recvs.fetch_sub(
+              1, std::memory_order_relaxed);
         }
       } else {  // remove-acceptor: no accept callback may fire after this
         io_uring_sqe* sqe = GetSqe();
@@ -380,6 +384,12 @@ class RingEngine {
       }
       return;
     }
+    NativeMetrics& nm = native_metrics();
+    nm.uring_recv_completions.fetch_add(1, std::memory_order_relaxed);
+    if (res > 0) {
+      nm.uring_recv_bytes.fetch_add((uint64_t)res,
+                                    std::memory_order_relaxed);
+    }
     SocketId sid = it->second.id;
     Socket* s = Socket::Address(sid);
     if (s != nullptr && s->ring_feed != nullptr) {
@@ -408,9 +418,11 @@ class RingEngine {
       // re-arms — a silently un-armed live connection would stall
       bool terminal = res == 0 || (res < 0 && res != -ENOBUFS);
       if (!terminal && s != nullptr) {
+        nm.uring_rearms.fetch_add(1, std::memory_order_relaxed);
         ArmRecv(sid, s->fd);
       } else {
         recv_uds_.erase(slot);
+        nm.uring_active_recvs.fetch_sub(1, std::memory_order_relaxed);
       }
     }
     if (s != nullptr) {
@@ -443,6 +455,8 @@ class RingEngine {
           auto it = acceptors_.find(lfd);
           if (it != acceptors_.end()) {
             if (cqe->res >= 0) {
+              native_metrics().uring_accepts.fetch_add(
+                  1, std::memory_order_relaxed);
               it->second.on_accept(it->second.user, cqe->res);
             }
             if (!(cqe->flags & IORING_CQE_F_MORE)) {

@@ -203,3 +203,29 @@ class TestIoUringTransport:
             print("OK")
         """ % REPO)
         assert "OK" in out
+
+    def test_ring_metrics_visible(self):
+        # the engine's internals surface through /vars like every other
+        # native subsystem (VERDICT: "native internals unobservable")
+        out = run_ring("""
+            import urllib.request
+            srv = Server(); srv.add_echo_service(); srv.start("127.0.0.1:0")
+            ch = Channel(f"127.0.0.1:{srv.port}")
+            for i in range(20):
+                ch.call("Echo.echo", b"m" * 200)
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/vars", timeout=5
+            ).read().decode()
+            vals = {}
+            for line in body.splitlines():
+                if line.startswith("native_uring_"):
+                    k, _, v = line.partition(" : ")
+                    vals[k.strip()] = int(v)
+            assert vals.get("native_uring_accepts", 0) >= 1, vals
+            assert vals.get("native_uring_recv_completions", 0) >= 20, vals
+            assert vals.get("native_uring_recv_bytes", 0) > 4000, vals
+            assert vals.get("native_uring_active_recvs", 0) >= 1, vals
+            ch.close(); srv.destroy()
+            print("OK")
+        """)
+        assert "OK" in out
