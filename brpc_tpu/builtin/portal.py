@@ -39,6 +39,12 @@ _SERVICES = [
     ("/fibers", "fiber runtime counters (≙ /bthreads)"),
     ("/rpcz", "sampled RPC spans (?trace_id=, ?max_scan=)"),
     ("/hotspots", "collapsed-stack CPU samples (?seconds=)"),
+    ("/pprof/profile", "native SIGPROF profile (?seconds=, ?hz=)"),
+    ("/sockets", "every live socket in the process"),
+    ("/ids", "in-flight client correlation ids"),
+    ("/threads", "python stacks + OS thread census"),
+    ("/protobufs", "registered pb message types"),
+    ("/dir", "working-dir browser (needs builtin_writable)"),
 ]
 
 
@@ -265,6 +271,59 @@ def install_builtin_services(server, dispatcher: HttpDispatcher) -> None:
         n = lib().trpc_ids_dump(buf, len(buf))
         return HttpResponse.text(buf.raw[:n].decode())
 
+    def _protobufs(req: HttpRequest) -> HttpResponse:
+        """Registered pb message types (≙ builtin/protobufs_service.cpp
+        listing descriptor pool messages): the request/response classes
+        of every pb-typed method, with their field layout."""
+        specs = getattr(server, "_pb_specs", {})
+        out = {}
+        for method, (req_cls, resp_cls) in sorted(specs.items()):
+            out[method] = {
+                "request": req_cls.DESCRIPTOR.full_name,
+                "request_fields": [f.name for f in
+                                   req_cls.DESCRIPTOR.fields],
+                "response": resp_cls.DESCRIPTOR.full_name,
+                "response_fields": [f.name for f in
+                                    resp_cls.DESCRIPTOR.fields],
+            }
+        return HttpResponse.json(out)
+
+    def _dir(req: HttpRequest) -> HttpResponse:
+        """Working-directory browser (≙ builtin/dir_service.cpp), gated
+        behind builtin_writable: an unauthenticated filesystem listing is
+        too sharp to expose by default."""
+        if not writable:
+            return HttpResponse.text(
+                "/dir requires ServerOptions(builtin_writable=True)\n",
+                403)
+        rel = req.query_params().get("path", ".")
+        base = os.path.realpath(os.getcwd())
+        target = os.path.realpath(os.path.join(base, rel))
+        # bare startswith would admit /root/repo-secrets beside /root/repo
+        if target != base and not target.startswith(base + os.sep):
+            return HttpResponse.text("path escapes the working dir\n", 403)
+        if not os.path.isdir(target):
+            return HttpResponse.text(f"not a directory: {rel}\n", 404)
+        rows = []
+        for name in sorted(os.listdir(target)):
+            full = os.path.join(target, name)
+            try:
+                st = os.lstat(full)
+                import stat as _stat
+                if _stat.S_ISLNK(st.st_mode):
+                    kind, size = "link", 0
+                elif _stat.S_ISDIR(st.st_mode):
+                    kind, size = "dir", 0
+                else:
+                    kind, size = "file", st.st_size
+            except OSError:
+                # deleted between listdir and stat: one broken entry
+                # must not 500 the whole listing
+                kind, size = "unknown", 0
+            rows.append({"name": name, "type": kind, "size": size})
+        return HttpResponse.json({"path": os.path.relpath(target, base),
+                                  "entries": rows})
+
     def _threads(req: HttpRequest) -> HttpResponse:
         """One stack per Python thread plus the native thread census from
         /proc/self/task (≙ builtin/threads_service.cpp attaching pstack;
@@ -312,4 +371,6 @@ def install_builtin_services(server, dispatcher: HttpDispatcher) -> None:
     d.register("/sockets", _sockets)
     d.register("/ids", _ids)
     d.register("/threads", _threads)
+    d.register("/protobufs", _protobufs)
+    d.register("/dir", _dir)
     d.register("/rpcz", _rpcz)

@@ -33,7 +33,31 @@ def test_host_example(name):
     assert r.returncode == 0, f"{name} failed:\n{r.stdout}\n{r.stderr}"
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=1)
+def _jax_initializable() -> bool:
+    """On hosts with a dead device tunnel, even JAX_PLATFORMS=cpu hangs
+    inside plugin discovery — no example can run, through no fault of
+    its own.  Probe once per session (cached)."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            capture_output=True, timeout=60)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 @pytest.mark.parametrize("name", _MESH)
 def test_mesh_example(name):
-    r = _run(name, 300)
+    try:
+        r = _run(name, 300)
+    except subprocess.TimeoutExpired:
+        if not _jax_initializable():
+            pytest.skip("jax cannot initialize on this host right now "
+                        "(hung device tunnel)")
+        raise
     assert r.returncode == 0, f"{name} failed:\n{r.stdout}\n{r.stderr}"

@@ -567,3 +567,26 @@ class TestSnappyFormat:
                 compress.decompress(packed, S)
         finally:
             _flags.set_flag("max_decompressed_size", old)
+
+
+class TestDirService:
+    def test_dir_gated_by_default(self):
+        srv = Server()
+        srv.add_echo_service()
+        srv.start("127.0.0.1:0")
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(srv.port, "/dir")
+            assert ei.value.code == 403
+        finally:
+            srv.destroy()
+
+    def test_dir_lists_cwd_when_writable(self, server):
+        out = json.load(_get(server.port, "/dir"))
+        names = {e["name"] for e in out["entries"]}
+        assert "tests" in names and "brpc_tpu" in names
+
+    def test_dir_escape_rejected(self, server):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(server.port, "/dir?path=../..")
+        assert ei.value.code == 403

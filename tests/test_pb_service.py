@@ -111,3 +111,14 @@ class TestPbOverHttpJson:
         resp = AddResponse()
         resp.ParseFromString(raw)
         assert resp.sum == 11
+
+
+class TestProtobufsEndpoint:
+    def test_lists_registered_messages(self, pb_server):
+        out = json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{pb_server.port}/protobufs", timeout=5))
+        assert "Calc.Add" in out
+        add = out["Calc.Add"]
+        assert add["request"] == "brpc_tpu.test.AddRequest"
+        assert sorted(add["request_fields"]) == ["a", "b"]
+        assert add["response_fields"] == ["sum"]
