@@ -1,0 +1,32 @@
+"""Undo the axon sitecustomize's platform force-selection when the
+caller explicitly wants CPU.
+
+The sitecustomize (triggered by PALLAS_AXON_POOL_IPS) runs
+``jax.config.update("jax_platforms", "axon,cpu")`` at interpreter start,
+which overrides the JAX_PLATFORMS env var — and a dead device tunnel
+then hangs every ``jax.devices()``.  Calling this when
+``JAX_PLATFORMS=cpu`` restores the env var's intent.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def force_cpu_platform() -> None:
+    """If JAX_PLATFORMS=cpu, make jax honor it despite sitecustomize."""
+    if os.environ.get("JAX_PLATFORMS") != "cpu":
+        return
+    try:
+        import jax
+        from jax.extend import backend as _jex_backend
+    except ImportError:
+        return  # no jax here: nothing to undo
+    try:
+        _jex_backend.clear_backends()
+        jax.config.update("jax_platforms", "cpu")
+    except Exception as e:  # jax API moved: the hang guard is GONE — say so
+        print(f"warning: force_cpu_platform failed ({e!r}); "
+              "jax may still select the tunneled platform",
+              file=sys.stderr)

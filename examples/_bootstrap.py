@@ -3,3 +3,10 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Honor an explicit JAX_PLATFORMS=cpu even under the axon sitecustomize
+# (which force-selects the tunneled-TPU platform; a dead tunnel then
+# hangs jax initialization).
+from brpc_tpu.utils.jaxenv import force_cpu_platform  # noqa: E402
+
+force_cpu_platform()

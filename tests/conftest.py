@@ -9,7 +9,23 @@ multi-device mesh, no mocks — loopback TCP stands in for the network and
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The axon sitecustomize registers the tunneled-TPU PJRT backend at
+# interpreter start whenever PALLAS_AXON_POOL_IPS is set — and its
+# monkey-patched get_backend initializes that backend EVEN under
+# JAX_PLATFORMS=cpu, which deadlocks every jax.devices() when the tunnel
+# is down.  Tests are CPU-only by design (the device-plane tests dlopen
+# the PJRT plugin directly and do not need the hook), so drop the
+# trigger for this process AND every subprocess tests spawn.
+_stash = os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+if _stash is not None:
+    # device-plane tests restore this for THEIR subprocesses (the plane
+    # plugin keys its relay-tunnel contract on it, native/src/tpu.cc)
+    os.environ["_AXON_POOL_IPS_STASH"] = _stash
+
+# FORCE cpu, not setdefault: the driver exports JAX_PLATFORMS=axon, and
+# with the registration trigger popped above that platform no longer
+# exists in subprocesses — leaving it selected breaks every jax init
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
