@@ -1,0 +1,41 @@
+"""Echo served through the io_uring transport (the FORK's RingListener
+≙ socket.h:360 + provided-buffer recv): multishot ACCEPT adopts
+connections, multishot RECV feeds the parse path — ~19% over epoll on
+the echo bench.  Falls back to epoll transparently when the kernel
+refuses the ring (the flag is safe to leave on)."""
+import _bootstrap  # noqa: F401
+
+from brpc_tpu._native import lib
+from brpc_tpu.rpc.channel import Channel
+from brpc_tpu.rpc.server import Server
+from brpc_tpu.utils import flags
+
+
+def main():
+    available = bool(lib().trpc_io_uring_available())
+    print("io_uring available:", available)
+    flags.set_flag("use_io_uring", True)
+
+    server = Server()
+    server.add_echo_service()
+    port = server.start("127.0.0.1:0")
+
+    ch = Channel(f"127.0.0.1:{port}")
+    for i in range(5):
+        assert ch.call("Echo.echo", f"ring-{i}".encode()) == \
+            f"ring-{i}".encode()
+    print("5 echoes over", "io_uring" if available else "epoll (fallback)")
+
+    # the engine's internals are live bvars (also on /vars)
+    import ctypes
+    buf = ctypes.create_string_buffer(1 << 16)
+    n = lib().trpc_native_metrics_dump(buf, len(buf))
+    for line in buf.raw[:n].decode().splitlines():
+        if line.startswith("native_uring_"):
+            print(" ", line)
+    ch.close()
+    server.destroy()
+
+
+if __name__ == "__main__":
+    main()

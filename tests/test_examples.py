@@ -15,7 +15,7 @@ _HOST = ["echo", "asynchronous_echo", "multi_threaded_echo",
          "selective_echo", "cascade_echo", "backup_request",
          "auto_concurrency_limiter", "streaming_echo", "http_server",
          "thrift_echo", "pb_echo", "session_data_and_thread_local",
-         "progressive_http", "memcache_client"]
+         "progressive_http", "memcache_client", "io_uring_echo"]
 _MESH = ["mesh_collectives", "long_context_ring"]
 
 
