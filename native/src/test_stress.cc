@@ -13,9 +13,12 @@
 //      mid-call; version recycling of Socket slots
 //   6. IOBuf block refcounts shared across threads
 // Each scenario is time-bounded so the whole binary stays <60s under TSAN.
+#include <arpa/inet.h>
 #include <assert.h>
+#include <netinet/in.h>
 #include <stdio.h>
 #include <string.h>
+#include <sys/socket.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -30,6 +33,7 @@
 #include "fiber_sync.h"
 #include "iobuf.h"
 #include "rpc.h"
+#include "uring.h"
 
 using namespace trpc;
 
@@ -537,6 +541,73 @@ static void test_bound_jump_storm() {
   printf("ok bound_jump_storm\n");
 }
 
+// --- 9. io_uring transport churn -------------------------------------------
+// Ring-fed server under restart + abrupt-disconnect storm: multishot
+// cancel vs socket recycle vs slot reuse interleavings (the engine's
+// generation-tagged user_data is what keeps a late CQE off a reused
+// slot).  Skipped when the kernel refuses io_uring.
+static void test_uring_churn() {
+  if (!uring_available()) {
+    printf("ok uring_churn (skipped: no io_uring)\n");
+    return;
+  }
+  uring_set_enabled(true);
+  Server* srv = server_create();
+  server_add_service(srv, "Echo", 0, nullptr, nullptr);
+  CHECK_TRUE(server_start(srv, "127.0.0.1", 0) == 0);
+  int port = server_port(srv);
+
+  std::atomic<bool> stop{false};
+  std::atomic<uint64_t> ok{0}, failed{0};
+  std::vector<std::thread> ts;
+  // callers over real channels (ring-fed on both sides)
+  for (int t = 0; t < 4; ++t) {
+    ts.emplace_back([&, t] {
+      Channel* ch = channel_create("127.0.0.1", port);
+      channel_set_connection_type(ch, t % 2);
+      std::string payload(256, 'r');
+      CallResult res;
+      while (!stop.load(std::memory_order_acquire)) {
+        if (channel_call(ch, "Echo", (const uint8_t*)payload.data(),
+                         payload.size(), nullptr, 0, 200 * 1000,
+                         &res) == 0) {
+          ok.fetch_add(1);
+        } else {
+          failed.fetch_add(1);
+        }
+      }
+      channel_destroy(ch);
+    });
+  }
+  // abrupt-disconnect chum: open, half-send, vanish — every one leaves
+  // a multishot recv to cancel against a recycling socket slot
+  ts.emplace_back([&] {
+    while (!stop.load(std::memory_order_acquire)) {
+      int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+      sockaddr_in a;
+      memset(&a, 0, sizeof(a));
+      a.sin_family = AF_INET;
+      a.sin_port = htons((uint16_t)port);
+      a.sin_addr.s_addr = inet_addr("127.0.0.1");
+      if (connect(fd, (sockaddr*)&a, sizeof(a)) == 0) {
+        (void)!write(fd, "TR", 2);  // half a magic
+      }
+      ::close(fd);
+      usleep(2000);
+    }
+  });
+  usleep(2 * 1000 * 1000);
+  stop.store(true, std::memory_order_release);
+  for (auto& t : ts) {
+    t.join();
+  }
+  server_destroy(srv);
+  uring_set_enabled(false);
+  CHECK_TRUE(ok.load() > 100);
+  printf("ok uring_churn ok=%llu failed=%llu\n",
+         (unsigned long long)ok.load(), (unsigned long long)failed.load());
+}
+
 int main() {
   fiber_runtime_init(4);
   test_butex_churn();
@@ -548,6 +619,7 @@ int main() {
   test_call_timeout_races();
   test_socketmap_races();
   test_restart_storm();
+  test_uring_churn();
   if (g_failures == 0) {
     printf("ALL STRESS TESTS PASSED\n");
     return 0;
