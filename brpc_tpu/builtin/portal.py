@@ -67,7 +67,21 @@ def _version(req: HttpRequest) -> str:
 
 
 def _vars(req: HttpRequest) -> HttpResponse:
-    needle = req.query_params().get("filter", "")
+    params = req.query_params()
+    series = params.get("series")
+    if series:
+        # trend data for one windowed variable (≙ the flot plots behind
+        # the reference's /vars): [[ts, per-second value], ...]
+        data = bvar.series_of(series)
+        if data is None:
+            return HttpResponse.text(
+                f"no sample history for {series!r}\n", 404)
+        # samples carry process-monotonic stamps; emit epoch seconds so
+        # external graphers get a real time axis
+        offset = time.time() - time.monotonic()
+        return HttpResponse.json(
+            [[round(t + offset, 3), v] for t, v in data])
+    needle = params.get("filter", "")
     lines = []
     for name, val in bvar.dump_exposed(
             (lambda n: needle in n) if needle else None):

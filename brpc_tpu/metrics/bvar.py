@@ -84,6 +84,23 @@ def dump_exposed(filter_fn: Optional[Callable[[str], bool]] = None
     return out
 
 
+def series_of(name: str) -> Optional[List[Tuple[float, Any]]]:
+    """Per-second sample history of an exposed windowed variable
+    (≙ the reference's /vars plots reading bvar::detail::Series) — the
+    data behind a trend graph: [(monotonic_ts, per-second value), ...].
+    None when the variable doesn't exist or keeps no history."""
+    var = _registry.get(name)
+    if var is None:
+        return None
+    sampler = getattr(var, "_sampler", None)
+    if sampler is None:
+        inner = getattr(var, "_win", None)  # PerSecond wraps a Window
+        sampler = getattr(inner, "_sampler", None)
+    if sampler is None:
+        return None
+    return sampler.samples()
+
+
 class Variable:
     """Base of everything exposable (≙ bvar::Variable, variable.h:102)."""
 
@@ -685,10 +702,13 @@ class LatencyRecorder(Variable):
     def expose(self, prefix: str) -> bool:  # type: ignore[override]
         self.hide()
         self._name = prefix
+        # the qps var is the PerSecond ITSELF (not a PassiveStatus over
+        # it) so /vars?series= can reach its per-second sample history
+        self._qps.expose(f"{prefix}_qps")
         self._sub_vars = [
             PassiveStatus(self.latency, f"{prefix}_latency"),
             PassiveStatus(self.max_latency, f"{prefix}_max_latency"),
-            PassiveStatus(self.qps, f"{prefix}_qps"),
+            self._qps,
             PassiveStatus(self.count, f"{prefix}_count"),
         ]
         for p, nm in ((0.5, "50"), (0.9, "90"), (0.99, "99"),

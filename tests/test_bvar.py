@@ -229,3 +229,31 @@ class TestCollector:
         Bad().submit(c)
         Good().submit(c)
         assert done.wait(5)  # processing continued past the bad sample
+
+
+class TestSeries:
+    def test_series_of_windowed_var(self):
+        import time
+        from brpc_tpu.metrics import bvar as b
+
+        a = b.Adder("series_test_adder")
+        qps = b.PerSecond(a, window_size=5, name="series_test_qps")
+        try:
+            a.add(10)
+            # poll: the shared sampler ticks ~1/s but drifts under load
+            deadline = time.time() + 10
+            s = None
+            while time.time() < deadline:
+                s = b.series_of("series_test_qps")
+                if s is not None and len(s) >= 2:
+                    break
+                time.sleep(0.2)
+            a.add(5)
+            assert s is not None and len(s) >= 2
+            assert sum(v for _, v in s) >= 10  # sampled deltas landed
+            # plain adders keep no history
+            assert b.series_of("series_test_adder") is None
+            assert b.series_of("no_such_var") is None
+        finally:
+            qps.close()
+            a.hide()
