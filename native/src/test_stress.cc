@@ -33,6 +33,7 @@
 #include "fiber_sync.h"
 #include "iobuf.h"
 #include "rpc.h"
+#include "h2.h"
 #include "uring.h"
 
 using namespace trpc;
@@ -608,6 +609,49 @@ static void test_uring_churn() {
          (unsigned long long)ok.load(), (unsigned long long)failed.load());
 }
 
+// --- 10. h2 client multiplexing storm ---------------------------------------
+// Many pthreads share ONE h2 connection: concurrent HEADERS/DATA
+// interleaving, stream-map mutation, and send-window accounting under
+// contention.  The in-process server answers 404 natively (no Python
+// handler registered) — the full wire path still runs end to end.
+static void test_h2_client_storm() {
+  Server* srv = server_create();
+  server_add_service(srv, "Echo", 0, nullptr, nullptr);
+  CHECK_TRUE(server_start(srv, "127.0.0.1", 0) == 0);
+  int port = server_port(srv);
+
+  int crc = 0;
+  void* conn = h2_client_create("127.0.0.1", port, 2 * 1000 * 1000, &crc);
+  CHECK_TRUE(conn != nullptr);
+
+  std::atomic<uint64_t> ok{0}, bad{0};
+  std::vector<std::thread> ts;
+  for (int t = 0; t < 6; ++t) {
+    ts.emplace_back([&, t] {
+      std::string body(1024 + 512 * t, 'h');
+      for (int i = 0; i < 150; ++i) {
+        H2ClientResult res;
+        int rc = h2_client_call(conn, "POST", "/nope", nullptr,
+                                (const uint8_t*)body.data(), body.size(),
+                                5 * 1000 * 1000, &res);
+        if (rc == 0 && res.status == 404) {
+          ok.fetch_add(1);
+        } else {
+          bad.fetch_add(1);
+        }
+      }
+    });
+  }
+  for (auto& t : ts) {
+    t.join();
+  }
+  h2_client_destroy(conn);
+  server_destroy(srv);
+  CHECK_TRUE(ok.load() == 6 * 150);
+  CHECK_TRUE(bad.load() == 0);
+  printf("ok h2_client_storm ok=%llu\n", (unsigned long long)ok.load());
+}
+
 int main() {
   fiber_runtime_init(4);
   test_butex_churn();
@@ -619,6 +663,7 @@ int main() {
   test_call_timeout_races();
   test_socketmap_races();
   test_restart_storm();
+  test_h2_client_storm();
   test_uring_churn();
   if (g_failures == 0) {
     printf("ALL STRESS TESTS PASSED\n");
