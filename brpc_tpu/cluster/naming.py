@@ -102,6 +102,35 @@ class DNSNamingService(NamingService):
         return nodes
 
 
+class DomainListNamingService(NamingService):
+    """dlist://host1:port1,host2:port2 — every entry DNS-resolved each
+    poll (≙ policy/domain_naming_service.cpp over a list — the
+    reference's dlist scheme); a name that fails to resolve drops out
+    this round instead of failing the whole refresh."""
+
+    poll_interval_s = 5.0
+
+    def get_servers(self) -> List[ServerNode]:
+        nodes = []
+        seen = set()
+        for entry in self.param.split(","):
+            entry = entry.strip()
+            if not entry:
+                continue
+            host, _, port = entry.rpartition(":")
+            try:
+                infos = pysocket.getaddrinfo(
+                    host, int(port), pysocket.AF_INET, pysocket.SOCK_STREAM)
+            except (OSError, ValueError):
+                continue  # one dead name must not empty the cluster
+            for info in infos:
+                ip = info[4][0]
+                if (ip, port) not in seen:
+                    seen.add((ip, port))
+                    nodes.append(ServerNode(EndPoint(ip=ip, port=int(port))))
+        return nodes
+
+
 class _HttpNamingBase(NamingService):
     """Shared plumbing for HTTP-backed naming (the framework's own HTTP
     client underneath): "host:port/path" param parsing and channel
@@ -206,6 +235,7 @@ _NS_REGISTRY: Dict[str, type] = {
     "list": ListNamingService,
     "file": FileNamingService,
     "dns": DNSNamingService,
+    "dlist": DomainListNamingService,
     "remote_file": RemoteFileNamingService,
     "watch": WatchNamingService,
 }

@@ -254,3 +254,24 @@ class TestClusterChannel:
             1 for _ in range(12) if ch.call("Who.ami").startswith(b"srv"))
         assert oks == 12
         ch.close()
+
+
+class TestDomainListNaming:
+    def test_resolves_each_entry(self):
+        from brpc_tpu.cluster.naming import DomainListNamingService
+        svc = DomainListNamingService("localhost:8001,localhost:8002")
+        nodes = svc.get_servers()
+        eps = {(n.endpoint.ip, n.endpoint.port) for n in nodes}
+        assert ("127.0.0.1", 8001) in eps and ("127.0.0.1", 8002) in eps
+
+    def test_dead_name_drops_not_fails(self):
+        from brpc_tpu.cluster.naming import DomainListNamingService
+        svc = DomainListNamingService(
+            "localhost:9001,definitely-not-a-host.invalid:9002")
+        nodes = svc.get_servers()
+        assert len(nodes) >= 1  # the resolvable entry survives
+        assert all(n.endpoint.port == 9001 for n in nodes)
+
+    def test_registered_scheme(self):
+        from brpc_tpu.cluster import naming
+        assert "dlist" in naming._NS_REGISTRY
