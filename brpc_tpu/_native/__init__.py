@@ -169,6 +169,10 @@ def _declare(L: ctypes.CDLL) -> None:
     L.trpc_h2_client_create.argtypes = [c.c_char_p, c.c_int, c.c_int64,
                                         c.POINTER(c.c_int)]
     L.trpc_h2_client_create.restype = c.c_void_p
+    L.trpc_h2_client_create_tls.argtypes = [c.c_char_p, c.c_int, c.c_int64,
+                                            c.c_int, c.c_char_p,
+                                            c.POINTER(c.c_int)]
+    L.trpc_h2_client_create_tls.restype = c.c_void_p
     L.trpc_h2_client_call.argtypes = [c.c_void_p, c.c_char_p, c.c_char_p,
                                       c.c_char_p, c.c_char_p, c.c_size_t,
                                       c.c_int64, c.POINTER(c.c_void_p)]

@@ -42,15 +42,24 @@ class H2Channel:
     """h2c (prior-knowledge) client connection.  Calls are thread-safe
     and multiplex concurrently on one socket."""
 
-    def __init__(self, target: str, connect_timeout_ms: float = 1000.0):
+    def __init__(self, target: str, connect_timeout_ms: float = 1000.0,
+                 tls: bool = False, tls_verify: bool = True,
+                 tls_ca_file: Optional[str] = None):
         import socket as _socket
         host, _, port = target.rpartition(":")
         # the native side takes IPv4 literals only; resolve names here
         ip = _socket.gethostbyname(host or "127.0.0.1")
         rc = ctypes.c_int()
-        self._handle = lib().trpc_h2_client_create(
-            ip.encode(), int(port), int(connect_timeout_ms * 1000),
-            ctypes.byref(rc))
+        if tls:
+            self._handle = lib().trpc_h2_client_create_tls(
+                ip.encode(), int(port), int(connect_timeout_ms * 1000),
+                1 if tls_verify else 0,
+                tls_ca_file.encode() if tls_ca_file else None,
+                ctypes.byref(rc))
+        else:
+            self._handle = lib().trpc_h2_client_create(
+                ip.encode(), int(port), int(connect_timeout_ms * 1000),
+                ctypes.byref(rc))
         if not self._handle:
             raise errors.RpcError(rc.value, f"h2 connect to {target} failed")
 

@@ -264,6 +264,22 @@ void* trpc_h2_client_create(const char* ip, int port,
   return h2_client_create(ip, port, connect_timeout_us, rc_out);
 }
 
+void* trpc_h2_client_create_tls(const char* ip, int port,
+                                int64_t connect_timeout_us, int verify,
+                                const char* ca_file, int* rc_out) {
+  void* ctx = tls_client_ctx_create(verify, ca_file, nullptr, nullptr);
+  if (ctx == nullptr) {
+    *rc_out = -EPROTO;
+    return nullptr;
+  }
+  void* conn = h2_client_create_tls(ip, port, connect_timeout_us, ctx,
+                                    rc_out);
+  // ctx lifetime: the TlsState holds what it needs; context can go once
+  // the session is up (OpenSSL refcounts the SSL_CTX under the SSL)
+  tls_ctx_destroy(ctx);
+  return conn;
+}
+
 int trpc_h2_client_call(void* conn, const char* method, const char* path,
                         const char* headers_blob, const uint8_t* body,
                         size_t body_len, int64_t timeout_us, void** result) {

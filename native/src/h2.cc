@@ -3,6 +3,7 @@
 #include "metrics.h"
 
 #include <arpa/inet.h>
+#include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <string.h>
@@ -15,6 +16,7 @@
 
 #include "execution_queue.h"
 #include "h2_tables.h"
+#include "tls.h"
 
 namespace trpc {
 
@@ -1169,6 +1171,13 @@ void H2ClientOnMessages(Socket* s) {
 
 void* h2_client_create(const char* ip, int port, int64_t connect_timeout_us,
                        int* rc_out) {
+  return h2_client_create_tls(ip, port, connect_timeout_us, nullptr,
+                              rc_out);
+}
+
+void* h2_client_create_tls(const char* ip, int port,
+                           int64_t connect_timeout_us, void* tls_ctx,
+                           int* rc_out) {
   fiber_runtime_init(0);
   int fd = ::socket(AF_INET, SOCK_STREAM | SOCK_CLOEXEC, 0);
   if (fd < 0) {
@@ -1198,6 +1207,26 @@ void* h2_client_create(const char* ip, int port, int64_t connect_timeout_us,
   }
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  // epoll-driven reads drain to EAGAIN: the fd MUST be non-blocking or
+  // the dispatcher blocks inside read(2) once the data runs out
+  int fl = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+
+  // TLS: handshake synchronously on the fresh fd (same pattern as
+  // DialConn); once socket->tls is set, Write/ReadToBuf encrypt and
+  // decrypt transparently — the h2 framing layer never notices
+  TlsState* tls_st = nullptr;
+  if (tls_ctx != nullptr) {
+    tls_st = tls_state_create(tls_ctx, 1);
+    if (tls_st == nullptr ||
+        tls_client_handshake_fd(tls_st, fd,
+                                monotonic_us() + connect_timeout_us) != 0) {
+      tls_state_free(tls_st);
+      ::close(fd);
+      *rc_out = -EPROTO;
+      return nullptr;
+    }
+  }
 
   H2ClientConn* c = new H2ClientConn();
   c->window_butex = butex_create();
@@ -1233,9 +1262,13 @@ void* h2_client_create(const char* ip, int port, int64_t connect_timeout_us,
   hello.push_back((char)winc);
   Socket* s = Socket::Address(c->sock);
   if (s != nullptr) {
+    s->tls = tls_st;
+    s->tls_checked = true;
     write_frames(s, hello);
     EventDispatcher::Instance().AddConsumer(c->sock, fd);
     s->Dereference();
+  } else if (tls_st != nullptr) {
+    tls_state_free(tls_st);
   }
   *rc_out = 0;
   return c;

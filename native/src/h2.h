@@ -85,6 +85,11 @@ struct H2ClientResult {
 // Dial + preface + SETTINGS.  nullptr on connect failure (rc_out set).
 void* h2_client_create(const char* ip, int port, int64_t connect_timeout_us,
                        int* rc_out);
+// Same over TLS: tls_ctx from tls_client_ctx_create (tls.h); handshake
+// happens synchronously before the preface, frames encrypt transparently.
+void* h2_client_create_tls(const char* ip, int port,
+                           int64_t connect_timeout_us, void* tls_ctx,
+                           int* rc_out);
 // One call; blocks the calling thread/fiber until the stream completes
 // or timeout_us passes (stream is then RST).  0 or -TRPC_*/-errno.
 int h2_client_call(void* conn, const char* method, const char* path,
