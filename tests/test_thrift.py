@@ -210,3 +210,35 @@ class TestThriftEndToEnd:
         s.settimeout(5)
         assert s.recv(64) == b""  # server closed on us
         s.close()
+
+
+class TestThriftPipelineCap:
+    def test_deep_pipeline_crosses_cap(self, thrift_server):
+        """200 framed calls written before any read: crosses the 64
+        in-flight sequencer cap; every reply must come back in order
+        (pins the parse_capped re-arm, VERDICT weak #10)."""
+        import socket as pysock
+        import struct as pstruct
+        srv, _, _ = thrift_server
+        s = pysock.create_connection(("127.0.0.1", srv.port), timeout=10)
+        n = 200
+        out = bytearray()
+        for i in range(n):
+            body = t.encode_struct({"a": i, "b": i}, ADD_ARGS)
+            msg = t.encode_message("add", t.MessageType.CALL, i + 1, body)
+            out += pstruct.pack("!I", len(msg)) + msg
+        s.sendall(out)
+        for i in range(n):
+            hdr = b""
+            while len(hdr) < 4:
+                hdr += s.recv(4 - len(hdr))
+            (mlen,) = pstruct.unpack("!I", hdr)
+            frame = b""
+            while len(frame) < mlen:
+                frame += s.recv(mlen - len(frame))
+            _m, mtype, seqid, off = t.decode_message(frame)
+            assert mtype == t.MessageType.REPLY and seqid == i + 1
+            result, _ = t.decode_struct(
+                frame, off, (t.TType.STRUCT, {0: ("success", t.TType.I64)}))
+            assert result["success"] == 2 * i
+        s.close()
