@@ -43,6 +43,7 @@ _SERVICES = [
     ("/sockets", "every live socket in the process"),
     ("/ids", "in-flight client correlation ids"),
     ("/threads", "python stacks + OS thread census"),
+    ("/vlog", "VLOG verbosity: ?v=N[&module=] to set"),
     ("/protobufs", "registered pb message types"),
     ("/dir", "working-dir browser (needs builtin_writable)"),
 ]
@@ -338,6 +339,25 @@ def install_builtin_services(server, dispatcher: HttpDispatcher) -> None:
         return HttpResponse.json({"path": os.path.relpath(target, base),
                                   "entries": rows})
 
+    def _vlog(req: HttpRequest) -> HttpResponse:
+        """Runtime VLOG verbosity (≙ builtin/vlog_service.cpp): GET shows
+        levels; ?v=N (optionally &module=name) sets — writes gated like
+        /flags."""
+        from brpc_tpu.utils import logging as _log
+        params = req.query_params()
+        if "v" in params:
+            if not writable:
+                return HttpResponse.text(
+                    "vlog writes disabled "
+                    "(ServerOptions.builtin_writable)\n", 403)
+            try:
+                level = int(params["v"])
+            except ValueError:
+                return HttpResponse.text("bad v\n", 400)
+            _log.set_vlog_level(level, params.get("module"))
+        return HttpResponse.json({"global_v": _log.vlog_level(),
+                                  "modules": _log.vlog_modules()})
+
     def _threads(req: HttpRequest) -> HttpResponse:
         """One stack per Python thread plus the native thread census from
         /proc/self/task (≙ builtin/threads_service.cpp attaching pstack;
@@ -385,6 +405,7 @@ def install_builtin_services(server, dispatcher: HttpDispatcher) -> None:
     d.register("/sockets", _sockets)
     d.register("/ids", _ids)
     d.register("/threads", _threads)
+    d.register("/vlog", _vlog)
     d.register("/protobufs", _protobufs)
     d.register("/dir", _dir)
     d.register("/rpcz", _rpcz)

@@ -590,3 +590,30 @@ class TestDirService:
         with pytest.raises(urllib.error.HTTPError) as ei:
             _get(server.port, "/dir?path=../..")
         assert ei.value.code == 403
+
+
+class TestVlogService:
+    def test_get_and_set(self, server):
+        from brpc_tpu.utils import logging as _log
+        out = json.load(_get(server.port, "/vlog"))
+        assert "global_v" in out
+        # writable fixture: set global then per-module, verify live
+        json.load(_get(server.port, "/vlog?v=2"))
+        assert _log.vlog_level() == 2
+        json.load(_get(server.port, "/vlog?v=5&module=ring"))
+        assert _log.vlog_level("ring") == 5
+        out = json.load(_get(server.port, "/vlog"))
+        assert out["global_v"] == 2 and out["modules"] == {"ring": 5}
+        _get(server.port, "/vlog?v=0")
+        _log.set_vlog_level(0, "ring")
+
+    def test_write_gated(self):
+        srv = Server()
+        srv.add_echo_service()
+        srv.start("127.0.0.1:0")
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(srv.port, "/vlog?v=3")
+            assert ei.value.code == 403
+        finally:
+            srv.destroy()
