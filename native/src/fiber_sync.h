@@ -13,6 +13,7 @@
 
 #include "common.h"
 #include "fiber.h"
+#include "metrics.h"
 
 namespace trpc {
 
@@ -33,7 +34,12 @@ class FiberMutex {
     // Drepper's contended path, verbatim: every acquisition attempt is
     // the exchange itself — an exchange(2) returning 0 MEANS we own the
     // lock (value left at 2 so unlock wakes; slightly pessimistic, never
-    // wrong).
+    // wrong).  Contention self-instruments (≙ the reference's contention
+    // profiler hooks in bthread_mutex, mutex.cpp:62-150): count + time
+    // land in native metrics, visible on /vars under load.
+    NativeMetrics& nm = native_metrics();
+    nm.mutex_contended.fetch_add(1, std::memory_order_relaxed);
+    int64_t t0 = monotonic_ns();
     if (c != 2) {
       c = butex_value(b_).exchange(2, std::memory_order_acquire);
     }
@@ -41,6 +47,8 @@ class FiberMutex {
       butex_wait(b_, 2, -1);
       c = butex_value(b_).exchange(2, std::memory_order_acquire);
     }
+    nm.mutex_wait_ns.fetch_add((uint64_t)(monotonic_ns() - t0),
+                               std::memory_order_relaxed);
   }
 
   bool try_lock() {

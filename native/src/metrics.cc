@@ -45,6 +45,8 @@ size_t native_metrics_dump(char* buf, size_t cap) {
   put("native_sequencer_parked", rel(m.sequencer_parked));
   put("native_parse_errors", relu(m.parse_errors));
   put("native_h2_connections", rel(m.h2_connections));
+  put("native_mutex_contended", relu(m.mutex_contended));
+  put("native_mutex_wait_ns", relu(m.mutex_wait_ns));
   put("native_uring_recv_completions", relu(m.uring_recv_completions));
   put("native_uring_recv_bytes", relu(m.uring_recv_bytes));
   put("native_uring_accepts", relu(m.uring_accepts));

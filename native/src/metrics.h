@@ -47,6 +47,12 @@ struct NativeMetrics {
   // h2 connections (h2.cc registry)
   std::atomic<int64_t> h2_connections{0};
 
+  // fiber-mutex contention (fiber_sync.h ≙ the contention profiler's
+  // counters): contended acquisitions and total nanoseconds spent
+  // waiting — a rising wait/contended ratio is a lock convoy
+  std::atomic<uint64_t> mutex_contended{0};
+  std::atomic<uint64_t> mutex_wait_ns{0};
+
   // io_uring engine (uring.cc): ring-fed receive path
   std::atomic<uint64_t> uring_recv_completions{0};
   std::atomic<uint64_t> uring_recv_bytes{0};

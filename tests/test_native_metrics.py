@@ -155,3 +155,42 @@ def test_pprof_symbol_resolves():
     out = urllib.request.urlopen(req, timeout=10).read().decode()
     assert "trpc_profiler_start" in out, out
     srv.destroy()
+
+
+def test_mutex_contention_counters():
+    """Contended FiberMutex acquisitions surface in the native metrics
+    (≙ the contention profiler's counters, mutex.cpp:62-150)."""
+    import ctypes
+    import threading
+
+    from brpc_tpu import fiber
+    from brpc_tpu._native import lib
+
+    def dump():
+        buf = ctypes.create_string_buffer(1 << 16)
+        n = lib().trpc_native_metrics_dump(buf, len(buf))
+        out = {}
+        for line in buf.raw[:n].decode().splitlines():
+            k, _, v = line.partition(" ")
+            out[k] = int(v)
+        return out
+
+    before = dump()
+    m = fiber.Mutex()
+    stop = threading.Event()
+
+    def fighter():
+        while not stop.is_set():
+            with m:
+                pass
+
+    ts = [threading.Thread(target=fighter) for _ in range(4)]
+    [t.start() for t in ts]
+    import time
+    time.sleep(0.5)
+    stop.set()
+    [t.join() for t in ts]
+    m.close()
+    after = dump()
+    assert after["native_mutex_contended"] > before["native_mutex_contended"]
+    assert after["native_mutex_wait_ns"] >= before["native_mutex_wait_ns"]
