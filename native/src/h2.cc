@@ -896,6 +896,11 @@ struct H2ClientStream {
 struct H2ClientConn {
   SocketId sock = INVALID_SOCKET_ID;
   std::mutex mu;
+  // serializes stream-id allocation with the HEADERS write (RFC 9113
+  // §5.1.1 increasing-id order) WITHOUT holding mu across Socket::Write:
+  // a failed inline write runs H2ClientOnFailed, which takes mu.
+  // Ordering: header_mu may wrap mu, never the reverse.
+  std::mutex header_mu;
   Hpack hpack_rx;  // decodes response header blocks
   uint32_t next_stream = 1;
   std::unordered_map<uint32_t, H2ClientStream*> streams;
@@ -908,6 +913,10 @@ struct H2ClientConn {
   // receive replenishment
   int64_t consumed_since_update = 0;
   uint32_t continuation_stream = 0;
+  // header block of a stream that no longer exists (timed out): HPACK
+  // state is connection-wide, so the block must still reach the decoder
+  std::string orphan_block;
+  bool tls = false;
   std::atomic<bool> failed{false};
 };
 
@@ -918,9 +927,21 @@ void H2ClientCompleteLocked(H2ClientConn* c, uint32_t sid,
   c->stream_send_window.erase(sid);
   butex_value(st->done).store(1, std::memory_order_release);
   butex_wake_all(st->done);
+  // a sender parked on flow control must notice the completion (e.g.
+  // the peer finished the response before the request body was done)
+  butex_value(c->window_butex).fetch_add(1, std::memory_order_release);
+  butex_wake_all(c->window_butex);
 }
 
 void H2ClientFailAllLocked(H2ClientConn* c, int error) {
+  if (c->continuation_stream != 0) {
+    // a header block is mid-flight: keep its accumulated prefix so the
+    // remaining CONTINUATION frames still decode as one full block
+    auto it = c->streams.find(c->continuation_stream);
+    if (it != c->streams.end()) {
+      c->orphan_block = std::move(it->second->hdr_block);
+    }
+  }
   for (auto& kv : c->streams) {
     H2ClientStream* st = kv.second;
     st->error = error;
@@ -929,6 +950,8 @@ void H2ClientFailAllLocked(H2ClientConn* c, int error) {
   }
   c->streams.clear();
   c->stream_send_window.clear();
+  butex_value(c->window_butex).fetch_add(1, std::memory_order_release);
+  butex_wake_all(c->window_butex);
 }
 
 void H2ClientOnFailed(Socket* s) {
@@ -938,9 +961,7 @@ void H2ClientOnFailed(Socket* s) {
   }
   c->failed.store(true, std::memory_order_release);
   std::lock_guard<std::mutex> lk(c->mu);
-  H2ClientFailAllLocked(c, -TRPC_EFAILEDSOCKET);
-  butex_value(c->window_butex).fetch_add(1, std::memory_order_release);
-  butex_wake_all(c->window_butex);
+  H2ClientFailAllLocked(c, -TRPC_EFAILEDSOCKET);  // also wakes senders
 }
 
 // Decode one complete header block into st->result (headers, then
@@ -1063,10 +1084,12 @@ void H2ClientOnMessages(Socket* s) {
       case F_HEADERS:
       case F_CONTINUATION: {
         auto it = c->streams.find(sid);
-        if (it == c->streams.end()) {
-          break;  // late frames for a timed-out stream
-        }
-        H2ClientStream* st = it->second;
+        // even when the stream is gone (timed out and erased) the block
+        // MUST still run through the connection-wide HPACK decoder, or
+        // its dynamic-table updates are lost and every later response
+        // decodes corrupt — accumulate orphans and decode-then-discard
+        H2ClientStream* st = it == c->streams.end() ? nullptr : it->second;
+        std::string* blk = st != nullptr ? &st->hdr_block : &c->orphan_block;
         size_t off = 0;
         if (type == F_HEADERS) {
           size_t pad = 0;
@@ -1083,20 +1106,32 @@ void H2ClientOnMessages(Socket* s) {
             s->SetFailed(EPROTO);
             return;
           }
-          st->hdr_block.assign((const char*)p + off, n - off - pad);
-          st->hdr_end_stream = (flags & FLAG_END_STREAM) != 0;
+          blk->assign((const char*)p + off, n - off - pad);
+          if (st != nullptr) {
+            st->hdr_end_stream = (flags & FLAG_END_STREAM) != 0;
+          }
         } else {
-          st->hdr_block.append((const char*)p, n);
+          blk->append((const char*)p, n);
         }
         if (flags & FLAG_END_HEADERS) {
           c->continuation_stream = 0;
-          if (!H2ClientHeaderBlock(c, st, st->hdr_block)) {
+          bool ok;
+          if (st != nullptr) {
+            ok = H2ClientHeaderBlock(c, st, st->hdr_block);
+            st->hdr_block.clear();
+          } else {
+            std::vector<std::pair<std::string, std::string>> discard;
+            ok = c->hpack_rx.decode_block(
+                (const uint8_t*)c->orphan_block.data(),
+                c->orphan_block.size(), &discard);
+            c->orphan_block.clear();
+          }
+          if (!ok) {
             lk.unlock();
             s->SetFailed(EPROTO);
             return;
           }
-          st->hdr_block.clear();
-          if (st->hdr_end_stream) {
+          if (st != nullptr && st->hdr_end_stream) {
             H2ClientCompleteLocked(c, sid, st, 0);
           }
         } else {
@@ -1229,6 +1264,7 @@ void* h2_client_create_tls(const char* ip, int port,
   }
 
   H2ClientConn* c = new H2ClientConn();
+  c->tls = tls_ctx != nullptr;  // drives ':scheme' on every request
   c->window_butex = butex_create();
   SocketOptions opts;
   opts.fd = fd;
@@ -1287,41 +1323,39 @@ int h2_client_call(void* conn, const char* method, const char* path,
   st.done = butex_create();
   butex_value(st.done).store(0, std::memory_order_relaxed);
 
-  uint32_t sid;
-  {
-    std::lock_guard<std::mutex> lk(c->mu);
-    sid = c->next_stream;
-    c->next_stream += 2;
-    c->streams[sid] = &st;
-    c->stream_send_window[sid] = c->peer_initial_window;
-  }
-
   Socket* s = Socket::Address(c->sock);
   if (s == nullptr) {
-    std::lock_guard<std::mutex> lk(c->mu);
-    c->streams.erase(sid);
-    c->stream_send_window.erase(sid);
     butex_destroy(st.done);
     return -TRPC_EFAILEDSOCKET;
   }
 
-  // HEADERS: pseudo-headers first, then the caller's blob
+  // HEADERS: pseudo-headers first, then the caller's blob (built before
+  // the lock — nothing in it depends on the stream id)
   std::string block;
   hpack_literal(&block, ":method", method);
-  hpack_literal(&block, ":scheme", "http");
+  hpack_literal(&block, ":scheme", c->tls ? "https" : "http");
   hpack_literal(&block, ":path", path);
   hpack_literal(&block, ":authority", "localhost");
   encode_blob(&block, headers_blob);
-  std::string frames;
   bool end_stream = body_len == 0;
+  uint32_t sid;
   {
-    // split the header block across CONTINUATION frames when it exceeds
-    // the peer's max frame size (the server enforces it with a GOAWAY)
+    // RFC 9113 §5.1.1: HEADERS must reach the wire in increasing
+    // stream-id order, so sid allocation and the HEADERS write share the
+    // header_mu critical section (DATA frames below interleave freely)
+    std::lock_guard<std::mutex> order_lk(c->header_mu);
     size_t maxf;
     {
       std::lock_guard<std::mutex> lk(c->mu);
+      sid = c->next_stream;
+      c->next_stream += 2;
+      c->streams[sid] = &st;
+      c->stream_send_window[sid] = c->peer_initial_window;
       maxf = c->peer_max_frame;
     }
+    // split the header block across CONTINUATION frames when it exceeds
+    // the peer's max frame size (the server enforces it with a GOAWAY)
+    std::string frames;
     size_t off = 0;
     bool first = true;
     do {
@@ -1336,8 +1370,8 @@ int h2_client_call(void* conn, const char* method, const char* path,
       off += chunk;
       first = false;
     } while (off < block.size());
+    write_frames(s, frames);
   }
-  write_frames(s, frames);
 
   // DATA respecting the peer's windows
   size_t sent = 0;
@@ -1348,6 +1382,18 @@ int h2_client_call(void* conn, const char* method, const char* path,
     int64_t avail = c->conn_send_window;
     auto it = c->stream_send_window.find(sid);
     if (it == c->stream_send_window.end()) {
+      if (st.error == 0 &&
+          butex_value(st.done).load(std::memory_order_acquire) != 0) {
+        // the peer finished the response before we finished the request
+        // (legal per RFC 9113 §8.1, common for early 404/413): stop
+        // uploading, tell the server via RST NO_ERROR, take the response
+        lk.unlock();
+        std::string rst;
+        put_frame_header(&rst, 4, F_RST, 0, sid);
+        rst.append("\x00\x00\x00\x00", 4);  // NO_ERROR
+        write_frames(s, rst);
+        break;
+      }
       rc = st.error != 0 ? st.error : -TRPC_EINTERNAL;
       break;  // stream died under us
     }
@@ -1402,6 +1448,12 @@ int h2_client_call(void* conn, const char* method, const char* path,
     std::lock_guard<std::mutex> lk(c->mu);
     still_registered = c->streams.erase(sid) > 0;
     c->stream_send_window.erase(sid);
+    if (still_registered && c->continuation_stream == sid) {
+      // erased mid-header-block: the rest of the block arrives as
+      // CONTINUATION for a gone stream — hand the accumulated prefix to
+      // the orphan buffer so the HPACK decoder still sees a full block
+      c->orphan_block = std::move(st.hdr_block);
+    }
   }
   if (still_registered) {
     // timed out / failed before the peer finished: reset the stream so

@@ -1,0 +1,148 @@
+"""h2 client edge cases against a raw-socket peer that misbehaves in
+RFC-legal ways our own server never does (≙ the adversarial halves of
+brpc's h2_unsent_message / http2_rpc_protocol unittests):
+
+- the peer completes the response (END_STREAM) while the client is still
+  flow-control-blocked uploading the request body (RFC 9113 §8.1);
+- the peer sends HPACK incremental-indexing entries on a stream the
+  client already timed out — connection-wide decoder state must survive;
+- ':scheme' is emitted on plaintext connections as 'http'.
+
+The peer is a hand-rolled frame pump on a real loopback socket (no h2
+library, no mocks) so each wire sequence is exact and deterministic.
+"""
+
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.h2_client import H2Channel
+
+F_DATA, F_HEADERS, F_RST, F_SETTINGS = 0x0, 0x1, 0x3, 0x4
+FLAG_END_STREAM, FLAG_END_HEADERS = 0x1, 0x4
+
+
+def _read_exact(conn, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            raise EOFError
+        buf += chunk
+    return buf
+
+
+def _read_frame(conn):
+    hdr = _read_exact(conn, 9)
+    length = int.from_bytes(hdr[:3], "big")
+    payload = _read_exact(conn, length) if length else b""
+    sid = int.from_bytes(hdr[5:9], "big") & 0x7FFFFFFF
+    return hdr[3], hdr[4], sid, payload
+
+
+def _frame(typ, flags, sid, payload=b""):
+    return (len(payload).to_bytes(3, "big") + bytes([typ, flags]) +
+            sid.to_bytes(4, "big") + payload)
+
+
+def _await_headers(conn):
+    """Skip frames until a HEADERS arrives; returns (sid, block)."""
+    while True:
+        typ, flags, sid, payload = _read_frame(conn)
+        if typ == F_HEADERS:
+            return sid, payload
+
+
+class _Peer:
+    def __init__(self, fn):
+        self.lsock = socket.socket()
+        self.lsock.bind(("127.0.0.1", 0))
+        self.lsock.listen(1)
+        self.port = self.lsock.getsockname()[1]
+        self.out = {}
+        self.err = None
+
+        def run():
+            try:
+                conn, _ = self.lsock.accept()
+                conn.settimeout(15)
+                _read_exact(conn, 24)  # client preface
+                conn.sendall(_frame(F_SETTINGS, 0, 0))
+                fn(conn, self.out)
+            except Exception as e:  # surfaced by join()
+                self.err = e
+
+        self.thread = threading.Thread(target=run, daemon=True)
+        self.thread.start()
+
+    def join(self):
+        self.thread.join(timeout=15)
+        self.lsock.close()
+        if self.err is not None:
+            raise self.err
+
+
+def test_early_end_stream_wins_over_unfinished_upload():
+    """Peer 404s (END_STREAM) right after HEADERS while the client still
+    has ~1MB of body blocked on the 65535-byte initial windows; the call
+    must return the 404 promptly, not EINTERNAL or a deadline timeout."""
+
+    def peer(conn, out):
+        sid, block = _await_headers(conn)
+        out["scheme_http"] = b":scheme\x04http" in block
+        # complete the response before any WINDOW_UPDATE: static-table
+        # index 13 = ':status: 404'
+        conn.sendall(_frame(F_HEADERS, FLAG_END_HEADERS | FLAG_END_STREAM,
+                            sid, b"\x8d"))
+        # client must abandon the upload with RST NO_ERROR
+        while True:
+            typ, flags, fsid, payload = _read_frame(conn)
+            if typ == F_RST and fsid == sid:
+                out["rst_code"] = int.from_bytes(payload, "big")
+                return
+
+    p = _Peer(peer)
+    ch = H2Channel(f"127.0.0.1:{p.port}")
+    t0 = time.monotonic()
+    resp = ch.request("POST", "/reject-early", body=b"x" * (1 << 20),
+                      timeout_ms=10_000.0)
+    elapsed = time.monotonic() - t0
+    p.join()
+    ch.close()
+    assert resp.status == 404
+    assert elapsed < 5.0, f"sender was not woken by the completion ({elapsed:.1f}s)"
+    assert p.out["rst_code"] == 0  # NO_ERROR, per §8.1
+    assert p.out["scheme_http"]
+
+
+def test_hpack_state_survives_timed_out_stream():
+    """Response headers for a stream the client already abandoned still
+    mutate the connection-wide HPACK dynamic table; a later response
+    that back-references those entries must decode."""
+
+    def peer(conn, out):
+        sid1, _ = _await_headers(conn)
+        time.sleep(0.5)  # let the 150ms client deadline fire
+        # ':status: 200' + literal WITH incremental indexing 'x-a: 1'
+        conn.sendall(_frame(F_HEADERS, FLAG_END_HEADERS | FLAG_END_STREAM,
+                            sid1, b"\x88" + b"\x40\x03x-a\x011"))
+        sid2, _ = _await_headers(conn)
+        out["sids"] = (sid1, sid2)
+        # dynamic-table index 62 == the 'x-a: 1' inserted on the dead stream
+        conn.sendall(_frame(F_HEADERS, FLAG_END_HEADERS | FLAG_END_STREAM,
+                            sid2, b"\x88" + b"\xbe"))
+
+    p = _Peer(peer)
+    ch = H2Channel(f"127.0.0.1:{p.port}")
+    with pytest.raises(errors.RpcError):
+        ch.request("GET", "/slow", timeout_ms=150.0)
+    resp = ch.request("GET", "/fast", timeout_ms=10_000.0)
+    p.join()
+    ch.close()
+    assert resp.status == 200
+    assert resp.headers.get("x-a") == "1"
+    assert p.out["sids"] == (1, 3)  # increasing ids on one connection
