@@ -24,6 +24,7 @@ Python face of ``native/src/tpu.{h,cc}`` (≙ the reference's RDMA transport,
 from __future__ import annotations
 
 import ctypes
+import errno
 from typing import Dict, Optional
 
 from brpc_tpu._native import lib
@@ -83,8 +84,11 @@ class DeviceBuffer:
     def wait(self, timeout_s: float = 30.0) -> None:
         """Block (fiber-friendly) until the buffer is resident in HBM."""
         rc = lib().trpc_tpu_buf_wait(self._id, int(timeout_s * 1e6))
-        if rc != 0:
+        if rc == 0:
+            return
+        if rc == -errno.ETIMEDOUT:
             raise TimeoutError(f"device transfer not ready: rc={rc}")
+        raise IOError(f"device transfer failed: rc={rc} ({error()})")
 
     def to_host(self) -> bytes:
         """DMA the buffer back to host memory."""

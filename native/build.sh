@@ -10,6 +10,10 @@ ninja -C build
 # cp would rewrite the inode under them and crash mid-run test suites)
 cp build/libbrpc_tpu_core.so ../brpc_tpu/_native/.libbrpc_tpu_core.so.tmp
 mv ../brpc_tpu/_native/.libbrpc_tpu_core.so.tmp ../brpc_tpu/_native/libbrpc_tpu_core.so
+if [[ -f build/libpjrt_fake.so ]]; then
+  cp build/libpjrt_fake.so ../brpc_tpu/_native/.libpjrt_fake.so.tmp
+  mv ../brpc_tpu/_native/.libpjrt_fake.so.tmp ../brpc_tpu/_native/libpjrt_fake.so
+fi
 if [[ "${1:-}" == "--test" ]]; then
   ./build/test_core
 fi

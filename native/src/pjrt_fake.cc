@@ -1,0 +1,419 @@
+// pjrt_fake.cc — in-repo fake PJRT plugin for CI coverage of the device
+// data plane (tpu.cc).  ≙ the reference's testing doctrine for its RDMA
+// transport (test/brpc_rdma_unittest.cpp guards everything above the
+// verbs layer so it tests WITHOUT special hardware): the plane calls ~10
+// PJRT entry points; this .so implements exactly those against host
+// memory, with a real background completion thread so callbacks fire on
+// a foreign thread like a genuine DMA engine, and injectable
+// delayed/failed/dropped events so the plane's error and timeout paths
+// are exercisable anywhere.
+//
+// Knobs (read per-operation, so tests can flip them between calls):
+//   TRPC_FAKE_PJRT_DEVICES    device count at client create (default 2)
+//   TRPC_FAKE_PJRT_DELAY_US   event completion delay (default 0 — still
+//                             asynchronous, just immediate)
+//   TRPC_FAKE_PJRT_FAIL       "h2d" sync create failure; "ready" the
+//                             residency event completes with an error;
+//                             "d2h" the copy event completes with an error
+//   TRPC_FAKE_PJRT_DROP_D2H_EVENT=1   the copy event never fires
+//
+// NOT a PJRT implementation: no compilation, no executables, no layouts.
+// Only the transfer surface the data plane binds.
+
+#include <stdlib.h>
+#include <string.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+// ---------------------------------------------------------------------------
+// Opaque types.  The header forward-declares these; the plugin owns the
+// definitions.
+
+struct PJRT_Error {
+  std::string msg;
+};
+
+struct PJRT_Event {
+  std::mutex mu;
+  bool ready = false;
+  bool dropped = false;  // never completes (injected wedge)
+  std::string error;     // nonempty: completes with an error
+  std::vector<std::pair<PJRT_Event_OnReadyCallback, void*>> cbs;
+};
+
+struct PJRT_Device {
+  int id = 0;
+};
+
+struct PJRT_Client {
+  std::vector<PJRT_Device> devices;
+  std::vector<PJRT_Device*> device_ptrs;
+  std::string platform = "fake";
+};
+
+struct PJRT_Buffer {
+  std::atomic<int> refs{1};
+  char* data = nullptr;
+  size_t len = 0;
+  PJRT_Device* dev = nullptr;
+  PJRT_Event* ready = nullptr;
+};
+
+namespace {
+
+// --- config ----------------------------------------------------------------
+
+int64_t env_i64(const char* name, int64_t dflt) {
+  const char* v = getenv(name);
+  return (v != nullptr && v[0] != '\0') ? strtoll(v, nullptr, 10) : dflt;
+}
+
+bool fail_mode(const char* what) {
+  const char* v = getenv("TRPC_FAKE_PJRT_FAIL");
+  return v != nullptr && strcmp(v, what) == 0;
+}
+
+// --- event registry + completion thread ------------------------------------
+// Every event lives in a global registry (reachable forever => the leak
+// sanitizer stays quiet about the handles tpu.cc deliberately never
+// destroys); buffers ARE refcounted and a missed tpu_buf_free shows up
+// as a real leak — that is a feature.
+
+// All cross-thread singletons are heap-allocated and leaked on purpose:
+// the detached completion thread outlives main(), and destroying a
+// condition variable (or mutex) with a waiter parked on it at process
+// exit hangs in glibc.  Leaked globals stay reachable, so LSan is quiet.
+std::mutex& events_mu() {
+  static std::mutex* m = new std::mutex();
+  return *m;
+}
+std::vector<PJRT_Event*>& all_events() {
+  static std::vector<PJRT_Event*>* v = new std::vector<PJRT_Event*>();
+  return *v;
+}
+
+PJRT_Event* new_event() {
+  PJRT_Event* e = new PJRT_Event();
+  std::lock_guard<std::mutex> lk(events_mu());
+  all_events().push_back(e);
+  return e;
+}
+
+void fire_event(PJRT_Event* e, const std::string& error) {
+  std::vector<std::pair<PJRT_Event_OnReadyCallback, void*>> cbs;
+  {
+    std::lock_guard<std::mutex> lk(e->mu);
+    if (e->ready || e->dropped) {
+      return;
+    }
+    e->ready = true;
+    e->error = error;
+    cbs.swap(e->cbs);
+  }
+  for (auto& cb : cbs) {
+    // ownership of the PJRT_Error transfers to the callback
+    cb.first(error.empty() ? nullptr : new PJRT_Error{error}, cb.second);
+  }
+}
+
+struct Job {
+  int64_t at_us;
+  std::function<void()> fn;
+};
+
+std::mutex& jobs_mu() {
+  static std::mutex* m = new std::mutex();
+  return *m;
+}
+std::condition_variable& jobs_cv() {
+  static std::condition_variable* cv = new std::condition_variable();
+  return *cv;
+}
+std::deque<Job>& jobs() {
+  static std::deque<Job>* q = new std::deque<Job>();
+  return *q;
+}
+std::atomic<bool> g_worker_up{false};
+
+int64_t now_us() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (int64_t)ts.tv_sec * 1000000 + ts.tv_nsec / 1000;
+}
+
+void worker_loop() {
+  std::deque<Job>& q = jobs();
+  std::unique_lock<std::mutex> lk(jobs_mu());
+  while (true) {
+    if (q.empty()) {
+      jobs_cv().wait(lk);
+      continue;
+    }
+    // earliest-deadline job (the queue stays tiny in tests)
+    size_t best = 0;
+    for (size_t i = 1; i < q.size(); ++i) {
+      if (q[i].at_us < q[best].at_us) {
+        best = i;
+      }
+    }
+    int64_t wait = q[best].at_us - now_us();
+    if (wait > 0) {
+      jobs_cv().wait_for(lk, std::chrono::microseconds(wait));
+      continue;
+    }
+    Job j = std::move(q[best]);
+    q.erase(q.begin() + best);
+    lk.unlock();
+    j.fn();
+    lk.lock();
+  }
+}
+
+void schedule(int64_t delay_us, std::function<void()> fn) {
+  {
+    std::lock_guard<std::mutex> lk(jobs_mu());
+    if (!g_worker_up.exchange(true)) {
+      std::thread(worker_loop).detach();  // lives for the process
+    }
+    jobs().push_back(Job{now_us() + delay_us, std::move(fn)});
+  }
+  jobs_cv().notify_one();
+}
+
+void buf_unref(PJRT_Buffer* b) {
+  if (b->refs.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+    free(b->data);
+    delete b;
+  }
+}
+
+// --- API entry points ------------------------------------------------------
+
+void fake_Error_Message(PJRT_Error_Message_Args* a) {
+  a->message = a->error->msg.c_str();
+  a->message_size = a->error->msg.size();
+}
+
+void fake_Error_Destroy(PJRT_Error_Destroy_Args* a) {
+  delete a->error;
+}
+
+PJRT_Error* fake_Error_GetCode(PJRT_Error_GetCode_Args* a) {
+  a->code = PJRT_Error_Code_INTERNAL;
+  return nullptr;
+}
+
+PJRT_Error* fake_Plugin_Initialize(PJRT_Plugin_Initialize_Args*) {
+  return nullptr;
+}
+
+PJRT_Error* fake_Client_Create(PJRT_Client_Create_Args* a) {
+  int n = (int)env_i64("TRPC_FAKE_PJRT_DEVICES", 2);
+  if (n < 1) {
+    n = 1;
+  }
+  PJRT_Client* c = new PJRT_Client();
+  c->devices.resize(n);
+  for (int i = 0; i < n; ++i) {
+    c->devices[i].id = i;
+    c->device_ptrs.push_back(&c->devices[i]);
+  }
+  a->client = c;
+  return nullptr;
+}
+
+PJRT_Error* fake_Client_Destroy(PJRT_Client_Destroy_Args* a) {
+  delete a->client;
+  return nullptr;
+}
+
+PJRT_Error* fake_Client_PlatformName(PJRT_Client_PlatformName_Args* a) {
+  a->platform_name = a->client->platform.c_str();
+  a->platform_name_size = a->client->platform.size();
+  return nullptr;
+}
+
+PJRT_Error* fake_Client_AddressableDevices(
+    PJRT_Client_AddressableDevices_Args* a) {
+  a->addressable_devices = a->client->device_ptrs.data();
+  a->num_addressable_devices = a->client->device_ptrs.size();
+  return nullptr;
+}
+
+PJRT_Error* fake_Client_BufferFromHostBuffer(
+    PJRT_Client_BufferFromHostBuffer_Args* a) {
+  if (fail_mode("h2d")) {
+    return new PJRT_Error{"injected h2d failure"};
+  }
+  size_t len = 1;
+  for (size_t i = 0; i < a->num_dims; ++i) {
+    len *= (size_t)a->dims[i];
+  }
+  // only the plane's U8 byte-stream shape is supported
+  if (a->type != PJRT_Buffer_Type_U8) {
+    return new PJRT_Error{"fake plugin supports U8 only"};
+  }
+  PJRT_Buffer* b = new PJRT_Buffer();
+  b->data = (char*)malloc(len);
+  b->len = len;
+  b->dev = a->device;
+  b->ready = new_event();
+  PJRT_Event* done = new_event();
+  const void* src = a->data;
+  bool fail_ready = fail_mode("ready");
+  b->refs.fetch_add(1, std::memory_order_relaxed);  // the transfer's ref
+  // the "DMA": reads host memory on the completion thread, honoring
+  // kImmutableUntilTransferCompletes — the source must stay valid until
+  // `done` fires, exactly what the plane's IOBuf-block pinning promises
+  schedule(env_i64("TRPC_FAKE_PJRT_DELAY_US", 0), [b, src, len, done,
+                                                   fail_ready]() {
+    memcpy(b->data, src, len);
+    fire_event(done, "");
+    fire_event(b->ready, fail_ready ? "injected ready failure" : "");
+    buf_unref(b);
+  });
+  a->done_with_host_buffer = done;
+  a->buffer = b;
+  return nullptr;
+}
+
+PJRT_Error* fake_Buffer_ReadyEvent(PJRT_Buffer_ReadyEvent_Args* a) {
+  a->event = a->buffer->ready;
+  return nullptr;
+}
+
+PJRT_Error* fake_Buffer_ToHostBuffer(PJRT_Buffer_ToHostBuffer_Args* a) {
+  PJRT_Buffer* b = a->src;
+  if (a->dst == nullptr) {
+    a->dst_size = b->len;
+    return nullptr;
+  }
+  if (a->dst_size < b->len) {
+    return new PJRT_Error{"dst too small"};
+  }
+  PJRT_Event* ev = new_event();
+  a->event = ev;
+  if (env_i64("TRPC_FAKE_PJRT_DROP_D2H_EVENT", 0) != 0) {
+    std::lock_guard<std::mutex> lk(ev->mu);
+    ev->dropped = true;  // no copy, no completion: a wedged DMA
+    return nullptr;
+  }
+  void* dst = a->dst;
+  bool fail_d2h = fail_mode("d2h");
+  b->refs.fetch_add(1, std::memory_order_relaxed);
+  schedule(env_i64("TRPC_FAKE_PJRT_DELAY_US", 0), [b, dst, ev,
+                                                   fail_d2h]() {
+    memcpy(dst, b->data, b->len);
+    fire_event(ev, fail_d2h ? "injected d2h failure" : "");
+    buf_unref(b);
+  });
+  return nullptr;
+}
+
+PJRT_Error* fake_Buffer_CopyToDevice(PJRT_Buffer_CopyToDevice_Args* a) {
+  PJRT_Buffer* src = a->buffer;
+  PJRT_Buffer* dst = new PJRT_Buffer();
+  dst->data = (char*)malloc(src->len);
+  dst->len = src->len;
+  dst->dev = a->dst_device;
+  dst->ready = new_event();
+  src->refs.fetch_add(1, std::memory_order_relaxed);
+  dst->refs.fetch_add(1, std::memory_order_relaxed);
+  // device-to-device: no host round-trip a caller could observe; the
+  // copy happens wholly on the completion thread
+  schedule(env_i64("TRPC_FAKE_PJRT_DELAY_US", 0), [src, dst]() {
+    memcpy(dst->data, src->data, src->len);
+    fire_event(dst->ready, "");
+    buf_unref(src);
+    buf_unref(dst);
+  });
+  a->dst_buffer = dst;
+  return nullptr;
+}
+
+PJRT_Error* fake_Buffer_Destroy(PJRT_Buffer_Destroy_Args* a) {
+  buf_unref(a->buffer);
+  return nullptr;
+}
+
+PJRT_Error* fake_Buffer_Device(PJRT_Buffer_Device_Args* a) {
+  a->device = a->buffer->dev;
+  return nullptr;
+}
+
+PJRT_Error* fake_Event_OnReady(PJRT_Event_OnReady_Args* a) {
+  PJRT_Event* e = a->event;
+  PJRT_Event_OnReadyCallback cb = a->callback;
+  void* user = a->user_arg;
+  bool run_now = false;
+  std::string err;
+  {
+    std::lock_guard<std::mutex> lk(e->mu);
+    if (e->dropped) {
+      return nullptr;  // registered into the void, never fires
+    }
+    if (e->ready) {
+      run_now = true;
+      err = e->error;
+    } else {
+      e->cbs.emplace_back(cb, user);
+    }
+  }
+  if (run_now) {
+    cb(err.empty() ? nullptr : new PJRT_Error{err}, user);
+  }
+  return nullptr;
+}
+
+PJRT_Error* fake_Event_Destroy(PJRT_Event_Destroy_Args*) {
+  return nullptr;  // events live in the global registry
+}
+
+PJRT_Error* fake_Event_IsReady(PJRT_Event_IsReady_Args* a) {
+  std::lock_guard<std::mutex> lk(a->event->mu);
+  a->is_ready = a->event->ready;
+  return nullptr;
+}
+
+}  // namespace
+
+extern "C" const PJRT_Api* GetPjrtApi() {
+  static PJRT_Api* api = []() {
+    PJRT_Api* a = new PJRT_Api();
+    memset(a, 0, sizeof(*a));
+    a->struct_size = PJRT_Api_STRUCT_SIZE;
+    a->pjrt_api_version.struct_size = PJRT_Api_Version_STRUCT_SIZE;
+    a->pjrt_api_version.major_version = PJRT_API_MAJOR;
+    a->pjrt_api_version.minor_version = PJRT_API_MINOR;
+    a->PJRT_Error_Destroy = fake_Error_Destroy;
+    a->PJRT_Error_Message = fake_Error_Message;
+    a->PJRT_Error_GetCode = fake_Error_GetCode;
+    a->PJRT_Plugin_Initialize = fake_Plugin_Initialize;
+    a->PJRT_Client_Create = fake_Client_Create;
+    a->PJRT_Client_Destroy = fake_Client_Destroy;
+    a->PJRT_Client_PlatformName = fake_Client_PlatformName;
+    a->PJRT_Client_AddressableDevices = fake_Client_AddressableDevices;
+    a->PJRT_Client_BufferFromHostBuffer = fake_Client_BufferFromHostBuffer;
+    a->PJRT_Buffer_ReadyEvent = fake_Buffer_ReadyEvent;
+    a->PJRT_Buffer_ToHostBuffer = fake_Buffer_ToHostBuffer;
+    a->PJRT_Buffer_CopyToDevice = fake_Buffer_CopyToDevice;
+    a->PJRT_Buffer_Destroy = fake_Buffer_Destroy;
+    a->PJRT_Buffer_Device = fake_Buffer_Device;
+    a->PJRT_Event_OnReady = fake_Event_OnReady;
+    a->PJRT_Event_Destroy = fake_Event_Destroy;
+    a->PJRT_Event_IsReady = fake_Event_IsReady;
+    return a;
+  }();
+  return api;
+}

@@ -34,6 +34,7 @@
 #include "iobuf.h"
 #include "rpc.h"
 #include "h2.h"
+#include "tpu.h"
 #include "uring.h"
 
 using namespace trpc;
@@ -652,6 +653,103 @@ static void test_h2_client_storm() {
   printf("ok h2_client_storm ok=%llu\n", (unsigned long long)ok.load());
 }
 
+// --- 12. device plane races (fake PJRT plugin) ------------------------------
+// h2d / wait / d2h / free race on SHARED ids across threads while plugin
+// completion callbacks fire on a foreign thread with a real delay: the
+// pinned-waiter seam (a waiter must never read a recycled slot's next
+// occupant) and the deferred PJRT_Buffer_Destroy (never under a live
+// reader) only show up under this interleaving.
+static void test_tpu_plane_races() {
+  // the fake plugin sits next to the test binary (same build dir)
+  char exe[512];
+  ssize_t n = readlink("/proc/self/exe", exe, sizeof(exe) - 1);
+  if (n <= 0) {
+    printf("skip tpu_plane_races (no /proc/self/exe)\n");
+    return;
+  }
+  exe[n] = '\0';
+  std::string dir(exe);
+  dir = dir.substr(0, dir.rfind('/'));
+  std::string fake = dir + "/libpjrt_fake.so";
+  if (access(fake.c_str(), R_OK) != 0) {
+    printf("skip tpu_plane_races (no %s)\n", fake.c_str());
+    return;
+  }
+  setenv("TRPC_FAKE_PJRT_DELAY_US", "300", 1);
+  if (tpu_plane_init(fake.c_str()) != 0) {
+    printf("skip tpu_plane_races (init: %s)\n", tpu_plane_error());
+    return;
+  }
+  CHECK_TRUE(tpu_plane_device_count() >= 2);
+  const int kThreads = 6;
+  const int kRounds = 120;
+  std::string payload(8192, '\x5a');
+  std::atomic<uint64_t> roundtrips{0}, freed_races{0};
+  std::atomic<int> bad{0};
+  std::vector<std::thread> ts;
+  for (int t = 0; t < kThreads; ++t) {
+    ts.emplace_back([&, t]() {
+      for (int i = 0; i < kRounds; ++i) {
+        IOBuf src;
+        src.append(payload.data(), payload.size());
+        TpuBufId id = tpu_h2d_from_iobuf(src, (t + i) % 2);
+        if (id == 0) {
+          bad.fetch_add(1);
+          continue;
+        }
+        // hand the id to a RACING thread that frees it mid-flight on
+        // half the rounds; the other half round-trips the bytes
+        if (i % 2 == 0) {
+          std::thread killer([id]() { tpu_buf_free(id); });
+          // wait/d2h race the free: any rc is legal, crashes/UAF are not
+          (void)tpu_buf_wait(id, 1000000);
+          char* mem = nullptr;
+          size_t len = 0;
+          if (tpu_d2h_raw(id, &mem, &len) == 0) {
+            free(mem);
+          }
+          killer.join();
+          tpu_buf_free(id);  // double-free must be idempotent
+          freed_races.fetch_add(1);
+        } else {
+          if (tpu_buf_wait(id, 5000000) != 0) {
+            bad.fetch_add(1);
+          } else {
+            char* mem = nullptr;
+            size_t len = 0;
+            int rc = tpu_d2h_raw(id, &mem, &len);
+            if (rc != 0 || len != payload.size() ||
+                memcmp(mem, payload.data(), len) != 0) {
+              bad.fetch_add(1);
+            }
+            if (rc == 0) {
+              free(mem);
+            }
+            roundtrips.fetch_add(1);
+          }
+          tpu_buf_free(id);
+        }
+      }
+    });
+  }
+  for (auto& t : ts) {
+    t.join();
+  }
+  // every slot must have drained: live_buffers falls back to zero once
+  // the delayed completions run out
+  for (int spin = 0; spin < 100 && tpu_plane_stats().live_buffers != 0;
+       ++spin) {
+    usleep(10000);
+  }
+  TpuPlaneStats st = tpu_plane_stats();
+  CHECK_TRUE(bad.load() == 0);
+  CHECK_TRUE(st.live_buffers == 0);
+  CHECK_TRUE(roundtrips.load() == (uint64_t)kThreads * kRounds / 2);
+  printf("ok tpu_plane_races roundtrips=%llu freed_races=%llu\n",
+         (unsigned long long)roundtrips.load(),
+         (unsigned long long)freed_races.load());
+}
+
 int main() {
   fiber_runtime_init(4);
   test_butex_churn();
@@ -665,6 +763,7 @@ int main() {
   test_restart_storm();
   test_h2_client_storm();
   test_uring_churn();
+  test_tpu_plane_races();
   if (g_failures == 0) {
     printf("ALL STRESS TESTS PASSED\n");
     return 0;

@@ -104,8 +104,14 @@ struct DeviceBuf {
   }
 };
 
+void destroy_pjrt_buf(DeviceBuf* b);
+
 void unpin_buf(DeviceBuf* b) {
   if (b->pins.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+    // last pin out destroys the PJRT buffer (deferred from tpu_buf_free
+    // when a pinned waiter/callback was still live — the buffer handle
+    // must outlive every reader) and only then recycles the slot
+    destroy_pjrt_buf(b);
     ResourcePool<DeviceBuf>::Return(b->slot);
   }
 }
@@ -114,6 +120,30 @@ DeviceBuf* addr_buf(TpuBufId id) {
   DeviceBuf* b = ResourcePool<DeviceBuf>::Address((uint32_t)id);
   if (b == nullptr ||
       b->version.load(std::memory_order_acquire) != (uint32_t)(id >> 32)) {
+    return nullptr;
+  }
+  return b;
+}
+
+// Take a reader pin on the slot (≙ Socket::Address giving readers a ref,
+// socket.h:430): the slot cannot recycle — and the PJRT buffer cannot be
+// destroyed — while the pin is held.  Fails when the id's occupant is
+// gone or already draining (pins only climb from a live, nonzero count;
+// the version re-check under the pin rejects a recycled slot).
+DeviceBuf* pin_buf(TpuBufId id) {
+  DeviceBuf* b = ResourcePool<DeviceBuf>::Address((uint32_t)id);
+  if (b == nullptr) {
+    return nullptr;
+  }
+  int32_t cur = b->pins.load(std::memory_order_acquire);
+  do {
+    if (cur <= 0) {
+      return nullptr;  // draining or recycled: nothing to pin
+    }
+  } while (!b->pins.compare_exchange_weak(cur, cur + 1,
+                                          std::memory_order_acq_rel));
+  if (b->version.load(std::memory_order_acquire) != (uint32_t)(id >> 32)) {
+    unpin_buf(b);  // pinned the slot's NEXT occupant: back out
     return nullptr;
   }
   return b;
@@ -492,11 +522,9 @@ TpuBufId tpu_h2d_from_iobuf(const IOBuf& buf, int device_index) {
   return tpu_h2d(staging, buf.size(), device_index, release_free, nullptr);
 }
 
-int tpu_buf_wait(TpuBufId id, int64_t timeout_us) {
-  DeviceBuf* b = addr_buf(id);
-  if (b == nullptr) {
-    return -EINVAL;
-  }
+namespace {
+// Residency wait on an ALREADY-PINNED buf (callers own the pin).
+int wait_ready_pinned(DeviceBuf* b, int64_t timeout_us) {
   while (butex_value(b->ready).load(std::memory_order_acquire) == 0) {
     if (butex_wait(b->ready, 0, timeout_us) != 0 && errno == ETIMEDOUT) {
       if (butex_value(b->ready).load(std::memory_order_acquire) != 0) {
@@ -507,47 +535,85 @@ int tpu_buf_wait(TpuBufId id, int64_t timeout_us) {
   }
   return b->error.load(std::memory_order_acquire) == 0 ? 0 : -EIO;
 }
+}  // namespace
+
+int tpu_buf_wait(TpuBufId id, int64_t timeout_us) {
+  // the pin keeps the slot (and its butex arming) ours for the whole
+  // wait: without it a racing tpu_buf_free could recycle the slot and a
+  // parked waiter would be reading the NEXT occupant's ready/error
+  DeviceBuf* b = pin_buf(id);
+  if (b == nullptr) {
+    return -EINVAL;
+  }
+  int rc = wait_ready_pinned(b, timeout_us);
+  unpin_buf(b);
+  return rc;
+}
 
 int64_t tpu_buf_size(TpuBufId id) {
-  DeviceBuf* b = addr_buf(id);
-  return b == nullptr ? -1 : (int64_t)b->len;
+  DeviceBuf* b = pin_buf(id);
+  if (b == nullptr) {
+    return -1;
+  }
+  int64_t n = (int64_t)b->len;
+  unpin_buf(b);
+  return n;
 }
 
 // DMA the device buffer into fresh malloc'd host memory.  On success the
 // caller owns *mem (free()); *len_out is the byte count.
 static int tpu_d2h_alloc(TpuBufId id, char** mem_out, size_t* len_out) {
   Plane& p = plane();
-  DeviceBuf* b = addr_buf(id);
-  if (b == nullptr || b->buf == nullptr) {
+  // pinned for the whole op: the PJRT buffer handle must stay alive
+  // across the ToHostBuffer call and its completion (a racing free only
+  // schedules the destroy; it runs when the last pin drains)
+  DeviceBuf* b = pin_buf(id);
+  if (b == nullptr) {
     return -EINVAL;
   }
-  int rc = tpu_buf_wait(id, 30 * 1000 * 1000);
-  if (rc != 0) {
-    return rc;
+  int rc = wait_ready_pinned(b, 30 * 1000 * 1000);
+  if (rc != 0 || b->buf == nullptr) {
+    unpin_buf(b);
+    return rc != 0 ? rc : -EINVAL;
   }
+  size_t len = b->len;
   // DMA straight into fresh host memory: exactly one host-side landing
-  // zone, shared by the IOBuf path and the C-API path
-  char* mem = (char*)malloc(b->len);
-  PJRT_Buffer_ToHostBuffer_Args args;
-  memset(&args, 0, sizeof(args));
-  args.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
-  args.src = b->buf;
-  args.dst = mem;
-  args.dst_size = b->len;
-  PJRT_Error* err = p.api->PJRT_Buffer_ToHostBuffer(&args);
-  if (err != nullptr) {
-    p.errors.fetch_add(1, std::memory_order_relaxed);
-    set_plane_error("d2h: " + pjrt_error_string(p.api, err));
-    free(mem);
-    return -EIO;
-  }
-  // wait for the copy event on a private butex (store 1 + wake pattern)
+  // zone, shared by the IOBuf path and the C-API path.  The landing zone
+  // is OWNED BY THE CONTEXT until the caller claims it on success — a
+  // timed-out caller walks away and the late DMA still writes valid
+  // memory, freed by whoever drops the last context reference.
   struct D2hCtx {
     Butex* done;
     std::atomic<int32_t> err{0};
     std::atomic<int32_t> refs{2};  // caller + callback
+    char* mem = nullptr;
+    // single teardown shared by caller and callback: the last ref out
+    // frees the landing zone unless the caller claimed it
+    static void Drop(D2hCtx* c) {
+      if (c->refs.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+        free(c->mem);
+        butex_destroy(c->done);
+        delete c;
+      }
+    }
   };
   D2hCtx* ctx = new D2hCtx{butex_create()};
+  ctx->mem = (char*)malloc(len);
+  PJRT_Buffer_ToHostBuffer_Args args;
+  memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+  args.src = b->buf;
+  args.dst = ctx->mem;
+  args.dst_size = len;
+  PJRT_Error* err = p.api->PJRT_Buffer_ToHostBuffer(&args);
+  if (err != nullptr) {
+    p.errors.fetch_add(1, std::memory_order_relaxed);
+    set_plane_error("d2h: " + pjrt_error_string(p.api, err));
+    ctx->refs.store(1, std::memory_order_relaxed);  // no callback coming
+    D2hCtx::Drop(ctx);
+    unpin_buf(b);
+    return -EIO;
+  }
   PJRT_Event_OnReady_Args oargs;
   memset(&oargs, 0, sizeof(oargs));
   oargs.struct_size = PJRT_Event_OnReady_Args_STRUCT_SIZE;
@@ -563,29 +629,55 @@ static int tpu_d2h_alloc(TpuBufId id, char** mem_out, size_t* len_out) {
     }
     butex_value(c->done).store(1, std::memory_order_release);
     butex_wake_all(c->done);
-    if (c->refs.fetch_sub(1, std::memory_order_acq_rel) == 1) {
-      butex_destroy(c->done);
-      delete c;
-    }
+    D2hCtx::Drop(c);
   };
   oargs.user_arg = ctx;
   p.api->PJRT_Event_OnReady(&oargs);
+  // BOUNDED wait for the copy event: a plugin that drops the event must
+  // not park a usercode-pool thread forever (that silently shrinks the
+  // handler pool).  Budget tunable for tests via TRPC_TPU_D2H_TIMEOUT_US.
+  int64_t budget_us = 30 * 1000 * 1000;
+  {
+    const char* bv = getenv("TRPC_TPU_D2H_TIMEOUT_US");
+    if (bv != nullptr && bv[0] != '\0') {
+      int64_t v = strtoll(bv, nullptr, 10);
+      if (v > 0) {  // unparseable/negative: keep the safe default
+        budget_us = v;
+      }
+    }
+  }
+  int64_t ev_deadline = monotonic_us() + budget_us;
+  bool timed_out = false;
   while (butex_value(ctx->done).load(std::memory_order_acquire) == 0) {
-    butex_wait(ctx->done, 0, 100 * 1000);
+    int64_t left = ev_deadline - monotonic_us();
+    if (left <= 0) {
+      timed_out = true;
+      break;
+    }
+    butex_wait(ctx->done, 0, left < 100 * 1000 ? left : 100 * 1000);
+  }
+  if (timed_out) {
+    p.errors.fetch_add(1, std::memory_order_relaxed);
+    set_plane_error("d2h: copy event never completed (plugin dropped it)");
+    D2hCtx::Drop(ctx);  // ctx keeps the landing zone for the late DMA
+    unpin_buf(b);
+    return -ETIMEDOUT;
   }
   int32_t cerr = ctx->err.load(std::memory_order_acquire);
-  if (ctx->refs.fetch_sub(1, std::memory_order_acq_rel) == 1) {
-    butex_destroy(ctx->done);
-    delete ctx;
+  char* mem = nullptr;
+  if (cerr == 0) {
+    mem = ctx->mem;  // claim: the last ctx ref must not free it
+    ctx->mem = nullptr;
   }
+  D2hCtx::Drop(ctx);
+  unpin_buf(b);
   if (cerr != 0) {
-    free(mem);
     return -EIO;
   }
   p.d2h_transfers.fetch_add(1, std::memory_order_relaxed);
-  p.d2h_bytes.fetch_add(b->len, std::memory_order_relaxed);
+  p.d2h_bytes.fetch_add(len, std::memory_order_relaxed);
   *mem_out = mem;
-  *len_out = b->len;
+  *len_out = len;
   return 0;
 }
 
@@ -607,33 +699,41 @@ int tpu_d2h_raw(TpuBufId id, char** mem_out, size_t* len_out) {
   return tpu_d2h_alloc(id, mem_out, len_out);
 }
 
-void tpu_buf_free(TpuBufId id) {
+namespace {
+// Runs at last-pin drain (often the freer's own unpin): with no readers
+// or callbacks left, the handle release cannot race a ToHostBuffer.
+void destroy_pjrt_buf(DeviceBuf* b) {
+  if (b->buf == nullptr) {
+    return;
+  }
   Plane& p = plane();
-  DeviceBuf* b = addr_buf(id);
+  PJRT_Buffer_Destroy_Args args;
+  memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+  args.buffer = b->buf;
+  PJRT_Error* err = p.api->PJRT_Buffer_Destroy(&args);
+  if (err != nullptr) {
+    pjrt_error_string(p.api, err);
+  }
+  b->buf = nullptr;
+  p.live_buffers.fetch_sub(1, std::memory_order_relaxed);
+}
+}  // namespace
+
+void tpu_buf_free(TpuBufId id) {
+  DeviceBuf* b = ResourcePool<DeviceBuf>::Address((uint32_t)id);
   if (b == nullptr) {
     return;
   }
-  // claim the slot by bumping the version; only one freer wins
+  // claim the slot by bumping the version; only one freer wins.  The
+  // PJRT buffer is destroyed when the last pin drains (usually the
+  // freer's own unpin right here), never under a live reader.
   uint32_t ver = (uint32_t)(id >> 32);
   uint32_t expected = ver;
   if (!b->version.compare_exchange_strong(expected, ver + 1,
                                           std::memory_order_acq_rel)) {
     return;
   }
-  if (b->buf != nullptr) {
-    PJRT_Buffer_Destroy_Args args;
-    memset(&args, 0, sizeof(args));
-    args.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
-    args.buffer = b->buf;
-    PJRT_Error* err = p.api->PJRT_Buffer_Destroy(&args);
-    if (err != nullptr) {
-      pjrt_error_string(p.api, err);
-    }
-    b->buf = nullptr;
-    p.live_buffers.fetch_sub(1, std::memory_order_relaxed);
-  }
-  // drop the freer's pin; the slot recycles only after every pending
-  // completion callback has also dropped its pin
   unpin_buf(b);
 }
 
