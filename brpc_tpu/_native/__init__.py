@@ -377,12 +377,35 @@ def _declare(L: ctypes.CDLL) -> None:
     L.trpc_tpu_buf_free.restype = None
     L.trpc_tpu_plane_stats.argtypes = [c.POINTER(c.c_uint64)]
     L.trpc_tpu_plane_stats.restype = None
+    L.trpc_tpu_d2d.argtypes = [c.c_uint64, c.c_int]
+    L.trpc_tpu_d2d.restype = c.c_uint64
+    L.trpc_tpu_plane_uid.restype = c.c_uint64
+    L.trpc_stream_write_device.argtypes = [c.c_uint64, c.c_uint64,
+                                           c.c_int64]
+    L.trpc_stream_write_device.restype = c.c_int
+    L.trpc_stream_read_device.argtypes = [
+        c.c_uint64, c.c_int, c.c_int64, c.POINTER(c.c_uint64),
+        c.POINTER(c.c_uint64)]
+    L.trpc_stream_read_device.restype = c.c_int
     L.trpc_server_add_hbm_echo.argtypes = [c.c_void_p, c.c_char_p]
     L.trpc_server_add_hbm_echo.restype = c.c_int
     L.trpc_channel_request_device_plane.argtypes = [c.c_void_p, c.c_int]
     L.trpc_channel_request_device_plane.restype = None
     L.trpc_channel_transport_state.argtypes = [c.c_void_p]
     L.trpc_channel_transport_state.restype = c.c_int
+
+    # RPC cancellation (≙ Controller::StartCancel / NotifyOnCancel)
+    L.trpc_channel_call_cancelable.argtypes = [
+        c.c_void_p, c.c_char_p, c.c_char_p, c.c_size_t, c.c_char_p,
+        c.c_size_t, c.c_int64, c.c_uint64, c.c_int,
+        c.POINTER(c.c_uint64), c.POINTER(c.c_void_p)]
+    L.trpc_channel_call_cancelable.restype = c.c_int
+    L.trpc_call_cancel.argtypes = [c.c_uint64]
+    L.trpc_call_cancel.restype = c.c_int
+    L.trpc_call_canceled.argtypes = [c.c_uint64]
+    L.trpc_call_canceled.restype = c.c_int
+    L.trpc_call_wait_canceled.argtypes = [c.c_uint64, c.c_int64]
+    L.trpc_call_wait_canceled.restype = c.c_int
 
     # HTTP client (the framework's own; rpc/http_client.py)
     L.trpc_channel_set_http.argtypes = [c.c_void_p, c.c_char_p]

@@ -115,9 +115,9 @@ class ClusterChannel:
                 return (errors.ENOSERVICE, "no servers resolved", b"", b"")
         sub = self._sub(node)
         t0 = time.monotonic_ns()
-        code, text, data, att = sub.call_once(method, payload, attachment,
-                                              timeout_us, stream_handle,
-                                              compress)
+        code, text, data, att = sub.call_once(
+            method, payload, attachment, timeout_us, stream_handle,
+            compress, cancel_buf=getattr(cntl, "_call_id_buf", None))
         latency_us = (time.monotonic_ns() - t0) // 1000
         failed = code != 0
         self.lb.feedback(node, latency_us, failed)

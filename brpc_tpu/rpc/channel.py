@@ -108,10 +108,19 @@ class _NativeCall:
 
     def call(self, method: bytes, payload: bytes, attachment: bytes,
              timeout_us: int, stream_handle: int = 0,
-             compress: int = 0) -> Tuple[int, str, bytes, bytes]:
+             compress: int = 0, cancel_buf=None
+             ) -> Tuple[int, str, bytes, bytes]:
         L = lib()
         result = ctypes.c_void_p()
-        if stream_handle:
+        if cancel_buf is not None:
+            # publishes the call id into cancel_buf before the request is
+            # written, so Controller.start_cancel works from any thread
+            rc = L.trpc_channel_call_cancelable(
+                self.handle, method, payload, len(payload),
+                attachment if attachment else None, len(attachment),
+                timeout_us, stream_handle, compress,
+                ctypes.byref(cancel_buf), ctypes.byref(result))
+        elif stream_handle:
             rc = L.trpc_channel_call_stream(
                 self.handle, method, payload, len(payload),
                 attachment if attachment else None, len(attachment),
@@ -188,7 +197,7 @@ class SubChannel:
 
     def call_once(self, method: bytes, payload: bytes, attachment: bytes,
                   timeout_us: int, stream_handle: int = 0,
-                  compress: int = 0):
+                  compress: int = 0, cancel_buf=None):
         """One attempt.  A nonzero stream_handle makes this the streaming
         handshake (≙ StreamCreate riding CallMethod via stream_settings,
         baidu_rpc_meta.proto:16)."""
@@ -200,7 +209,8 @@ class SubChannel:
             self._inflight += 1
         try:
             return self._native.call(method, payload, attachment,
-                                     timeout_us, stream_handle, compress)
+                                     timeout_us, stream_handle, compress,
+                                     cancel_buf)
         finally:
             with self._lock:
                 self._inflight -= 1
@@ -312,8 +322,17 @@ class Channel:
         from brpc_tpu.rpc import span as span_mod
         sp = span_mod.start_span("client", method)
 
+        # arm the cancellation window (≙ Controller::call_id being valid
+        # from IssueRPC on): start_cancel from another thread claims the
+        # published id; between attempts the flag stops the retry loop
+        cntl._call_id_buf = ctypes.c_uint64(0)
+
         attempt = 0
         while True:
+            if cntl._cancel_requested:
+                cntl.set_failed(errors.ECANCELED,
+                                "canceled before the attempt")
+                break
             remaining_us = (deadline - time.monotonic_ns()) // 1000
             if remaining_us <= 0:
                 cntl.set_failed(errors.ERPCTIMEDOUT)
@@ -380,16 +399,19 @@ class Channel:
             return self._cluster.call_once(method, payload, attachment,
                                            timeout_us, cntl,
                                            compress=compress)
+        cancel_buf = getattr(cntl, "_call_id_buf", None)
         if backup_ms is None or timeout_us <= backup_ms * 1000:
             return self._sub.call_once(method, payload, attachment,
-                                       timeout_us, compress=compress)
+                                       timeout_us, compress=compress,
+                                       cancel_buf=cancel_buf)
         return self._backup_race(self._sub, method, payload, attachment,
-                                 timeout_us, backup_ms, cntl, compress)
+                                 timeout_us, backup_ms, cntl, compress,
+                                 cancel_buf)
 
     @staticmethod
     def _backup_race(sub: SubChannel, method: bytes, payload: bytes,
                      attachment: bytes, timeout_us: int, backup_ms: float,
-                     cntl: Controller, compress: int = 0):
+                     cntl: Controller, compress: int = 0, cancel_buf=None):
         """Backup request (≙ reference channel.cpp:551-560,
         controller.cpp:601-634): if no response within backup_ms, race a
         second attempt; first success wins."""
@@ -398,8 +420,10 @@ class Channel:
         deadline = time.monotonic() + timeout_us / 1e6  # from attempt start
 
         def attempt(budget_us):
+            # both racing attempts publish into the same cell: a cancel
+            # claims whichever armed last; the flag stops the retry loop
             r = sub.call_once(method, payload, attachment, budget_us,
-                              compress=compress)
+                              compress=compress, cancel_buf=cancel_buf)
             with cond:
                 result.append(r)
                 cond.notify_all()

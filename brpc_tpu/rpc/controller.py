@@ -48,6 +48,12 @@ class Controller:
         # server-side streaming: the pending-call token, set by the server
         # dispatcher when the request carries a stream handshake
         self._stream_token: Optional[int] = None
+        # client-side cancellation (≙ Controller::call_id + StartCancel,
+        # controller.h:631,843): Channel.call attaches a ctypes buffer the
+        # native layer fills with the in-flight call id before the request
+        # hits the wire
+        self._call_id_buf = None
+        self._cancel_requested = False
 
     def has_stream(self) -> bool:
         """True if the client attached a stream to this request."""
@@ -66,6 +72,39 @@ class Controller:
         return _stream.accept_from_token(
             self._stream_token, window or _stream.DEFAULT_WINDOW)
 
+    def start_cancel(self) -> None:
+        """Cancel the in-flight call from ANY thread (≙ StartCancel,
+        controller.h:631): the thread blocked in Channel.call returns
+        ECANCELED immediately, the correlation slot is released safely,
+        and a best-effort notice lets the server's handler observe it.
+        The connection stays usable.  Idempotent; a no-op once the call
+        completed."""
+        self._cancel_requested = True
+        buf = self._call_id_buf
+        if buf is not None and buf.value:
+            from brpc_tpu._native import lib
+            lib().trpc_call_cancel(buf.value)
+
+    def is_canceled(self) -> bool:
+        """Server side (≙ Controller::IsCanceled): True once the peer
+        canceled this call or its connection died — long handlers should
+        poll this (or wait_cancel) and abort."""
+        if self._stream_token is None:
+            return False
+        from brpc_tpu._native import lib
+        return lib().trpc_call_canceled(self._stream_token) == 1
+
+    def wait_cancel(self, timeout_s: Optional[float] = None) -> bool:
+        """Server side (≙ NotifyOnCancel, controller.h:385-388): park
+        until the peer cancels (True) or the timeout passes (False).
+        Fiber/thread-cheap: rides the call's cancel butex."""
+        if self._stream_token is None:
+            return False
+        from brpc_tpu._native import lib
+        timeout_us = -1 if timeout_s is None else int(timeout_s * 1e6)
+        return lib().trpc_call_wait_canceled(
+            self._stream_token, timeout_us) == 1
+
     def failed(self) -> bool:
         return self.error_code != 0
 
@@ -78,5 +117,7 @@ class Controller:
         self.error_text = ""
         self.latency_us = 0
         self.retried_count = 0
+        self._call_id_buf = None
+        self._cancel_requested = False
         self.backup_fired = False
         self.excluded_nodes = set()

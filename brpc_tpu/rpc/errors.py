@@ -16,6 +16,7 @@ EINTERNAL = 2001
 EOVERCROWDED = 2004
 ELIMIT = 2005
 ESTREAMUNACCEPTED = 2006
+ECANCELED = 2007
 EAUTH = 2008
 
 _TEXT = {
@@ -32,6 +33,7 @@ _TEXT = {
     EOVERCROWDED: "too many buffered writes",
     ELIMIT: "rejected by concurrency limiter",
     ESTREAMUNACCEPTED: "server did not accept the stream",
+    ECANCELED: "the rpc was canceled by the caller",
     EAUTH: "authentication failed",
 }
 

@@ -39,6 +39,10 @@ class StreamClosed(Exception):
     """operation on a closed or destroyed stream."""
 
 
+class StreamProtocolError(Exception):
+    """read kind mismatch: device frame vs host data (nothing consumed)."""
+
+
 class Stream:
     """One half of a bidirectional stream (native handle underneath)."""
 
@@ -81,8 +85,61 @@ class Stream:
             return None  # EOF
         if n == -errno.EAGAIN:
             raise StreamTimeout(f"read timed out after {timeout_s}s")
+        if n == -errno.EPROTO:
+            raise StreamProtocolError(
+                "next stream message is a device frame (read_device() it)")
         if n == -errno.EINVAL:
             raise StreamClosed("stream destroyed")
+        raise errors.RpcError(errors.EFAILEDSOCKET,
+                              "stream connection failed")
+
+    def write_device(self, buf, timeout_s: Optional[float] = None) -> None:
+        """Send one TENSOR (a tpu_plane.DeviceBuffer).  Ownership of
+        ``buf`` transfers on success — do not free or reuse it.  When both
+        stream ends share one PJRT client (equal plane uids from the
+        tpu:// handshake) only the 17-byte handle rides the wire and the
+        receiver copies device→device with no host landing; otherwise the
+        frame carries one explicit d2h landing zone.  Window accounting
+        uses the tensor's byte size either way."""
+        timeout_us = -1 if timeout_s is None else int(timeout_s * 1e6)
+        rc = lib().trpc_stream_write_device(self._h, buf.handle, timeout_us)
+        if rc == 0:
+            return
+        if rc == -errno.EAGAIN:
+            raise StreamTimeout(f"write timed out after {timeout_s}s")
+        if rc == -errno.EPIPE:
+            raise StreamClosed("peer closed the stream")
+        if rc == -errno.EINVAL:
+            raise StreamClosed("stream destroyed or bad buffer")
+        raise errors.RpcError(errors.EFAILEDSOCKET,
+                              "stream connection failed")
+
+    def read_device(self, device: int = 0,
+                    timeout_s: Optional[float] = None):
+        """Receive one tensor onto ``device``; returns a NEW
+        tpu_plane.DeviceBuffer (caller frees), or None on clean EOF.
+        Raises StreamProtocolError if the next message is host data
+        (read() it instead — nothing is consumed)."""
+        from brpc_tpu import tpu_plane
+        timeout_us = -1 if timeout_s is None else int(timeout_s * 1e6)
+        out = ctypes.c_uint64()
+        length = ctypes.c_uint64()
+        rc = lib().trpc_stream_read_device(
+            self._h, device, timeout_us, ctypes.byref(out),
+            ctypes.byref(length))
+        if rc == 0:
+            return tpu_plane.DeviceBuffer(out.value, length.value)
+        if rc == -errno.EPIPE:
+            return None  # EOF
+        if rc == -errno.EAGAIN:
+            raise StreamTimeout(f"read timed out after {timeout_s}s")
+        if rc == -errno.EPROTO:
+            raise StreamProtocolError(
+                "next stream message is not a device frame")
+        if rc == -errno.EINVAL:
+            raise StreamClosed("stream destroyed")
+        if rc == -errno.EIO:
+            raise IOError("device materialization failed")
         raise errors.RpcError(errors.EFAILEDSOCKET,
                               "stream connection failed")
 

@@ -62,17 +62,15 @@ class DeviceBuffer:
     """A byte buffer resident in HBM.  Handle semantics are versioned
     (ABA-safe) like SocketIds; ``free()`` is idempotent.
 
-    Holds a reference to the source bytes until ``free()``: the H2D DMA
-    reads host memory asynchronously (kImmutableUntilTransferCompletes),
-    so the source must outlive the transfer even if the caller passed a
-    temporary."""
+    Source lifetime is handled natively: the ctypes boundary copies the
+    bytes once and the DMA's release hook frees the copy when the
+    transfer is done — Python object lifetimes never gate the DMA."""
 
-    __slots__ = ("_id", "_len", "_src")
+    __slots__ = ("_id", "_len")
 
-    def __init__(self, buf_id: int, length: int, src: bytes = b""):
+    def __init__(self, buf_id: int, length: int):
         self._id = buf_id
         self._len = length
-        self._src = src  # pins the DMA source (see class docstring)
 
     def __len__(self) -> int:
         return self._len
@@ -104,7 +102,6 @@ class DeviceBuffer:
 
     def free(self) -> None:
         lib().trpc_tpu_buf_free(self._id)
-        self._src = b""
 
     def __enter__(self) -> "DeviceBuffer":
         return self
@@ -121,14 +118,31 @@ def h2d(data: bytes, device: int = 0) -> DeviceBuffer:
     buf_id = lib().trpc_tpu_h2d(data, len(data), device)
     if buf_id == 0:
         raise IOError(f"h2d failed: {error()}")
-    return DeviceBuffer(buf_id, len(data), src=data)
+    return DeviceBuffer(buf_id, len(data))
 
 
 def stats() -> Dict[str, int]:
     """Plane counters (feeds /vars via the native metrics seam)."""
-    out = (ctypes.c_uint64 * 9)()
+    out = (ctypes.c_uint64 * 11)()
     lib().trpc_tpu_plane_stats(out)
     keys = ("h2d_transfers", "d2h_transfers", "h2d_bytes", "d2h_bytes",
             "events_fired", "gather_copies", "zero_copy_sends",
-            "live_buffers", "errors")
+            "live_buffers", "errors", "d2d_transfers", "d2d_bytes")
     return dict(zip(keys, out))
+
+
+def plane_uid() -> int:
+    """Nonzero token identifying THIS process's PJRT client; exchanged in
+    the tpu:// handshake so connections learn whether both ends share one
+    client (enabling device-to-device stream frames)."""
+    return lib().trpc_tpu_plane_uid()
+
+
+def d2d(buf: DeviceBuffer, device: int) -> DeviceBuffer:
+    """Copy a device buffer to another device of THIS client over the
+    device fabric (PJRT CopyToDevice — no host landing zone).  Returns a
+    new buffer; the source stays valid and still needs its own free()."""
+    nb = lib().trpc_tpu_d2d(buf.handle, device)
+    if nb == 0:
+        raise IOError(f"d2d failed: {error()}")
+    return DeviceBuffer(nb, len(buf))

@@ -1,0 +1,52 @@
+"""RPC cancellation: a client abandons a slow call from another thread;
+the blocked caller returns ECANCELED immediately, the server's handler
+observes the cancel and aborts its work, and the connection keeps
+serving (≙ example/cancel_c++ + Controller::StartCancel/NotifyOnCancel,
+controller.h:631,385)."""
+import _bootstrap  # noqa: F401
+
+import threading
+import time
+
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.channel import Channel
+from brpc_tpu.rpc.controller import Controller
+from brpc_tpu.rpc.server import Server
+
+
+def main():
+    server = Server()
+
+    def long_job(cntl, req):
+        # a "10 second" job that parks on the cancel butex while working
+        # (≙ NotifyOnCancel): the moment the peer cancels, abort
+        if cntl.wait_cancel(timeout_s=10.0):
+            print("server: peer canceled — aborting the job")
+            raise errors.RpcError(errors.EINTERNAL, "aborted")
+        return b"finished"
+
+    server.add_service("LongJob", long_job)
+    server.add_service("Echo", lambda cntl, req: req)
+    port = server.start("127.0.0.1:0")
+
+    ch = Channel(f"127.0.0.1:{port}")
+    cntl = Controller()
+    threading.Thread(target=lambda: (time.sleep(0.3), cntl.start_cancel()),
+                     daemon=True).start()
+    t0 = time.monotonic()
+    try:
+        ch.call("LongJob", b"work", cntl=cntl, timeout_ms=30_000)
+        raise SystemExit("the call should have been canceled")
+    except errors.RpcError as e:
+        assert e.code == errors.ECANCELED, e
+        print(f"client: canceled after {time.monotonic() - t0:.2f}s "
+              f"(the job had 10s to go)")
+    # the connection survives the canceled call
+    assert ch.call("Echo", b"still here") == b"still here"
+    print("connection still usable after cancel")
+    ch.close()
+    server.destroy()
+
+
+if __name__ == "__main__":
+    main()

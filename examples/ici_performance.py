@@ -75,10 +75,76 @@ def bench_rpc(size: int, seconds: float) -> dict:
     }
 
 
+def bench_stream(size: int, seconds: float) -> dict:
+    """Tensor stream dev0->dev1: device-payload frames over a stream on a
+    tpu:// channel.  Both ends share this process's PJRT client, so each
+    frame is ONE CopyToDevice on the local rail — the counters prove no
+    host landing happened (≙ 'tensor streams overlapping compute',
+    SURVEY §2.9)."""
+    if tpu_plane.device_count() < 2:
+        return {"skipped": "needs 2 addressable devices"}
+    server = Server()
+    accepted = []
+    server.add_service("TensorSink",
+                       lambda cntl, req: (accepted.append(
+                           cntl.accept_stream()), b"ok")[1])
+    port = server.start("127.0.0.1:0")
+    ch = Channel(f"tpu://0/0@127.0.0.1:{port}",
+                 ChannelOptions(timeout_ms=60_000, max_retry=0))
+    _, st = ch.create_stream("TensorSink", b"")
+    sink = accepted[0]
+    payload = bytes(size)
+    # pure device-to-device rate first: one resident source, repeated
+    # CopyToDevice (the source stays valid — d2d doesn't consume it)
+    src = tpu_plane.h2d(payload, device=0)
+    src.wait()
+    deadline = time.monotonic() + seconds / 2
+    hops = 0
+    t0 = time.monotonic()
+    while time.monotonic() < deadline:
+        dst = tpu_plane.d2d(src, 1)
+        dst.wait()  # a hop isn't done until the copy completed
+        dst.free()
+        hops += 1
+    d2d_dt = time.monotonic() - t0
+    src.free()
+    # then end-to-end tensor frames (h2d source + stream + d2d per frame)
+    before = tpu_plane.stats()
+    deadline = time.monotonic() + seconds / 2
+    frames = 0
+    t0 = time.monotonic()
+    while time.monotonic() < deadline:
+        buf = tpu_plane.h2d(payload, device=0)
+        st.write_device(buf)  # ownership transfers
+        got = sink.read_device(device=1, timeout_s=60)
+        got.free()
+        frames += 1
+    dt = time.monotonic() - t0
+    after = tpu_plane.stats()
+    st.destroy()
+    sink.destroy()
+    ch.close()
+    server.destroy()
+    return {
+        "d2d_hops": hops,
+        "d2d_gbps": hops * size / d2d_dt / 1e9,
+        "frames": frames,
+        # end-to-end: includes the per-frame source h2d + RPC framing
+        "frame_gbps": frames * size / dt / 1e9,
+        "d2d_transfers": after["d2d_transfers"] - before["d2d_transfers"],
+        "gather_copies": after["gather_copies"] - before["gather_copies"],
+        # host landings beyond the unavoidable source h2d per frame
+        "extra_host_copies": (after["d2h_transfers"] -
+                              before["d2h_transfers"]),
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--size-mb", type=float, default=8.0)
     ap.add_argument("--seconds", type=float, default=3.0)
+    ap.add_argument("--stream", action="store_true",
+                    help="also run the dev0->dev1 tensor-stream bench")
     args = ap.parse_args()
     size = int(args.size_mb * 1024 * 1024)
 
@@ -97,6 +163,8 @@ def main():
         "raw": bench_raw(size, args.seconds),
         "rpc": bench_rpc(size, args.seconds),
     }
+    if args.stream:
+        out["stream"] = bench_stream(size, args.seconds)
     print(json.dumps(out))
 
 

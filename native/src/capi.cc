@@ -416,6 +416,31 @@ int trpc_channel_call_compressed(void* c, const char* method,
   return rc;
 }
 
+// Unified call entry with a pre-published call id: *call_id_out is
+// written before the request hits the wire, so another thread can
+// trpc_call_cancel() it while this one is blocked (≙ StartCancel).
+int trpc_channel_call_cancelable(void* c, const char* method,
+                                 const uint8_t* req, size_t req_len,
+                                 const uint8_t* attach, size_t attach_len,
+                                 int64_t timeout_us, uint64_t stream,
+                                 int compress_type, uint64_t* call_id_out,
+                                 void** result) {
+  CallResult* r = new CallResult();
+  int rc = channel_call((Channel*)c, method, req, req_len, attach,
+                        attach_len, timeout_us, r, stream,
+                        (uint8_t)compress_type, call_id_out);
+  *result = r;
+  return rc;
+}
+
+int trpc_call_cancel(uint64_t call_id) { return call_cancel(call_id); }
+
+// Server-side cancellation observation (≙ IsCanceled/NotifyOnCancel).
+int trpc_call_canceled(uint64_t token) { return call_canceled(token); }
+int trpc_call_wait_canceled(uint64_t token, int64_t timeout_us) {
+  return call_wait_canceled(token, timeout_us);
+}
+
 int32_t trpc_result_error_code(void* r) {
   return ((CallResult*)r)->error_code;
 }
@@ -469,6 +494,15 @@ int64_t trpc_stream_read(uint64_t h, int64_t timeout_us, uint8_t** out) {
   return (int64_t)stream_read(h, timeout_us, out);
 }
 void trpc_stream_buf_free(uint8_t* p) { stream_buf_free(p); }
+// Tensor frames: write transfers ownership of the device buffer on
+// success; read returns a NEW buffer on dst_device (see stream.h).
+int trpc_stream_write_device(uint64_t h, uint64_t buf, int64_t timeout_us) {
+  return stream_write_device(h, buf, timeout_us);
+}
+int trpc_stream_read_device(uint64_t h, int dst_device, int64_t timeout_us,
+                            uint64_t* out, uint64_t* len_out) {
+  return stream_read_device(h, dst_device, timeout_us, out, len_out);
+}
 int trpc_stream_close(uint64_t h) { return stream_close(h); }
 void trpc_stream_destroy(uint64_t h) { stream_destroy(h); }
 int trpc_stream_remote_closed(uint64_t h) { return stream_remote_closed(h); }
@@ -504,10 +538,20 @@ const char* trpc_tpu_plane_error() { return tpu_plane_error(); }
 const char* trpc_tpu_plane_platform() { return tpu_plane_platform(); }
 int trpc_tpu_device_count() { return tpu_plane_device_count(); }
 
-// H2D from caller memory (one DMA; the bytes are copied by the DMA
-// engine, not by host code).  Returns a buffer handle or 0.
+// H2D from caller memory.  The DMA reads the source ASYNCHRONOUSLY
+// (kImmutableUntilTransferCompletes), and a ctypes caller cannot be
+// trusted to keep its bytes object alive that long — so this boundary
+// takes ONE explicit host copy and hands lifetime to the native release
+// hook.  (The zero-copy path is tpu_h2d_from_iobuf, used by the RPC
+// attachment plane; this is the convenience surface.)
 uint64_t trpc_tpu_h2d(const uint8_t* data, size_t len, int device) {
-  return tpu_h2d(data, len, device, nullptr, nullptr);
+  void* copy = malloc(len > 0 ? len : 1);
+  if (copy == nullptr) {
+    return 0;
+  }
+  memcpy(copy, data, len);
+  return tpu_h2d(copy, len, device,
+                 [](void* d, void*) { free(d); }, nullptr);
 }
 int trpc_tpu_buf_wait(uint64_t id, int64_t timeout_us) {
   return tpu_buf_wait(id, timeout_us);
@@ -527,7 +571,7 @@ int64_t trpc_tpu_d2h(uint64_t id, uint8_t** out) {
 void trpc_tpu_buf_release(uint8_t* p) { free(p); }
 void trpc_tpu_buf_free(uint64_t id) { tpu_buf_free(id); }
 
-void trpc_tpu_plane_stats(uint64_t out[9]) {
+void trpc_tpu_plane_stats(uint64_t out[11]) {
   TpuPlaneStats s = tpu_plane_stats();
   out[0] = s.h2d_transfers;
   out[1] = s.d2h_transfers;
@@ -538,7 +582,15 @@ void trpc_tpu_plane_stats(uint64_t out[9]) {
   out[6] = s.zero_copy_sends;
   out[7] = s.live_buffers;
   out[8] = s.errors;
+  out[9] = s.d2d_transfers;
+  out[10] = s.d2d_bytes;
 }
+
+uint64_t trpc_tpu_d2d(uint64_t src, int dst_device) {
+  return tpu_d2d(src, dst_device);
+}
+
+uint64_t trpc_tpu_plane_uid() { return tpu_plane_uid(); }
 
 // HBM echo service (kind=2): attachments round-trip host->HBM->host.
 int trpc_server_add_hbm_echo(void* s, const char* name) {

@@ -45,6 +45,7 @@ enum ErrorCode {
   TRPC_EOVERCROWDED = 2004,   // too many buffered writes (≙ brpc EOVERCROWDED)
   TRPC_ELIMIT = 2005,         // concurrency limiter rejected (≙ brpc ELIMIT)
   TRPC_ESTREAMUNACCEPTED = 2006,  // handshake RPC ok but no StreamAccept
+  TRPC_ECANCELED = 2007,      // caller canceled the call (≙ brpc ECANCELED)
   TRPC_EAUTH = 2008,          // credential verify failed (≙ brpc ERPCAUTH)
 };
 

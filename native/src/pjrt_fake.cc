@@ -160,20 +160,17 @@ void worker_loop() {
       jobs_cv().wait(lk);
       continue;
     }
-    // earliest-deadline job (the queue stays tiny in tests)
-    size_t best = 0;
-    for (size_t i = 1; i < q.size(); ++i) {
-      if (q[i].at_us < q[best].at_us) {
-        best = i;
-      }
-    }
-    int64_t wait = q[best].at_us - now_us();
+    // FIFO pop: the delay is effectively constant per test, so at_us is
+    // nondecreasing and front() is due first.  MUST be O(1) — a bench
+    // storm can queue tens of thousands of completions, and a per-job
+    // scan makes the drain quadratically slow (events then starve).
+    int64_t wait = q.front().at_us - now_us();
     if (wait > 0) {
       jobs_cv().wait_for(lk, std::chrono::microseconds(wait));
       continue;
     }
-    Job j = std::move(q[best]);
-    q.erase(q.begin() + best);
+    Job j = std::move(q.front());
+    q.pop_front();
     lk.unlock();
     j.fn();
     lk.lock();

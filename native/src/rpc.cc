@@ -121,6 +121,9 @@ void EncodeMeta(const RpcMeta& m, MetaWriter* w) {
   if (m.device_caps != 0) {
     w->tlv_u64(14, m.device_caps);
   }
+  if (m.plane_uid != 0) {
+    w->tlv_u64(15, m.plane_uid);
+  }
 }
 
 bool DecodeMeta(const char* p, size_t n, RpcMeta* m) {
@@ -149,6 +152,7 @@ bool DecodeMeta(const char* p, size_t n, RpcMeta* m) {
       case 12: if (len == 8) memcpy(&m->feedback_bytes, v, 8); break;
       case 13: m->auth.assign(v, len); break;
       case 14: if (len == 8) memcpy(&m->device_caps, v, 8); break;
+      case 15: if (len == 8) memcpy(&m->plane_uid, v, 8); break;
       default: break;  // forward compatibility: skip unknown tags
     }
     i += len;
@@ -216,6 +220,29 @@ bool PeekFrameLayout(const IOBuf& buf, size_t* total, size_t* attach_off) {
     }
   }
   return true;
+}
+
+// Socket frame-hint probe (SocketOptions.frame_hint_fn): called by
+// ReadToBuf between bounded drain chunks.  When a LARGE TRPC frame is in
+// progress at the head of read_buf, arm the contiguity hints so its
+// attachment lands in one dedicated block — the zero-copy DMA source.
+// Magic-gated: on non-TRPC bytes (HTTP, TLS, h2, redis) PeekFrameLayout
+// declines and this is a no-op.
+void ArmTrpcFrameHints(Socket* s) {
+  size_t need = 0, attach_off = 0;
+  if (s->frame_bytes_hint == 0 &&
+      PeekFrameLayout(s->read_buf, &need, &attach_off) &&
+      need > s->read_buf.size() &&  // first frame still incomplete
+      need >= IOBuf::kBigBlockThreshold) {
+    s->frame_bytes_hint = need;
+    s->frame_attach_hint = attach_off;
+    if (need - attach_off >= IOBuf::kBigBlockThreshold &&
+        s->read_buf.size() > attach_off) {
+      // bounded one-time copy (≤ one drain chunk) of the attachment
+      // head that already arrived; the rest streams into the same block
+      s->read_buf.realign_tail(attach_off, need - attach_off);
+    }
+  }
 }
 
 int ParseFrame(IOBuf* buf, RpcMeta* meta, IOBuf* payload, IOBuf* attachment) {
@@ -303,6 +330,15 @@ struct CallCtx {
   uint64_t pipe_seq = 0;
   uint32_t slot = 0;
   std::atomic<uint32_t> version{1};
+  // cancellation (≙ server side of Controller::StartCancel +
+  // NotifyOnCancel, controller.h:385-388,631): set by a cancel notice or
+  // the connection dying; handlers poll call_canceled(token) or park on
+  // call_wait_canceled.  Registered in the TRPC usercode dispatch only
+  // (cancel_registered mirrors that so respond() unregisters exactly
+  // what was registered).
+  std::atomic<bool> canceled{false};
+  bool cancel_registered = false;
+  Butex* cancel_butex = nullptr;
 
   uint64_t token() const {
     return ((uint64_t)version.load(std::memory_order_relaxed) << 32) | slot;
@@ -318,6 +354,82 @@ std::atomic<int> g_usercode_workers{4};
 // queue without bound (≙ ConcurrencyLimiter, concurrency_limiter.h:29-44;
 // HTTP/RESP already cap per-connection at kMaxPipelined).
 std::atomic<int64_t> g_usercode_max_inflight{4096};
+
+// --- RPC cancellation registry (≙ Controller::StartCancel + server
+// NotifyOnCancel, controller.h:631,385-388) -------------------------------
+// (socket, correlation id) -> CallCtx token for in-flight TRPC usercode
+// calls.  The mutex also serializes flag-setting against respond()'s
+// unregister: a canceller that finds the token sets the flag BEFORE the
+// version can bump (respond unregisters first, bumps after), so the flag
+// can never land on a recycled slot's next occupant.
+std::mutex g_cancel_mu;
+std::unordered_map<SocketId, std::unordered_map<uint64_t, uint64_t>>
+    g_inflight_calls;
+
+void RegisterInflight(SocketId sid, uint64_t corr, uint64_t token) {
+  std::lock_guard<std::mutex> lk(g_cancel_mu);
+  g_inflight_calls[sid][corr] = token;
+}
+
+void UnregisterInflight(SocketId sid, uint64_t corr) {
+  std::lock_guard<std::mutex> lk(g_cancel_mu);
+  auto it = g_inflight_calls.find(sid);
+  if (it == g_inflight_calls.end()) {
+    return;
+  }
+  it->second.erase(corr);
+  if (it->second.empty()) {
+    g_inflight_calls.erase(it);
+  }
+}
+
+// g_cancel_mu must be held (see the registry comment for why that makes
+// the version check race-free against respond()).
+void MarkCanceledLocked(uint64_t token) {
+  CallCtx* ctx = ResourcePool<CallCtx>::Address((uint32_t)token);
+  if (ctx == nullptr ||
+      ctx->version.load(std::memory_order_acquire) != (uint32_t)(token >> 32)) {
+    return;
+  }
+  ctx->canceled.store(true, std::memory_order_release);
+  if (ctx->cancel_butex != nullptr) {
+    butex_value(ctx->cancel_butex).store(1, std::memory_order_release);
+    butex_wake_all(ctx->cancel_butex);
+  }
+}
+
+// A cancel notice (meta flags bit1) arrived for (sid, corr).
+void CancelInflight(SocketId sid, uint64_t corr) {
+  std::lock_guard<std::mutex> lk(g_cancel_mu);
+  auto it = g_inflight_calls.find(sid);
+  if (it == g_inflight_calls.end()) {
+    return;
+  }
+  auto jt = it->second.find(corr);
+  if (jt == it->second.end()) {
+    return;
+  }
+  MarkCanceledLocked(jt->second);
+  it->second.erase(jt);
+  if (it->second.empty()) {
+    g_inflight_calls.erase(it);
+  }
+}
+
+// The connection died: every in-flight call on it is implicitly canceled
+// (the peer can never receive the response — ≙ NotifyOnCancel firing on
+// client disconnect).
+void CancelAllOnSocket(SocketId sid) {
+  std::lock_guard<std::mutex> lk(g_cancel_mu);
+  auto it = g_inflight_calls.find(sid);
+  if (it == g_inflight_calls.end()) {
+    return;
+  }
+  for (auto& kv : it->second) {
+    MarkCanceledLocked(kv.second);
+  }
+  g_inflight_calls.erase(it);
+}
 
 bool UsercodeAdmit() {
   NativeMetrics& nm = native_metrics();
@@ -631,6 +743,7 @@ void SendResponse(SocketId sock_id, uint64_t correlation_id,
   meta.error_code = error_code;
   if (s->advertise_device_caps.load(std::memory_order_acquire)) {
     meta.device_caps = ServerDeviceCaps();
+    meta.plane_uid = tpu_plane_uid();
   }
   if (error_text != nullptr) {
     meta.error_text = error_text;
@@ -680,6 +793,8 @@ void DispatchHttp(Socket* s, Server* srv, HttpRequest&& req) {
   CallCtx* ctx = nullptr;
   uint32_t slot = ResourcePool<CallCtx>::Get(&ctx);
   ctx->slot = slot;
+  ctx->canceled.store(false, std::memory_order_relaxed);
+  ctx->cancel_registered = false;
   ctx->sock = s->id();
   ctx->is_http = true;
   ctx->is_redis = false;
@@ -723,6 +838,8 @@ void DispatchH2(Socket* s, Server* srv, H2Request&& req) {
   CallCtx* ctx = nullptr;
   uint32_t slot = ResourcePool<CallCtx>::Get(&ctx);
   ctx->slot = slot;
+  ctx->canceled.store(false, std::memory_order_relaxed);
+  ctx->cancel_registered = false;
   ctx->sock = s->id();
   ctx->is_http = true;
   ctx->is_redis = false;
@@ -920,6 +1037,8 @@ void ServerOnMessages(Socket* s) {
         CallCtx* rctx = nullptr;
         uint32_t rslot = ResourcePool<CallCtx>::Get(&rctx);
         rctx->slot = rslot;
+  rctx->canceled.store(false, std::memory_order_relaxed);
+  rctx->cancel_registered = false;
         rctx->sock = s->id();
         rctx->is_http = false;
         rctx->is_redis = true;
@@ -999,6 +1118,8 @@ void ServerOnMessages(Socket* s) {
         CallCtx* tctx = nullptr;
         uint32_t tslot = ResourcePool<CallCtx>::Get(&tctx);
         tctx->slot = tslot;
+  tctx->canceled.store(false, std::memory_order_relaxed);
+  tctx->cancel_registered = false;
         tctx->sock = s->id();
         tctx->is_http = false;
         tctx->is_redis = false;
@@ -1102,6 +1223,8 @@ void ServerOnMessages(Socket* s) {
           CallCtx* uctx = nullptr;
           uint32_t uslot = ResourcePool<CallCtx>::Get(&uctx);
           uctx->slot = uslot;
+  uctx->canceled.store(false, std::memory_order_relaxed);
+  uctx->cancel_registered = false;
           uctx->sock = s->id();
           uctx->is_http = false;
           uctx->is_redis = false;
@@ -1164,25 +1287,21 @@ void ServerOnMessages(Socket* s) {
       // arm the contiguity hints once per frame: on later events the
       // armed hint drives ReadToBuf directly (re-peeking would re-align
       // — and re-copy — the already-landed attachment head every wake)
-      size_t need = 0, attach_off = 0;
-      if (s->frame_bytes_hint == 0 &&
-          PeekFrameLayout(s->read_buf, &need, &attach_off) &&
-          need >= IOBuf::kBigBlockThreshold) {
-        s->frame_bytes_hint = need;  // large frame: land it contiguously
-        s->frame_attach_hint = attach_off;
-        if (need - attach_off >= IOBuf::kBigBlockThreshold &&
-            s->read_buf.size() > attach_off) {
-          // bounded one-time copy of the attachment head that already
-          // arrived; the remainder streams into the same block
-          s->read_buf.realign_tail(attach_off, need - attach_off);
-        }
-      }
+      ArmTrpcFrameHints(s);
       break;
     }
     if (rc < 0) {
       flush();
       s->SetFailed(TRPC_EREQUEST);
       return;
+    }
+    if (meta.flags & 2) {
+      // cancel notice (≙ StartCancel's wire half): flag the in-flight
+      // handler, send nothing back — the canceling client already
+      // completed its call locally.  Scoped to THIS connection, so a
+      // stranger can't cancel another client's call by guessing ids.
+      CancelInflight(s->id(), meta.correlation_id);
+      continue;
     }
     if (meta.stream_frame_type != STREAM_FRAME_NONE) {
       if (srv->has_auth && !s->authed.load(std::memory_order_acquire)) {
@@ -1193,7 +1312,10 @@ void ServerOnMessages(Socket* s) {
         s->SetFailed(TRPC_EAUTH);
         return;
       }
-      StreamHandleFrame(meta, std::move(payload));
+      // a device frame's tensor body rides as the attachment (single
+      // dedicated block); splice it behind the header zero-copy
+      payload.append(std::move(attachment));
+      StreamHandleFrame(s, meta, std::move(payload));
       continue;
     }
     if (!srv->running.load(std::memory_order_acquire)) {
@@ -1215,6 +1337,9 @@ void ServerOnMessages(Socket* s) {
     if (meta.device_caps & 1) {
       // device-plane probe: answer on every response of this connection
       s->advertise_device_caps.store(true, std::memory_order_release);
+      if (meta.plane_uid != 0) {
+        s->peer_plane_uid.store(meta.plane_uid, std::memory_order_release);
+      }
     }
     srv->nrequests.fetch_add(1, std::memory_order_relaxed);
     ServiceHandler* sh = srv->services.find(meta.method);
@@ -1292,6 +1417,7 @@ void ServerOnMessages(Socket* s) {
       rmeta.compress_type = meta.compress_type;
       if (s->advertise_device_caps.load(std::memory_order_acquire)) {
         rmeta.device_caps = ServerDeviceCaps();
+        rmeta.plane_uid = tpu_plane_uid();
       }
       PackFrame(&batched_out, rmeta, std::move(payload),
                 std::move(attachment));
@@ -1306,6 +1432,8 @@ void ServerOnMessages(Socket* s) {
       CallCtx* ctx = nullptr;
       uint32_t slot = ResourcePool<CallCtx>::Get(&ctx);
       ctx->slot = slot;
+  ctx->canceled.store(false, std::memory_order_relaxed);
+  ctx->cancel_registered = false;
       ctx->sock = s->id();
       ctx->is_http = false;
       ctx->is_redis = false;
@@ -1321,6 +1449,14 @@ void ServerOnMessages(Socket* s) {
       ctx->attachment = attachment.to_string();
       ctx->cb = h.cb;
       ctx->user = h.user;
+      // cancellation surface: the call is findable by (sock, corr) until
+      // respond() — a cancel notice or connection death flags it
+      if (ctx->cancel_butex == nullptr) {
+        ctx->cancel_butex = butex_create();
+      }
+      butex_value(ctx->cancel_butex).store(0, std::memory_order_relaxed);
+      ctx->cancel_registered = true;
+      RegisterInflight(ctx->sock, ctx->correlation_id, ctx->token());
       UsercodePool::Instance().Submit(ctx);
     }
   }
@@ -1340,6 +1476,9 @@ void ServerConnFailed(Socket* s) {
   // accept time.
   H2ConnDestroy(s->id());
   StreamsOnSocketFailed(s->id());
+  // the peer can never receive these responses: implicit cancel
+  // (≙ NotifyOnCancel firing on client disconnect)
+  CancelAllOnSocket(s->id());
 }
 
 // edge_fn of the acceptor socket (≙ Acceptor::OnNewConnections,
@@ -1355,6 +1494,7 @@ void ServerAdoptConnection(Server* srv, int fd) {
   opts.edge_fn = ServerOnMessages;
   opts.user = srv;
   opts.on_failed = ServerConnFailed;
+  opts.frame_hint_fn = ArmTrpcFrameHints;
   SocketId id;
   if (Socket::Create(opts, &id) != 0) {
     ::close(fd);
@@ -1789,6 +1929,13 @@ int respond(uint64_t token, int32_t error_code, const char* error_text,
   SendResponse(ctx->sock, ctx->correlation_id, error_code, error_text,
                std::move(payload), std::move(attachment), accepted,
                accepted != 0 ? stream_window(accepted) : 0, compress_type);
+  if (ctx->cancel_registered) {
+    // ordering matters: unregister BEFORE the version bump, so a racing
+    // canceller that still finds the token under g_cancel_mu is flagging
+    // a live slot, never a recycled one
+    UnregisterInflight(ctx->sock, ctx->correlation_id);
+    ctx->cancel_registered = false;
+  }
   ctx->version.fetch_add(1, std::memory_order_release);  // invalidate token
   ctx->payload.clear();
   ctx->attachment.clear();
@@ -2496,17 +2643,7 @@ void ChannelOnMessages(Socket* s) {
     IOBuf payload, attachment;
     int rc = ParseFrame(&s->read_buf, &meta, &payload, &attachment);
     if (rc == 0) {
-      size_t need = 0, attach_off = 0;
-      if (s->frame_bytes_hint == 0 &&  // arm once per frame (see server)
-          PeekFrameLayout(s->read_buf, &need, &attach_off) &&
-          need >= IOBuf::kBigBlockThreshold) {
-        s->frame_bytes_hint = need;  // large response: land contiguously
-        s->frame_attach_hint = attach_off;
-        if (need - attach_off >= IOBuf::kBigBlockThreshold &&
-            s->read_buf.size() > attach_off) {
-          s->read_buf.realign_tail(attach_off, need - attach_off);
-        }
-      }
+      ArmTrpcFrameHints(s);  // arm once per frame (see server loop)
       break;
     }
     if (rc < 0) {
@@ -2514,7 +2651,10 @@ void ChannelOnMessages(Socket* s) {
       return;
     }
     if (meta.stream_frame_type != STREAM_FRAME_NONE) {
-      StreamHandleFrame(meta, std::move(payload));
+      // a device frame's tensor body rides as the attachment (single
+      // dedicated block); splice it behind the header zero-copy
+      payload.append(std::move(attachment));
+      StreamHandleFrame(s, meta, std::move(payload));
       continue;
     }
     PendingCall* pc = ClaimPending(meta.correlation_id, s->id());
@@ -2537,6 +2677,9 @@ void ChannelOnMessages(Socket* s) {
       // TS_TCP is also a valid pre-state — a SocketMap-shared connection
       // first dialed by a non-tpu:// channel still settles when a tpu://
       // channel probes over it.
+      if (meta.plane_uid != 0) {
+        s->peer_plane_uid.store(meta.plane_uid, std::memory_order_release);
+      }
       ClientConn* conn = (ClientConn*)s->user;
       conn->peer_device_caps.store(meta.device_caps,
                                    std::memory_order_release);
@@ -2738,6 +2881,7 @@ Socket* DialConn(Channel* c, int* rc_out) {
   opts.edge_fn = c->protocol == 1 ? HttpClientOnMessages : ChannelOnMessages;
   opts.user = conn;
   opts.on_failed = ClientConnFailed;
+  opts.frame_hint_fn = ArmTrpcFrameHints;  // no-op on HTTP bytes
   opts.corked = true;  // caller fibers share this connection: batch writes
   SocketId sid;
   if (Socket::Create(opts, &sid) != 0) {
@@ -3055,7 +3199,7 @@ void channel_destroy(Channel* c) {
 int channel_call(Channel* c, const char* method, const uint8_t* req,
                  size_t req_len, const uint8_t* attach, size_t attach_len,
                  int64_t timeout_us, CallResult* out, uint64_t stream,
-                 uint8_t compress) {
+                 uint8_t compress, uint64_t* call_id_out) {
   int rc = 0;
   Socket* s = AcquireConn(c, &rc);
   if (s == nullptr) {
@@ -3087,6 +3231,14 @@ int channel_call(Channel* c, const char* method, const uint8_t* req,
   pc->vs.store(((uint64_t)ver << 32) | PC_ARMED, std::memory_order_release);
   native_metrics().pending_calls.fetch_add(1, std::memory_order_relaxed);
   uint64_t corr = ((uint64_t)ver << 32) | slot;
+  if (call_id_out != nullptr) {
+    // published BEFORE the request hits the wire: a concurrent
+    // call_cancel(corr) from another thread is valid from this point on
+    // (the claim CAS arbitrates against the response/timeout/sweep).
+    // Atomic release so a canceller thread may legally poll the cell
+    // while this thread is still blocked in the call.
+    __atomic_store_n(call_id_out, corr, __ATOMIC_RELEASE);
+  }
   conn->SweepLink(pc);
   RpcMeta meta;
   meta.method = method;
@@ -3095,6 +3247,7 @@ int channel_call(Channel* c, const char* method, const uint8_t* req,
   meta.auth = c->auth;
   if (c->device_plane) {
     meta.device_caps = 1;  // probe: answered by every response (tag 14)
+    meta.plane_uid = tpu_plane_uid();  // tag 15: same-client detection
   }
   meta.stream_id = stream;  // client stream handle rides the request
   if (stream != 0) {
@@ -3181,6 +3334,77 @@ int channel_call(Channel* c, const char* method, const uint8_t* req,
   }
   s->Dereference();
   return result;
+}
+
+int call_cancel(uint64_t call_id) {
+  PendingCall* pc = ClaimPending(call_id);
+  if (pc == nullptr) {
+    return -1;  // response/timeout/sweep already claimed it, or stale
+  }
+  // fill BEFORE flipping done: the claim gives this thread exclusive
+  // ownership of the slot's result fields
+  SocketId sid = pc->sock_id.load(std::memory_order_acquire);
+  pc->error_code = TRPC_ECANCELED;
+  pc->error_text = "canceled by caller";
+  butex_value(pc->done).store(1, std::memory_order_release);
+  butex_wake_all(pc->done);
+  // best-effort notice so the server can abandon the handler; the local
+  // call is already complete either way, and the connection stays usable
+  // (frames are delimited — a late response is dropped as stale)
+  Socket* s = Socket::Address(sid);
+  if (s != nullptr) {
+    RpcMeta m;
+    m.correlation_id = call_id;
+    m.flags = 2;  // cancel notice
+    IOBuf f;
+    PackFrame(&f, m, IOBuf(), IOBuf());
+    s->Write(std::move(f));
+    s->Dereference();
+  }
+  return 0;
+}
+
+int call_canceled(uint64_t token) {
+  CallCtx* ctx = ResourcePool<CallCtx>::Address((uint32_t)token);
+  if (ctx == nullptr ||
+      ctx->version.load(std::memory_order_acquire) != (uint32_t)(token >> 32)) {
+    return -1;
+  }
+  return ctx->canceled.load(std::memory_order_acquire) ? 1 : 0;
+}
+
+int call_wait_canceled(uint64_t token, int64_t timeout_us) {
+  CallCtx* ctx = ResourcePool<CallCtx>::Address((uint32_t)token);
+  if (ctx == nullptr ||
+      ctx->version.load(std::memory_order_acquire) != (uint32_t)(token >> 32)) {
+    return -1;
+  }
+  Butex* b = ctx->cancel_butex;
+  if (b == nullptr) {
+    return -1;  // not a cancellable (TRPC usercode) call
+  }
+  // gate on ctx->canceled, NOT the raw butex value: the butex cell is
+  // only reset by the TRPC dispatch, so a slot recycled through the
+  // HTTP/redis paths could hold a stale 1 — the flag is reset everywhere
+  int64_t deadline = timeout_us < 0 ? -1 : monotonic_us() + timeout_us;
+  while (true) {
+    if (ctx->version.load(std::memory_order_acquire) !=
+        (uint32_t)(token >> 32)) {
+      return -1;  // caller misused the API and responded concurrently
+    }
+    if (ctx->canceled.load(std::memory_order_acquire)) {
+      return 1;
+    }
+    int64_t left = deadline < 0 ? -1 : deadline - monotonic_us();
+    if (deadline >= 0 && left <= 0) {
+      return 0;
+    }
+    int32_t seen = butex_value(b).load(std::memory_order_acquire);
+    if (ctx->canceled.load(std::memory_order_acquire)) {
+      return 1;  // flag flipped between the checks: don't park past it
+    }
+    butex_wait(b, seen, left);
+  }
 }
 
 // /ids: every non-free client-correlation slot (≙ builtin

@@ -51,6 +51,12 @@ struct RpcMeta {
   // up (device count in bits 8+), bit1 = server answered the probe (so
   // an explicit "no plane" is distinguishable from an old server).
   uint64_t device_caps = 0;
+  // tag 15 — the sender's tpu_plane_uid, carried alongside the caps
+  // probe/answer.  Equal uids on both ends = same process's PJRT client:
+  // stream device frames may pass buffer handles and copy dev→dev with
+  // no host landing (≙ RDMA only posting from registered blocks when the
+  // peer rides the same fabric).
+  uint64_t plane_uid = 0;
 
   bool is_response() const { return flags & 1; }
 };
@@ -259,10 +265,31 @@ int http_client_call(Channel* c, const char* method, const char* target,
 // the connection and the server's accepted-stream handle.
 // `compress` declares how the caller already encoded `req` (the native
 // layer only carries the tag; codecs live in the Python compress registry).
+// `call_id_out` (optional): receives the call's correlation id BEFORE
+// the request is written, so another thread can call_cancel() it while
+// this thread is still blocked (≙ Controller::call_id + StartCancel,
+// controller.h:631,843).
 int channel_call(Channel* c, const char* method, const uint8_t* req,
                  size_t req_len, const uint8_t* attach, size_t attach_len,
                  int64_t timeout_us, CallResult* out, uint64_t stream = 0,
-                 uint8_t compress = 0);
+                 uint8_t compress = 0, uint64_t* call_id_out = nullptr);
+
+// Cancel an in-flight call from any thread: the blocked caller returns
+// TRPC_ECANCELED immediately, the correlation slot is claimed safely
+// (response/timeout racers back off via the claim CAS), and a cancel
+// notice rides the connection so the server's handler can observe it.
+// Returns 0 if this cancel won the call, -1 if it was already
+// completing/completed (≙ Controller::StartCancel, controller.h:631).
+int call_cancel(uint64_t call_id);
+
+// Server side (≙ Controller::IsCanceled/NotifyOnCancel,
+// controller.h:385-388): 1 = the peer canceled this call (or its
+// connection died), 0 = still wanted, -1 = stale token (already
+// responded).  wait_canceled parks on the cancel butex until the flag
+// flips or the timeout passes (1 / 0 / -1 as above).  Only valid before
+// respond().
+int call_canceled(uint64_t token);
+int call_wait_canceled(uint64_t token, int64_t timeout_us);
 
 // --- streaming handshake helpers (server side; see stream.h) --------------
 

@@ -46,6 +46,7 @@ int Socket::Create(const SocketOptions& opts, SocketId* id_out) {
   s->edge_fn = opts.edge_fn;
   s->user = opts.user;
   s->on_failed = opts.on_failed;
+  s->frame_hint_fn = opts.frame_hint_fn;
   s->failed.store(false, std::memory_order_relaxed);
   s->error_code = 0;
   s->nevent.store(0, std::memory_order_relaxed);
@@ -55,6 +56,7 @@ int Socket::Create(const SocketOptions& opts, SocketId* id_out) {
   s->authed.store(false, std::memory_order_relaxed);
   s->is_h2.store(false, std::memory_order_relaxed);
   s->advertise_device_caps.store(false, std::memory_order_relaxed);
+  s->peer_plane_uid.store(0, std::memory_order_relaxed);
   s->corked = opts.corked;
   s->frame_bytes_hint = 0;
   s->frame_attach_hint = 0;
@@ -292,41 +294,55 @@ ssize_t Socket::ReadToBuf(bool* eof) {
     return total;
   }
   ssize_t total = 0;
-  if (frame_bytes_hint > read_buf.size()) {
-    // large frame in progress: pre-attachment bytes continue into pooled
-    // blocks, then the attachment lands in one dedicated block aligned
-    // exactly to its start
-    if (frame_attach_hint > read_buf.size()) {
-      size_t head = frame_attach_hint - read_buf.size();
-      ssize_t n = read_buf.append_from_fd(fd, head, eof);
+  while (true) {
+    if (frame_bytes_hint > read_buf.size()) {
+      // large frame in progress: pre-attachment bytes continue into
+      // pooled blocks, then the attachment lands in one dedicated block
+      // aligned exactly to its start
+      if (frame_attach_hint > read_buf.size()) {
+        size_t head = frame_attach_hint - read_buf.size();
+        ssize_t n = read_buf.append_from_fd(fd, head, eof);
+        if (n < 0) {
+          return total > 0 ? total : -1;
+        }
+        bytes_in.fetch_add((uint64_t)n, std::memory_order_relaxed);
+        total += n;
+        if ((size_t)n < head) {
+          return total;  // EAGAIN or EOF
+        }
+      }
+      size_t want = frame_bytes_hint - read_buf.size();
+      ssize_t n = read_buf.append_from_fd_big(fd, want, eof);
       if (n < 0) {
-        return -1;
+        return total > 0 ? total : -1;
       }
       bytes_in.fetch_add((uint64_t)n, std::memory_order_relaxed);
       total += n;
-      if ((size_t)n < head) {
-        return total;  // EAGAIN or EOF
+      if ((size_t)n < want) {
+        return total;  // EAGAIN or EOF: frame still incomplete
       }
+      frame_bytes_hint = 0;
+      frame_attach_hint = 0;
+      continue;  // frame landed; keep draining (the next may hint too)
     }
-    size_t want = frame_bytes_hint - read_buf.size();
-    ssize_t n = read_buf.append_from_fd_big(fd, want, eof);
+    // Unhinted: drain in bounded chunks when the protocol layer gave us
+    // a hint probe, so a large frame that is ALREADY fully buffered in
+    // the kernel still gets its attachment landed in one block (the
+    // probe arms the hints between chunks).  Without a probe, one
+    // unbounded drain — the original behavior.
+    size_t cap = frame_hint_fn != nullptr ? (size_t)(16 * 1024)
+                                          : (size_t)-1;
+    ssize_t n = read_buf.append_from_fd(fd, cap, eof);
     if (n < 0) {
-      return -1;
+      return total > 0 ? total : -1;
     }
     bytes_in.fetch_add((uint64_t)n, std::memory_order_relaxed);
     total += n;
-    if ((size_t)n < want) {
-      return total;  // EAGAIN or EOF: frame still incomplete
+    if ((size_t)n < cap || (eof != nullptr && *eof)) {
+      return total;  // EAGAIN or EOF: fully drained
     }
-    frame_bytes_hint = 0;
-    frame_attach_hint = 0;
+    frame_hint_fn(this);
   }
-  ssize_t n = read_buf.append_from_fd(fd, (size_t)-1, eof);
-  if (n < 0) {
-    return total > 0 ? total : -1;
-  }
-  bytes_in.fetch_add((uint64_t)n, std::memory_order_relaxed);
-  return total + n;
 }
 
 void Socket::ProcessEventFiber(void* arg) {

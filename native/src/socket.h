@@ -41,6 +41,12 @@ struct SocketOptions {
   EdgeFn edge_fn = nullptr;
   void* user = nullptr;       // owner: Server* / Channel* / Acceptor ctx
   void (*on_failed)(Socket*) = nullptr;  // called once from SetFailed
+  // Invoked by ReadToBuf between bounded drain chunks so the protocol
+  // layer can arm frame_bytes_hint/frame_attach_hint for a large frame
+  // IN PROGRESS — without this, a frame that is already fully buffered
+  // in the kernel would drain into pooled 8KB blocks in one gulp and a
+  // big attachment would lose its single-block (zero-copy DMA) landing.
+  void (*frame_hint_fn)(Socket*) = nullptr;
   // corked: Write() never writes inline — it enqueues and lets the flush
   // fiber (scheduled after the currently-ready fibers) drain the queue in
   // one writev.  Concurrent producers coalesce into one syscall; costs
@@ -62,6 +68,7 @@ class Socket {
   EdgeFn edge_fn = nullptr;
   void* user = nullptr;
   void (*on_failed)(Socket*) = nullptr;
+  void (*frame_hint_fn)(Socket*) = nullptr;  // see SocketOptions
   Butex* epollout_butex = nullptr;
   // running statistics
   std::atomic<uint64_t> bytes_in{0};
@@ -76,6 +83,10 @@ class Socket {
   // peer asked for the device plane (meta tag 14): every response on this
   // connection advertises the server's plane caps back
   std::atomic<bool> advertise_device_caps{false};
+  // peer's tpu_plane_uid from the tag-15 handshake (0 = unknown/none);
+  // == our own tpu_plane_uid() means both ends share one PJRT client,
+  // enabling handle-passing device frames on streams over this socket
+  std::atomic<uint64_t> peer_plane_uid{0};
   // opaque per-connection parser/pipelining state owned by the protocol
   // io_uring staging (uring.h RingFeed): when non-null, ReadToBuf drains
   // it instead of calling recv(2); freed at recycle time

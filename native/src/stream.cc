@@ -20,12 +20,49 @@
 #include "fiber.h"
 #include "object_pool.h"
 #include "rpc.h"
+#include "tpu.h"
 
 namespace trpc {
 
 namespace {
 
 constexpr uint64_t kDefaultWindow = 2u << 20;  // 2 MiB, like a sane TCP wnd
+
+// One queued inbound message.  `credit` is what its consumption reports
+// in FEEDBACK frames: the byte size for host data, the TENSOR size for
+// device frames (whose wire payload is a tiny header) — so HBM
+// backpressure behaves exactly like host-byte backpressure.
+struct RqMsg {
+  std::string bytes;   // host data, or a device frame's header only
+  IOBuf iob;           // device frame body (host rail): zero-copy from
+                       // the socket blocks straight to the h2d source
+  uint64_t credit = 0;
+  bool device = false;
+};
+
+// device-frame header codec (see STREAM_FRAME_DEVICE in stream.h)
+void put_u64le(std::string* s, uint64_t v) {
+  for (int i = 0; i < 8; ++i) {
+    s->push_back((char)(v >> (8 * i)));
+  }
+}
+
+uint64_t get_u64le(const char* p) {
+  uint64_t v = 0;
+  for (int i = 0; i < 8; ++i) {
+    v |= (uint64_t)(uint8_t)p[i] << (8 * i);
+  }
+  return v;
+}
+
+
+
+// Free a queued local-rail frame's passed handle (drops without a read).
+void drop_rq_msg(const RqMsg& m) {
+  if (m.device && m.bytes.size() >= 17 && m.bytes[0] == 1) {
+    tpu_buf_free(get_u64le(m.bytes.data() + 9));
+  }
+}
 
 struct Stream {
   uint32_t slot = 0;
@@ -45,7 +82,7 @@ struct Stream {
   uint64_t bytes_sent = 0;
   uint64_t bytes_acked = 0;
   // receive side: consumed counter drives Feedback frames
-  std::deque<std::string> rq;
+  std::deque<RqMsg> rq;
   uint64_t rq_bytes = 0;
   uint64_t consumed = 0;
   uint64_t last_feedback = 0;
@@ -121,9 +158,13 @@ void bump_wake(Butex* b) {
 }
 
 // Send a control/data frame on the stream's socket.  st->mu must NOT be
-// held (Socket::Write may run KeepWrite inline).
+// held (Socket::Write may run KeepWrite inline).  `attachment` carries a
+// device frame's tensor body: as a TRPC attachment it lands in ONE
+// dedicated block on the receiver (the frame-hint machinery), making the
+// receive-side h2d a zero-copy DMA from the socket block.
 int send_stream_frame(SocketId sock, uint64_t peer_id, uint8_t frame_type,
-                      IOBuf&& payload, uint64_t feedback_bytes) {
+                      IOBuf&& payload, IOBuf&& attachment,
+                      uint64_t feedback_bytes) {
   Socket* s = Socket::Address(sock);
   if (s == nullptr) {
     return -ECONNRESET;
@@ -133,7 +174,7 @@ int send_stream_frame(SocketId sock, uint64_t peer_id, uint8_t frame_type,
   meta.stream_frame_type = frame_type;
   meta.feedback_bytes = feedback_bytes;
   IOBuf frame;
-  PackFrame(&frame, meta, std::move(payload), IOBuf());
+  PackFrame(&frame, meta, std::move(payload), std::move(attachment));
   int rc = s->Write(std::move(frame));
   s->Dereference();
   return rc;
@@ -159,14 +200,33 @@ struct StreamSendTask {
   uint64_t peer;
   uint8_t type = STREAM_FRAME_DATA;
   IOBuf payload;
+  IOBuf attachment;  // device frame body (host rail)
 };
 
 void RunStreamSend(void*, void* targ) {
   StreamSendTask* t = (StreamSendTask*)targ;
+  // local-rail device frames carry a passed buffer handle: when the
+  // socket is already dead the frame never reaches the (same-process)
+  // peer, so the handle must be freed here or the HBM buffer leaks.
+  // (A write that queues and THEN loses the socket still leaks until
+  // process exit — same window as the reference losing posted WRs.)
+  uint64_t passed = 0;
+  if (t->type == STREAM_FRAME_DEVICE && t->payload.size() >= 17) {
+    char hdr[17];
+    t->payload.copy_to(hdr, 17);
+    if (hdr[0] == 1) {
+      passed = get_u64le(hdr + 9);
+    }
+  }
   // failure surfaces via the socket's on_failed -> StreamsOnSocketFailed
   // (writers see sock_failed on their next call), matching the async
   // write contract
-  send_stream_frame(t->sock, t->peer, t->type, std::move(t->payload), 0);
+  int rc = send_stream_frame(t->sock, t->peer, t->type,
+                             std::move(t->payload),
+                             std::move(t->attachment), 0);
+  if (rc != 0 && passed != 0) {
+    tpu_buf_free(passed);
+  }
   delete t;
 }
 
@@ -238,9 +298,13 @@ StreamHandle stream_accept_on(SocketId sock, uint64_t remote_id,
   return h;
 }
 
-int stream_write(StreamHandle h, const uint8_t* data, size_t len,
-                 int64_t timeout_us) {
-  int64_t deadline = timeout_us < 0 ? -1 : monotonic_us() + timeout_us;
+namespace {
+
+// Shared writer core: reserve `credit` bytes of the peer's window (wait
+// on the ack butex while full), then submit one frame of `type` carrying
+// `payload` through the per-stream ExecutionQueue.
+int stream_submit(StreamHandle h, uint64_t credit, uint8_t type,
+                  IOBuf&& payload, IOBuf&& attachment, int64_t deadline) {
   while (true) {
     Stream* st = address_locked(h);
     if (st == nullptr) {
@@ -258,9 +322,11 @@ int stream_write(StreamHandle h, const uint8_t* data, size_t len,
       st->mu.unlock();
       return -EPIPE;
     }
-    bool fits = st->bytes_sent - st->bytes_acked + len <= st->peer_window;
+    bool fits =
+        st->bytes_sent - st->bytes_acked + credit <= st->peer_window;
     // an oversized message may go alone once the pipe is drained
-    bool alone = len > st->peer_window && st->bytes_sent == st->bytes_acked;
+    bool alone =
+        credit > st->peer_window && st->bytes_sent == st->bytes_acked;
     if (fits || alone) {
       // reserve window under mu, submit AFTER releasing it: Submit's
       // inline-drain fallback (fiber exhaustion) runs send_stream_frame,
@@ -269,13 +335,13 @@ int stream_write(StreamHandle h, const uint8_t* data, size_t len,
       // writer's frames still emit in its call order; ordering across
       // RACING writers was never defined (same as the reference, where
       // order is set at socket-queue entry).
-      st->bytes_sent += len;
+      st->bytes_sent += credit;
       StreamSendTask* t = new StreamSendTask();
       t->sock = st->sock;
       t->peer = st->remote_id;
-      if (len > 0) {
-        t->payload.append(data, len);
-      }
+      t->type = type;
+      t->payload = std::move(payload);
+      t->attachment = std::move(attachment);
       ExecutionQueue* q = &st->send_q;
       st->mu.unlock();
       q->Submit(t);
@@ -290,19 +356,97 @@ int stream_write(StreamHandle h, const uint8_t* data, size_t len,
   }
 }
 
-ssize_t stream_read(StreamHandle h, int64_t timeout_us, uint8_t** out) {
-  *out = nullptr;
+}  // namespace
+
+int stream_write(StreamHandle h, const uint8_t* data, size_t len,
+                 int64_t timeout_us) {
   int64_t deadline = timeout_us < 0 ? -1 : monotonic_us() + timeout_us;
+  IOBuf payload;
+  if (len > 0) {
+    payload.append(data, len);
+  }
+  return stream_submit(h, len, STREAM_FRAME_DATA, std::move(payload),
+                       IOBuf(), deadline);
+}
+
+int stream_write_device(StreamHandle h, uint64_t buf, int64_t timeout_us) {
+  int64_t len64 = tpu_buf_size((TpuBufId)buf);
+  if (len64 < 0) {
+    return -EINVAL;
+  }
+  uint64_t len = (uint64_t)len64;
+  int64_t deadline = timeout_us < 0 ? -1 : monotonic_us() + timeout_us;
+  // pick the rail from the bound socket's tag-15 handshake state
+  bool local_rail = false;
+  {
+    Stream* st = address_locked(h);
+    if (st == nullptr) {
+      return -EINVAL;
+    }
+    SocketId sock = st->sock;
+    bool connected = st->connected;
+    st->mu.unlock();
+    if (!connected) {
+      return -EPIPE;
+    }
+    Socket* s = Socket::Address(sock);
+    if (s != nullptr) {
+      uint64_t uid = tpu_plane_uid();
+      local_rail =
+          uid != 0 && s->peer_plane_uid.load(std::memory_order_acquire) == uid;
+      s->Dereference();
+    }
+  }
+  IOBuf payload, attachment;
+  std::string hdr;
+  hdr.push_back(local_rail ? (char)1 : (char)0);
+  put_u64le(&hdr, len);
+  if (local_rail) {
+    // handle passing: 17 bytes on the wire, zero host copies — the
+    // receiver CopyToDevice's straight from this buffer and frees it
+    put_u64le(&hdr, buf);
+    payload.append(hdr.data(), hdr.size());
+  } else {
+    // host rail: ONE d2h landing zone becomes the frame's ATTACHMENT —
+    // on the receiver the attachment machinery lands it in a single
+    // dedicated block, so the h2d there is a zero-copy DMA too
+    payload.append(hdr.data(), hdr.size());
+    int rc = tpu_d2h_into_iobuf((TpuBufId)buf, &attachment);
+    if (rc != 0) {
+      return rc;
+    }
+  }
+  int rc = stream_submit(h, len, STREAM_FRAME_DEVICE, std::move(payload),
+                         std::move(attachment), deadline);
+  if (rc == 0 && !local_rail) {
+    tpu_buf_free((TpuBufId)buf);  // consumed (local rail: receiver frees)
+  }
+  return rc;
+}
+
+namespace {
+
+// Pop the next queued message (the read half shared by stream_read and
+// stream_read_device).  Returns 1 with *msg filled, 0 on clean EOF,
+// -EAGAIN/-ECONNRESET/-EINVAL like stream_read, or -EPROTO when the
+// front message's kind doesn't match `want_device` (left queued so the
+// caller can switch read APIs).
+int stream_pop(StreamHandle h, int64_t deadline, bool want_device,
+               RqMsg* msg) {
   while (true) {
     Stream* st = address_locked(h);
     if (st == nullptr) {
       return -EINVAL;
     }
     if (!st->rq.empty()) {
-      std::string msg = std::move(st->rq.front());
+      if (st->rq.front().device != want_device) {
+        st->mu.unlock();
+        return -EPROTO;
+      }
+      *msg = std::move(st->rq.front());
       st->rq.pop_front();
-      st->rq_bytes -= msg.size();
-      st->consumed += msg.size();
+      st->rq_bytes -= msg->credit;
+      st->consumed += msg->credit;
       // credit the sender once we've consumed half a window
       // (≙ the reference sending Feedback on consumption, stream.cpp:597)
       bool feedback = st->connected && !st->sock_failed &&
@@ -316,12 +460,9 @@ ssize_t stream_read(StreamHandle h, int64_t timeout_us, uint8_t** out) {
       st->mu.unlock();
       if (feedback) {
         send_stream_frame(sock, peer, STREAM_FRAME_FEEDBACK, IOBuf(),
-                          consumed);
+                          IOBuf(), consumed);
       }
-      uint8_t* buf = (uint8_t*)malloc(msg.size() > 0 ? msg.size() : 1);
-      memcpy(buf, msg.data(), msg.size());
-      *out = buf;
-      return (ssize_t)msg.size();
+      return 1;
     }
     if (st->remote_closed) {
       st->mu.unlock();
@@ -347,12 +488,70 @@ ssize_t stream_read(StreamHandle h, int64_t timeout_us, uint8_t** out) {
     st->mu.unlock();
     if (flush) {
       send_stream_frame(sock, peer, STREAM_FRAME_FEEDBACK, IOBuf(),
-                        consumed);
+                        IOBuf(), consumed);
     }
     if (wait_bump(rb, seen, deadline) != 0) {
       return -EAGAIN;
     }
   }
+}
+
+}  // namespace
+
+ssize_t stream_read(StreamHandle h, int64_t timeout_us, uint8_t** out) {
+  *out = nullptr;
+  int64_t deadline = timeout_us < 0 ? -1 : monotonic_us() + timeout_us;
+  RqMsg msg;
+  int rc = stream_pop(h, deadline, /*want_device=*/false, &msg);
+  if (rc <= 0) {
+    return rc;
+  }
+  uint8_t* buf = (uint8_t*)malloc(msg.bytes.size() > 0 ? msg.bytes.size()
+                                                       : 1);
+  memcpy(buf, msg.bytes.data(), msg.bytes.size());
+  *out = buf;
+  return (ssize_t)msg.bytes.size();
+}
+
+int stream_read_device(StreamHandle h, int dst_device, int64_t timeout_us,
+                       uint64_t* out, uint64_t* len_out) {
+  *out = 0;
+  *len_out = 0;
+  int64_t deadline = timeout_us < 0 ? -1 : monotonic_us() + timeout_us;
+  RqMsg msg;
+  int rc = stream_pop(h, deadline, /*want_device=*/true, &msg);
+  if (rc <= 0) {
+    return rc == 0 ? -EPIPE : rc;  // EOF has no tensor to return
+  }
+  // header + body were fully validated at arrival (StreamHandleFrame
+  // drops malformed frames), so nothing here can consume-then-reject
+  const std::string& b = msg.bytes;
+  uint8_t mode = (uint8_t)b[0];
+  uint64_t len = get_u64le(b.data() + 1);
+  if (mode == 1) {
+    // local rail: both ends share one PJRT client (proved by the tag-15
+    // handshake at arrival) — a single CopyToDevice moves the tensor
+    // chip→chip, no host landing zone
+    TpuBufId src = (TpuBufId)get_u64le(b.data() + 9);
+    TpuBufId nb = tpu_d2d(src, dst_device);
+    tpu_buf_free(src);  // the passed handle's ownership ends here
+    if (nb == 0) {
+      return -EIO;
+    }
+    *out = nb;
+    *len_out = len;
+    return 0;
+  }
+  // host rail: the frame body IS the h2d source (single-block bodies DMA
+  // from the socket block itself; multi-block counts a gather, never
+  // silent)
+  TpuBufId nb = tpu_h2d_from_iobuf(msg.iob, dst_device);
+  if (nb == 0) {
+    return -EIO;
+  }
+  *out = nb;
+  *len_out = len;
+  return 0;
 }
 
 void stream_buf_free(uint8_t* p) { free(p); }
@@ -408,6 +607,9 @@ void stream_destroy(StreamHandle h) {
   SocketId sock = st->sock;
   bool was_bound = st->connected;
   st->version.fetch_add(1, std::memory_order_release);  // invalidate handle
+  for (const RqMsg& m : st->rq) {
+    drop_rq_msg(m);  // unread local-rail frames still own passed handles
+  }
   st->rq.clear();
   st->rq_bytes = 0;
   Butex* ab = st->ack_butex;
@@ -456,18 +658,72 @@ int64_t stream_pending_bytes(StreamHandle h) {
   return v;
 }
 
-void StreamHandleFrame(const RpcMeta& meta, IOBuf&& payload) {
+void StreamHandleFrame(Socket* s, const RpcMeta& meta, IOBuf&& payload) {
+  // DEVICE frames are parsed and VALIDATED before any queueing: the
+  // mode byte comes off the wire, and an arbitrary remote peer must
+  // never be able to make this process d2d/free a local HBM handle it
+  // guessed — the local rail is only honored when the socket's tag-15
+  // handshake proved both ends share this process's PJRT client.
+  RqMsg dm;
+  if (meta.stream_frame_type == STREAM_FRAME_DEVICE) {
+    char hdr[17];
+    if (payload.size() < 9) {
+      return;  // malformed: drop
+    }
+    payload.copy_to(hdr, 1);
+    uint8_t mode = (uint8_t)hdr[0];
+    size_t hlen = mode == 1 ? 17 : 9;
+    if (mode > 1 || payload.size() < hlen) {
+      return;  // unknown mode / truncated header: drop
+    }
+    payload.copy_to(hdr, hlen);
+    if (mode == 1) {
+      uint64_t uid = tpu_plane_uid();
+      if (uid == 0 ||
+          s->peer_plane_uid.load(std::memory_order_acquire) != uid) {
+        return;  // forged/foreign local-rail frame: drop, touch nothing
+      }
+    }
+    dm.device = true;
+    dm.bytes.assign(hdr, hlen);
+    // window credit = the TENSOR length from the header (a local-rail
+    // frame's wire payload is just the 17-byte header)
+    dm.credit = get_u64le(hdr + 1);
+    payload.pop_front(hlen);
+    // body length must match the header's claim HERE, so a read can
+    // never consume-then-reject (the read APIs promise -EPROTO leaves
+    // the queue untouched): local rail carries no body, host rail's
+    // body is exactly the tensor
+    if (mode == 1 ? !payload.empty() : payload.size() != dm.credit) {
+      return;  // malformed: drop the whole frame
+    }
+    dm.iob = std::move(payload);  // host-rail body, zero-copy blocks
+  }
   Stream* st = address_locked(meta.stream_id);
   if (st == nullptr) {
-    return;  // stale/unknown stream: drop (≙ reference dropping RST races)
+    // stale/unknown stream: drop (≙ reference dropping RST races) — but
+    // a validated local-rail frame still owns its passed handle
+    drop_rq_msg(dm);
+    return;
   }
   switch (meta.stream_frame_type) {
-    case STREAM_FRAME_DATA:
-      st->rq.push_back(payload.to_string());
-      st->rq_bytes += st->rq.back().size();
+    case STREAM_FRAME_DATA: {
+      RqMsg m;
+      m.bytes = payload.to_string();
+      m.credit = m.bytes.size();
+      st->rq.push_back(std::move(m));
+      st->rq_bytes += st->rq.back().credit;
       st->mu.unlock();
       bump_wake(st->recv_butex);
       break;
+    }
+    case STREAM_FRAME_DEVICE: {
+      st->rq.push_back(std::move(dm));
+      st->rq_bytes += st->rq.back().credit;
+      st->mu.unlock();
+      bump_wake(st->recv_butex);
+      break;
+    }
     case STREAM_FRAME_CLOSE:
       st->remote_closed = true;
       st->mu.unlock();

@@ -34,6 +34,13 @@ enum StreamFrameType : uint8_t {
   STREAM_FRAME_DATA = 1,
   STREAM_FRAME_CLOSE = 2,
   STREAM_FRAME_FEEDBACK = 3,
+  // a tensor frame (see stream_write_device): the payload is a small
+  // header [mode u8 | len u64le | mode==1: TpuBufId u64le] followed, in
+  // mode 0 (host), by the raw bytes.  Mode 1 (local rail) passes the
+  // buffer HANDLE — both ends share one PJRT client (equal plane uids
+  // from the tag-15 handshake) and the receiver copies dev→dev with no
+  // host landing zone.
+  STREAM_FRAME_DEVICE = 4,
 };
 
 // Create the local half (client side, before the handshake RPC).
@@ -68,6 +75,27 @@ int stream_write(StreamHandle h, const uint8_t* data, size_t len,
 ssize_t stream_read(StreamHandle h, int64_t timeout_us, uint8_t** out);
 void stream_buf_free(uint8_t* p);
 
+// --- device-payload frames (tensor streams; ≙ "tensor streams
+// overlapping compute", SURVEY §2.9; the RDMA analog posts sends from
+// registered blocks, rdma_endpoint.h:82) --------------------------------
+//
+// Write one tensor (a device buffer) to the stream.  OWNERSHIP of `buf`
+// TRANSFERS on success (rc==0): the callee frees it after the bytes (or
+// the handle, on the local rail) are on their way — the caller must not
+// free or reuse it.  Window accounting uses the tensor's byte length on
+// both ends, so HBM backpressure behaves exactly like host-byte
+// backpressure.  Same return codes as stream_write.
+int stream_write_device(StreamHandle h, uint64_t buf, int64_t timeout_us);
+
+// Read one tensor: the next queued message MUST be a device frame
+// (-EPROTO otherwise, without consuming, so mixed streams can fall back
+// to stream_read).  On success *out is a NEW device buffer on
+// `dst_device` (local rail: one CopyToDevice, no host landing; host
+// mode: one h2d from the frame bytes) and *len_out its size.  Returns 0,
+// or stream_read's error codes.
+int stream_read_device(StreamHandle h, int dst_device, int64_t timeout_us,
+                       uint64_t* out, uint64_t* len_out);
+
 // Send CLOSE to the peer and forbid further writes (reads still drain).
 int stream_close(StreamHandle h);
 
@@ -87,7 +115,7 @@ int64_t stream_pending_bytes(StreamHandle h);
 // --- hooks for the rpc.cc parse loops -------------------------------------
 
 // Route a frame whose meta.stream_frame_type != 0.  Consumes payload.
-void StreamHandleFrame(const RpcMeta& meta, IOBuf&& payload);
+void StreamHandleFrame(Socket* s, const RpcMeta& meta, IOBuf&& payload);
 
 // Fail every stream bound to this socket (called from socket on_failed).
 void StreamsOnSocketFailed(SocketId sid);

@@ -34,6 +34,7 @@
 #include "iobuf.h"
 #include "rpc.h"
 #include "h2.h"
+#include "stream.h"
 #include "tpu.h"
 #include "uring.h"
 
@@ -659,25 +660,33 @@ static void test_h2_client_storm() {
 // pinned-waiter seam (a waiter must never read a recycled slot's next
 // occupant) and the deferred PJRT_Buffer_Destroy (never under a live
 // reader) only show up under this interleaving.
-static void test_tpu_plane_races() {
-  // the fake plugin sits next to the test binary (same build dir)
+// Bring up the device plane on the in-repo fake plugin (sits next to the
+// test binary).  Idempotent; false = scenario should skip.
+static bool ensure_fake_plane(const char* who) {
   char exe[512];
   ssize_t n = readlink("/proc/self/exe", exe, sizeof(exe) - 1);
   if (n <= 0) {
-    printf("skip tpu_plane_races (no /proc/self/exe)\n");
-    return;
+    printf("skip %s (no /proc/self/exe)\n", who);
+    return false;
   }
   exe[n] = '\0';
   std::string dir(exe);
   dir = dir.substr(0, dir.rfind('/'));
   std::string fake = dir + "/libpjrt_fake.so";
   if (access(fake.c_str(), R_OK) != 0) {
-    printf("skip tpu_plane_races (no %s)\n", fake.c_str());
-    return;
+    printf("skip %s (no %s)\n", who, fake.c_str());
+    return false;
   }
   setenv("TRPC_FAKE_PJRT_DELAY_US", "300", 1);
   if (tpu_plane_init(fake.c_str()) != 0) {
-    printf("skip tpu_plane_races (init: %s)\n", tpu_plane_error());
+    printf("skip %s (init: %s)\n", who, tpu_plane_error());
+    return false;
+  }
+  return true;
+}
+
+static void test_tpu_plane_races() {
+  if (!ensure_fake_plane("tpu_plane_races")) {
     return;
   }
   CHECK_TRUE(tpu_plane_device_count() >= 2);
@@ -715,15 +724,30 @@ static void test_tpu_plane_races() {
           if (tpu_buf_wait(id, 5000000) != 0) {
             bad.fetch_add(1);
           } else {
+            // every other clean round detours dev->dev first (the d2d
+            // slot arming races the same free/callback machinery)
+            TpuBufId read_id = id;
+            TpuBufId hop = 0;
+            if (i % 4 == 1) {
+              hop = tpu_d2d(id, (t + i + 1) % 2);
+              if (hop != 0) {
+                read_id = hop;
+              } else {
+                bad.fetch_add(1);
+              }
+            }
             char* mem = nullptr;
             size_t len = 0;
-            int rc = tpu_d2h_raw(id, &mem, &len);
+            int rc = tpu_d2h_raw(read_id, &mem, &len);
             if (rc != 0 || len != payload.size() ||
                 memcmp(mem, payload.data(), len) != 0) {
               bad.fetch_add(1);
             }
             if (rc == 0) {
               free(mem);
+            }
+            if (hop != 0) {
+              tpu_buf_free(hop);
             }
             roundtrips.fetch_add(1);
           }
@@ -750,6 +774,287 @@ static void test_tpu_plane_races() {
          (unsigned long long)freed_races.load());
 }
 
+// --- 12b. cancel vs response vs timeout races --------------------------------
+// channel_call publishes each call id (atomically) into a shared slab
+// BEFORE blocking; a canceller thread fires call_cancel on live ids at
+// random moments, so cancels race responses, timeouts, the failure sweep
+// and the slot release.  A slow usercode handler gives cancels a real
+// window; stale slab ids only ever hit the claim CAS's version arm.
+static std::atomic<uint64_t> g_cancel_ids[8];
+static std::atomic<uint64_t> g_handler_saw_cancel{0};
+
+static void cancel_slow_handler(uint64_t token, const char*,
+                                const uint8_t* req, size_t req_len,
+                                const uint8_t*, size_t, void*) {
+  usleep(100 + fast_rand() % 700);
+  // half the handlers that observe the cancel abort instead of answering
+  // (exercises call_canceled against concurrent CancelInflight/respond);
+  // either way the client must treat a late response as stale
+  if (call_canceled(token) == 1) {
+    g_handler_saw_cancel.fetch_add(1);
+    if (fast_rand() % 2 == 0) {
+      respond(token, TRPC_EINTERNAL, "aborted on cancel", nullptr, 0,
+              nullptr, 0, 0);
+      return;
+    }
+  }
+  respond(token, 0, nullptr, req, req_len, nullptr, 0, 0);
+}
+
+static void test_cancel_races() {
+  Server* srv = server_create();
+  server_add_service(srv, "Slow", 1, cancel_slow_handler, nullptr);
+  server_add_service(srv, "Echo", 0, nullptr, nullptr);
+  CHECK_TRUE(server_start(srv, "127.0.0.1", 0) == 0);
+  int port = server_port(srv);
+
+  std::atomic<bool> stop{false};
+  std::atomic<uint64_t> ok{0}, canceled{0}, timeouts{0}, aborted{0},
+      other{0};
+  std::vector<std::thread> ts;
+  for (int t = 0; t < 6; ++t) {
+    ts.emplace_back([&, t] {
+      Channel* ch = channel_create("127.0.0.1", port);
+      std::string payload(32, 'c');
+      CallResult res;
+      std::atomic<uint64_t>& slab = g_cancel_ids[t];
+      while (!stop.load(std::memory_order_acquire)) {
+        // a third of the calls also carry a tight deadline so cancel
+        // races timeout, not just response
+        int64_t to = (fast_rand() % 3 == 0)
+                         ? (int64_t)(500 + fast_rand() % 1500)
+                         : 100 * 1000;
+        int rc = channel_call(ch, "Slow", (const uint8_t*)payload.data(),
+                              payload.size(), nullptr, 0, to, &res, 0, 0,
+                              (uint64_t*)&slab);
+        if (rc == 0) {
+          ok.fetch_add(1);
+        } else if (rc == TRPC_ECANCELED) {
+          canceled.fetch_add(1);
+        } else if (rc == TRPC_ERPCTIMEDOUT) {
+          timeouts.fetch_add(1);
+        } else if (rc == TRPC_EINTERNAL) {
+          aborted.fetch_add(1);
+        } else {
+          other.fetch_add(1);
+          static std::atomic<int> printed{0};
+          if (printed.fetch_add(1) < 3) {
+            printf("  cancel_races: unexpected rc=%d (%s)\n", rc,
+                   res.error_text.c_str());
+          }
+        }
+        slab.store(0, std::memory_order_release);  // done: id is stale
+      }
+      channel_destroy(ch);
+    });
+  }
+  std::thread canceller([&] {
+    while (!stop.load(std::memory_order_acquire)) {
+      for (auto& slab : g_cancel_ids) {
+        uint64_t id = slab.load(std::memory_order_acquire);
+        if (id != 0 && fast_rand() % 8 == 0) {
+          call_cancel(id);  // races response/timeout/release: any outcome
+        }
+      }
+      usleep(fast_rand() % 700);
+    }
+  });
+  usleep(2 * 1000 * 1000);
+  stop.store(true, std::memory_order_release);
+  canceller.join();
+  for (auto& t : ts) {
+    t.join();
+  }
+  // post-storm: the server and fresh connections still work
+  Channel* ch = channel_create("127.0.0.1", port);
+  CallResult res;
+  CHECK_TRUE(channel_call(ch, "Echo", (const uint8_t*)"z", 1, nullptr, 0,
+                          5 * 1000 * 1000, &res) == 0);
+  channel_destroy(ch);
+  server_destroy(srv);
+  CHECK_TRUE(other.load() == 0);
+  CHECK_TRUE(canceled.load() > 0);  // cancels really landed mid-flight
+  CHECK_TRUE(g_handler_saw_cancel.load() > 0);  // and the server SAW them
+  printf("ok cancel_races ok=%llu canceled=%llu to=%llu observed=%llu\n",
+         (unsigned long long)ok.load(), (unsigned long long)canceled.load(),
+         (unsigned long long)timeouts.load(),
+         (unsigned long long)g_handler_saw_cancel.load());
+}
+
+// --- 13. stream device-frame ownership races --------------------------------
+// Tensor frames pass HBM buffer HANDLES between threads: injectors race a
+// reader and a mid-storm stream_destroy, forged frames from a socket with
+// the WRONG plane uid race the validator, and a host-rail writer storm
+// races a socket failure.  live_buffers must drain to zero — every
+// ownership path (read, stale-drop, destroy-sweep, send-failure) frees.
+static void test_stream_device_races() {
+  if (!ensure_fake_plane("stream_device_races")) {
+    return;
+  }
+  static std::string payload(4096, '\x7e');  // static: outlives the DMAs
+  uint64_t my_uid = tpu_plane_uid();
+  CHECK_TRUE(my_uid != 0);
+
+  int sp[2];
+  CHECK_TRUE(socketpair(AF_UNIX, SOCK_STREAM | SOCK_NONBLOCK, 0, sp) == 0);
+  SocketOptions sopts;
+  sopts.fd = sp[0];
+  SocketId trusted_id;
+  CHECK_TRUE(Socket::Create(sopts, &trusted_id) == 0);
+  Socket* trusted = Socket::Address(trusted_id);
+  CHECK_TRUE(trusted != nullptr);
+  trusted->peer_plane_uid.store(my_uid);  // as if the handshake ran
+
+  int sp2[2];
+  CHECK_TRUE(socketpair(AF_UNIX, SOCK_STREAM | SOCK_NONBLOCK, 0, sp2) == 0);
+  SocketOptions fopts;
+  fopts.fd = sp2[0];
+  SocketId foreign_id;
+  CHECK_TRUE(Socket::Create(fopts, &foreign_id) == 0);
+  Socket* foreign = Socket::Address(foreign_id);
+  CHECK_TRUE(foreign != nullptr);
+  foreign->peer_plane_uid.store(0xdeadbeef);  // different plane
+
+  StreamHandle r = stream_create(64u << 20);
+  const int kInject = 400;
+  std::atomic<uint64_t> read_ok{0}, injected{0}, forged{0};
+  std::atomic<int> bad{0};
+  std::atomic<bool> reader_stop{false};
+
+  auto make_device_frame = [&](uint64_t handle) {
+    IOBuf p;
+    std::string hdr;
+    hdr.push_back((char)1);
+    for (int i = 0; i < 8; ++i) {
+      hdr.push_back((char)((uint64_t)payload.size() >> (8 * i)));
+    }
+    for (int i = 0; i < 8; ++i) {
+      hdr.push_back((char)(handle >> (8 * i)));
+    }
+    p.append(hdr.data(), hdr.size());
+    return p;
+  };
+
+  std::vector<std::thread> ts;
+  for (int t = 0; t < 2; ++t) {
+    ts.emplace_back([&]() {  // injectors: real local-rail frames
+      for (int i = 0; i < kInject; ++i) {
+        TpuBufId id = tpu_h2d(payload.data(), payload.size(), i % 2,
+                              nullptr, nullptr);
+        if (id == 0) {
+          bad.fetch_add(1);
+          continue;
+        }
+        RpcMeta meta;
+        meta.stream_id = r;
+        meta.stream_frame_type = STREAM_FRAME_DEVICE;
+        // ownership of `id` passes with the frame: consumed by the
+        // reader, by the destroy sweep, or by the stale-stream drop
+        StreamHandleFrame(trusted, meta, make_device_frame(id));
+        injected.fetch_add(1);
+      }
+    });
+  }
+  ts.emplace_back([&]() {  // forger: guessed handles on the WRONG socket
+    for (int i = 0; i < kInject; ++i) {
+      RpcMeta meta;
+      meta.stream_id = r;
+      meta.stream_frame_type = STREAM_FRAME_DEVICE;
+      uint64_t guess = ((uint64_t)1 << 32) | (uint64_t)(i % 64);
+      StreamHandleFrame(foreign, meta, make_device_frame(guess));
+      forged.fetch_add(1);
+    }
+  });
+  ts.emplace_back([&]() {  // reader: drains tensors onto alternating devs
+    int dev = 0;
+    while (!reader_stop.load(std::memory_order_acquire)) {
+      uint64_t out = 0, len = 0;
+      int rc = stream_read_device(r, dev ^= 1, 50 * 1000, &out, &len);
+      if (rc == 0) {
+        if (len != payload.size()) {
+          bad.fetch_add(1);
+        }
+        tpu_buf_free(out);
+        read_ok.fetch_add(1);
+      } else if (rc == -EINVAL) {
+        break;  // destroyed under us — expected mid-storm
+      }
+    }
+  });
+  // destroy once the reader has made real progress but (usually) before
+  // the queue drains, so all three consumption paths run: read by the
+  // reader, swept from rq by destroy, dropped stale by late injections
+  while (read_ok.load(std::memory_order_acquire) < 50) {
+    usleep(100);
+  }
+  stream_destroy(r);
+  for (auto& t : ts) {
+    t.join();
+  }
+  reader_stop.store(true);
+
+  // host-rail writer storm racing a socket failure
+  StreamHandle w = stream_create(1u << 20);
+  stream_bind(w, foreign_id, /*remote_id=*/(StreamHandle)1 << 32,
+              /*peer_window=*/64u << 20);
+  std::atomic<uint64_t> wrote{0}, wfail{0};
+  std::vector<std::thread> ws;
+  for (int t = 0; t < 3; ++t) {
+    ws.emplace_back([&]() {
+      for (int i = 0; i < 100; ++i) {
+        TpuBufId id = tpu_h2d(payload.data(), payload.size(), 0, nullptr,
+                              nullptr);
+        if (id == 0) {
+          bad.fetch_add(1);
+          continue;
+        }
+        int rc = stream_write_device(w, id, 1000000);
+        if (rc == 0) {
+          wrote.fetch_add(1);  // consumed by the stream
+        } else {
+          wfail.fetch_add(1);
+          tpu_buf_free(id);  // NOT consumed on failure: still ours
+        }
+      }
+    });
+  }
+  std::thread drain([&]() {  // keep the socketpair moving, then kill it
+    char buf[8192];
+    // fail the socket while writers are mid-storm (about a third in)
+    while (wrote.load(std::memory_order_acquire) + wfail.load() < 100) {
+      while (read(sp2[1], buf, sizeof(buf)) > 0) {
+      }
+      usleep(200);
+    }
+    foreign->SetFailed(ECONNRESET);
+  });
+  for (auto& t : ws) {
+    t.join();
+  }
+  drain.join();
+  stream_destroy(w);
+  trusted->SetFailed(ECONNRESET);
+  trusted->Dereference();
+  foreign->Dereference();
+
+  // every ownership path must have freed its handle
+  for (int spin = 0; spin < 200 && tpu_plane_stats().live_buffers != 0;
+       ++spin) {
+    usleep(10000);
+  }
+  TpuPlaneStats st = tpu_plane_stats();
+  CHECK_TRUE(bad.load() == 0);
+  CHECK_TRUE(st.live_buffers == 0);
+  CHECK_TRUE(read_ok.load() > 0);
+  printf("ok stream_device_races injected=%llu read=%llu forged=%llu "
+         "wrote=%llu wfail=%llu\n",
+         (unsigned long long)injected.load(),
+         (unsigned long long)read_ok.load(),
+         (unsigned long long)forged.load(),
+         (unsigned long long)wrote.load(),
+         (unsigned long long)wfail.load());
+}
+
 int main() {
   fiber_runtime_init(4);
   test_butex_churn();
@@ -759,11 +1064,13 @@ int main() {
   test_fiber_storm();
   test_iobuf_sharing();
   test_call_timeout_races();
+  test_cancel_races();
   test_socketmap_races();
   test_restart_storm();
   test_h2_client_storm();
   test_uring_churn();
   test_tpu_plane_races();
+  test_stream_device_races();
   if (g_failures == 0) {
     printf("ALL STRESS TESTS PASSED\n");
     return 0;

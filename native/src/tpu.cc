@@ -38,11 +38,14 @@ struct Plane {
   std::atomic<bool> up{false};
   std::mutex init_mu;
 
+  uint64_t uid = 0;  // handshake token (tpu_plane_uid); set at init
+
   // stats (relaxed: monotonic counters)
   std::atomic<uint64_t> h2d_transfers{0}, d2h_transfers{0};
   std::atomic<uint64_t> h2d_bytes{0}, d2h_bytes{0};
   std::atomic<uint64_t> events_fired{0}, gather_copies{0};
   std::atomic<uint64_t> zero_copy_sends{0}, live_buffers{0}, errors{0};
+  std::atomic<uint64_t> d2d_transfers{0}, d2d_bytes{0};
 };
 
 Plane& plane() {
@@ -371,6 +374,11 @@ int tpu_plane_init(const char* plugin_path) {
   }
   p.devices.assign(dargs.addressable_devices,
                    dargs.addressable_devices + dargs.num_addressable_devices);
+  // mint the handshake token: unique per plane instance, never zero
+  p.uid = ((uint64_t)getpid() << 32) ^ (uint64_t)monotonic_ns();
+  if (p.uid == 0) {
+    p.uid = 1;
+  }
   p.dso = dso;
   p.api = api;
   p.client = cargs.client;
@@ -396,6 +404,11 @@ int tpu_plane_device_count() {
 }
 
 const char* tpu_plane_platform() { return plane().platform.c_str(); }
+
+uint64_t tpu_plane_uid() {
+  Plane& p = plane();
+  return p.up.load(std::memory_order_acquire) ? p.uid : 0;
+}
 
 TpuBufId tpu_h2d(const void* data, size_t len, int device_index,
                  void (*release)(void*, void*), void* release_arg) {
@@ -536,6 +549,80 @@ int wait_ready_pinned(DeviceBuf* b, int64_t timeout_us) {
   return b->error.load(std::memory_order_acquire) == 0 ? 0 : -EIO;
 }
 }  // namespace
+
+TpuBufId tpu_d2d(TpuBufId src_id, int dst_device) {
+  Plane& p = plane();
+  if (!p.up.load(std::memory_order_acquire) ||
+      dst_device < 0 || dst_device >= (int)p.devices.size()) {
+    return 0;
+  }
+  DeviceBuf* src = pin_buf(src_id);
+  if (src == nullptr) {
+    return 0;
+  }
+  // the source must be resident before CopyToDevice (PJRT would queue it
+  // anyway; waiting here keeps the error attribution crisp)
+  int rc = wait_ready_pinned(src, 30 * 1000 * 1000);
+  if (rc != 0 || src->buf == nullptr) {
+    set_plane_error(rc == -ETIMEDOUT
+                        ? "d2d: source never became resident"
+                        : "d2d: source transfer failed or buffer gone");
+    unpin_buf(src);
+    return 0;
+  }
+  PJRT_Buffer_CopyToDevice_Args args;
+  memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_Buffer_CopyToDevice_Args_STRUCT_SIZE;
+  args.buffer = src->buf;
+  args.dst_device = p.devices[dst_device];
+  PJRT_Error* err = p.api->PJRT_Buffer_CopyToDevice(&args);
+  size_t len = src->len;
+  unpin_buf(src);
+  if (err != nullptr) {
+    p.errors.fetch_add(1, std::memory_order_relaxed);
+    set_plane_error("d2d: " + pjrt_error_string(p.api, err));
+    return 0;
+  }
+  // arm a fresh slot for the destination buffer — same butex seam as h2d
+  DeviceBuf* b = nullptr;
+  uint32_t slot = ResourcePool<DeviceBuf>::Get(&b);
+  b->slot = slot;
+  if (b->ready == nullptr) {
+    b->ready = butex_create();
+  }
+  butex_value(b->ready).store(0, std::memory_order_release);
+  b->error.store(0, std::memory_order_relaxed);
+  b->pins.store(1, std::memory_order_relaxed);  // tpu_buf_free's pin
+  b->len = len;
+  b->release = nullptr;
+  b->release_arg = nullptr;
+  b->release_data = nullptr;
+  b->buf = args.dst_buffer;
+  TpuBufId id = b->id();
+  p.d2d_transfers.fetch_add(1, std::memory_order_relaxed);
+  p.d2d_bytes.fetch_add(len, std::memory_order_relaxed);
+  p.live_buffers.fetch_add(1, std::memory_order_relaxed);
+  PJRT_Buffer_ReadyEvent_Args rargs;
+  memset(&rargs, 0, sizeof(rargs));
+  rargs.struct_size = PJRT_Buffer_ReadyEvent_Args_STRUCT_SIZE;
+  rargs.buffer = b->buf;
+  PJRT_Error* rerr = p.api->PJRT_Buffer_ReadyEvent(&rargs);
+  if (rerr != nullptr) {
+    pjrt_error_string(p.api, rerr);
+    butex_value(b->ready).store(1, std::memory_order_release);
+    butex_wake_all(b->ready);
+  } else {
+    b->pins.fetch_add(1, std::memory_order_acq_rel);
+    PJRT_Event_OnReady_Args wargs;
+    memset(&wargs, 0, sizeof(wargs));
+    wargs.struct_size = PJRT_Event_OnReady_Args_STRUCT_SIZE;
+    wargs.event = rargs.event;
+    wargs.callback = on_ready_cb;
+    wargs.user_arg = b;
+    p.api->PJRT_Event_OnReady(&wargs);
+  }
+  return id;
+}
 
 int tpu_buf_wait(TpuBufId id, int64_t timeout_us) {
   // the pin keeps the slot (and its butex arming) ours for the whole
@@ -749,6 +836,8 @@ TpuPlaneStats tpu_plane_stats() {
   s.zero_copy_sends = p.zero_copy_sends.load(std::memory_order_relaxed);
   s.live_buffers = p.live_buffers.load(std::memory_order_relaxed);
   s.errors = p.errors.load(std::memory_order_relaxed);
+  s.d2d_transfers = p.d2d_transfers.load(std::memory_order_relaxed);
+  s.d2d_bytes = p.d2d_bytes.load(std::memory_order_relaxed);
   return s;
 }
 
@@ -761,6 +850,8 @@ const char* tpu_plane_error() {
 }
 int tpu_plane_device_count() { return 0; }
 const char* tpu_plane_platform() { return ""; }
+uint64_t tpu_plane_uid() { return 0; }
+TpuBufId tpu_d2d(TpuBufId, int) { return 0; }
 TpuBufId tpu_h2d(const void* data, size_t, int,
                  void (*release)(void*, void*), void* release_arg) {
   if (release != nullptr) {

@@ -33,6 +33,11 @@ const char* tpu_plane_error();
 int tpu_plane_device_count();
 // Platform name reported by the plugin ("tpu", "axon", ...; empty if down).
 const char* tpu_plane_platform();
+// Random nonzero token minted at plane init, exchanged in the tag-14/15
+// handshake: equal tokens on both ends of a connection mean both ends
+// share THIS process's PJRT client, so buffers can move device-to-device
+// (CopyToDevice over ICI) with no host landing zone.  0 when down.
+uint64_t tpu_plane_uid();
 
 // --- device buffers --------------------------------------------------------
 // Handles are (version<<32)|slot over a versioned pool — the same ABA-safe
@@ -71,6 +76,13 @@ int tpu_d2h_into_iobuf(TpuBufId id, IOBuf* out);
 // second host copy.
 int tpu_d2h_raw(TpuBufId id, char** mem_out, size_t* len_out);
 
+// Device-to-device copy WITHIN this process's PJRT client (≙ the RDMA
+// template posting sends straight from registered blocks — no host
+// round-trip; here the bytes ride ICI via PJRT CopyToDevice).  Returns a
+// NEW buffer handle on `dst_device` (readiness async, same butex seam as
+// h2d); the source buffer is untouched.  0 on failure.
+TpuBufId tpu_d2d(TpuBufId src, int dst_device);
+
 void tpu_buf_free(TpuBufId id);
 
 // --- observability (feeds the native metrics seam) -------------------------
@@ -85,6 +97,8 @@ struct TpuPlaneStats {
   uint64_t zero_copy_sends = 0; // single-block sends (pointer identity)
   uint64_t live_buffers = 0;
   uint64_t errors = 0;
+  uint64_t d2d_transfers = 0;   // CopyToDevice moves (no host landing)
+  uint64_t d2d_bytes = 0;
 };
 TpuPlaneStats tpu_plane_stats();
 

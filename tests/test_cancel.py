@@ -1,0 +1,150 @@
+"""RPC cancellation (≙ Controller::StartCancel + NotifyOnCancel,
+controller.h:631,843,385-388, and the example/cancel_c++ workload):
+a client abandons a call mid-flight from another thread; the blocked
+caller returns ECANCELED immediately, the server's handler observes the
+cancel (poll or park), and the connection stays usable."""
+
+import threading
+import time
+
+import pytest
+
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.channel import Channel
+from brpc_tpu.rpc.controller import Controller
+from brpc_tpu.rpc.server import Server
+
+
+@pytest.fixture()
+def server():
+    state = {"events": []}
+
+    def slow_wait(cntl, req):
+        # parks on the cancel butex (≙ NotifyOnCancel)
+        state["events"].append(("wait", cntl.wait_cancel(timeout_s=10)))
+        raise errors.RpcError(errors.EINTERNAL, "aborted")
+
+    def slow_poll(cntl, req):
+        # polls (≙ IsCanceled) while "working"
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if cntl.is_canceled():
+                state["events"].append(("poll", True))
+                raise errors.RpcError(errors.EINTERNAL, "aborted")
+            time.sleep(0.01)
+        state["events"].append(("poll", False))
+        return b"finished"
+
+    def flaky(cntl, req):
+        time.sleep(0.15)
+        raise errors.RpcError(errors.EINTERNAL, "try again")  # retryable
+
+    srv = Server()
+    srv.add_service("SlowWait", slow_wait)
+    srv.add_service("SlowPoll", slow_poll)
+    srv.add_service("Flaky", flaky)
+    srv.add_service("Echo", lambda cntl, req: req)
+    srv.start("127.0.0.1:0")
+    yield srv, state
+    srv.destroy()
+
+
+def _cancel_after(cntl, delay_s):
+    t = threading.Thread(target=lambda: (time.sleep(delay_s),
+                                         cntl.start_cancel()), daemon=True)
+    t.start()
+    return t
+
+
+def test_cancel_unblocks_caller_immediately(server):
+    srv, state = server
+    ch = Channel(f"127.0.0.1:{srv.port}")
+    cntl = Controller()
+    _cancel_after(cntl, 0.2)
+    t0 = time.monotonic()
+    with pytest.raises(errors.RpcError) as ei:
+        ch.call("SlowWait", b"work", cntl=cntl, timeout_ms=30_000)
+    elapsed = time.monotonic() - t0
+    assert ei.value.code == errors.ECANCELED
+    assert elapsed < 2.0, f"cancel did not unblock the caller ({elapsed:.1f}s)"
+    # the handler's park was released by the notice
+    deadline = time.monotonic() + 5
+    while not state["events"] and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert ("wait", True) in state["events"]
+    # the connection survives a canceled call
+    assert ch.call("Echo", b"alive") == b"alive"
+    ch.close()
+
+
+def test_polling_handler_observes_cancel(server):
+    srv, state = server
+    ch = Channel(f"127.0.0.1:{srv.port}")
+    cntl = Controller()
+    _cancel_after(cntl, 0.2)
+    with pytest.raises(errors.RpcError) as ei:
+        ch.call("SlowPoll", b"work", cntl=cntl, timeout_ms=30_000)
+    assert ei.value.code == errors.ECANCELED
+    deadline = time.monotonic() + 5
+    while not state["events"] and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert ("poll", True) in state["events"]
+    ch.close()
+
+
+def test_cancel_stops_the_retry_loop(server):
+    """A cancel landing during retries (between or mid-attempt) ends the
+    loop with ECANCELED instead of burning the remaining attempts."""
+    srv, _ = server
+    ch = Channel(f"127.0.0.1:{srv.port}", max_retry=100)
+    cntl = Controller()
+    _cancel_after(cntl, 0.4)
+    t0 = time.monotonic()
+    with pytest.raises(errors.RpcError) as ei:
+        ch.call("Flaky", b"x", cntl=cntl, timeout_ms=30_000)
+    elapsed = time.monotonic() - t0
+    assert ei.value.code == errors.ECANCELED
+    assert elapsed < 5.0, elapsed
+    ch.close()
+
+
+def test_cancel_after_completion_is_noop(server):
+    srv, _ = server
+    ch = Channel(f"127.0.0.1:{srv.port}")
+    cntl = Controller()
+    assert ch.call("Echo", b"done", cntl=cntl) == b"done"
+    cntl.start_cancel()  # must not disturb past or future calls
+    cntl2 = Controller()
+    assert ch.call("Echo", b"again", cntl=cntl2) == b"again"
+    ch.close()
+
+
+def test_peer_death_cancels_inflight_handlers(server):
+    """The peer vanishing mid-call is an implicit cancel — the handler's
+    wait_cancel fires (≙ NotifyOnCancel on client disconnect).  The
+    client runs in a subprocess killed mid-call: the only honest way to
+    make a connection die under an in-flight request."""
+    import os
+    import signal
+    import subprocess
+    import sys
+
+    srv, state = server
+    code = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "from brpc_tpu.rpc.channel import Channel\n"
+        "ch = Channel('127.0.0.1:%d', max_retry=0)\n"
+        "print('CALLING', flush=True)\n"
+        "ch.call('SlowWait', b'w', timeout_ms=30_000)\n"
+    ) % (os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+         srv.port)
+    p = subprocess.Popen([sys.executable, "-c", code],
+                         stdout=subprocess.PIPE, text=True)
+    assert p.stdout.readline().strip() == "CALLING"
+    time.sleep(0.5)  # the handler is parked in wait_cancel by now
+    p.send_signal(signal.SIGKILL)
+    p.wait(timeout=10)
+    deadline = time.monotonic() + 10
+    while not state["events"] and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert ("wait", True) in state["events"], state["events"]

@@ -211,6 +211,16 @@ b.wait()
 assert b.to_host() == data
 b.free()
 
+# raw device-to-device: new buffer on the target device, source intact
+b = tpu_plane.h2d(data, device=0)
+b.wait()
+c = tpu_plane.d2d(b, 1)
+assert c.to_host() == data
+assert b.to_host() == data  # source untouched
+before_d2d = tpu_plane.stats()["d2d_transfers"]
+assert before_d2d >= 1
+b.free(); c.free()
+
 # sync create failure surfaces at h2d() with the plane's reason
 os.environ["TRPC_FAKE_PJRT_FAIL"] = "h2d"
 try:
@@ -270,6 +280,98 @@ assert stats["errors"] >= 3
 assert stats["live_buffers"] == 0, stats
 print("FAULTS-OK")
 """
+
+
+DEVICE_STREAM_CODE = r"""
+import time
+from brpc_tpu import tpu_plane
+from brpc_tpu.rpc.channel import Channel, ChannelOptions
+from brpc_tpu.rpc.server import Server
+from brpc_tpu.rpc.stream import StreamProtocolError
+
+assert tpu_plane.init(), tpu_plane.error()
+accepted = []
+
+def handler(cntl, req):
+    accepted.append(cntl.accept_stream())
+    return b"ok"
+
+srv = Server()
+srv.add_service("TensorSink", handler)
+srv.start("127.0.0.1:0")
+
+# --- LOCAL rail: tpu:// channel, handshake exchanges plane uids --------
+ch = Channel(f"tpu://0/0@127.0.0.1:{srv.port}",
+             ChannelOptions(max_retry=0, timeout_ms=30_000))
+resp, st = ch.create_stream("TensorSink", b"")
+assert resp == b"ok"
+assert ch.transport_state == "device", ch.transport_state
+server_half = accepted[0]
+
+frames = [bytes([i]) * (64 * 1024) for i in range(8)]
+before = tpu_plane.stats()
+for f in frames:
+    buf = tpu_plane.h2d(f, device=0)
+    st.write_device(buf)  # ownership transfers to the stream
+got = [server_half.read_device(device=1, timeout_s=30) for _ in frames]
+after = tpu_plane.stats()
+# 8 tensors moved dev0->dev1 on the local rail: one CopyToDevice each,
+# ZERO host landings beyond the 8 creation h2ds, ZERO gathers
+assert after["d2d_transfers"] == before["d2d_transfers"] + 8, (before, after)
+assert after["gather_copies"] == before["gather_copies"], (before, after)
+assert after["h2d_transfers"] == before["h2d_transfers"] + 8, (before, after)
+assert after["d2h_transfers"] == before["d2h_transfers"], (before, after)
+# content survives (the verification d2h comes after the accounting)
+assert got[3].to_host() == frames[3]
+for b in got:
+    b.free()
+
+# --- HOST rail: a POOLED connection never carries the tag-14/15 probe,
+# so the socket has no shared-client evidence and the frame must fall
+# back to explicit host bytes.  (A plain single channel would SocketMap-
+# share the probed connection above and legitimately keep the local rail.)
+ch2 = Channel(f"127.0.0.1:{srv.port}",
+              ChannelOptions(connection_type="pooled"))
+resp, st2 = ch2.create_stream("TensorSink", b"")
+server_half2 = accepted[1]
+b4 = tpu_plane.stats()
+buf = tpu_plane.h2d(frames[5], device=0)
+st2.write_device(buf)
+# a host read on a device frame is a typed error and consumes nothing
+deadline = time.monotonic() + 10
+while server_half2.pending_bytes == 0 and time.monotonic() < deadline:
+    time.sleep(0.01)
+try:
+    server_half2.read(timeout_s=1)
+    raise SystemExit("read() must reject a device frame")
+except StreamProtocolError:
+    pass
+rbuf = server_half2.read_device(device=1, timeout_s=30)
+assert rbuf.to_host() == frames[5]
+rbuf.free()
+a4 = tpu_plane.stats()
+assert a4["d2d_transfers"] == b4["d2d_transfers"], (b4, a4)   # no rail
+assert a4["d2h_transfers"] >= b4["d2h_transfers"] + 1          # explicit
+assert a4["gather_copies"] == b4["gather_copies"], (b4, a4)
+
+for s in (st, st2, *accepted):
+    s.destroy()
+ch.close(); ch2.close(); srv.destroy()
+live = tpu_plane.stats()["live_buffers"]
+assert live == 0, live
+print("DEVICE-STREAM-OK")
+"""
+
+
+def test_device_payload_streams():
+    """Tensor streams: multi-frame dev0->dev1 over the LOCAL rail (handle
+    passing + CopyToDevice, zero host copies) and the explicit HOST rail
+    on a plain channel — the 'tensor streams overlapping compute' row of
+    SURVEY §2.9."""
+    _need_fake()
+    r = _run(DEVICE_STREAM_CODE, env_extra=FAKE_ENV)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "DEVICE-STREAM-OK" in r.stdout
 
 
 def test_fault_injection_on_fake_plane():
