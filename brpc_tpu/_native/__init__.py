@@ -394,6 +394,17 @@ def _declare(L: ctypes.CDLL) -> None:
     L.trpc_channel_transport_state.argtypes = [c.c_void_p]
     L.trpc_channel_transport_state.restype = c.c_int
 
+    # heap + contention profiler (heap_profiler.h)
+    L.trpc_heap_profiler_enable.argtypes = [c.c_int64]
+    L.trpc_heap_profiler_enable.restype = None
+    L.trpc_heap_profiler_enabled.restype = c.c_int
+    L.trpc_heap_dump.argtypes = [c.c_int, c.POINTER(c.c_void_p)]
+    L.trpc_heap_dump.restype = c.c_size_t
+    L.trpc_contention_dump.argtypes = [c.POINTER(c.c_void_p)]
+    L.trpc_contention_dump.restype = c.c_size_t
+    L.trpc_contention_profiler_set.argtypes = [c.c_int]
+    L.trpc_contention_profiler_set.restype = None
+
     # RPC cancellation (≙ Controller::StartCancel / NotifyOnCancel)
     L.trpc_channel_call_cancelable.argtypes = [
         c.c_void_p, c.c_char_p, c.c_char_p, c.c_size_t, c.c_char_p,

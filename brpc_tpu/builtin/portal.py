@@ -40,6 +40,9 @@ _SERVICES = [
     ("/rpcz", "sampled RPC spans (?trace_id=, ?max_scan=)"),
     ("/hotspots", "collapsed-stack CPU samples (?seconds=)"),
     ("/pprof/profile", "native SIGPROF profile (?seconds=, ?hz=)"),
+    ("/pprof/heap", "sampled live heap (?interval=; first hit enables)"),
+    ("/pprof/growth", "cumulative allocation profile"),
+    ("/pprof/contention", "sampled lock-wait stacks (always on)"),
     ("/sockets", "every live socket in the process"),
     ("/ids", "in-flight client correlation ids"),
     ("/threads", "python stacks + OS thread census"),
@@ -218,6 +221,56 @@ def _pprof_profile(req: HttpRequest) -> HttpResponse:
         _hotspots_gate.release()
 
 
+def _heap_profile(req: HttpRequest, growth: bool) -> HttpResponse:
+    """≙ /pprof/heap + /pprof/growth (builtin/pprof_service.h:38,
+    hotspots_service.cpp:1240 — re-designed: the framework samples its
+    own allocation seams — IOBuf blocks, pool slabs, DMA landing zones —
+    instead of interposing the global allocator).  First hit enables
+    sampling (?interval= bytes/sample, default 512KB); later hits dump
+    live (heap) or cumulative (growth) bytes with symbolized stacks."""
+    from brpc_tpu._native import lib as _lib
+    L = _lib()
+    try:
+        interval = int(req.query_params().get("interval", str(512 * 1024)))
+    except ValueError:
+        return HttpResponse.text("bad interval\n", 400)
+    if req.query_params().get("disable"):
+        L.trpc_heap_profiler_enable(0)
+        return HttpResponse.text("heap profiler disabled\n")
+    if not L.trpc_heap_profiler_enabled():
+        L.trpc_heap_profiler_enable(max(interval, 4096))
+        return HttpResponse.text(
+            "heap profiler enabled (interval=%d); run load, then GET "
+            "again for the dump\n" % max(interval, 4096))
+    out = ctypes.c_void_p()
+    n = L.trpc_heap_dump(1 if growth else 0, ctypes.byref(out))
+    try:
+        text = ctypes.string_at(out, n).decode("utf-8", "replace") \
+            if n else "no samples\n"
+    finally:
+        if out:
+            L.trpc_profiler_free(out)
+    return HttpResponse.text(text)
+
+
+def _pprof_contention(req: HttpRequest) -> HttpResponse:
+    """≙ the bthread contention profiler's pprof dump (mutex.cpp:62-150):
+    sampled lock-wait stacks from the core's hot mutexes, always on
+    (rate-limited), dumped in '--- contention ---' format with a
+    symbolized tail — /hotspots?view=contention shows the same data."""
+    from brpc_tpu._native import lib as _lib
+    L = _lib()
+    out = ctypes.c_void_p()
+    n = L.trpc_contention_dump(ctypes.byref(out))
+    try:
+        text = ctypes.string_at(out, n).decode("utf-8", "replace") \
+            if n else "no contention sampled\n"
+    finally:
+        if out:
+            L.trpc_profiler_free(out)
+    return HttpResponse.text(text)
+
+
 def _pprof_symbol(req: HttpRequest) -> HttpResponse:
     """≙ /pprof/symbol: resolve hex code addresses to symbol names.
     GET returns a capability marker (num_symbols); POST body is
@@ -257,6 +310,9 @@ def install_builtin_services(server, dispatcher: HttpDispatcher) -> None:
     d.register("/hotspots", _hotspots)
     d.register("/pprof/profile", _pprof_profile)
     d.register("/pprof/symbol", _pprof_symbol)
+    d.register("/pprof/heap", lambda r: _heap_profile(r, growth=False))
+    d.register("/pprof/growth", lambda r: _heap_profile(r, growth=True))
+    d.register("/pprof/contention", _pprof_contention)
 
     def _status(req: HttpRequest) -> HttpResponse:
         return HttpResponse.json({

@@ -20,6 +20,7 @@
 #include "stream.h"
 #include "tls.h"
 #include "tpu.h"
+#include "heap_profiler.h"
 #include "uring.h"
 
 using namespace trpc;
@@ -190,6 +191,26 @@ int trpc_respond_compressed(uint64_t token, int32_t error_code,
 }
 
 int trpc_token_compress(uint64_t token) { return token_compress_type(token); }
+
+// --- heap + contention profiler (heap_profiler.h ≙ /pprof/heap,
+// /pprof/growth, sampled lock-wait stacks) ---------------------------------
+
+void trpc_heap_profiler_enable(int64_t interval_bytes) {
+  heap_profiler_enable(interval_bytes);
+}
+int trpc_heap_profiler_enabled() {
+  return heap_profiler_enabled() ? 1 : 0;
+}
+// which: 0 = live ("heap"), 1 = cumulative ("growth")
+size_t trpc_heap_dump(int which, char** out) {
+  return heap_profiler_dump(which != 0, out);
+}
+size_t trpc_contention_dump(char** out) { return contention_dump(out); }
+void trpc_contention_profiler_set(int on) {
+  contention_profiler_set(on != 0);
+}
+// all profiler dump texts (CPU/heap/contention) free via
+// trpc_profiler_free — one contract, one function
 
 // --- HTTP on the shared port ----------------------------------------------
 
@@ -545,13 +566,13 @@ int trpc_tpu_device_count() { return tpu_plane_device_count(); }
 // hook.  (The zero-copy path is tpu_h2d_from_iobuf, used by the RPC
 // attachment plane; this is the convenience surface.)
 uint64_t trpc_tpu_h2d(const uint8_t* data, size_t len, int device) {
-  void* copy = malloc(len > 0 ? len : 1);
+  void* copy = hp_malloc(len > 0 ? len : 1);
   if (copy == nullptr) {
     return 0;
   }
   memcpy(copy, data, len);
   return tpu_h2d(copy, len, device,
-                 [](void* d, void*) { free(d); }, nullptr);
+                 [](void* d, void*) { hp_free(d); }, nullptr);
 }
 int trpc_tpu_buf_wait(uint64_t id, int64_t timeout_us) {
   return tpu_buf_wait(id, timeout_us);
@@ -568,7 +589,7 @@ int64_t trpc_tpu_d2h(uint64_t id, uint8_t** out) {
   *out = (uint8_t*)mem;  // the DMA landing zone itself — no second copy
   return (int64_t)n;
 }
-void trpc_tpu_buf_release(uint8_t* p) { free(p); }
+void trpc_tpu_buf_release(uint8_t* p) { hp_free(p); }
 void trpc_tpu_buf_free(uint64_t id) { tpu_buf_free(id); }
 
 void trpc_tpu_plane_stats(uint64_t out[11]) {

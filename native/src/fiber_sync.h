@@ -13,6 +13,7 @@
 
 #include "common.h"
 #include "fiber.h"
+#include "heap_profiler.h"
 #include "metrics.h"
 
 namespace trpc {
@@ -47,8 +48,11 @@ class FiberMutex {
       butex_wait(b_, 2, -1);
       c = butex_value(b_).exchange(2, std::memory_order_acquire);
     }
-    nm.mutex_wait_ns.fetch_add((uint64_t)(monotonic_ns() - t0),
+    int64_t waited = monotonic_ns() - t0;
+    nm.mutex_wait_ns.fetch_add((uint64_t)waited,
                                std::memory_order_relaxed);
+    contention_sample(waited);  // sampled lock-wait stacks (heap_profiler.h)
+    asm volatile("");  // keep the caller frame out of tail-call elision
   }
 
   bool try_lock() {

@@ -16,6 +16,7 @@
 
 #include "execution_queue.h"
 #include "h2_tables.h"
+#include "heap_profiler.h"
 #include "tls.h"
 
 namespace trpc {
@@ -263,7 +264,7 @@ struct StreamState {
 class H2Conn {
  public:
   std::atomic<int> refs{1};  // registry's reference
-  std::mutex mu;
+  ProfiledMutex mu;  // hot: every frame; contention-profiled
   Hpack hpack;
   std::unordered_map<uint32_t, StreamState> streams;
   uint32_t continuation_stream = 0;  // nonzero: expecting CONTINUATION
@@ -471,7 +472,7 @@ H2Conn* H2ConnCreate(Socket* s) {
   c->resp_q.Init(RunRespondTask, c, RespQStart, RespQExit);
   s->is_h2.store(true, std::memory_order_release);
   {
-    std::lock_guard<std::mutex> lk(g_conns_mu);
+    std::lock_guard lk(g_conns_mu);
     g_conns[s->id()] = c;
   }
   // server preface: SETTINGS with our max frame size
@@ -501,7 +502,7 @@ H2Conn* H2ConnCreate(Socket* s) {
 }
 
 H2Conn* H2ConnFind(SocketId id) {
-  std::lock_guard<std::mutex> lk(g_conns_mu);
+  std::lock_guard lk(g_conns_mu);
   auto it = g_conns.find(id);
   if (it == g_conns.end()) {
     return nullptr;
@@ -520,7 +521,7 @@ void H2ConnRelease(H2Conn* c) {
 void H2ConnDestroy(SocketId id) {
   H2Conn* c = nullptr;
   {
-    std::lock_guard<std::mutex> lk(g_conns_mu);
+    std::lock_guard lk(g_conns_mu);
     auto it = g_conns.find(id);
     if (it != g_conns.end()) {
       c = it->second;
@@ -566,7 +567,7 @@ void FlushPending(H2Conn* c, Socket* s, uint32_t sid, StreamState* st,
 }  // namespace
 
 int H2ConnConsume(H2Conn* c, Socket* s, std::vector<H2Request>* out) {
-  std::lock_guard<std::mutex> lk(c->mu);
+  std::lock_guard lk(c->mu);
   std::string reply;  // protocol frames to write back
   while (true) {
     if (s->read_buf.size() < 9) {
@@ -826,7 +827,7 @@ int H2ConnConsume(H2Conn* c, Socket* s, std::vector<H2Request>* out) {
 int H2Respond(H2Conn* c, Socket* s, uint32_t stream_id, int status,
               const char* headers_blob, const uint8_t* body,
               size_t body_len, const char* trailers_blob) {
-  std::lock_guard<std::mutex> lk(c->mu);
+  std::lock_guard lk(c->mu);
   auto it = c->streams.find(stream_id);
   if (it == c->streams.end()) {
     return -1;  // client reset the stream
@@ -895,7 +896,7 @@ struct H2ClientStream {
 
 struct H2ClientConn {
   SocketId sock = INVALID_SOCKET_ID;
-  std::mutex mu;
+  ProfiledMutex mu;  // hot: every frame/call; contention-profiled
   // serializes stream-id allocation with the HEADERS write (RFC 9113
   // §5.1.1 increasing-id order) WITHOUT holding mu across Socket::Write:
   // a failed inline write runs H2ClientOnFailed, which takes mu.
@@ -960,7 +961,7 @@ void H2ClientOnFailed(Socket* s) {
     return;
   }
   c->failed.store(true, std::memory_order_release);
-  std::lock_guard<std::mutex> lk(c->mu);
+  std::lock_guard lk(c->mu);
   H2ClientFailAllLocked(c, -TRPC_EFAILEDSOCKET);  // also wakes senders
 }
 
@@ -995,7 +996,7 @@ void H2ClientOnMessages(Socket* s) {
   ssize_t r = s->ReadToBuf(&eof);
   bool dead = eof || (r < 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
                       errno != EINTR);
-  std::unique_lock<std::mutex> lk(c->mu);
+  std::unique_lock lk(c->mu);
   std::string reply;
   bool window_grew = false;
   while (true) {
@@ -1343,10 +1344,10 @@ int h2_client_call(void* conn, const char* method, const char* path,
     // RFC 9113 §5.1.1: HEADERS must reach the wire in increasing
     // stream-id order, so sid allocation and the HEADERS write share the
     // header_mu critical section (DATA frames below interleave freely)
-    std::lock_guard<std::mutex> order_lk(c->header_mu);
+    std::lock_guard order_lk(c->header_mu);
     size_t maxf;
     {
-      std::lock_guard<std::mutex> lk(c->mu);
+      std::lock_guard lk(c->mu);
       sid = c->next_stream;
       c->next_stream += 2;
       c->streams[sid] = &st;
@@ -1378,7 +1379,7 @@ int h2_client_call(void* conn, const char* method, const char* path,
   int rc = 0;
   while (sent < body_len && rc == 0) {
     size_t want = body_len - sent;
-    std::unique_lock<std::mutex> lk(c->mu);
+    std::unique_lock lk(c->mu);
     int64_t avail = c->conn_send_window;
     auto it = c->stream_send_window.find(sid);
     if (it == c->stream_send_window.end()) {
@@ -1445,7 +1446,7 @@ int h2_client_call(void* conn, const char* method, const char* path,
 
   bool still_registered;
   {
-    std::lock_guard<std::mutex> lk(c->mu);
+    std::lock_guard lk(c->mu);
     still_registered = c->streams.erase(sid) > 0;
     c->stream_send_window.erase(sid);
     if (still_registered && c->continuation_stream == sid) {

@@ -1,0 +1,329 @@
+// heap_profiler.cc — see heap_profiler.h for the design rationale.
+#include "heap_profiler.h"
+
+#include <execinfo.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include <atomic>
+#include <map>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+#include "profiler.h"
+
+namespace trpc {
+
+namespace {
+
+constexpr int kMaxDepth = 32;
+// Skip nothing: raw[0] is the record fn itself (capture_stack inlines
+// into it), a self-describing leaf.  Inlining and tail calls make any
+// larger skip count eat REAL caller frames at -O2.
+constexpr int kSkipFrames = 0;
+
+struct StackKey {
+  void* frames[kMaxDepth];
+  int depth = 0;
+
+  bool operator==(const StackKey& o) const {
+    return depth == o.depth &&
+           memcmp(frames, o.frames, sizeof(void*) * depth) == 0;
+  }
+};
+
+struct StackKeyHash {
+  size_t operator()(const StackKey& k) const {
+    size_t h = (size_t)k.depth * 1099511628211ULL;
+    for (int i = 0; i < k.depth; ++i) {
+      h = (h ^ (size_t)k.frames[i]) * 1099511628211ULL;
+    }
+    return h;
+  }
+};
+
+int capture_stack(StackKey* k) {
+  void* raw[kMaxDepth + kSkipFrames];
+  int n = backtrace(raw, kMaxDepth + kSkipFrames);
+  if (n <= kSkipFrames) {
+    return 0;
+  }
+  k->depth = n - kSkipFrames;
+  memcpy(k->frames, raw + kSkipFrames, sizeof(void*) * k->depth);
+  return k->depth;
+}
+
+// --- heap state ------------------------------------------------------------
+
+struct HeapStat {
+  int64_t live_bytes = 0;
+  int64_t live_count = 0;
+  int64_t total_bytes = 0;
+  int64_t total_count = 0;
+};
+
+std::atomic<int64_t> g_interval{0};  // 0 = off
+
+// All cross-thread singletons heap-allocated and leaked (library threads
+// may outlive static destruction).
+std::mutex& heap_mu() {
+  static std::mutex* m = new std::mutex();
+  return *m;
+}
+struct LiveSample {
+  size_t weight;  // bytes this sample REPRESENTS (>= its own size)
+  const StackKey* stack;  // interned key owned by stats map
+};
+std::unordered_map<void*, LiveSample>& live_map() {
+  static auto* m = new std::unordered_map<void*, LiveSample>();
+  return *m;
+}
+std::unordered_map<StackKey, HeapStat, StackKeyHash>& heap_stats() {
+  static auto* m =
+      new std::unordered_map<StackKey, HeapStat, StackKeyHash>();
+  return *m;
+}
+
+// tcmalloc-style per-thread countdown: sample when it crosses zero.
+thread_local int64_t t_countdown = 0;
+
+// --- contention state ------------------------------------------------------
+
+struct ContStat {
+  int64_t wait_ns = 0;
+  int64_t count = 0;
+};
+
+std::mutex& cont_mu() {
+  static std::mutex* m = new std::mutex();
+  return *m;
+}
+std::unordered_map<StackKey, ContStat, StackKeyHash>& cont_stats() {
+  static auto* m =
+      new std::unordered_map<StackKey, ContStat, StackKeyHash>();
+  return *m;
+}
+std::atomic<int64_t> g_cont_sampled{0}, g_cont_seen{0};
+std::atomic<bool> g_cont_on{true};
+int64_t g_cont_reset_us = 0;
+thread_local uint32_t t_cont_tick = 0;
+
+std::string fold_symbolized(const std::vector<std::pair<StackKey, int64_t>>&
+                                rows) {
+  // stable human-readable tail: "leaf;...;root value" lines, like the
+  // CPU profiler's folded output (portal flamegraphs reuse the parser)
+  std::map<void*, std::string> syms;
+  for (const auto& r : rows) {
+    for (int i = 0; i < r.first.depth; ++i) {
+      syms.emplace(r.first.frames[i], std::string());
+    }
+  }
+  for (auto& kv : syms) {
+    char buf[256];
+    size_t n = profiler_symbolize(kv.first, buf, sizeof(buf));
+    kv.second.assign(buf, n);
+  }
+  std::string out;
+  for (const auto& r : rows) {
+    for (int i = 0; i < r.first.depth; ++i) {
+      if (i > 0) {
+        out += ';';
+      }
+      out += syms[r.first.frames[i]];
+    }
+    char tail[32];
+    snprintf(tail, sizeof(tail), " %lld\n", (long long)r.second);
+    out += tail;
+  }
+  return out;
+}
+
+}  // namespace
+
+void heap_profiler_enable(int64_t interval_bytes) {
+  std::lock_guard<std::mutex> lk(heap_mu());
+  if (interval_bytes > 0) {
+    g_interval.store(interval_bytes, std::memory_order_release);
+  } else {
+    g_interval.store(0, std::memory_order_release);
+    live_map().clear();
+    heap_stats().clear();
+  }
+}
+
+bool heap_profiler_enabled() {
+  return g_interval.load(std::memory_order_acquire) > 0;
+}
+
+void heap_record_alloc(void* p, size_t sz) {
+  int64_t interval = g_interval.load(std::memory_order_acquire);
+  if (interval <= 0 || p == nullptr) {
+    return;
+  }
+  t_countdown -= (int64_t)sz;
+  if (t_countdown > 0) {
+    return;
+  }
+  // this allocation is the sample; it stands for ~interval bytes (or
+  // itself, if larger — jumbo allocations self-represent)
+  t_countdown = interval;
+  size_t weight = sz > (size_t)interval ? sz : (size_t)interval;
+  StackKey key;
+  if (capture_stack(&key) == 0) {
+    return;
+  }
+  std::lock_guard<std::mutex> lk(heap_mu());
+  auto [it, ignored] = heap_stats().try_emplace(key);
+  HeapStat& st = it->second;
+  st.live_bytes += (int64_t)weight;
+  st.live_count += 1;
+  st.total_bytes += (int64_t)weight;
+  st.total_count += 1;
+  live_map()[p] = LiveSample{weight, &it->first};
+}
+
+void heap_record_free(void* p) {
+  if (g_interval.load(std::memory_order_acquire) <= 0 || p == nullptr) {
+    return;
+  }
+  std::lock_guard<std::mutex> lk(heap_mu());
+  auto it = live_map().find(p);
+  if (it == live_map().end()) {
+    return;  // unsampled (the common case)
+  }
+  auto st = heap_stats().find(*it->second.stack);
+  if (st != heap_stats().end()) {
+    st->second.live_bytes -= (int64_t)it->second.weight;
+    st->second.live_count -= 1;
+  }
+  live_map().erase(it);
+}
+
+size_t heap_profiler_dump(bool growth, char** out) {
+  *out = nullptr;
+  int64_t interval = g_interval.load(std::memory_order_acquire);
+  std::string text;
+  int64_t tot_count = 0, tot_bytes = 0, all_count = 0, all_bytes = 0;
+  std::vector<std::pair<StackKey, int64_t>> rows;
+  {
+    std::lock_guard<std::mutex> lk(heap_mu());
+    for (const auto& [key, st] : heap_stats()) {
+      tot_count += st.live_count;
+      tot_bytes += st.live_bytes;
+      all_count += st.total_count;
+      all_bytes += st.total_bytes;
+    }
+    char hdr[160];
+    snprintf(hdr, sizeof(hdr),
+             "heap profile: %lld: %lld [%lld: %lld] @ %s/%lld\n",
+             (long long)(growth ? all_count : tot_count),
+             (long long)(growth ? all_bytes : tot_bytes),
+             (long long)all_count, (long long)all_bytes,
+             growth ? "growth" : "heap", (long long)interval);
+    text += hdr;
+    for (const auto& [key, st] : heap_stats()) {
+      int64_t count = growth ? st.total_count : st.live_count;
+      int64_t bytes = growth ? st.total_bytes : st.live_bytes;
+      if (count <= 0 && bytes <= 0) {
+        continue;
+      }
+      char line[160];
+      snprintf(line, sizeof(line), "%10lld: %10lld [%10lld: %10lld] @",
+               (long long)count, (long long)bytes,
+               (long long)st.total_count, (long long)st.total_bytes);
+      text += line;
+      for (int i = 0; i < key.depth; ++i) {
+        char a[24];
+        snprintf(a, sizeof(a), " %p", key.frames[i]);
+        text += a;
+      }
+      text += '\n';
+      rows.emplace_back(key, bytes);
+    }
+  }
+  text += growth ? "\n# symbolized (cumulative bytes)\n"
+                 : "\n# symbolized (live bytes)\n";
+  text += fold_symbolized(rows);
+  size_t n = 0;
+  *out = profiler_text_dup(text.data(), text.size(), &n);
+  return n;
+}
+
+void heap_profiler_free(char* p) { profiler_free(p); }
+
+// --- contention ------------------------------------------------------------
+
+void contention_profiler_set(bool on) {
+  g_cont_on.store(on, std::memory_order_release);
+}
+
+void contention_sample(int64_t wait_ns) {
+  if (!g_cont_on.load(std::memory_order_acquire)) {
+    return;
+  }
+  g_cont_seen.fetch_add(1, std::memory_order_relaxed);
+  // rate limit: every 61st contended acquisition, plus every wait that
+  // is long enough to matter on its own
+  if (++t_cont_tick % 61 != 0 && wait_ns < 1000000) {
+    return;
+  }
+  StackKey key;
+  if (capture_stack(&key) == 0) {
+    return;
+  }
+  g_cont_sampled.fetch_add(1, std::memory_order_relaxed);
+  std::lock_guard<std::mutex> lk(cont_mu());
+  if (g_cont_reset_us == 0) {
+    g_cont_reset_us = monotonic_us();
+  }
+  ContStat& st = cont_stats()[key];
+  st.wait_ns += wait_ns;
+  st.count += 1;
+}
+
+size_t contention_dump(char** out) {
+  *out = nullptr;
+  std::string text = "--- contention ---\ncycles/second = 1000000000\n";
+  std::vector<std::pair<StackKey, int64_t>> rows;
+  {
+    std::lock_guard<std::mutex> lk(cont_mu());
+    // every wait >= 1ms records unconditionally, so the EFFECTIVE
+    // period is seen/sampled — report it and the true discarded count
+    int64_t seen = g_cont_seen.load(std::memory_order_relaxed);
+    int64_t sampled = g_cont_sampled.load(std::memory_order_relaxed);
+    char hdr[160];
+    snprintf(hdr, sizeof(hdr),
+             "sampling period = %lld\nms since reset = %lld\n"
+             "discarded samples = %lld\n",
+             sampled > 0 ? (long long)(seen / sampled) : 1LL,
+             g_cont_reset_us == 0
+                 ? 0LL
+                 : (long long)((monotonic_us() - g_cont_reset_us) / 1000),
+             (long long)(seen - sampled));
+    text += hdr;
+    for (const auto& [key, st] : cont_stats()) {
+      char line[64];
+      snprintf(line, sizeof(line), "%lld %lld @", (long long)st.wait_ns,
+               (long long)st.count);
+      text += line;
+      for (int i = 0; i < key.depth; ++i) {
+        char a[24];
+        snprintf(a, sizeof(a), " %p", key.frames[i]);
+        text += a;
+      }
+      text += '\n';
+      rows.emplace_back(key, st.wait_ns);
+    }
+  }
+  text += "\n# symbolized (total wait ns)\n";
+  text += fold_symbolized(rows);
+  size_t n = 0;
+  *out = profiler_text_dup(text.data(), text.size(), &n);
+  return n;
+}
+
+}  // namespace trpc

@@ -1,0 +1,103 @@
+// heap_profiler.h — sampled allocation profiler + contention stack
+// sampler (capability of the reference's tcmalloc-backed /pprof/heap +
+// /pprof/growth, builtin/pprof_service.h:38, hotspots_service.cpp:1240,
+// and the bthread contention profiler's sampled lock-wait stacks,
+// mutex.cpp:62-150 — re-designed: instead of interposing the global
+// allocator, the framework samples at its own allocation seams, which is
+// where an RPC/tensor framework's bytes actually live: IOBuf blocks,
+// pool slabs, DMA landing zones).
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+namespace trpc {
+
+// --- heap sampling ---------------------------------------------------------
+
+// Enable sampling: roughly one sample per `interval_bytes` allocated
+// (tcmalloc-style per-thread countdown; 0 disables and clears).  Cheap
+// when off: one relaxed load per seam hit.
+void heap_profiler_enable(int64_t interval_bytes);
+bool heap_profiler_enabled();
+
+// Seam hooks (called by IOBlock::New/Unref, pool slabs, DMA zones, ...).
+void heap_record_alloc(void* p, size_t sz);
+void heap_record_free(void* p);
+
+// Dump LIVE sampled allocations ("heap") or CUMULATIVE since enable
+// ("growth") in pprof heap text format with a symbolized folded section
+// appended.  Malloc'd; caller frees via heap_profiler_free.
+size_t heap_profiler_dump(bool growth, char** out);
+void heap_profiler_free(char* p);
+
+// --- contention sampling ---------------------------------------------------
+
+// Record one contended acquisition that waited `wait_ns` (rate-limited
+// internally; call unconditionally from lock slow paths).
+void contention_sample(int64_t wait_ns);
+
+// Default ON (the sampler is cheap: 1/61 of contended acquisitions plus
+// >=1ms waits); off turns contention_sample into one atomic load.
+void contention_profiler_set(bool on);
+
+// pprof "--- contention ---" text + symbolized folded section.
+size_t contention_dump(char** out);
+
+// malloc/free with the sampling hooks attached — for seams whose memory
+// is raw malloc'd (DMA landing zones, staging buffers).
+inline void* hp_malloc(size_t sz) {
+  void* p = __builtin_malloc(sz);
+  if (heap_profiler_enabled()) {
+    heap_record_alloc(p, sz);
+  }
+  return p;
+}
+inline void hp_free(void* p) {
+  if (heap_profiler_enabled()) {
+    heap_record_free(p);
+  }
+  __builtin_free(p);
+}
+
+}  // namespace trpc
+
+#include <mutex>
+
+#include "common.h"
+#include "metrics.h"
+
+namespace trpc {
+
+// Drop-in std::mutex with contention stacks: the uncontended path is one
+// try_lock (same CAS as lock); a contended acquisition records its wait
+// into the native counters and the sampled stack profile.  Adopted at
+// the hot native sites so /pprof/contention shows WHERE the core
+// contends, not just that it does (≙ bthread's contention profiler
+// wrapping mutex acquisition, mutex.cpp:62-150).
+class ProfiledMutex {
+ public:
+  void lock() {
+    if (mu_.try_lock()) {
+      return;
+    }
+    NativeMetrics& nm = native_metrics();
+    nm.mutex_contended.fetch_add(1, std::memory_order_relaxed);
+    int64_t t0 = monotonic_ns();
+    mu_.lock();
+    int64_t waited = monotonic_ns() - t0;
+    nm.mutex_wait_ns.fetch_add((uint64_t)waited,
+                               std::memory_order_relaxed);
+    contention_sample(waited);
+    // not a tail call: the caller's frame must survive into the sampled
+    // stack, or the contended SITE vanishes from the profile
+    asm volatile("");
+  }
+  bool try_lock() { return mu_.try_lock(); }
+  void unlock() { mu_.unlock(); }
+
+ private:
+  std::mutex mu_;
+};
+
+}  // namespace trpc

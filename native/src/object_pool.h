@@ -8,6 +8,7 @@
 #include <vector>
 
 #include "common.h"
+#include "heap_profiler.h"
 
 namespace trpc {
 
@@ -205,6 +206,11 @@ class ResourcePool {
       return UINT32_MAX;
     }
     T* slab = new T[kSlabSize];
+    // slabs are immortal: the heap profiler shows them as permanently
+    // live bytes attributed to the pool's first grower
+    if (heap_profiler_enabled()) {
+      heap_record_alloc(slab, sizeof(T) * kSlabSize);
+    }
     slabs()[slab_idx].store(slab, std::memory_order_release);
     nslab() = slab_idx + 1;
     uint32_t base = slab_idx << kSlabBits;

@@ -181,17 +181,24 @@ size_t profiler_stop(char** out) {
              (unsigned long long)dropped);
     text += note;
   }
-  char* mem = (char*)malloc(text.size() + 1);
-  if (mem == nullptr) {
-    return 0;
-  }
-  memcpy(mem, text.data(), text.size());
-  mem[text.size()] = '\0';
-  *out = mem;
-  return text.size();
+  size_t n2 = 0;
+  *out = profiler_text_dup(text.data(), text.size(), &n2);
+  return n2;
 }
 
 void profiler_free(char* p) { free(p); }
+
+char* profiler_text_dup(const char* data, size_t len, size_t* len_out) {
+  char* mem = (char*)malloc(len + 1);
+  if (mem == nullptr) {
+    *len_out = 0;
+    return nullptr;
+  }
+  memcpy(mem, data, len);
+  mem[len] = '\0';
+  *len_out = len;
+  return mem;
+}
 
 bool profiler_running() {
   return g_running.load(std::memory_order_acquire);

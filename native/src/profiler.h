@@ -30,4 +30,9 @@ bool profiler_running();
 // Returns bytes written ("0x..." hex fallback when unknown).
 size_t profiler_symbolize(const void* addr, char* buf, size_t cap);
 
+// Shared dump-text seam: malloc + copy + NUL (every profiler dump —
+// CPU, heap, contention — returns text on this contract; freed with
+// profiler_free).
+char* profiler_text_dup(const char* data, size_t len, size_t* len_out);
+
 }  // namespace trpc

@@ -32,6 +32,7 @@
 #include "stream.h"
 #include "timer_thread.h"
 #include "tls.h"
+#include "heap_profiler.h"
 #include "tpu.h"
 
 namespace trpc {
@@ -362,17 +363,17 @@ std::atomic<int64_t> g_usercode_max_inflight{4096};
 // unregister: a canceller that finds the token sets the flag BEFORE the
 // version can bump (respond unregisters first, bumps after), so the flag
 // can never land on a recycled slot's next occupant.
-std::mutex g_cancel_mu;
+ProfiledMutex g_cancel_mu;
 std::unordered_map<SocketId, std::unordered_map<uint64_t, uint64_t>>
     g_inflight_calls;
 
 void RegisterInflight(SocketId sid, uint64_t corr, uint64_t token) {
-  std::lock_guard<std::mutex> lk(g_cancel_mu);
+  std::lock_guard lk(g_cancel_mu);
   g_inflight_calls[sid][corr] = token;
 }
 
 void UnregisterInflight(SocketId sid, uint64_t corr) {
-  std::lock_guard<std::mutex> lk(g_cancel_mu);
+  std::lock_guard lk(g_cancel_mu);
   auto it = g_inflight_calls.find(sid);
   if (it == g_inflight_calls.end()) {
     return;
@@ -400,7 +401,7 @@ void MarkCanceledLocked(uint64_t token) {
 
 // A cancel notice (meta flags bit1) arrived for (sid, corr).
 void CancelInflight(SocketId sid, uint64_t corr) {
-  std::lock_guard<std::mutex> lk(g_cancel_mu);
+  std::lock_guard lk(g_cancel_mu);
   auto it = g_inflight_calls.find(sid);
   if (it == g_inflight_calls.end()) {
     return;
@@ -420,7 +421,7 @@ void CancelInflight(SocketId sid, uint64_t corr) {
 // (the peer can never receive the response — ≙ NotifyOnCancel firing on
 // client disconnect).
 void CancelAllOnSocket(SocketId sid) {
-  std::lock_guard<std::mutex> lk(g_cancel_mu);
+  std::lock_guard lk(g_cancel_mu);
   auto it = g_inflight_calls.find(sid);
   if (it == g_inflight_calls.end()) {
     return;
@@ -460,7 +461,7 @@ class UsercodePool {
     nm.usercode_submitted.fetch_add(1, std::memory_order_relaxed);
     nm.usercode_queue_depth.fetch_add(1, std::memory_order_relaxed);
     {
-      std::lock_guard<std::mutex> lk(mu_);
+      std::lock_guard lk(mu_);
       q_.push_back(ctx);
     }
     cv_.notify_one();
@@ -487,7 +488,7 @@ class UsercodePool {
 
   void Run() {
     NativeMetrics& nm = native_metrics();
-    std::unique_lock<std::mutex> lk(mu_);
+    std::unique_lock lk(mu_);
     while (true) {
       cv_.wait(lk, [this] { return !q_.empty(); });
       CallCtx* ctx = q_.front();
@@ -583,7 +584,7 @@ void PaAbort(uint64_t pa_token);         // idem — dead conn, wake writers
 
 struct ConnState {
   HttpParseState http;  // chunked-body resume state
-  std::mutex mu;
+  ProfiledMutex mu;  // hot: per-request pipeline sequencing
   uint64_t next_dispatch = 0;  // seq assigned to the next parsed request
   uint64_t next_release = 0;   // seq whose response may be written next
   bool parse_capped = false;   // parser paused at kMaxPipelined in flight
@@ -644,7 +645,7 @@ void ReleaseSequencedEntry(Socket* s, uint64_t seq,
   ConnState* cs = (ConnState*)s->parse_state;
   NativeMetrics& nm = native_metrics();
   bool rearm = false;
-  std::unique_lock<std::mutex> lk(cs->mu);
+  std::unique_lock lk(cs->mu);
   if (cs->closing) {
     // connection is winding down; drop queued responses — but a dropped
     // progressive open must still release its writers
@@ -776,7 +777,7 @@ void DispatchHttp(Socket* s, Server* srv, HttpRequest&& req) {
   ConnState* cs = GetConnState(s);
   uint64_t seq;
   {
-    std::lock_guard<std::mutex> lk(cs->mu);
+    std::lock_guard lk(cs->mu);
     seq = cs->next_dispatch++;
   }
   if (srv->http_cb == nullptr || !srv->running.load(std::memory_order_acquire)) {
@@ -983,7 +984,7 @@ void ServerOnMessages(Socket* s) {
         // replies release in command order through the sequencer
         ConnState* cs = GetConnState(s);
         {
-          std::lock_guard<std::mutex> lk(cs->mu);
+          std::lock_guard lk(cs->mu);
           if (cs->next_dispatch - cs->next_release >= kMaxPipelined) {
             cs->parse_capped = true;
             break;
@@ -1003,7 +1004,7 @@ void ServerOnMessages(Socket* s) {
           err.append("-ERR server is stopping\r\n", 25);
           uint64_t seq;
           {
-            std::lock_guard<std::mutex> lk(cs->mu);
+            std::lock_guard lk(cs->mu);
             seq = cs->next_dispatch++;
           }
           ReleaseSequenced(s, seq, std::move(err), false);
@@ -1027,7 +1028,7 @@ void ServerOnMessages(Socket* s) {
           }
           uint64_t seq;
           {
-            std::lock_guard<std::mutex> lk(cs->mu);
+            std::lock_guard lk(cs->mu);
             seq = cs->next_dispatch++;
           }
           ReleaseSequenced(s, seq, std::move(reply), false);
@@ -1052,7 +1053,7 @@ void ServerOnMessages(Socket* s) {
         rctx->req_stream_window = 0;
         rctx->accepted_stream = 0;
         {
-          std::lock_guard<std::mutex> lk(cs->mu);
+          std::lock_guard lk(cs->mu);
           rctx->pipe_seq = cs->next_dispatch++;
         }
         rctx->rcb = srv->redis_cb;
@@ -1099,7 +1100,7 @@ void ServerOnMessages(Socket* s) {
         }
         ConnState* tcs = GetConnState(s);
         {
-          std::lock_guard<std::mutex> lk(tcs->mu);
+          std::lock_guard lk(tcs->mu);
           if (tcs->next_dispatch - tcs->next_release >= kMaxPipelined) {
             tcs->parse_capped = true;
             break;
@@ -1133,7 +1134,7 @@ void ServerOnMessages(Socket* s) {
         tctx->req_stream_window = 0;
         tctx->accepted_stream = 0;
         {
-          std::lock_guard<std::mutex> lk(tcs->mu);
+          std::lock_guard lk(tcs->mu);
           tctx->pipe_seq = tcs->next_dispatch++;
         }
         tctx->rcb = srv->thrift_cb;
@@ -1169,7 +1170,7 @@ void ServerOnMessages(Socket* s) {
           // magic matched: this connection's bytes belong to `up` now
           ConnState* ucs = GetConnState(s);
           {
-            std::lock_guard<std::mutex> lk(ucs->mu);
+            std::lock_guard lk(ucs->mu);
             if (ucs->next_dispatch - ucs->next_release >= kMaxPipelined) {
               ucs->parse_capped = true;
               waiting = true;
@@ -1238,7 +1239,7 @@ void ServerOnMessages(Socket* s) {
           uctx->req_stream_window = 0;
           uctx->accepted_stream = 0;
           {
-            std::lock_guard<std::mutex> lk(ucs->mu);
+            std::lock_guard lk(ucs->mu);
             uctx->pipe_seq = ucs->next_dispatch++;
           }
           uctx->rcb = (RedisHandlerCb)up.handler;
@@ -1261,7 +1262,7 @@ void ServerOnMessages(Socket* s) {
       }
       ConnState* hcs = GetConnState(s);
       {
-        std::lock_guard<std::mutex> lk(hcs->mu);
+        std::lock_guard lk(hcs->mu);
         if (hcs->next_dispatch - hcs->next_release >= kMaxPipelined) {
           hcs->parse_capped = true;
           break;
@@ -1501,7 +1502,7 @@ void ServerAdoptConnection(Server* srv, int fd) {
     return;
   }
   {
-    std::lock_guard<std::mutex> lk(srv->conns_mu);
+    std::lock_guard lk(srv->conns_mu);
     srv->conns[id] = true;
     // amortized prune of fully-recycled ids so a long-lived server's
     // table tracks live connections, not history
@@ -1695,7 +1696,7 @@ int server_set_tls(Server* s, const char* cert_file, const char* key_file,
 size_t server_conn_stats(Server* s, char* buf, size_t cap) {
   std::vector<SocketId> conns;
   {
-    std::lock_guard<std::mutex> lk(s->conns_mu);
+    std::lock_guard lk(s->conns_mu);
     for (auto& kv : s->conns) {
       conns.push_back(kv.first);
     }
@@ -1875,7 +1876,7 @@ void server_destroy(Server* s) {
   // Server* through socket->user)
   std::vector<SocketId> conns;
   {
-    std::lock_guard<std::mutex> lk(s->conns_mu);
+    std::lock_guard lk(s->conns_mu);
     for (auto& kv : s->conns) {
       conns.push_back(kv.first);
     }
@@ -2462,7 +2463,7 @@ void HttpPendingUnref(HttpPending* p) {
 }
 
 struct ClientConn {
-  std::mutex sweep_mu;
+  ProfiledMutex sweep_mu;  // hot: linked/unlinked around every call
   PendingCall* sweep_head = nullptr;
   SocketId sock = INVALID_SOCKET_ID;
   std::string map_key;            // nonempty: registered in the SocketMap
@@ -2488,7 +2489,7 @@ struct ClientConn {
   HttpRespParseState hst;
 
   void SweepLink(PendingCall* pc) {
-    std::lock_guard<std::mutex> lk(sweep_mu);
+    std::lock_guard lk(sweep_mu);
     pc->sweep_prev = nullptr;
     pc->sweep_next = sweep_head;
     if (sweep_head != nullptr) {
@@ -2499,7 +2500,7 @@ struct ClientConn {
   }
 
   void SweepUnlink(PendingCall* pc) {
-    std::lock_guard<std::mutex> lk(sweep_mu);
+    std::lock_guard lk(sweep_mu);
     if (!pc->linked) {
       return;  // the failure sweep already detached it
     }
@@ -2565,7 +2566,7 @@ void ClientConnFailed(Socket* s) {
     // HTTP pendings complete with a connection error (FIFO order moot now)
     std::deque<HttpPending*> q;
     {
-      std::lock_guard<std::mutex> lk(conn->http_mu);
+      std::lock_guard lk(conn->http_mu);
       q.swap(conn->http_q);
     }
     for (HttpPending* p : q) {
@@ -2577,7 +2578,7 @@ void ClientConnFailed(Socket* s) {
     }
   }
   if (!conn->map_key.empty()) {
-    std::lock_guard<std::mutex> lk(g_socket_map_mu);
+    std::lock_guard lk(g_socket_map_mu);
     SocketMapEntry* e = g_socket_map.find(conn->map_key);
     if (e != nullptr && e->conn == conn) {
       // keep the entry (and its channel_refs!) so attached channels'
@@ -2590,7 +2591,7 @@ void ClientConnFailed(Socket* s) {
     // are not in the list; their release sees the failed socket)
     Channel* ch = conn->pool_owner;
     SocketId sid = conn->sock;
-    std::lock_guard<std::mutex> lk(ch->pool_mu);
+    std::lock_guard lk(ch->pool_mu);
     auto& v = ch->pool_free;
     for (size_t i = 0; i < v.size(); ++i) {
       if (v[i] == sid) {
@@ -2605,7 +2606,7 @@ void ClientConnFailed(Socket* s) {
   // connection in between must not be spuriously failed
   std::vector<std::pair<PendingCall*, uint64_t>> mine;
   {
-    std::lock_guard<std::mutex> lk(conn->sweep_mu);
+    std::lock_guard lk(conn->sweep_mu);
     for (PendingCall* p = conn->sweep_head; p != nullptr;
          p = p->sweep_next) {
       p->linked = false;
@@ -2727,7 +2728,7 @@ void HttpClientOnMessages(Socket* s) {
     // trampoline it points at) while we parse
     HttpPending* head = nullptr;
     {
-      std::lock_guard<std::mutex> lk(conn->http_mu);
+      std::lock_guard lk(conn->http_mu);
       if (!conn->http_q.empty()) {
         head = conn->http_q.front();
         head->refs.fetch_add(1, std::memory_order_acq_rel);
@@ -2765,7 +2766,7 @@ void HttpClientOnMessages(Socket* s) {
     }
     bool deliver = false;
     {
-      std::lock_guard<std::mutex> lk(conn->http_mu);
+      std::lock_guard lk(conn->http_mu);
       if (!conn->http_q.empty() && conn->http_q.front() == head) {
         conn->http_q.pop_front();
         deliver = true;
@@ -2910,7 +2911,7 @@ Socket* DialConn(Channel* c, int* rc_out) {
     // teardown bookkeeping (single-type teardown goes through the
     // SocketMap instead); prune recycled ids so a long-lived short-type
     // channel doesn't accumulate one entry per call
-    std::lock_guard<std::mutex> lk(c->pool_mu);
+    std::lock_guard lk(c->pool_mu);
     if (c->all_socks.size() >= 64 &&
         (c->all_socks.size() & (c->all_socks.size() - 1)) == 0) {
       std::vector<SocketId> live;
@@ -2952,11 +2953,11 @@ Socket* AcquireSingle(Channel* c, int* rc_out) {
       s->Dereference();
     }
   }
-  std::lock_guard<std::mutex> lk(c->conn_mu);
+  std::lock_guard lk(c->conn_mu);
   std::string key = SocketMapKeyOf(c);
   {
     // another channel (or a previous call) may have a live entry
-    std::lock_guard<std::mutex> mlk(g_socket_map_mu);
+    std::lock_guard mlk(g_socket_map_mu);
     SocketMapEntry* me = g_socket_map.find(key);
     if (me != nullptr && me->conn != nullptr) {
       SocketId sid = me->conn->sock;
@@ -2989,7 +2990,7 @@ Socket* AcquireSingle(Channel* c, int* rc_out) {
   // g_socket_map_mu (ClientConnFailed reacquires it).
   Socket* adopted = nullptr;
   {
-    std::lock_guard<std::mutex> mlk(g_socket_map_mu);
+    std::lock_guard mlk(g_socket_map_mu);
     SocketMapEntry* ep = g_socket_map.find(key);  // persists across reconnects
     if (ep == nullptr) {
       ep = g_socket_map.insert(key, SocketMapEntry());
@@ -3034,7 +3035,7 @@ Socket* AcquirePooled(Channel* c, int* rc_out) {
   while (true) {
     SocketId sid = INVALID_SOCKET_ID;
     {
-      std::lock_guard<std::mutex> lk(c->pool_mu);
+      std::lock_guard lk(c->pool_mu);
       if (!c->pool_free.empty()) {
         sid = c->pool_free.back();
         c->pool_free.pop_back();
@@ -3067,7 +3068,7 @@ Socket* AcquirePooled(Channel* c, int* rc_out) {
 // see failed and never park it — a dead id can't linger in the list
 // (and even if one did, AcquirePooled's Address check drops it safely).
 void ReleasePooled(Channel* c, Socket* s) {
-  std::lock_guard<std::mutex> lk(c->pool_mu);
+  std::lock_guard lk(c->pool_mu);
   if (s->failed.load(std::memory_order_acquire) ||
       ((ClientConn*)s->user)->closing.load(std::memory_order_acquire)) {
     return;  // broken or about to close: never park it
@@ -3150,9 +3151,9 @@ void channel_destroy(Channel* c) {
   bool fail_single = false;
   SocketId single_sid = INVALID_SOCKET_ID;
   {
-    std::lock_guard<std::mutex> lk(c->conn_mu);
+    std::lock_guard lk(c->conn_mu);
     if (c->map_attached) {
-      std::lock_guard<std::mutex> mlk(g_socket_map_mu);
+      std::lock_guard mlk(g_socket_map_mu);
       SocketMapEntry* de = g_socket_map.find(c->map_key);
       if (de != nullptr && --de->channel_refs <= 0) {
         if (de->conn != nullptr) {
@@ -3174,7 +3175,7 @@ void channel_destroy(Channel* c) {
       socks.push_back(single_sid);
     }
   } else {
-    std::lock_guard<std::mutex> lk(c->pool_mu);
+    std::lock_guard lk(c->pool_mu);
     socks = c->all_socks;
   }
   for (SocketId sid : socks) {
@@ -3489,7 +3490,7 @@ int http_client_call(Channel* c, const char* method, const char* target,
   // ClientConnFailed, which needs http_mu).
   bool self_fail = false;
   {
-    std::unique_lock<std::mutex> lk(conn->http_mu);
+    std::unique_lock lk(conn->http_mu);
     conn->http_q.push_back(p);
     conn->http_out.push_back(std::move(frame));
     if (!conn->http_writer) {
@@ -3607,7 +3608,7 @@ void BenchWorker(void* p) {
     }
   }
   {
-    std::lock_guard<std::mutex> lk(sh->lat_mu);
+    std::lock_guard lk(sh->lat_mu);
     sh->latencies.insert(sh->latencies.end(), lat.begin(), lat.end());
   }
   delete a;

@@ -19,6 +19,7 @@
 #include "common.h"
 #include "fiber.h"
 #include "object_pool.h"
+#include "heap_profiler.h"
 #include "rpc.h"
 #include "tpu.h"
 
@@ -68,7 +69,7 @@ struct Stream {
   uint32_t slot = 0;
   std::atomic<uint32_t> version{1};
 
-  std::mutex mu;
+  ProfiledMutex mu;  // hot: every frame/read/write; contention-profiled
   SocketId sock = INVALID_SOCKET_ID;
   uint64_t remote_id = 0;
   uint64_t window = kDefaultWindow;       // our receive window (advertised)
@@ -109,12 +110,12 @@ std::mutex g_sock_streams_mu;
 std::unordered_map<SocketId, std::vector<StreamHandle>> g_sock_streams;
 
 void register_on_socket(SocketId sid, StreamHandle h) {
-  std::lock_guard<std::mutex> lk(g_sock_streams_mu);
+  std::lock_guard lk(g_sock_streams_mu);
   g_sock_streams[sid].push_back(h);
 }
 
 void unregister_on_socket(SocketId sid, StreamHandle h) {
-  std::lock_guard<std::mutex> lk(g_sock_streams_mu);
+  std::lock_guard lk(g_sock_streams_mu);
   auto it = g_sock_streams.find(sid);
   if (it == g_sock_streams.end()) {
     return;
@@ -235,7 +236,7 @@ void RunStreamSend(void*, void* targ) {
 StreamHandle stream_create(uint64_t window_bytes) {
   Stream* st = nullptr;
   uint32_t slot = ResourcePool<Stream>::Get(&st);
-  std::lock_guard<std::mutex> lk(st->mu);
+  std::lock_guard lk(st->mu);
   st->slot = slot;
   if (st->ack_butex == nullptr) {
     st->ack_butex = butex_create();
@@ -746,7 +747,7 @@ void StreamHandleFrame(Socket* s, const RpcMeta& meta, IOBuf&& payload) {
 void StreamsOnSocketFailed(SocketId sid) {
   std::vector<StreamHandle> handles;
   {
-    std::lock_guard<std::mutex> lk(g_sock_streams_mu);
+    std::lock_guard lk(g_sock_streams_mu);
     auto it = g_sock_streams.find(sid);
     if (it == g_sock_streams.end()) {
       return;

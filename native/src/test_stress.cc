@@ -34,6 +34,7 @@
 #include "iobuf.h"
 #include "rpc.h"
 #include "h2.h"
+#include "heap_profiler.h"
 #include "stream.h"
 #include "tpu.h"
 #include "uring.h"
@@ -1055,6 +1056,82 @@ static void test_stream_device_races() {
          (unsigned long long)wfail.load());
 }
 
+// --- 14. profiler races ------------------------------------------------------
+// The sampled heap profiler's maps race allocation seams on every
+// thread, enable(0) clears them mid-flight, dumps walk them concurrently,
+// and the contention sampler hammers its global mutex from contended
+// locks — all of it must hold under TSAN/ASAN.
+static void test_profiler_races() {
+  std::atomic<bool> stop{false};
+  std::atomic<uint64_t> blocks{0}, dumps{0};
+  std::vector<std::thread> ts;
+  for (int t = 0; t < 3; ++t) {
+    ts.emplace_back([&]() {  // IOBlock churn through the sampled seam
+      std::vector<IOBlock*> held;
+      while (!stop.load(std::memory_order_acquire)) {
+        IOBlock* b = IOBlock::New(4096);
+        held.push_back(b);
+        if (held.size() >= 32) {
+          for (IOBlock* h : held) {
+            h->Unref();
+          }
+          held.clear();
+        }
+        blocks.fetch_add(1, std::memory_order_relaxed);
+      }
+      for (IOBlock* h : held) {
+        h->Unref();
+      }
+    });
+  }
+  ts.emplace_back([&]() {  // toggler: enable/disable/clear under fire
+    while (!stop.load(std::memory_order_acquire)) {
+      heap_profiler_enable(2048);
+      usleep(3000);
+      heap_profiler_enable(0);  // clears live/stat maps mid-storm
+      usleep(500);
+    }
+  });
+  ts.emplace_back([&]() {  // dumper: walks the maps concurrently
+    while (!stop.load(std::memory_order_acquire)) {
+      char* out = nullptr;
+      heap_profiler_dump(fast_rand() % 2 == 0, &out);
+      heap_profiler_free(out);
+      char* cout_ = nullptr;
+      contention_dump(&cout_);
+      heap_profiler_free(cout_);
+      dumps.fetch_add(1, std::memory_order_relaxed);
+      usleep(1000);
+    }
+  });
+  {  // contended FiberMutex feeding contention_sample from many threads
+    FiberMutex mu;
+    std::vector<std::thread> fighters;
+    for (int t = 0; t < 3; ++t) {
+      fighters.emplace_back([&]() {
+        while (!stop.load(std::memory_order_acquire)) {
+          mu.lock();
+          mu.unlock();
+        }
+      });
+    }
+    usleep(1500 * 1000);
+    stop.store(true, std::memory_order_release);
+    for (auto& t : fighters) {
+      t.join();
+    }
+  }
+  for (auto& t : ts) {
+    t.join();
+  }
+  heap_profiler_enable(0);
+  CHECK_TRUE(blocks.load() > 0);
+  CHECK_TRUE(dumps.load() > 0);
+  printf("ok profiler_races blocks=%llu dumps=%llu\n",
+         (unsigned long long)blocks.load(),
+         (unsigned long long)dumps.load());
+}
+
 int main() {
   fiber_runtime_init(4);
   test_butex_churn();
@@ -1071,6 +1148,7 @@ int main() {
   test_uring_churn();
   test_tpu_plane_races();
   test_stream_device_races();
+  test_profiler_races();
   if (g_failures == 0) {
     printf("ALL STRESS TESTS PASSED\n");
     return 0;

@@ -15,6 +15,7 @@
 #include <vector>
 
 #include "fiber.h"
+#include "heap_profiler.h"
 #include "object_pool.h"
 #include "timer_thread.h"
 
@@ -506,7 +507,7 @@ void release_block_ref(void* data, void* arg) {
 }
 void release_free(void* data, void* arg) {
   (void)arg;
-  free(data);
+  hp_free(data);
 }
 }  // namespace
 
@@ -529,7 +530,7 @@ TpuBufId tpu_h2d_from_iobuf(const IOBuf& buf, int device_index) {
   }
   // multi-block: one gather into a fresh staging buffer (explicit in
   // stats — never a silent extra copy)
-  char* staging = (char*)malloc(buf.size());
+  char* staging = (char*)hp_malloc(buf.size());
   buf.copy_to(staging, buf.size());
   p.gather_copies.fetch_add(1, std::memory_order_relaxed);
   return tpu_h2d(staging, buf.size(), device_index, release_free, nullptr);
@@ -678,14 +679,14 @@ static int tpu_d2h_alloc(TpuBufId id, char** mem_out, size_t* len_out) {
     // frees the landing zone unless the caller claimed it
     static void Drop(D2hCtx* c) {
       if (c->refs.fetch_sub(1, std::memory_order_acq_rel) == 1) {
-        free(c->mem);
+        hp_free(c->mem);
         butex_destroy(c->done);
         delete c;
       }
     }
   };
   D2hCtx* ctx = new D2hCtx{butex_create()};
-  ctx->mem = (char*)malloc(len);
+  ctx->mem = (char*)hp_malloc(len);
   PJRT_Buffer_ToHostBuffer_Args args;
   memset(&args, 0, sizeof(args));
   args.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
@@ -778,7 +779,7 @@ int tpu_d2h_into_iobuf(TpuBufId id, IOBuf* out) {
   // the malloc'd landing zone becomes an IOBuf user block: the socket
   // writev sends from it with no further copies
   out->append_user_data(
-      mem, len, [](void* d, void*) { free(d); }, nullptr);
+      mem, len, [](void* d, void*) { hp_free(d); }, nullptr);
   return 0;
 }
 

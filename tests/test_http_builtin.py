@@ -617,3 +617,28 @@ class TestVlogService:
             assert ei.value.code == 403
         finally:
             srv.destroy()
+
+
+class TestHeapAndContentionEndpoints:
+    def test_pprof_heap_and_growth(self, server):
+        # first hit arms the sampler; traffic; second hit dumps
+        body = _get(server.port, "/pprof/heap?interval=8192").read()
+        assert b"enabled" in body
+        ch = Channel(f"127.0.0.1:{server.port}")
+        big = bytes(128 * 1024)
+        for _ in range(30):
+            ch.call("Echo.echo", big)
+        ch.close()
+        try:
+            heap = _get(server.port, "/pprof/heap").read().decode()
+            growth = _get(server.port, "/pprof/growth").read().decode()
+            assert heap.startswith("heap profile:")
+            assert "trpc::" in heap.split("# symbolized", 1)[1]
+            assert growth.startswith("heap profile:")
+        finally:
+            _get(server.port, "/pprof/heap?disable=1").read()
+
+    def test_pprof_contention(self, server):
+        body = _get(server.port, "/pprof/contention").read().decode()
+        assert body.startswith("--- contention ---")
+        assert "sampling period" in body
