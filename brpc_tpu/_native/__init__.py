@@ -405,6 +405,10 @@ def _declare(L: ctypes.CDLL) -> None:
     L.trpc_contention_profiler_set.argtypes = [c.c_int]
     L.trpc_contention_profiler_set.restype = None
 
+    L.trpc_server_add_tls_sni.argtypes = [c.c_void_p, c.c_char_p,
+                                          c.c_char_p, c.c_char_p]
+    L.trpc_server_add_tls_sni.restype = c.c_int
+
     # RPC cancellation (≙ Controller::StartCancel / NotifyOnCancel)
     L.trpc_channel_call_cancelable.argtypes = [
         c.c_void_p, c.c_char_p, c.c_char_p, c.c_size_t, c.c_char_p,

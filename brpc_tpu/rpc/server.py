@@ -84,6 +84,10 @@ class ServerOptions:
     tls_cert_file: Optional[str] = None
     tls_key_file: Optional[str] = None
     tls_verify_ca: Optional[str] = None
+    # SNI certificate map (≙ ssl_options.h:30-41 sni_filters): list of
+    # (pattern, cert_file, key_file); pattern is an exact hostname or a
+    # one-label "*.domain" wildcard.  Unmatched names get the base cert.
+    tls_sni: Optional[list] = None
 
 
 class _MethodStatus:
@@ -480,6 +484,10 @@ class Server:
         if self.options.auth:
             lib().trpc_server_set_auth(self._handle, self.options.auth,
                                        len(self.options.auth))
+        if self.options.tls_sni and not self.options.tls_cert_file:
+            raise ValueError(
+                "tls_sni requires tls_cert_file/tls_key_file (the base "
+                "certificate is the fallback for unmatched SNI names)")
         if self.options.tls_cert_file:
             rc = lib().trpc_server_set_tls(
                 self._handle, self.options.tls_cert_file.encode(),
@@ -488,6 +496,15 @@ class Server:
             if rc != 0:
                 reason = (lib().trpc_tls_error() or b"").decode()
                 raise OSError(-rc, f"TLS setup failed: {reason}")
+            for pattern, cert, key in (self.options.tls_sni or ()):
+                rc = lib().trpc_server_add_tls_sni(
+                    self._handle, pattern.encode(), cert.encode(),
+                    key.encode())
+                if rc != 0:
+                    reason = (lib().trpc_tls_error() or b"").decode()
+                    raise OSError(-rc,
+                                  f"SNI cert for {pattern!r} failed: "
+                                  f"{reason}")
         unix_path = None
         if address.startswith("unix:") or address.startswith("/"):
             # unix-domain listener (≙ brpc unix-socket EndPoint): the

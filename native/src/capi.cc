@@ -345,6 +345,11 @@ void trpc_server_set_auth(void* s, const uint8_t* secret, size_t len) {
 
 int trpc_tls_available() { return tls_available() ? 1 : 0; }
 const char* trpc_tls_error() { return tls_error(); }
+int trpc_server_add_tls_sni(void* s, const char* pattern, const char* cert,
+                            const char* key) {
+  return server_add_tls_sni((Server*)s, pattern, cert, key);
+}
+
 int trpc_server_set_tls(void* s, const char* cert, const char* key,
                         const char* verify_ca) {
   return server_set_tls((Server*)s, cert, key, verify_ca);

@@ -559,6 +559,7 @@ class Server {
   // TLS handshake record (0x16) are wrapped; plaintext connections keep
   // working beside them (≙ brpc serving SSL and plain on one port)
   void* tls_ctx = nullptr;
+  std::string tls_verify_ca;  // mTLS CA, inherited by SNI sub-ctxs
   int listen_fd = -1;
   bool ring_acceptor = false;  // accepts flow through the io_uring engine
   SocketId listen_sock = INVALID_SOCKET_ID;
@@ -1677,6 +1678,25 @@ void server_set_auth(Server* s, const uint8_t* secret, size_t len) {
   s->has_auth = len > 0;
 }
 
+// SNI: map a hostname pattern to its own cert on the shared port
+// (≙ ssl_options.h:30-41 sni_filters).  Call after server_set_tls.
+int server_add_tls_sni(Server* s, const char* pattern,
+                       const char* cert_file, const char* key_file) {
+  if (s->running.load()) {
+    return -EBUSY;  // entries are read lock-free relative to the server
+  }
+  if (s->tls_ctx == nullptr) {
+    return -EINVAL;  // base TLS first
+  }
+  // mTLS carries over: the sub-ctx must verify against the same CA
+  return tls_server_ctx_add_sni(
+             s->tls_ctx, pattern, cert_file, key_file,
+             s->tls_verify_ca.empty() ? nullptr : s->tls_verify_ca.c_str())
+             == 0
+             ? 0
+             : -EPROTO;
+}
+
 int server_set_tls(Server* s, const char* cert_file, const char* key_file,
                    const char* verify_ca_file) {
   if (s->running.load()) {
@@ -1690,6 +1710,8 @@ int server_set_tls(Server* s, const char* cert_file, const char* key_file,
     tls_ctx_destroy(s->tls_ctx);
   }
   s->tls_ctx = ctx;
+  s->tls_verify_ca =
+      verify_ca_file != nullptr ? verify_ca_file : "";
   return 0;
 }
 

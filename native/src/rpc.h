@@ -158,6 +158,8 @@ void server_set_auth(Server* s, const uint8_t* secret, size_t len);
 // verification CA).  Sniffed per connection: TLS and plaintext coexist
 // on one port (tls.h ≙ ssl_options.h + ssl_helper.cpp).  0 or -errno
 // (-EPROTO: see tls_error()).
+int server_add_tls_sni(Server* s, const char* pattern,
+                       const char* cert_file, const char* key_file);
 int server_set_tls(Server* s, const char* cert_file, const char* key_file,
                    const char* verify_ca_file);
 int server_start(Server* s, const char* ip, int port);

@@ -36,6 +36,7 @@
 #include "h2.h"
 #include "heap_profiler.h"
 #include "stream.h"
+#include "tls.h"
 #include "tpu.h"
 #include "uring.h"
 
@@ -1056,6 +1057,119 @@ static void test_stream_device_races() {
          (unsigned long long)wfail.load());
 }
 
+// --- 13b. SNI handshake vs ctx teardown races --------------------------------
+// In-memory TLS handshakes (client/server TlsState pumping each other's
+// records) with random SNI names, racing tls_ctx_destroy + recreate of
+// the server ctx: servername_cb's map lookup and the destroy-time
+// clear/free must serialize (the round-5 SNI UAF window).
+static void test_sni_handshake_races() {
+  if (!tls_available()) {
+    printf("skip sni_handshake_races (no libssl)\n");
+    return;
+  }
+  const char* cert = "tests/certs/server.crt";
+  const char* key = "tests/certs/server.key";
+  if (access(cert, R_OK) != 0) {
+    printf("skip sni_handshake_races (no %s; run from repo root)\n", cert);
+    return;
+  }
+  std::atomic<void*> srv_ctx{nullptr};
+  std::atomic<bool> stop{false};
+  std::atomic<uint64_t> handshakes{0}, rebuilds{0};
+  std::atomic<int> bad{0};
+
+  auto build_ctx = [&]() -> void* {
+    void* c = tls_server_ctx_create(cert, key, nullptr);
+    if (c != nullptr) {
+      // two SNI entries reusing the same test cert: the point is the
+      // map machinery, not distinct leaves
+      tls_server_ctx_add_sni(c, "alpha.test", "tests/certs/alpha.crt",
+                             "tests/certs/alpha.key", nullptr);
+      tls_server_ctx_add_sni(c, "*.wild.test", "tests/certs/wild.crt",
+                             "tests/certs/wild.key", nullptr);
+    }
+    return c;
+  };
+  srv_ctx.store(build_ctx());
+  CHECK_TRUE(srv_ctx.load() != nullptr);
+  void* cli_ctx = tls_client_ctx_create(0, nullptr, nullptr, nullptr);
+  CHECK_TRUE(cli_ctx != nullptr);
+
+  std::vector<std::thread> ts;
+  for (int t = 0; t < 3; ++t) {
+    ts.emplace_back([&, t]() {
+      const char* names[] = {"alpha.test", "x.wild.test", "other.example"};
+      while (!stop.load(std::memory_order_acquire)) {
+        void* sc = srv_ctx.load(std::memory_order_acquire);
+        TlsState* srv = tls_state_create(sc, 0);
+        TlsState* cli = tls_state_create(cli_ctx, 1);
+        if (srv == nullptr || cli == nullptr) {
+          tls_state_free(srv);
+          tls_state_free(cli);
+          continue;  // ctx mid-teardown: acceptable, try again
+        }
+        tls_state_set_hostname(cli, names[(t + handshakes.load()) % 3]);
+        // pump client<->server through the memory BIOs until both sides
+        // report handshake completion (or a bounded round count)
+        IOBuf c2s, s2c;
+        auto emit_c = [](void* arg, IOBuf&& enc) {
+          ((IOBuf*)arg)->append(std::move(enc));
+        };
+        bool cli_done = false, srv_done = false;
+        // kick: pumping zero input drives SSL_do_handshake -> ClientHello
+        tls_pump_in(cli, nullptr, 0, nullptr, emit_c, &c2s, &cli_done);
+        for (int round = 0; round < 12 && !(cli_done && srv_done);
+             ++round) {
+          std::string bytes = c2s.to_string();
+          c2s.clear();
+          IOBuf plain;
+          if (tls_pump_in(srv, (const uint8_t*)bytes.data(), bytes.size(),
+                          &plain, emit_c, &s2c, &srv_done) != 0) {
+            break;
+          }
+          bytes = s2c.to_string();
+          s2c.clear();
+          if (tls_pump_in(cli, (const uint8_t*)bytes.data(), bytes.size(),
+                          &plain, emit_c, &c2s, &cli_done) != 0) {
+            break;
+          }
+        }
+        if (cli_done && srv_done) {
+          handshakes.fetch_add(1, std::memory_order_relaxed);
+        }
+        tls_state_free(cli);
+        tls_state_free(srv);
+      }
+    });
+  }
+  ts.emplace_back([&]() {  // teardown storm: destroy + rebuild the ctx
+    while (!stop.load(std::memory_order_acquire)) {
+      usleep(3000);
+      void* fresh = build_ctx();
+      if (fresh == nullptr) {
+        bad.fetch_add(1);
+        continue;
+      }
+      void* old = srv_ctx.exchange(fresh, std::memory_order_acq_rel);
+      usleep(1000);  // handshakes using `old` drain (bounded rounds)
+      tls_ctx_destroy(old);
+      rebuilds.fetch_add(1, std::memory_order_relaxed);
+    }
+  });
+  usleep(1500 * 1000);
+  stop.store(true, std::memory_order_release);
+  for (auto& t : ts) {
+    t.join();
+  }
+  tls_ctx_destroy(srv_ctx.load());
+  tls_ctx_destroy(cli_ctx);
+  CHECK_TRUE(bad.load() == 0);
+  CHECK_TRUE(handshakes.load() > 0);
+  printf("ok sni_handshake_races handshakes=%llu rebuilds=%llu\n",
+         (unsigned long long)handshakes.load(),
+         (unsigned long long)rebuilds.load());
+}
+
 // --- 14. profiler races ------------------------------------------------------
 // The sampled heap profiler's maps race allocation seams on every
 // thread, enable(0) clears them mid-flight, dumps walk them concurrently,
@@ -1148,6 +1262,7 @@ int main() {
   test_uring_churn();
   test_tpu_plane_races();
   test_stream_device_races();
+  test_sni_handshake_races();
   test_profiler_races();
   if (g_failures == 0) {
     printf("ALL STRESS TESTS PASSED\n");

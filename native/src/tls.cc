@@ -7,8 +7,10 @@
 #include <string.h>
 #include <unistd.h>
 
+#include <map>
 #include <mutex>
 #include <string>
+#include <vector>
 
 #include "common.h"
 
@@ -70,10 +72,90 @@ struct Ssl {
       int (*)(SSL*, const unsigned char**, unsigned char*,
               const unsigned char*, unsigned int, void*),
       void*) = nullptr;
+  // SNI plumbing (servername callback is a ctrl under the stable ABI)
+  long (*SSL_CTX_callback_ctrl)(SSL_CTX*, int, void (*)(void)) = nullptr;
+  long (*SSL_CTX_ctrl)(SSL_CTX*, int, long, void*) = nullptr;
+  const char* (*SSL_get_servername)(const SSL*, int) = nullptr;
+  SSL_CTX* (*SSL_set_SSL_CTX)(SSL*, SSL_CTX*) = nullptr;
+  long (*SSL_ctrl)(SSL*, int, long, void*) = nullptr;
 
   std::string error;
   bool up = false;
 };
+
+// OpenSSL ctrl numbers for the servername callback (stable since 0.9.8f;
+// documented in ssl.h) + the hostname extension type.
+constexpr int kSSL_CTRL_SET_TLSEXT_SERVERNAME_CB = 53;
+constexpr int kSSL_CTRL_SET_TLSEXT_SERVERNAME_ARG = 54;
+constexpr int kSSL_CTRL_SET_TLSEXT_HOSTNAME = 55;
+constexpr int kTLSEXT_NAMETYPE_host_name = 0;
+
+Ssl& ssl();  // defined below
+
+// --- SNI certificate map (≙ ssl_options.h:30-41 sni_filters +
+// details/ssl_helper.cpp mapping hostnames to certs at handshake) ----------
+
+struct SniEntry {
+  std::string pattern;  // exact name or "*.domain" (one leading label)
+  SSL_CTX* ctx = nullptr;
+};
+
+struct SniMap {
+  std::vector<SniEntry> entries;
+};
+
+std::mutex& sni_mu() {
+  static std::mutex* m = new std::mutex();
+  return *m;
+}
+// base server ctx -> its SNI map (owned; freed with the base ctx)
+std::map<SSL_CTX*, SniMap*>& sni_maps() {
+  static auto* m = new std::map<SSL_CTX*, SniMap*>();
+  return *m;
+}
+
+// hostnames are case-insensitive (RFC 6066 / DNS): compare lowercased
+bool sni_match(const std::string& pattern, const char* name) {
+  std::string lname(name);
+  for (char& c : lname) {
+    if (c >= 'A' && c <= 'Z') {
+      c += 'a' - 'A';
+    }
+  }
+  if (pattern == lname) {
+    return true;
+  }
+  // "*.example.com" matches exactly one extra leading label
+  if (pattern.size() > 2 && pattern[0] == '*' && pattern[1] == '.') {
+    size_t dot = lname.find('.');
+    return dot != std::string::npos &&
+           pattern.compare(1, std::string::npos, lname, dot,
+                           std::string::npos) == 0;
+  }
+  return false;
+}
+
+int servername_cb(SSL* ssl_conn, int*, void* arg) {
+  Ssl& s = ssl();
+  SniMap* map = (SniMap*)arg;
+  const char* name =
+      s.SSL_get_servername(ssl_conn, kTLSEXT_NAMETYPE_host_name);
+  if (name != nullptr && map != nullptr) {
+    // sni_mu held across match AND the ctx switch: a concurrent
+    // tls_ctx_destroy clears the entries and frees the sub-ctxs under
+    // the same mutex, so this either sees live entries (and the ctx ref
+    // taken by SSL_set_SSL_CTX keeps the sub-ctx alive) or none.  The
+    // map struct itself is never freed (tiny, leaked on destroy).
+    std::lock_guard<std::mutex> lk(sni_mu());
+    for (const SniEntry& e : map->entries) {
+      if (sni_match(e.pattern, name)) {
+        s.SSL_set_SSL_CTX(ssl_conn, e.ctx);
+        break;
+      }
+    }
+  }
+  return 0;  // SSL_TLSEXT_ERR_OK: no match = the base ctx's default cert
+}
 
 // ALPN selection: h2 (gRPC) preferred, then http/1.1; protocols we don't
 // know are un-acked (the client proceeds without ALPN).
@@ -196,6 +278,11 @@ bool load_ssl() {
   LOAD(ERR_get_error);
   LOAD(ERR_error_string_n);
   LOAD(SSL_CTX_set_alpn_select_cb);
+  LOAD(SSL_CTX_callback_ctrl);
+  LOAD(SSL_CTX_ctrl);
+  LOAD(SSL_get_servername);
+  LOAD(SSL_set_SSL_CTX);
+  LOAD(SSL_ctrl);
 #undef LOAD
   s.up = true;
   return true;
@@ -255,6 +342,53 @@ void* tls_server_ctx_create(const char* cert_file, const char* key_file,
   return ctx;
 }
 
+int tls_server_ctx_add_sni(void* base_ctx, const char* pattern,
+                           const char* cert_file, const char* key_file,
+                           const char* verify_ca_file) {
+  if (base_ctx == nullptr || !load_ssl()) {
+    return -1;
+  }
+  Ssl& s = ssl();
+  SSL_CTX* sub = s.SSL_CTX_new(s.TLS_server_method());
+  if (sub == nullptr) {
+    set_tls_error("SNI SSL_CTX_new: " + openssl_errors());
+    return -1;
+  }
+  if (s.SSL_CTX_use_certificate_chain_file(sub, cert_file) != 1 ||
+      s.SSL_CTX_use_PrivateKey_file(sub, key_file, kSSL_FILETYPE_PEM) != 1 ||
+      s.SSL_CTX_check_private_key(sub) != 1) {
+    set_tls_error("SNI cert/key load: " + openssl_errors());
+    s.SSL_CTX_free(sub);
+    return -1;
+  }
+  if (verify_ca_file != nullptr && verify_ca_file[0] != '\0') {
+    // OpenSSL verifies the client cert against the SWITCHED ctx's store:
+    // mTLS must carry over or SNI-matched clients would fail verify
+    if (s.SSL_CTX_load_verify_locations(sub, verify_ca_file, nullptr) != 1) {
+      set_tls_error("SNI verify CA load: " + openssl_errors());
+      s.SSL_CTX_free(sub);
+      return -1;
+    }
+    s.SSL_CTX_set_verify(
+        sub, kSSL_VERIFY_PEER | kSSL_VERIFY_FAIL_IF_NO_PEER_CERT, nullptr);
+  }
+  s.SSL_CTX_set_alpn_select_cb(sub, alpn_select_cb, nullptr);
+  std::lock_guard<std::mutex> lk(sni_mu());
+  SniMap*& map = sni_maps()[(SSL_CTX*)base_ctx];
+  if (map == nullptr) {
+    map = new SniMap();
+  }
+  // install unconditionally: a recycled ctx ADDRESS may have adopted a
+  // previous (cleared) map whose callback was set on the OLD ctx only
+  s.SSL_CTX_callback_ctrl((SSL_CTX*)base_ctx,
+                          kSSL_CTRL_SET_TLSEXT_SERVERNAME_CB,
+                          (void (*)(void))servername_cb);
+  s.SSL_CTX_ctrl((SSL_CTX*)base_ctx, kSSL_CTRL_SET_TLSEXT_SERVERNAME_ARG,
+                 0, map);
+  map->entries.push_back(SniEntry{pattern, sub});
+  return 0;
+}
+
 void* tls_client_ctx_create(int verify, const char* ca_file,
                             const char* cert_file, const char* key_file) {
   if (!load_ssl()) {
@@ -296,6 +430,23 @@ void* tls_client_ctx_create(int verify, const char* ca_file,
 
 void tls_ctx_destroy(void* ctx) {
   if (ctx != nullptr && ssl().up) {
+    {
+      // clear entries + drop our sub-ctx refs under sni_mu (an in-flight
+      // servername_cb serializes against this).  The SniMap STAYS in the
+      // registry: the base ctx's tlsext arg may still point at it from a
+      // handshake racing the destroy, and keeping it reachable also
+      // keeps LSan quiet.  If a future ctx reuses this address it simply
+      // adopts the (now empty) map.
+      std::lock_guard<std::mutex> lk(sni_mu());
+      auto it = sni_maps().find((SSL_CTX*)ctx);
+      if (it != sni_maps().end()) {
+        for (const SniEntry& e : it->second->entries) {
+          ssl().SSL_CTX_free(e.ctx);
+        }
+        it->second->entries.clear();
+        it->second->entries.shrink_to_fit();
+      }
+    }
     ssl().SSL_CTX_free((SSL_CTX*)ctx);
   }
 }
@@ -331,6 +482,19 @@ TlsState* tls_state_create(void* ctx, int role) {
     s.SSL_set_connect_state(st->conn);
   }
   return st;
+}
+
+int tls_state_set_hostname(TlsState* st, const char* hostname) {
+  // client side: send SNI (≙ ChannelSSLOptions.sni_name); required for a
+  // server's sni_filters to select a certificate
+  if (st == nullptr || hostname == nullptr || !ssl().up) {
+    return -1;
+  }
+  return ssl().SSL_ctrl(st->conn, kSSL_CTRL_SET_TLSEXT_HOSTNAME,
+                        kTLSEXT_NAMETYPE_host_name,
+                        (void*)hostname) == 1
+             ? 0
+             : -1;
 }
 
 void tls_state_free(TlsState* st) {

@@ -35,6 +35,14 @@ const char* tls_error();  // reason when unavailable / last ctx error
 // Returns an opaque ctx or nullptr (see tls_error()).
 void* tls_server_ctx_create(const char* cert_file, const char* key_file,
                             const char* verify_ca_file);
+// SNI: map `pattern` (exact hostname or "*.domain" wildcard, one label)
+// to its own cert/key on the same listening port (≙ ssl_options.h:30-41
+// sni_filters + details/ssl_helper.cpp selecting certs at handshake).
+// Unmatched names fall back to the base ctx's default cert.  Sub-ctxs
+// are freed with the base ctx.
+int tls_server_ctx_add_sni(void* base_ctx, const char* pattern,
+                           const char* cert_file, const char* key_file,
+                           const char* verify_ca_file);
 void tls_ctx_destroy(void* ctx);
 
 // Client context; verify=0 skips peer verification (tests/self-signed),
@@ -48,6 +56,9 @@ void* tls_client_ctx_create(int verify, const char* ca_file,
 struct TlsState;
 // role: 0 = server (accept), 1 = client (connect)
 TlsState* tls_state_create(void* ctx, int role);
+// Client side: request `hostname`'s certificate via SNI (call before the
+// handshake; ≙ ChannelSSLOptions.sni_name).  0 / -1.
+int tls_state_set_hostname(TlsState* st, const char* hostname);
 void tls_state_free(TlsState* st);
 
 // Ciphertext sink: called with TLS records to put on the wire.  ALWAYS
