@@ -409,6 +409,31 @@ def _declare(L: ctypes.CDLL) -> None:
                                           c.c_char_p, c.c_char_p]
     L.trpc_server_add_tls_sni.restype = c.c_int
 
+    # streaming h2/gRPC client
+    L.trpc_h2_stream_open.argtypes = [c.c_void_p, c.c_char_p, c.c_char_p,
+                                      c.c_char_p, c.POINTER(c.c_int)]
+    L.trpc_h2_stream_open.restype = c.c_void_p
+    L.trpc_h2_stream_write.argtypes = [c.c_void_p, c.c_char_p, c.c_size_t,
+                                       c.c_int64]
+    L.trpc_h2_stream_write.restype = c.c_int
+    L.trpc_h2_stream_close_send.argtypes = [c.c_void_p]
+    L.trpc_h2_stream_close_send.restype = c.c_int
+    L.trpc_h2_stream_read.argtypes = [c.c_void_p, c.c_int64,
+                                      c.POINTER(c.POINTER(c.c_uint8))]
+    L.trpc_h2_stream_read.restype = c.c_int64
+    L.trpc_h2_stream_chunk_free.argtypes = [c.POINTER(c.c_uint8)]
+    L.trpc_h2_stream_chunk_free.restype = None
+    L.trpc_h2_stream_status.argtypes = [c.c_void_p]
+    L.trpc_h2_stream_status.restype = c.c_int
+    L.trpc_h2_stream_headers.argtypes = [c.c_void_p,
+                                         c.POINTER(c.POINTER(c.c_uint8))]
+    L.trpc_h2_stream_headers.restype = c.c_size_t
+    L.trpc_h2_stream_trailers.argtypes = [c.c_void_p,
+                                          c.POINTER(c.POINTER(c.c_uint8))]
+    L.trpc_h2_stream_trailers.restype = c.c_size_t
+    L.trpc_h2_stream_destroy.argtypes = [c.c_void_p]
+    L.trpc_h2_stream_destroy.restype = None
+
     # RPC cancellation (≙ Controller::StartCancel / NotifyOnCancel)
     L.trpc_channel_call_cancelable.argtypes = [
         c.c_void_p, c.c_char_p, c.c_char_p, c.c_size_t, c.c_char_p,

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import gzip
 import re
+import time
 from typing import Callable, Dict
 
 from brpc_tpu.rpc import errors
@@ -76,55 +77,119 @@ def _grpc_error(status: int, message: str) -> HttpResponse:
                   "grpc-message": _encode_grpc_message(message)})
 
 
-def _wrap(method_full: str, handler: Callable[[Controller, bytes], bytes]):
+class ServerStreaming:
+    """Marks a handler fn(cntl, request_bytes) -> iterable[bytes] as
+    server-streaming: each yielded message becomes one length-prefixed
+    frame of the response (≙ gRPC server streaming; the h2 layer flushes
+    the frames as the response body with grpc-status trailers)."""
+
+    def __init__(self, fn):
+        self.fn = fn
+
+
+class ClientStreaming:
+    """fn(cntl, [request_bytes, ...]) -> response_bytes: the client
+    sends any number of frames before half-closing."""
+
+    def __init__(self, fn):
+        self.fn = fn
+
+
+class BidiStreaming:
+    """fn(cntl, [request_bytes, ...]) -> iterable[bytes]."""
+
+    def __init__(self, fn):
+        self.fn = fn
+
+
+def _split_frames(body: bytes):
+    """All length-prefixed messages in a gRPC body (raises on junk)."""
+    msgs = []
+    i = 0
+    while i < len(body):
+        if len(body) - i < 5:
+            raise ValueError("truncated grpc frame")
+        compressed = body[i]
+        mlen = int.from_bytes(body[i + 1:i + 5], "big")
+        msg = body[i + 5:i + 5 + mlen]
+        if len(msg) != mlen:
+            raise ValueError("truncated grpc message")
+        msgs.append((compressed, msg))
+        i += 5 + mlen
+    return msgs
+
+
+def _wrap(method_full: str, handler) -> Callable:
+    streaming_in = isinstance(handler, (ClientStreaming, BidiStreaming))
+    streaming_out = isinstance(handler, (ServerStreaming, BidiStreaming))
+    fn = handler.fn if isinstance(
+        handler, (ServerStreaming, ClientStreaming, BidiStreaming)) \
+        else handler
+
     def serve(req: HttpRequest) -> HttpResponse:
+        t0 = time.monotonic()
         ct = req.headers.get("content-type", "")
         if not ct.startswith("application/grpc"):
             return HttpResponse.text("expected application/grpc\n", 415)
-        body = req.body
-        if len(body) < 5:
+        try:
+            frames = _split_frames(req.body)
+        except ValueError as e:
+            return _grpc_error(GRPC_INTERNAL, str(e))
+        if not frames and not streaming_in:
             return _grpc_error(GRPC_INTERNAL, "truncated grpc frame")
-        compressed = body[0]
-        msg_len = int.from_bytes(body[1:5], "big")
-        msg = body[5:5 + msg_len]
-        if len(msg) != msg_len:
-            return _grpc_error(GRPC_INTERNAL, "truncated grpc message")
-        if len(body) != 5 + msg_len:
+        if not streaming_in and len(frames) != 1:
             # more than one length-prefixed frame = client streaming,
             # which unary handlers must not silently truncate
             return _grpc_error(GRPC_UNIMPLEMENTED,
                                "client streaming not supported")
-        if compressed:
-            if req.headers.get("grpc-encoding") != "gzip":
-                return _grpc_error(GRPC_UNIMPLEMENTED,
-                                   "unsupported grpc-encoding")
-            try:
-                msg = gzip.decompress(msg)
-            except Exception:  # zlib.error / EOFError / OSError
-                return _grpc_error(GRPC_INTERNAL, "bad gzip message")
+        msgs = []
+        for compressed, msg in frames:
+            if compressed:
+                if req.headers.get("grpc-encoding") != "gzip":
+                    return _grpc_error(GRPC_UNIMPLEMENTED,
+                                       "unsupported grpc-encoding")
+                try:
+                    msg = gzip.decompress(msg)
+                except Exception:  # zlib.error / EOFError / OSError
+                    return _grpc_error(GRPC_INTERNAL, "bad gzip message")
+            msgs.append(msg)
         cntl = Controller()
         cntl.method = method_full
+        deadline = None
         if "grpc-timeout" in req.headers:
             try:
                 cntl.timeout_ms = parse_grpc_timeout(
                     req.headers["grpc-timeout"])
+                deadline = t0 + cntl.timeout_ms / 1000.0
             except ValueError:
                 pass
+        if deadline is not None and time.monotonic() >= deadline:
+            return _grpc_error(GRPC_DEADLINE_EXCEEDED,
+                               "deadline expired before dispatch")
         try:
-            out = handler(cntl, msg)
+            out = fn(cntl, msgs if streaming_in else msgs[0])
+            if streaming_out:
+                out = list(out or ())  # drain the iterator inside the guard
         except errors.RpcError as e:
             return _grpc_error(_CODE_MAP.get(e.code, GRPC_UNKNOWN), e.text)
         except Exception as e:  # noqa: BLE001 — handler bug → INTERNAL
             return _grpc_error(GRPC_INTERNAL, str(e))
-        if isinstance(out, tuple):
-            out = out[0]
         if cntl.failed():
             return _grpc_error(_CODE_MAP.get(cntl.error_code, GRPC_UNKNOWN),
                                cntl.error_text)
-        out = out or b""
-        frame = b"\x00" + len(out).to_bytes(4, "big") + out
+        if deadline is not None and time.monotonic() >= deadline:
+            # honored server-side: a response past the deadline is useless
+            # to the peer (≙ grpc.cpp:208 deadline semantics)
+            return _grpc_error(GRPC_DEADLINE_EXCEEDED,
+                               "handler exceeded grpc-timeout")
+        if not streaming_out:
+            if isinstance(out, tuple):
+                out = out[0]
+            out = [out or b""]
+        body = b"".join(b"\x00" + len(m).to_bytes(4, "big") + m
+                        for m in out)
         return HttpResponse(
-            200, {"content-type": "application/grpc"}, frame,
+            200, {"content-type": "application/grpc"}, body,
             trailers={"grpc-status": "0"})
 
     return serve

@@ -18,7 +18,8 @@ from typing import Dict, Optional, Tuple
 from brpc_tpu._native import lib
 from brpc_tpu.rpc import errors
 
-__all__ = ["H2Response", "H2Channel", "GrpcError", "GrpcChannel"]
+__all__ = ["H2Response", "H2Channel", "H2Stream", "GrpcError",
+           "GrpcChannel", "GrpcStream"]
 
 
 @dataclass
@@ -94,6 +95,25 @@ class H2Channel:
             L.trpc_h2_result_destroy(result)
         return H2Response(status, hdrs, rbody, trls)
 
+    def open_stream(self, method: str, path: str,
+                    headers: Optional[Dict[str, str]] = None) -> "H2Stream":
+        """Open a streaming request (HEADERS only): write body chunks
+        incrementally, half-close, and read the response body as chunks
+        while the server is still sending (≙ ProgressiveReader both
+        ways on one h2 stream)."""
+        if self._handle is None:
+            raise errors.RpcError(errors.EFAILEDSOCKET, "channel closed")
+        blob = None
+        if headers:
+            blob = "".join(f"{k}: {v}\r\n"
+                           for k, v in headers.items()).encode()
+        rc = ctypes.c_int()
+        h = lib().trpc_h2_stream_open(self._handle, method.encode(),
+                                      path.encode(), blob, ctypes.byref(rc))
+        if not h:
+            raise errors.RpcError(rc.value, "h2 stream open failed")
+        return H2Stream(h)
+
     def get(self, path: str, **kw) -> H2Response:
         return self.request("GET", path, **kw)
 
@@ -104,6 +124,78 @@ class H2Channel:
         if self._handle is not None:
             lib().trpc_h2_client_destroy(self._handle)
             self._handle = None
+
+
+class H2Stream:
+    """One streaming h2 request: incremental body out, incremental body
+    in (chunks arrive while the server still streams)."""
+
+    def __init__(self, handle):
+        self._h = handle
+
+    def write(self, data: bytes, timeout_ms: float = 10_000.0) -> None:
+        rc = lib().trpc_h2_stream_write(self._h, data, len(data),
+                                        int(timeout_ms * 1000))
+        if rc != 0:
+            raise errors.RpcError(rc, f"h2 stream write failed ({rc})")
+
+    def close_send(self) -> None:
+        rc = lib().trpc_h2_stream_close_send(self._h)
+        if rc != 0:
+            raise errors.RpcError(rc, f"h2 stream half-close failed ({rc})")
+
+    def read(self, timeout_ms: float = 10_000.0) -> Optional[bytes]:
+        """Next response-body chunk; None at EOF (status/headers/trailers
+        are final then)."""
+        L = lib()
+        p = ctypes.POINTER(ctypes.c_uint8)()
+        n = L.trpc_h2_stream_read(self._h, int(timeout_ms * 1000),
+                                  ctypes.byref(p))
+        if n > 0:
+            try:
+                return ctypes.string_at(p, n)
+            finally:
+                L.trpc_h2_stream_chunk_free(p)
+        if n == 0:
+            return None
+        raise errors.RpcError(int(n), f"h2 stream read failed ({n})")
+
+    @property
+    def status(self) -> int:
+        return lib().trpc_h2_stream_status(self._h)
+
+    def headers(self) -> Dict[str, str]:
+        p = ctypes.POINTER(ctypes.c_uint8)()
+        n = lib().trpc_h2_stream_headers(self._h, ctypes.byref(p))
+        return _parse_lines(ctypes.string_at(p, n) if n else b"")
+
+    def trailers(self) -> Dict[str, str]:
+        p = ctypes.POINTER(ctypes.c_uint8)()
+        n = lib().trpc_h2_stream_trailers(self._h, ctypes.byref(p))
+        return _parse_lines(ctypes.string_at(p, n) if n else b"")
+
+    def destroy(self) -> None:
+        if self._h is not None:
+            lib().trpc_h2_stream_destroy(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.destroy()
+
+
+def _grpc_timeout_value(timeout_ms: float) -> str:
+    """gRPC TimeoutValue is at most 8 digits: escalate the unit when
+    milliseconds overflow (the spec's own coarsening rule)."""
+    ms = max(int(timeout_ms), 1)
+    if ms <= 99_999_999:
+        return f"{ms}m"
+    seconds = ms // 1000
+    if seconds <= 99_999_999:
+        return f"{seconds}S"
+    return f"{min(seconds // 3600, 99_999_999)}H"
 
 
 class GrpcError(Exception):
@@ -126,7 +218,9 @@ class GrpcChannel:
         framed = b"\x00" + struct.pack("!I", len(request)) + request
         resp = self._h2.post(
             f"/{service}/{method}", body=framed,
-            headers={"content-type": "application/grpc", "te": "trailers"},
+            headers={"content-type": "application/grpc", "te": "trailers",
+                     # deadline propagation (≙ grpc.cpp:208 both ways)
+                     "grpc-timeout": _grpc_timeout_value(timeout_ms)},
             timeout_ms=timeout_ms)
         status_map = dict(resp.trailers)
         if "grpc-status" not in status_map:
@@ -142,5 +236,78 @@ class GrpcChannel:
             raise GrpcError(12, "compressed grpc frames unsupported")
         return resp.body[5:5 + mlen]
 
+    def streaming_call(self, service: str, method: str,
+                       timeout_ms: float = 10_000.0) -> "GrpcStream":
+        """Open a streaming gRPC call (client/server/bidi): send_message
+        incrementally, done_sending to half-close, recv_message while the
+        server still streams (None = end; grpc-status then checked)."""
+        st = self._h2.open_stream(
+            "POST", f"/{service}/{method}",
+            headers={"content-type": "application/grpc", "te": "trailers",
+                     "grpc-timeout": _grpc_timeout_value(timeout_ms)})
+        return GrpcStream(st, timeout_ms)
+
     def close(self) -> None:
         self._h2.close()
+
+
+class GrpcStream:
+    """gRPC message framing over one streaming h2 call."""
+
+    def __init__(self, h2_stream: H2Stream, timeout_ms: float):
+        self._st = h2_stream
+        self._timeout_ms = timeout_ms
+        self._buf = b""
+        self._eof = False
+
+    def send_message(self, message: bytes) -> None:
+        framed = b"\x00" + struct.pack("!I", len(message)) + message
+        self._st.write(framed, timeout_ms=self._timeout_ms)
+
+    def done_sending(self) -> None:
+        self._st.close_send()
+
+    def recv_message(self) -> Optional[bytes]:
+        """Next response message; None when the server finished (then
+        grpc-status from the trailers is raised if nonzero)."""
+        while True:
+            if len(self._buf) >= 5:
+                compressed, mlen = self._buf[0], struct.unpack(
+                    "!I", self._buf[1:5])[0]
+                if len(self._buf) >= 5 + mlen:
+                    if compressed:
+                        raise GrpcError(12,
+                                        "compressed grpc frames unsupported")
+                    msg = self._buf[5:5 + mlen]
+                    self._buf = self._buf[5 + mlen:]
+                    return msg
+            if self._eof:
+                if self._buf:
+                    raise GrpcError(13, "truncated grpc frame at EOF")
+                status_map = self._st.trailers() or self._st.headers()
+                code = int(status_map.get("grpc-status", "2"))
+                if code != 0:
+                    raise GrpcError(code,
+                                    status_map.get("grpc-message", ""))
+                return None
+            chunk = self._st.read(timeout_ms=self._timeout_ms)
+            if chunk is None:
+                self._eof = True
+            else:
+                self._buf += chunk
+
+    def __iter__(self):
+        while True:
+            m = self.recv_message()
+            if m is None:
+                return
+            yield m
+
+    def destroy(self) -> None:
+        self._st.destroy()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.destroy()

@@ -333,6 +333,33 @@ size_t trpc_h2_result_trailers(void* r, const uint8_t** p) {
 
 void trpc_h2_result_destroy(void* r) { delete (H2ClientResult*)r; }
 
+// streaming h2/gRPC client calls (h2.h streaming section)
+void* trpc_h2_stream_open(void* conn, const char* method, const char* path,
+                          const char* headers_blob, int* rc_out) {
+  return h2_client_stream_open(conn, method, path, headers_blob, rc_out);
+}
+int trpc_h2_stream_write(void* st, const uint8_t* data, size_t len,
+                         int64_t timeout_us) {
+  return h2_client_stream_write(st, data, len, timeout_us);
+}
+int trpc_h2_stream_close_send(void* st) {
+  return h2_client_stream_close_send(st);
+}
+int64_t trpc_h2_stream_read(void* st, int64_t timeout_us, uint8_t** out) {
+  return h2_client_stream_read(st, timeout_us, out);
+}
+void trpc_h2_stream_chunk_free(uint8_t* p) {
+  h2_client_stream_chunk_free(p);
+}
+int trpc_h2_stream_status(void* st) { return h2_client_stream_status(st); }
+size_t trpc_h2_stream_headers(void* st, const uint8_t** p) {
+  return h2_client_stream_headers(st, p);
+}
+size_t trpc_h2_stream_trailers(void* st, const uint8_t** p) {
+  return h2_client_stream_trailers(st, p);
+}
+void trpc_h2_stream_destroy(void* st) { h2_client_stream_destroy(st); }
+
 void trpc_h2_client_destroy(void* conn) { h2_client_destroy(conn); }
 
 // --- auth ------------------------------------------------------------------

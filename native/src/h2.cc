@@ -268,6 +268,7 @@ class H2Conn {
   Hpack hpack;
   std::unordered_map<uint32_t, StreamState> streams;
   uint32_t continuation_stream = 0;  // nonzero: expecting CONTINUATION
+  uint32_t max_seen_sid = 0;  // highest client sid that sent HEADERS
   int64_t conn_send_window = kDefaultWindow;
   int64_t peer_initial_window = kDefaultWindow;
   bool goaway = false;
@@ -687,6 +688,9 @@ int H2ConnConsume(H2Conn* c, Socket* s, std::vector<H2Request>* out) {
           if (off + 5 > n) return FatalGoaway(s, 0, 1);
           off += 5;
         }
+        if (sid > c->max_seen_sid) {
+          c->max_seen_sid = sid;
+        }
         bool fresh = c->streams.find(sid) == c->streams.end();
         StreamState& st = c->streams[sid];
         if (fresh) {
@@ -766,7 +770,27 @@ int H2ConnConsume(H2Conn* c, Socket* s, std::vector<H2Request>* out) {
       }
       case F_DATA: {
         auto it = c->streams.find(sid);
-        if (it == c->streams.end() || !it->second.headers_done) {
+        if (it == c->streams.end()) {
+          if (sid == 0 || sid > c->max_seen_sid || (sid & 1) == 0) {
+            // DATA on a stream that never opened: connection error
+            // (RFC 9113 §6.1); only PAST streams we responded-and-erased
+            // are tolerated below
+            if (!reply.empty()) write_frames(s, reply);
+            return FatalGoaway(s, 0, 1);
+          }
+          // we responded and erased our half; the client's remaining
+          // upload is legal (RFC 9113 §5.1 half-closed(local)) — drop
+          // the bytes but keep the connection window fed
+          if (len > 0) {
+            put_frame_header(&reply, 4, F_WINDOW_UPDATE, 0, 0);
+            reply.push_back((char)((len >> 24) & 0x7f));
+            reply.push_back((char)((len >> 16) & 0xff));
+            reply.push_back((char)((len >> 8) & 0xff));
+            reply.push_back((char)(len & 0xff));
+          }
+          break;
+        }
+        if (!it->second.headers_done) {
           if (!reply.empty()) write_frames(s, reply);
           return FatalGoaway(s, 0, 1);
         }
@@ -883,6 +907,10 @@ int H2Respond(H2Conn* c, Socket* s, uint32_t stream_id, int status,
 namespace {
 
 constexpr int64_t kClientConnWindow = 1 << 30;  // opened wide at create
+// per-stream receive budget: bounds how far a server can run ahead of a
+// slow reader (streaming calls replenish from read(), so this is also
+// the max bytes buffered per stream); unary streams replenish on arrival
+constexpr int64_t kClientStreamWindow = 4 << 20;
 
 struct H2ClientStream {
   Butex* done = nullptr;  // 0 -> 1 when the stream completes/fails
@@ -892,6 +920,16 @@ struct H2ClientStream {
   // CONTINUATION accumulation for this stream's current header block
   std::string hdr_block;
   bool hdr_end_stream = false;
+  // streaming mode (h2_client_stream_*): response DATA is delivered
+  // incrementally through `chunks` + a bump-counter wake instead of
+  // accumulating into result.body
+  bool streaming = false;
+  std::deque<std::string> chunks;
+  Butex* data_butex = nullptr;  // bumped on every chunk/completion
+  // receive-window bytes consumed but not yet credited back: unary
+  // credits on arrival (the body is consumed immediately); streaming
+  // credits from read() so a slow reader throttles the server
+  uint64_t stream_unacked = 0;
 };
 
 struct H2ClientConn {
@@ -928,6 +966,10 @@ void H2ClientCompleteLocked(H2ClientConn* c, uint32_t sid,
   c->stream_send_window.erase(sid);
   butex_value(st->done).store(1, std::memory_order_release);
   butex_wake_all(st->done);
+  if (st->data_butex != nullptr) {
+    butex_value(st->data_butex).fetch_add(1, std::memory_order_release);
+    butex_wake_all(st->data_butex);
+  }
   // a sender parked on flow control must notice the completion (e.g.
   // the peer finished the response before the request body was done)
   butex_value(c->window_butex).fetch_add(1, std::memory_order_release);
@@ -948,6 +990,10 @@ void H2ClientFailAllLocked(H2ClientConn* c, int error) {
     st->error = error;
     butex_value(st->done).store(1, std::memory_order_release);
     butex_wake_all(st->done);
+    if (st->data_butex != nullptr) {
+      butex_value(st->data_butex).fetch_add(1, std::memory_order_release);
+      butex_wake_all(st->data_butex);
+    }
   }
   c->streams.clear();
   c->stream_send_window.clear();
@@ -1156,7 +1202,31 @@ void H2ClientOnMessages(Socket* s) {
         auto it = c->streams.find(sid);
         if (it != c->streams.end()) {
           H2ClientStream* st = it->second;
-          st->result.body.append((const char*)p + off, dlen);
+          if (st->streaming) {
+            if (dlen > 0) {
+              st->chunks.emplace_back((const char*)p + off, dlen);
+              butex_value(st->data_butex)
+                  .fetch_add(1, std::memory_order_release);
+              butex_wake_all(st->data_butex);
+            }
+            // stream-window credit comes from h2_client_stream_read:
+            // a slow reader deliberately throttles the server
+          } else {
+            st->result.body.append((const char*)p + off, dlen);
+            // unary consumes on arrival: credit the stream window so
+            // responses larger than the initial window keep flowing
+            st->stream_unacked += n;
+            if (!(flags & FLAG_END_STREAM) &&
+                st->stream_unacked >= (uint64_t)kClientStreamWindow / 2) {
+              put_frame_header(&reply, 4, F_WINDOW_UPDATE, 0, sid);
+              uint32_t inc = (uint32_t)st->stream_unacked;
+              reply.push_back((char)((inc >> 24) & 0x7f));
+              reply.push_back((char)(inc >> 16));
+              reply.push_back((char)(inc >> 8));
+              reply.push_back((char)inc);
+              st->stream_unacked = 0;
+            }
+          }
           if (flags & FLAG_END_STREAM) {
             H2ClientCompleteLocked(c, sid, st, 0);
           }
@@ -1284,11 +1354,11 @@ void* h2_client_create_tls(const char* ip, int port,
   std::string hello = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
   std::string settings;
   settings.push_back(0x00);
-  settings.push_back(0x04);  // INITIAL_WINDOW_SIZE
-  settings.push_back((char)((kClientConnWindow >> 24) & 0xff));
-  settings.push_back((char)((kClientConnWindow >> 16) & 0xff));
-  settings.push_back((char)((kClientConnWindow >> 8) & 0xff));
-  settings.push_back((char)(kClientConnWindow & 0xff));
+  settings.push_back(0x04);  // INITIAL_WINDOW_SIZE (per stream)
+  settings.push_back((char)((kClientStreamWindow >> 24) & 0xff));
+  settings.push_back((char)((kClientStreamWindow >> 16) & 0xff));
+  settings.push_back((char)((kClientStreamWindow >> 8) & 0xff));
+  settings.push_back((char)(kClientStreamWindow & 0xff));
   put_frame_header(&hello, (uint32_t)settings.size(), F_SETTINGS, 0, 0);
   hello += settings;
   uint32_t winc = (uint32_t)(kClientConnWindow - 65535);
@@ -1311,6 +1381,128 @@ void* h2_client_create_tls(const char* ip, int port,
   return c;
 }
 
+namespace {
+
+// Allocate a stream id, register `st`, and put the request HEADERS on
+// the wire — sid allocation and the write share the header_mu critical
+// section so ids reach the wire in increasing order (RFC 9113 §5.1.1).
+uint32_t H2ClientSendHeaders(H2ClientConn* c, Socket* s, H2ClientStream* st,
+                             const char* method, const char* path,
+                             const char* headers_blob, bool end_stream) {
+  // pseudo-headers first, then the caller's blob (built before the
+  // lock — nothing in it depends on the stream id)
+  std::string block;
+  hpack_literal(&block, ":method", method);
+  hpack_literal(&block, ":scheme", c->tls ? "https" : "http");
+  hpack_literal(&block, ":path", path);
+  hpack_literal(&block, ":authority", "localhost");
+  encode_blob(&block, headers_blob);
+  uint32_t sid;
+  std::lock_guard order_lk(c->header_mu);
+  size_t maxf;
+  {
+    std::lock_guard lk(c->mu);
+    sid = c->next_stream;
+    c->next_stream += 2;
+    c->streams[sid] = st;
+    c->stream_send_window[sid] = c->peer_initial_window;
+    maxf = c->peer_max_frame;
+  }
+  // split the header block across CONTINUATION frames when it exceeds
+  // the peer's max frame size (the server enforces it with a GOAWAY)
+  std::string frames;
+  size_t off = 0;
+  bool first = true;
+  do {
+    size_t chunk = block.size() - off;
+    if (chunk > maxf) chunk = maxf;
+    bool last = off + chunk == block.size();
+    uint8_t type = first ? F_HEADERS : F_CONTINUATION;
+    uint8_t flags = (last ? FLAG_END_HEADERS : 0) |
+                    (first && end_stream ? FLAG_END_STREAM : 0);
+    put_frame_header(&frames, (uint32_t)chunk, type, flags, sid);
+    frames.append(block, off, chunk);
+    off += chunk;
+    first = false;
+  } while (off < block.size());
+  write_frames(s, frames);
+  return sid;
+}
+
+// Flow-controlled DATA send (whole buffer; optionally END_STREAM on the
+// last frame).  Returns 0, 1 when the peer completed the response early
+// (upload abandoned with RST NO_ERROR — take the response), or -TRPC_*.
+int H2ClientSendData(H2ClientConn* c, Socket* s, uint32_t sid,
+                     H2ClientStream* st, const uint8_t* body,
+                     size_t body_len, bool end_stream, int64_t deadline) {
+  if (body_len == 0 && end_stream) {
+    {
+      std::lock_guard lk(c->mu);
+      if (c->stream_send_window.find(sid) == c->stream_send_window.end()) {
+        return 1;  // already completed/failed: nothing left to close
+      }
+    }
+    std::string df;
+    put_frame_header(&df, 0, F_DATA, FLAG_END_STREAM, sid);
+    write_frames(s, df);  // empty close frame needs no window
+    return 0;
+  }
+  size_t sent = 0;
+  while (sent < body_len) {
+    size_t want = body_len - sent;
+    std::unique_lock lk(c->mu);
+    int64_t avail = c->conn_send_window;
+    auto it = c->stream_send_window.find(sid);
+    if (it == c->stream_send_window.end()) {
+      if (st->error == 0 &&
+          butex_value(st->done).load(std::memory_order_acquire) != 0) {
+        // the peer finished the response before we finished the request
+        // (legal per RFC 9113 §8.1, common for early 404/413): stop
+        // uploading, tell the server via RST NO_ERROR, take the response
+        lk.unlock();
+        std::string rst;
+        put_frame_header(&rst, 4, F_RST, 0, sid);
+        rst.append("\x00\x00\x00\x00", 4);  // NO_ERROR
+        write_frames(s, rst);
+        return 1;
+      }
+      return st->error != 0 ? st->error : -TRPC_EINTERNAL;
+    }
+    avail = avail < it->second ? avail : it->second;
+    if (avail <= 0) {
+      int32_t seq =
+          butex_value(c->window_butex).load(std::memory_order_acquire);
+      lk.unlock();
+      int64_t left = deadline - monotonic_us();
+      if (left <= 0 || butex_wait(c->window_butex, seq, left) != 0) {
+        if (errno == ETIMEDOUT || left <= 0) {
+          return -TRPC_ERPCTIMEDOUT;
+        }
+      }
+      if (c->failed.load(std::memory_order_acquire)) {
+        return -TRPC_EFAILEDSOCKET;
+      }
+      continue;
+    }
+    size_t chunk = want;
+    if ((int64_t)chunk > avail) chunk = (size_t)avail;
+    if (chunk > c->peer_max_frame) chunk = c->peer_max_frame;
+    c->conn_send_window -= (int64_t)chunk;
+    it->second -= (int64_t)chunk;
+    bool last = sent + chunk == body_len;
+    lk.unlock();
+    std::string df;
+    put_frame_header(&df, (uint32_t)chunk, F_DATA,
+                     last && end_stream ? FLAG_END_STREAM : 0, sid);
+    df.append((const char*)body + sent, chunk);
+    write_frames(s, df);
+    sent += chunk;
+  }
+  return 0;
+}
+
+}  // namespace
+
 int h2_client_call(void* conn, const char* method, const char* path,
                    const char* headers_blob, const uint8_t* body,
                    size_t body_len, int64_t timeout_us,
@@ -1330,103 +1522,14 @@ int h2_client_call(void* conn, const char* method, const char* path,
     return -TRPC_EFAILEDSOCKET;
   }
 
-  // HEADERS: pseudo-headers first, then the caller's blob (built before
-  // the lock — nothing in it depends on the stream id)
-  std::string block;
-  hpack_literal(&block, ":method", method);
-  hpack_literal(&block, ":scheme", c->tls ? "https" : "http");
-  hpack_literal(&block, ":path", path);
-  hpack_literal(&block, ":authority", "localhost");
-  encode_blob(&block, headers_blob);
-  bool end_stream = body_len == 0;
-  uint32_t sid;
-  {
-    // RFC 9113 §5.1.1: HEADERS must reach the wire in increasing
-    // stream-id order, so sid allocation and the HEADERS write share the
-    // header_mu critical section (DATA frames below interleave freely)
-    std::lock_guard order_lk(c->header_mu);
-    size_t maxf;
-    {
-      std::lock_guard lk(c->mu);
-      sid = c->next_stream;
-      c->next_stream += 2;
-      c->streams[sid] = &st;
-      c->stream_send_window[sid] = c->peer_initial_window;
-      maxf = c->peer_max_frame;
-    }
-    // split the header block across CONTINUATION frames when it exceeds
-    // the peer's max frame size (the server enforces it with a GOAWAY)
-    std::string frames;
-    size_t off = 0;
-    bool first = true;
-    do {
-      size_t chunk = block.size() - off;
-      if (chunk > maxf) chunk = maxf;
-      bool last = off + chunk == block.size();
-      uint8_t type = first ? F_HEADERS : F_CONTINUATION;
-      uint8_t flags = (last ? FLAG_END_HEADERS : 0) |
-                      (first && end_stream ? FLAG_END_STREAM : 0);
-      put_frame_header(&frames, (uint32_t)chunk, type, flags, sid);
-      frames.append(block, off, chunk);
-      off += chunk;
-      first = false;
-    } while (off < block.size());
-    write_frames(s, frames);
-  }
-
-  // DATA respecting the peer's windows
-  size_t sent = 0;
+  uint32_t sid = H2ClientSendHeaders(c, s, &st, method, path, headers_blob,
+                                     body_len == 0);
   int rc = 0;
-  while (sent < body_len && rc == 0) {
-    size_t want = body_len - sent;
-    std::unique_lock lk(c->mu);
-    int64_t avail = c->conn_send_window;
-    auto it = c->stream_send_window.find(sid);
-    if (it == c->stream_send_window.end()) {
-      if (st.error == 0 &&
-          butex_value(st.done).load(std::memory_order_acquire) != 0) {
-        // the peer finished the response before we finished the request
-        // (legal per RFC 9113 §8.1, common for early 404/413): stop
-        // uploading, tell the server via RST NO_ERROR, take the response
-        lk.unlock();
-        std::string rst;
-        put_frame_header(&rst, 4, F_RST, 0, sid);
-        rst.append("\x00\x00\x00\x00", 4);  // NO_ERROR
-        write_frames(s, rst);
-        break;
-      }
-      rc = st.error != 0 ? st.error : -TRPC_EINTERNAL;
-      break;  // stream died under us
+  if (body_len > 0) {
+    rc = H2ClientSendData(c, s, sid, &st, body, body_len, true, deadline);
+    if (rc > 0) {
+      rc = 0;  // early response: fall through and take it
     }
-    avail = avail < it->second ? avail : it->second;
-    if (avail <= 0) {
-      int32_t seq =
-          butex_value(c->window_butex).load(std::memory_order_acquire);
-      lk.unlock();
-      int64_t left = deadline - monotonic_us();
-      if (left <= 0 || butex_wait(c->window_butex, seq, left) != 0) {
-        if (errno == ETIMEDOUT || left <= 0) {
-          rc = -TRPC_ERPCTIMEDOUT;
-        }
-      }
-      if (c->failed.load(std::memory_order_acquire)) {
-        rc = -TRPC_EFAILEDSOCKET;
-      }
-      continue;
-    }
-    size_t chunk = want;
-    if ((int64_t)chunk > avail) chunk = (size_t)avail;
-    if (chunk > c->peer_max_frame) chunk = c->peer_max_frame;
-    c->conn_send_window -= (int64_t)chunk;
-    it->second -= (int64_t)chunk;
-    bool last = sent + chunk == body_len;
-    lk.unlock();
-    std::string df;
-    put_frame_header(&df, (uint32_t)chunk, F_DATA,
-                     last ? FLAG_END_STREAM : 0, sid);
-    df.append((const char*)body + sent, chunk);
-    write_frames(s, df);
-    sent += chunk;
   }
 
   // await completion
@@ -1470,6 +1573,192 @@ int h2_client_call(void* conn, const char* method, const char* path,
   }
   butex_destroy(st.done);
   return rc;
+}
+
+
+// --- streaming client calls (≙ the reference h2 client expressing what
+// stream.cc speaks natively: request-body streaming + response streaming
+// to a reader, progressive_reader.h:36-shaped) ------------------------------
+
+struct H2ClientStreamHandle {
+  H2ClientConn* c = nullptr;
+  uint32_t sid = 0;
+  H2ClientStream* st = nullptr;  // heap; owned by the handle
+};
+
+void* h2_client_stream_open(void* conn, const char* method, const char* path,
+                            const char* headers_blob, int* rc_out) {
+  H2ClientConn* c = (H2ClientConn*)conn;
+  if (c->failed.load(std::memory_order_acquire)) {
+    *rc_out = -TRPC_EFAILEDSOCKET;
+    return nullptr;
+  }
+  Socket* s = Socket::Address(c->sock);
+  if (s == nullptr) {
+    *rc_out = -TRPC_EFAILEDSOCKET;
+    return nullptr;
+  }
+  H2ClientStream* st = new H2ClientStream();
+  st->done = butex_create();
+  butex_value(st->done).store(0, std::memory_order_relaxed);
+  st->streaming = true;
+  st->data_butex = butex_create();
+  butex_value(st->data_butex).store(0, std::memory_order_relaxed);
+  H2ClientStreamHandle* h = new H2ClientStreamHandle();
+  h->c = c;
+  h->st = st;
+  h->sid = H2ClientSendHeaders(c, s, st, method, path, headers_blob, false);
+  s->Dereference();
+  *rc_out = 0;
+  return h;
+}
+
+int h2_client_stream_write(void* stream, const uint8_t* data, size_t len,
+                           int64_t timeout_us) {
+  H2ClientStreamHandle* h = (H2ClientStreamHandle*)stream;
+  Socket* s = Socket::Address(h->c->sock);
+  if (s == nullptr) {
+    return -TRPC_EFAILEDSOCKET;
+  }
+  int rc = H2ClientSendData(h->c, s, h->sid, h->st, data, len, false,
+                            monotonic_us() + timeout_us);
+  s->Dereference();
+  // rc==1: the peer already completed the response — callers switch to
+  // reading; surface as EPIPE-shaped "stop sending"
+  return rc == 1 ? -TRPC_ESTOP : rc;
+}
+
+int h2_client_stream_close_send(void* stream) {
+  H2ClientStreamHandle* h = (H2ClientStreamHandle*)stream;
+  Socket* s = Socket::Address(h->c->sock);
+  if (s == nullptr) {
+    return -TRPC_EFAILEDSOCKET;
+  }
+  int rc = H2ClientSendData(h->c, s, h->sid, h->st, nullptr, 0, true,
+                            monotonic_us());
+  s->Dereference();
+  return rc == 1 ? 0 : rc;
+}
+
+// Next response-body chunk: >0 = length (malloc'd into *out, caller
+// frees with h2_client_stream_chunk_free), 0 = EOF (status/headers/
+// trailers now final), -TRPC_ERPCTIMEDOUT, or the stream error.
+int64_t h2_client_stream_read(void* stream, int64_t timeout_us,
+                              uint8_t** out) {
+  H2ClientStreamHandle* h = (H2ClientStreamHandle*)stream;
+  H2ClientStream* st = h->st;
+  *out = nullptr;
+  int64_t deadline = monotonic_us() + timeout_us;
+  while (true) {
+    int32_t seq;
+    bool have_chunk = false;
+    std::string chunk;
+    bool credit = false;
+    uint32_t inc = 0;
+    {
+      std::lock_guard lk(h->c->mu);
+      if (!st->chunks.empty()) {
+        chunk = std::move(st->chunks.front());
+        st->chunks.pop_front();
+        have_chunk = true;
+        // reader-driven flow control: credit what we just consumed so
+        // the server can send more — but only as fast as we read
+        st->stream_unacked += chunk.size();
+        credit =
+            st->stream_unacked >= (uint64_t)kClientStreamWindow / 2 &&
+            butex_value(st->done).load(std::memory_order_acquire) == 0;
+        if (credit) {
+          inc = (uint32_t)st->stream_unacked;
+          st->stream_unacked = 0;
+        }
+      }
+      if (!have_chunk &&
+          butex_value(st->done).load(std::memory_order_acquire) != 0) {
+        return st->error != 0 ? st->error : 0;  // EOF (or the failure)
+      }
+      seq = butex_value(st->data_butex).load(std::memory_order_acquire);
+    }
+    if (have_chunk) {
+      if (credit) {
+        // outside c->mu: an inline write failure runs H2ClientOnFailed,
+        // which takes c->mu (the round-5 self-deadlock lesson)
+        Socket* sock = Socket::Address(h->c->sock);
+        if (sock != nullptr) {
+          std::string wu;
+          put_frame_header(&wu, 4, F_WINDOW_UPDATE, 0, h->sid);
+          wu.push_back((char)((inc >> 24) & 0x7f));
+          wu.push_back((char)(inc >> 16));
+          wu.push_back((char)(inc >> 8));
+          wu.push_back((char)inc);
+          write_frames(sock, wu);
+          sock->Dereference();
+        }
+      }
+      uint8_t* mem = (uint8_t*)malloc(chunk.size() > 0 ? chunk.size() : 1);
+      memcpy(mem, chunk.data(), chunk.size());
+      *out = mem;
+      return (int64_t)chunk.size();
+    }
+    int64_t left = deadline - monotonic_us();
+    if (left <= 0) {
+      return -TRPC_ERPCTIMEDOUT;
+    }
+    butex_wait(st->data_butex, seq, left);
+  }
+}
+
+void h2_client_stream_chunk_free(uint8_t* p) { free(p); }
+
+int h2_client_stream_status(void* stream) {
+  H2ClientStreamHandle* h = (H2ClientStreamHandle*)stream;
+  std::lock_guard lk(h->c->mu);
+  return h->st->result.status;
+}
+
+size_t h2_client_stream_headers(void* stream, const uint8_t** p) {
+  H2ClientStreamHandle* h = (H2ClientStreamHandle*)stream;
+  std::lock_guard lk(h->c->mu);
+  *p = (const uint8_t*)h->st->result.headers.data();
+  return h->st->result.headers.size();
+}
+
+size_t h2_client_stream_trailers(void* stream, const uint8_t** p) {
+  H2ClientStreamHandle* h = (H2ClientStreamHandle*)stream;
+  std::lock_guard lk(h->c->mu);
+  *p = (const uint8_t*)h->st->result.trailers.data();
+  return h->st->result.trailers.size();
+}
+
+void h2_client_stream_destroy(void* stream) {
+  H2ClientStreamHandle* h = (H2ClientStreamHandle*)stream;
+  H2ClientConn* c = h->c;
+  bool still_registered;
+  {
+    std::lock_guard lk(c->mu);
+    still_registered = c->streams.erase(h->sid) > 0;
+    c->stream_send_window.erase(h->sid);
+    if (still_registered && c->continuation_stream == h->sid) {
+      c->orphan_block = std::move(h->st->hdr_block);
+    }
+  }
+  if (still_registered) {
+    // abandoned before the peer finished: reset so late frames can't
+    // touch the freed state
+    Socket* s = Socket::Address(c->sock);
+    if (s != nullptr) {
+      std::string rst;
+      put_frame_header(&rst, 4, F_RST, 0, h->sid);
+      rst.append("\x00\x00\x00\x08", 4);  // CANCEL
+      write_frames(s, rst);
+      s->Dereference();
+    }
+  }
+  // no frame-loop thread can touch st once it is out of c->streams (the
+  // erase above and every st access share c->mu)
+  butex_destroy(h->st->done);
+  butex_destroy(h->st->data_butex);
+  delete h->st;
+  delete h;
 }
 
 void h2_client_destroy(void* conn) {

@@ -95,6 +95,28 @@ void* h2_client_create_tls(const char* ip, int port,
 int h2_client_call(void* conn, const char* method, const char* path,
                    const char* headers_blob, const uint8_t* body,
                    size_t body_len, int64_t timeout_us, H2ClientResult* out);
+
+// --- streaming calls (request-body streaming + response streaming to a
+// reader, ≙ ProgressiveReader both ways on h2, progressive_reader.h:36;
+// gRPC client/server streaming rides this surface) -------------------------
+// open: HEADERS only (no END_STREAM); write: flow-controlled DATA;
+// close_send: half-close; read: next response chunk (>0 len, 0 EOF,
+// -TRPC_* errors; chunk freed with h2_client_stream_chunk_free);
+// status/headers/trailers are final after read()==0.  Destroy streams
+// BEFORE h2_client_destroy.
+void* h2_client_stream_open(void* conn, const char* method, const char* path,
+                            const char* headers_blob, int* rc_out);
+int h2_client_stream_write(void* stream, const uint8_t* data, size_t len,
+                           int64_t timeout_us);
+int h2_client_stream_close_send(void* stream);
+int64_t h2_client_stream_read(void* stream, int64_t timeout_us,
+                              uint8_t** out);
+void h2_client_stream_chunk_free(uint8_t* p);
+int h2_client_stream_status(void* stream);
+size_t h2_client_stream_headers(void* stream, const uint8_t** p);
+size_t h2_client_stream_trailers(void* stream, const uint8_t** p);
+void h2_client_stream_destroy(void* stream);
+
 void h2_client_destroy(void* conn);
 
 }  // namespace trpc

@@ -649,11 +649,69 @@ static void test_h2_client_storm() {
   for (auto& t : ts) {
     t.join();
   }
+  // streaming leg: concurrent open/write/close/read/destroy on the same
+  // multiplexed connection, including mid-flight abandons (RST path) —
+  // the chunks deque + data butex are shared with the frame loop
+  std::atomic<uint64_t> sok{0}, sabandoned{0}, sbad{0};
+  std::vector<std::thread> sts;
+  for (int t = 0; t < 4; ++t) {
+    sts.emplace_back([&, t] {
+      std::string chunk(700 + 100 * t, 's');
+      for (int i = 0; i < 60; ++i) {
+        int rc = 0;
+        void* st = h2_client_stream_open(conn, "POST", "/nope", nullptr,
+                                         &rc);
+        if (st == nullptr) {
+          sbad.fetch_add(1);
+          continue;
+        }
+        if (i % 5 == 4) {
+          // abandon mid-flight: destroy without close/read (RST CANCEL)
+          h2_client_stream_write(st, (const uint8_t*)chunk.data(),
+                                 chunk.size(), 1000 * 1000);
+          h2_client_stream_destroy(st);
+          sabandoned.fetch_add(1);
+          continue;
+        }
+        for (int k = 0; k < 3; ++k) {
+          h2_client_stream_write(st, (const uint8_t*)chunk.data(),
+                                 chunk.size(), 1000 * 1000);
+        }
+        h2_client_stream_close_send(st);
+        bool fine = true;
+        while (true) {
+          uint8_t* out = nullptr;
+          int64_t n = h2_client_stream_read(st, 5 * 1000 * 1000, &out);
+          if (n > 0) {
+            h2_client_stream_chunk_free(out);
+            continue;
+          }
+          if (n != 0) {
+            fine = false;
+          }
+          break;
+        }
+        if (fine && h2_client_stream_status(st) == 404) {
+          sok.fetch_add(1);
+        } else {
+          sbad.fetch_add(1);
+        }
+        h2_client_stream_destroy(st);
+      }
+    });
+  }
+  for (auto& t : sts) {
+    t.join();
+  }
   h2_client_destroy(conn);
   server_destroy(srv);
   CHECK_TRUE(ok.load() == 6 * 150);
   CHECK_TRUE(bad.load() == 0);
-  printf("ok h2_client_storm ok=%llu\n", (unsigned long long)ok.load());
+  CHECK_TRUE(sbad.load() == 0);
+  CHECK_TRUE(sok.load() > 0);
+  printf("ok h2_client_storm ok=%llu streams=%llu abandoned=%llu\n",
+         (unsigned long long)ok.load(), (unsigned long long)sok.load(),
+         (unsigned long long)sabandoned.load());
 }
 
 // --- 12. device plane races (fake PJRT plugin) ------------------------------
