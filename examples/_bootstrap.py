@@ -6,7 +6,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # Honor an explicit JAX_PLATFORMS=cpu even under the axon sitecustomize
 # (which force-selects the tunneled-TPU platform; a dead tunnel then
-# hangs jax initialization).
+# hangs jax initialization).  A cpu-forced example also must not inherit
+# the tunnel pool config: with it present, even `import jax` can hang on
+# a dead tunnel (same reason tests/conftest.py pops it).  Examples that
+# use the NATIVE device plane (ici_performance) don't force cpu, so
+# their relay contract is untouched.
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
 from brpc_tpu.utils.jaxenv import force_cpu_platform  # noqa: E402
 
 force_cpu_platform()

@@ -1,8 +1,8 @@
 """Long-context forward with ring attention: sequence sharded over sp,
 K/V blocks rotating on the ICI ring, O(S/n) HBM per chip
 (parallel/ring_attention.py)."""
-import _bootstrap  # noqa: F401
-
+# JAX_PLATFORMS must be set BEFORE _bootstrap: its force_cpu_platform
+# hang guard (dead-tunnel protection) only fires when the env says cpu
 import os
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -10,6 +10,10 @@ os.environ.setdefault(
     "XLA_FLAGS",
     (os.environ.get("XLA_FLAGS", "") +
      " --xla_force_host_platform_device_count=8").strip())
+
+import _bootstrap  # noqa: F401,E402
+
+import os  # noqa: E402  (env set before _bootstrap below)
 
 import jax  # noqa: E402
 

@@ -371,6 +371,8 @@ void trpc_server_set_auth(void* s, const uint8_t* secret, size_t len) {
 // --- TLS (tls.h: libssl dlopen'd at runtime) -------------------------------
 
 int trpc_tls_available() { return tls_available() ? 1 : 0; }
+// LIFETIME: own per-thread buffer, valid until the same thread's next
+// trpc_tls_error call (independent of trpc_tpu_plane_error's buffer).
 const char* trpc_tls_error() { return tls_error(); }
 int trpc_server_add_tls_sni(void* s, const char* pattern, const char* cert,
                             const char* key) {
@@ -587,6 +589,10 @@ int trpc_tpu_plane_init(const char* plugin_path) {
   return tpu_plane_init(plugin_path);
 }
 int trpc_tpu_plane_available() { return tpu_plane_available() ? 1 : 0; }
+// LIFETIME: the returned pointer is this function's own per-THREAD
+// buffer, valid until the SAME thread calls trpc_tpu_plane_error again —
+// copy it out before the next query (the ctypes layer converts to bytes
+// immediately, which satisfies this).
 const char* trpc_tpu_plane_error() { return tpu_plane_error(); }
 const char* trpc_tpu_plane_platform() { return tpu_plane_platform(); }
 int trpc_tpu_device_count() { return tpu_plane_device_count(); }

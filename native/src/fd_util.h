@@ -1,0 +1,34 @@
+// fd_util.h — the fd option helpers the reference keeps in butil/fd_utility
+// (≙ butil/fd_utility.h: make_non_blocking / make_close_on_exec /
+// make_no_delay), consolidated from the former inline call sites so
+// every transport configures sockets through one seam.
+#pragma once
+
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+
+namespace trpc {
+
+inline int fd_set_nonblock(int fd) {
+  int fl = fcntl(fd, F_GETFL, 0);
+  return fl < 0 ? fl : fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+}
+
+inline int fd_set_cloexec(int fd) {
+  int fl = fcntl(fd, F_GETFD, 0);
+  return fl < 0 ? fl : fcntl(fd, F_SETFD, fl | FD_CLOEXEC);
+}
+
+inline int fd_set_nodelay(int fd) {
+  int one = 1;
+  return setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+inline int fd_set_reuseaddr(int fd) {
+  int one = 1;
+  return setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+}
+
+}  // namespace trpc

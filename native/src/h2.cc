@@ -15,6 +15,7 @@
 #include <unordered_map>
 
 #include "execution_queue.h"
+#include "fd_util.h"
 #include "h2_tables.h"
 #include "heap_profiler.h"
 #include "tls.h"
@@ -1311,12 +1312,10 @@ void* h2_client_create_tls(const char* ip, int port,
     ::close(fd);
     return nullptr;
   }
-  int one = 1;
-  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  fd_set_nodelay(fd);
   // epoll-driven reads drain to EAGAIN: the fd MUST be non-blocking or
   // the dispatcher blocks inside read(2) once the data runs out
-  int fl = fcntl(fd, F_GETFL, 0);
-  fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+  fd_set_nonblock(fd);
 
   // TLS: handshake synchronously on the fresh fd (same pattern as
   // DialConn); once socket->tls is set, Write/ReadToBuf encrypt and

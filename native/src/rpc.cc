@@ -32,6 +32,7 @@
 #include "stream.h"
 #include "timer_thread.h"
 #include "tls.h"
+#include "fd_util.h"
 #include "heap_profiler.h"
 #include "tpu.h"
 
@@ -1489,8 +1490,7 @@ void ServerConnFailed(Socket* s) {
 // epoll acceptor AND the io_uring RingListener both land here; only the
 // readiness plumbing differs (AddConsumer vs multishot RECV).
 void ServerAdoptConnection(Server* srv, int fd) {
-  int one = 1;
-  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  fd_set_nodelay(fd);
   SocketOptions opts;
   opts.fd = fd;
   opts.edge_fn = ServerOnMessages;
@@ -1827,8 +1827,7 @@ int server_start(Server* s, const char* ip, int port) {
   if (fd < 0) {
     return -errno;
   }
-  int one = 1;
-  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  fd_set_reuseaddr(fd);
   sockaddr_in addr;
   memset(&addr, 0, sizeof(addr));
   addr.sin_family = AF_INET;
@@ -2850,8 +2849,7 @@ Socket* DialConn(Channel* c, int* rc_out) {
     salen = sizeof(addr);
   }
   // non-blocking connect with a deadline (ChannelOptions.connect_timeout_ms)
-  int fl = fcntl(fd, F_GETFL, 0);
-  fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+  fd_set_nonblock(fd);
   if (connect(fd, sa, salen) != 0) {
     if (errno != EINPROGRESS) {
       *rc_out = -errno;
@@ -2881,8 +2879,7 @@ Socket* DialConn(Channel* c, int* rc_out) {
       return nullptr;
     }
   }
-  int one = 1;
-  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  fd_set_nodelay(fd);
   // client TLS: handshake synchronously on the freshly-connected fd
   // (DialConn's connect path is already blocking; the dispatcher only
   // sees the socket once the session is up)

@@ -15,7 +15,10 @@ _HOST = ["echo", "asynchronous_echo", "multi_threaded_echo",
          "selective_echo", "cascade_echo", "backup_request",
          "auto_concurrency_limiter", "streaming_echo", "http_server",
          "thrift_echo", "pb_echo", "session_data_and_thread_local",
-         "progressive_http", "memcache_client", "io_uring_echo"]
+         "progressive_http", "memcache_client", "io_uring_echo",
+         "cancel"]
+# param_server_allreduce is exercised (with stronger assertions) by
+# tests/test_param_server.py — not double-run here
 _MESH = ["mesh_collectives", "long_context_ring"]
 
 
