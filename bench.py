@@ -51,7 +51,37 @@ def main() -> int:
                     help="shorter probes (used by the scaling table)")
     ap.add_argument("--no-scaling", action="store_true",
                     help="skip the multi-core scaling table")
+    ap.add_argument("--attach-bytes", type=int, default=0,
+                    help="run ONLY the large-attachment bench at this "
+                         "size and print one JSON line")
+    ap.add_argument("--attach-ab", action="store_true",
+                    help="back-to-back writev vs SEND_ZC table at "
+                         "512KB/1MB/4MB attachments (one subprocess per "
+                         "arm: the rail's state is process-global)")
     args = ap.parse_args()
+
+    if args.attach_ab:
+        me = os.path.abspath(__file__)
+        table = {}
+        for size in (512 << 10, 1 << 20, 4 << 20):
+            row = {}
+            for arm, extra in (("writev", {"BENCH_SENDZC": "0"}),
+                               ("sendzc", {"BENCH_SENDZC": "1",
+                                           "TRPC_SENDZC_FORCE": "1"})):
+                env = dict(os.environ)
+                env.update(extra)
+                try:
+                    r = subprocess.run(
+                        [sys.executable, me, "--attach-bytes", str(size)],
+                        capture_output=True, text=True, timeout=180,
+                        env=env)
+                    row[arm] = json.loads(
+                        r.stdout.strip().splitlines()[-1])
+                except Exception as e:  # noqa: BLE001 — arm recorded null
+                    row[arm] = {"error": str(e)}
+            table[str(size)] = row
+        print(json.dumps({"metric": "attach_ab", "table": table}))
+        return 0
 
     if args.cores > 0:
         # bind BEFORE the native init spawns fiber workers/dispatchers
@@ -73,6 +103,9 @@ def main() -> int:
     # (falls back automatically when io_uring is unavailable)
     use_ring = bool(L.trpc_io_uring_available())
     L.trpc_set_io_uring(1 if use_ring else 0)
+    # egress arm override for the --attach-ab harness
+    if os.environ.get("BENCH_SENDZC") == "0":
+        L.trpc_set_sendzc(0)
 
     # in-process echo server with the native echo handler (no Python in
     # the hot path), then the native multi-fiber client loop against it
@@ -93,6 +126,47 @@ def main() -> int:
         if rc != 0:
             return None
         return out[0], out[1], out[3]  # qps, p50, p99
+
+    def native_counter(name: str) -> int:
+        buf = ctypes.create_string_buffer(1 << 16)
+        n = L.trpc_native_metrics_dump(buf, len(buf))
+        for line in buf.raw[:n].decode().splitlines():
+            if line.startswith(name + " "):
+                return int(line.split()[1])
+        return 0
+
+    def egress_label() -> str:
+        if not use_ring:
+            return "writev (epoll transport)"
+        if not L.trpc_sendzc_available():
+            return "writev (kernel lacks SEND_ZC)"
+        if os.environ.get("BENCH_SENDZC") == "0":
+            return "writev (rail disabled for A/B)"
+        if not L.trpc_sendzc_active():
+            return "writev (rail flagged off)"
+        if native_counter("native_uring_sendzc_copied") > 0 and \
+                os.environ.get("TRPC_SENDZC_FORCE") != "1":
+            return ("sendzc->writev (notifications reported kernel "
+                    "copies on this route)")
+        return "sendzc"
+
+    if args.attach_bytes > 0:
+        # single large-attachment run for the A/B harness: GB/s + which
+        # egress rail the bytes took + the rail's own accounting
+        rc = L.trpc_run_echo_bench(b"127.0.0.1", port, 2, 16, 16,
+                                   args.attach_bytes, 2.0, out)
+        print(json.dumps({
+            "metric": "attach_gbps",
+            "value": round(out[8], 3) if rc == 0 else 0.0,
+            "qps": round(out[0], 1) if rc == 0 else 0.0,
+            "attach_bytes": args.attach_bytes,
+            "egress": egress_label(),
+            "sendzc_submitted": native_counter(
+                "native_uring_sendzc_submitted"),
+            "sendzc_copied": native_counter("native_uring_sendzc_copied"),
+            "sendzc_fixed": native_counter("native_uring_sendzc_fixed"),
+        }))
+        return 0 if rc == 0 else 1
 
     # batching amortizes syscalls; surprisingly the multi-connection
     # configs can win EVEN on one core (deeper aggregate pipelining —
@@ -116,6 +190,20 @@ def main() -> int:
     # unloaded latency: a single synchronous caller (the p99 <50us target
     # in BASELINE.md is a no-queueing number)
     lat = run(1, 1, 0.5 if args.brief else 1.5)
+
+    # large-payload egress: GB/s with a 1MB attachment per call — the
+    # path the zero-copy rail (SEND_ZC + registered landing zones) was
+    # built for.  `egress` records which rail the bytes actually took.
+    large = None
+    if not args.brief:
+        attach = 1 << 20
+        rc = L.trpc_run_echo_bench(b"127.0.0.1", port, 2, 16, 16, attach,
+                                   2.0, out)
+        if rc == 0 and out[0] > 0:
+            large = {"gbps": round(out[8], 3), "qps": round(out[0], 1),
+                     "attach_bytes": attach}
+    egress = egress_label()
+
     ref_qps_per_core = 1_000_000 / 24.0  # docs/cn/benchmark.md:7 low end
     cores_used = min(ncpu, workers)  # bench engages `workers` cores at most
     vs = (qps / cores_used) / ref_qps_per_core
@@ -132,7 +220,16 @@ def main() -> int:
         "concurrency": conc,
         "cores": ncpu,
         "transport": "io_uring" if use_ring else "epoll",
+        "egress": egress,
     }
+    if large is not None:
+        result["large_gbps"] = large["gbps"]
+        result["large_attach_bytes"] = large["attach_bytes"]
+        result["large_qps"] = large["qps"]
+        result["sendzc_submitted"] = native_counter(
+            "native_uring_sendzc_submitted")
+        result["sendzc_copied"] = native_counter(
+            "native_uring_sendzc_copied")
     if ncpu >= 2 and not args.brief and args.cores == 0 \
             and not args.no_scaling:
         # multi-core host: emit the per-core scaling table automatically

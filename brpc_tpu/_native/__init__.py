@@ -196,6 +196,8 @@ def _declare(L: ctypes.CDLL) -> None:
     L.trpc_pa_write.restype = c.c_int
     L.trpc_pa_close.argtypes = [c.c_uint64]
     L.trpc_pa_close.restype = c.c_int
+    L.trpc_pa_close_trailers.argtypes = [c.c_uint64, c.c_char_p]
+    L.trpc_pa_close_trailers.restype = c.c_int
 
     # auth
     L.trpc_server_set_auth.argtypes = [c.c_void_p, c.c_char_p, c.c_size_t]
@@ -218,6 +220,14 @@ def _declare(L: ctypes.CDLL) -> None:
     L.trpc_set_io_uring.restype = None
     L.trpc_io_uring_available.argtypes = []
     L.trpc_io_uring_available.restype = c.c_int
+    L.trpc_set_sendzc.argtypes = [c.c_int]
+    L.trpc_set_sendzc.restype = None
+    L.trpc_set_sendzc_threshold.argtypes = [c.c_uint64]
+    L.trpc_set_sendzc_threshold.restype = None
+    L.trpc_sendzc_available.argtypes = []
+    L.trpc_sendzc_available.restype = c.c_int
+    L.trpc_sendzc_active.argtypes = []
+    L.trpc_sendzc_active.restype = c.c_int
 
     # crc32c
     L.trpc_crc32c_extend.argtypes = [c.c_uint32, c.c_char_p, c.c_size_t]

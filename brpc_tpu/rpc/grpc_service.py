@@ -168,12 +168,17 @@ def _wrap(method_full: str, handler) -> Callable:
                                "deadline expired before dispatch")
         try:
             out = fn(cntl, msgs if streaming_in else msgs[0])
-            if streaming_out:
-                out = list(out or ())  # drain the iterator inside the guard
         except errors.RpcError as e:
             return _grpc_error(_CODE_MAP.get(e.code, GRPC_UNKNOWN), e.text)
         except Exception as e:  # noqa: BLE001 — handler bug → INTERNAL
             return _grpc_error(GRPC_INTERNAL, str(e))
+        if streaming_out:
+            # progressive: each yielded message flushes as DATA frames
+            # the moment the handler produces it; grpc-status rides the
+            # trailers at generator exhaustion.  Long-lived streams emit
+            # incrementally, and a slow client's flow control reaches
+            # back through the blocked write and paces the handler.
+            return _pump_streaming(cntl, iter(out or ()), deadline)
         if cntl.failed():
             return _grpc_error(_CODE_MAP.get(cntl.error_code, GRPC_UNKNOWN),
                                cntl.error_text)
@@ -182,10 +187,9 @@ def _wrap(method_full: str, handler) -> Callable:
             # to the peer (≙ grpc.cpp:208 deadline semantics)
             return _grpc_error(GRPC_DEADLINE_EXCEEDED,
                                "handler exceeded grpc-timeout")
-        if not streaming_out:
-            if isinstance(out, tuple):
-                out = out[0]
-            out = [out or b""]
+        if isinstance(out, tuple):
+            out = out[0]
+        out = [out or b""]
         body = b"".join(b"\x00" + len(m).to_bytes(4, "big") + m
                         for m in out)
         return HttpResponse(
@@ -193,6 +197,47 @@ def _wrap(method_full: str, handler) -> Callable:
             trailers={"grpc-status": "0"})
 
     return serve
+
+
+def _pump_streaming(cntl, gen, deadline):
+    """Drive a server/bidi-streaming handler's iterator through a
+    progressive response: one length-prefixed frame per message, written
+    (and flushed by the h2 layer) as it is produced; errors raised
+    mid-stream land in the trailers like real gRPC servers do."""
+    pa = HttpResponse.progressive(200,
+                                  {"content-type": "application/grpc"})
+
+    def pump():
+        status, message = GRPC_OK, ""
+        try:
+            for m in gen:
+                if deadline is not None and time.monotonic() >= deadline:
+                    status = GRPC_DEADLINE_EXCEEDED
+                    message = "handler exceeded grpc-timeout"
+                    break
+                pa.write(b"\x00" + len(m).to_bytes(4, "big") + m)
+            else:
+                if cntl.failed():
+                    status = _CODE_MAP.get(cntl.error_code, GRPC_UNKNOWN)
+                    message = cntl.error_text
+        except errors.RpcError as e:
+            status, message = _CODE_MAP.get(e.code, GRPC_UNKNOWN), e.text
+        except BrokenPipeError:
+            return  # peer reset the stream: no one left to trailer
+        except TimeoutError:
+            # live stream, but the peer stopped crediting flow control
+            # for >30s: end it with a real status (the trailers queue
+            # and flush whenever the window reopens or the stream dies)
+            status, message = GRPC_UNAVAILABLE, "flow-control stall"
+        except Exception as e:  # noqa: BLE001 — handler bug → INTERNAL
+            status, message = GRPC_INTERNAL, str(e)
+        trailers = {"grpc-status": str(status)}
+        if status != GRPC_OK and message:
+            trailers["grpc-message"] = _encode_grpc_message(message)
+        pa.close(trailers=trailers)
+
+    pa.on_bound = pump
+    return pa
 
 
 def install_grpc_service(server, service_name: str,

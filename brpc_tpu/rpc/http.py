@@ -70,14 +70,19 @@ class HttpResponse:
 
 
 class ProgressiveAttachment:
-    """Server half of a chunked stream.  The HTTP dispatch layer binds it
-    to the native PaState right after the handler returns; write() blocks
-    until then, so background writer threads can start immediately."""
+    """Server half of a streaming response (h1: chunked encoding; h2:
+    open DATA frames on the request's stream, client flow control pacing
+    blocked writes).  The HTTP dispatch layer binds it to the native
+    PaState right after the handler returns; write() blocks until then,
+    so background writer threads can start immediately.  Set `on_bound`
+    to a callable to drive the stream INLINE on the dispatch thread right
+    after binding (gRPC server-streaming pumps generators this way)."""
 
     def __init__(self, status: int, headers: Dict[str, str]):
         import threading as _t
         self.status = status
         self.headers = headers
+        self.on_bound = None  # optional: called after _bind, same thread
         self._handle = None
         self._bound = _t.Event()
         self._closed = False
@@ -88,26 +93,45 @@ class ProgressiveAttachment:
 
     def write(self, data: bytes) -> None:
         """One chunk onto the wire.  Raises BrokenPipeError once the
-        peer is gone, so infinite writers terminate."""
+        peer is gone, so infinite writers terminate.  On h2 this blocks
+        while the client's flow-control windows are exhausted."""
         if not self._bound.wait(timeout=30):
             raise RuntimeError("progressive response never bound")
         if self._closed or not self._handle:
             raise BrokenPipeError("progressive response closed")
         from brpc_tpu._native import lib
+        import errno as _errno
         rc = lib().trpc_pa_write(self._handle, data, len(data))
+        if rc == -_errno.ETIMEDOUT:
+            # h2 flow-control stall: the stream is alive, the peer just
+            # stopped crediting it for >30s.  Not a broken pipe — the
+            # caller decides (retry, or close with a real status).
+            raise TimeoutError("peer flow control stalled the stream")
         if rc != 0:
+            # close the NATIVE side before marking closed: without it a
+            # dead h2 stream would leak its PaState slot and H2Conn
+            # reference forever (close() below early-returns on _closed,
+            # and no teardown abort path exists for h2 attachments)
             self._closed = True
+            lib().trpc_pa_close(self._handle)
             raise BrokenPipeError(f"chunk write failed ({rc})")
 
-    def close(self) -> None:
-        """Final chunk; the connection closes after it flushes."""
+    def close(self, trailers: Optional[Dict[str, str]] = None) -> None:
+        """End the stream.  h1: final chunk, then the connection closes.
+        h2: trailing HEADERS carrying `trailers` (gRPC status) — or a
+        bare END_STREAM — and the connection keeps multiplexing."""
         if not self._bound.wait(timeout=30):
             return
         if self._closed or not self._handle:
             return
         self._closed = True
         from brpc_tpu._native import lib
-        lib().trpc_pa_close(self._handle)
+        if trailers:
+            blob = "".join(f"{k}: {v}\r\n"
+                           for k, v in trailers.items()).encode()
+            lib().trpc_pa_close_trailers(self._handle, blob)
+        else:
+            lib().trpc_pa_close(self._handle)
 
 
 # A handler returns HttpResponse | str (text/plain) | bytes (octet-stream) |

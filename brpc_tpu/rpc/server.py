@@ -36,6 +36,15 @@ flags.define_bool("use_io_uring", False,
                   "serve accepts + reads through io_uring (FORK "
                   "RingListener \u2259 socket.h:360); falls back to epoll "
                   "when the kernel refuses the ring")
+flags.define_bool("use_sendzc", True,
+                  "zero-copy egress on the io_uring transport: large "
+                  "write-queue blocks leave as IORING_OP_SEND_ZC with "
+                  "registered landing-zone buffers; falls back to writev "
+                  "when the kernel lacks SEND_ZC or reports that it "
+                  "copies anyway (no effect unless use_io_uring)")
+flags.define_int32("sendzc_threshold_bytes", 16384,
+                   "IOBuf blocks at least this large ride SEND_ZC; "
+                   "smaller refs gather into linked SENDMSG ops")
 def _push_usercode_cap(value) -> bool:
     """Flag validator doubling as the live-reload hook: every /flags set
     propagates straight into the native admission check."""
@@ -423,20 +432,27 @@ class Server:
                 resp = dispatcher.dispatch(req)
                 from brpc_tpu.rpc.http import ProgressiveAttachment
                 if isinstance(resp, ProgressiveAttachment):
-                    # chunked stream: headers go out now (sequenced), the
-                    # handler's writer keeps the pa and streams chunks
+                    # streaming response: headers go out now (h1:
+                    # sequenced chunked stream; h2: HEADERS on the
+                    # request's stream), then either the handler's own
+                    # writer thread streams chunks, or on_bound pumps
+                    # them inline on this usercode thread (gRPC
+                    # server-streaming — client flow control paces it)
                     handle = L.trpc_http_respond_progressive(
                         token, resp.status, pack_headers(resp.headers))
                     resp._bind(int(handle))
                     if not handle:
-                        # h2 request or dead connection: the client must
-                        # still get an answer, not a hung stream
+                        # dead connection / already-reset stream: the
+                        # client must still get an answer, not a hang
                         log.LOG(log.LOG_ERROR,
-                                "progressive respond failed (h2 or dead "
-                                "conn), %s", req.path)
-                        msg = b"progressive responses require HTTP/1.1\n"
-                        L.trpc_http_respond(token, 505, None, msg,
+                                "progressive respond failed (dead conn "
+                                "or reset stream), %s", req.path)
+                        msg = b"progressive response setup failed\n"
+                        L.trpc_http_respond(token, 500, None, msg,
                                             len(msg))
+                        return
+                    if resp.on_bound is not None:
+                        resp.on_bound()
                     return
                 body = b"" if req.method == "HEAD" else resp.body
                 if resp.trailers:
@@ -469,6 +485,10 @@ class Server:
             int(flags.get_flag("event_dispatcher_num")))
         lib().trpc_set_io_uring(
             1 if flags.get_flag("use_io_uring") else 0)
+        lib().trpc_set_sendzc(
+            1 if flags.get_flag("use_sendzc") else 0)
+        lib().trpc_set_sendzc_threshold(
+            int(flags.get_flag("sendzc_threshold_bytes")))
         if self.options.enable_builtin_services:
             from brpc_tpu.builtin import install_builtin_services
             install_builtin_services(self, self.http)

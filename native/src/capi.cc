@@ -175,6 +175,17 @@ void trpc_set_event_dispatcher_num(int n) {
 void trpc_set_io_uring(int on) { uring_set_enabled(on != 0); }
 int trpc_io_uring_available() { return uring_available() ? 1 : 0; }
 
+// Zero-copy egress rail (uring.h SEND_ZC): rides the ring transport;
+// large write-queue blocks leave as IORING_OP_SEND_ZC.
+void trpc_set_sendzc(int on) { uring_set_sendzc(on != 0); }
+void trpc_set_sendzc_threshold(uint64_t bytes) {
+  uring_set_sendzc_threshold((size_t)bytes);
+}
+int trpc_sendzc_available() { return uring_sendzc_available() ? 1 : 0; }
+// 1 = a send submitted now would ride SEND_ZC; 0 = writev (rail off,
+// kernel without SEND_ZC, or zerocopy notifications reported copies).
+int trpc_sendzc_active() { return uring_egress_ready() ? 1 : 0; }
+
 int trpc_respond(uint64_t token, int32_t error_code, const char* error_text,
                  const uint8_t* data, size_t len, const uint8_t* attach,
                  size_t attach_len) {
@@ -277,6 +288,13 @@ int trpc_pa_write(uint64_t pa, const uint8_t* data, size_t len) {
 }
 
 int trpc_pa_close(uint64_t pa) { return pa_close(pa); }
+
+// h2 progressive responses end with a trailing HEADERS block (gRPC
+// status rides here); trailers_blob is "Key: Value\r\n" lines, ignored
+// on HTTP/1.1 connections.
+int trpc_pa_close_trailers(uint64_t pa, const char* trailers_blob) {
+  return pa_close_trailers(pa, trailers_blob);
+}
 
 // --- HTTP/2 client ----------------------------------------------------------
 
@@ -627,7 +645,7 @@ int64_t trpc_tpu_d2h(uint64_t id, uint8_t** out) {
   *out = (uint8_t*)mem;  // the DMA landing zone itself — no second copy
   return (int64_t)n;
 }
-void trpc_tpu_buf_release(uint8_t* p) { hp_free(p); }
+void trpc_tpu_buf_release(uint8_t* p) { tpu_host_free(p); }
 void trpc_tpu_buf_free(uint64_t id) { tpu_buf_free(id); }
 
 void trpc_tpu_plane_stats(uint64_t out[11]) {

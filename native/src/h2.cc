@@ -6,6 +6,7 @@
 #include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <stdlib.h>
 #include <string.h>
 #include <sys/socket.h>
 #include <unistd.h>
@@ -28,9 +29,26 @@ constexpr char kPreface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
 constexpr size_t kPrefaceLen = 24;
 constexpr uint32_t kMaxFrameAccept = 1u << 20;   // 1MB per frame
 constexpr size_t kMaxHeaderBlock = 256u * 1024;
-constexpr size_t kMaxBodyBytes = 512u * 1024 * 1024;
 constexpr uint32_t kDefaultWindow = 65535;
 constexpr uint32_t kOurMaxFrameSize = 16384;
+
+// Per-request body cap.  Hitting it is a PER-STREAM failure (413 +
+// RST_STREAM, the connection and its other streams live on), not the
+// old connection-wide GOAWAY.  Env-tunable so tests can exercise the
+// early-response path without uploading half a gigabyte.
+size_t max_body_bytes() {
+  static const size_t v = [] {
+    const char* e = getenv("TRPC_H2_MAX_BODY");
+    if (e != nullptr && e[0] != '\0') {
+      long long n = strtoll(e, nullptr, 10);
+      if (n >= 4096) {
+        return (size_t)n;
+      }
+    }
+    return (size_t)512u * 1024 * 1024;
+  }();
+  return v;
+}
 
 enum FrameType : uint8_t {
   F_DATA = 0x0, F_HEADERS = 0x1, F_PRIORITY = 0x2, F_RST = 0x3,
@@ -253,6 +271,10 @@ struct StreamState {
   bool headers_done = false;
   bool end_stream = false;
   bool responded = false;
+  // progressive response in flight (H2RespondStart): FlushPending must
+  // NOT end the stream when pending drains — more DATA is coming; only
+  // H2StreamClose ends it
+  bool progressive = false;
   H2Request req;
   int64_t send_window = kDefaultWindow;
   // bytes waiting for window (flushed on WINDOW_UPDATE), then trailers
@@ -278,6 +300,15 @@ class H2Conn {
   // through bthread ExecutionQueue instead of contending the conn lock)
   SocketId sock_id = INVALID_SOCKET_ID;
   ExecutionQueue resp_q;
+  // bumped whenever send windows can have grown (WINDOW_UPDATE, SETTINGS
+  // initial-window) or a stream died (RST, teardown): progressive
+  // writers parked in H2StreamData re-check on every bump
+  Butex* window_butex = nullptr;
+  ~H2Conn() {
+    if (window_butex != nullptr) {
+      butex_destroy(window_butex);
+    }
+  }
 };
 
 namespace {
@@ -302,6 +333,14 @@ void write_frames(Socket* s, const std::string& frames) {
   IOBuf b;
   b.append(frames.data(), frames.size());
   s->Write(std::move(b));
+}
+
+void put_rst_stream(std::string* s, uint32_t sid, uint32_t err) {
+  put_frame_header(s, 4, F_RST, 0, sid);
+  s->push_back((char)((err >> 24) & 0xff));
+  s->push_back((char)((err >> 16) & 0xff));
+  s->push_back((char)((err >> 8) & 0xff));
+  s->push_back((char)(err & 0xff));
 }
 
 // HPACK encode: literal without indexing, new name, no huffman.
@@ -466,10 +505,21 @@ void H2RespondAsync(H2Conn* c, uint32_t stream_id, int status,
   c->resp_q.Submit(t);
 }
 
+namespace {
+// Wake progressive writers parked on the connection's window butex.
+void bump_window_butex(H2Conn* c) {
+  if (c->window_butex != nullptr) {
+    butex_value(c->window_butex).fetch_add(1, std::memory_order_release);
+    butex_wake_all(c->window_butex);
+  }
+}
+}  // namespace
+
 H2Conn* H2ConnCreate(Socket* s) {
   native_metrics().h2_connections.fetch_add(1, std::memory_order_relaxed);
   H2Conn* c = new H2Conn();
   c->refs.store(2, std::memory_order_relaxed);  // registry + caller
+  c->window_butex = butex_create();
   c->sock_id = s->id();
   c->resp_q.Init(RunRespondTask, c, RespQStart, RespQExit);
   s->is_h2.store(true, std::memory_order_release);
@@ -532,6 +582,9 @@ void H2ConnDestroy(SocketId id) {
           1, std::memory_order_relaxed);
     }
   }
+  if (c != nullptr) {
+    bump_window_butex(c);  // parked progressive writers re-check and fail
+  }
   H2ConnRelease(c);  // drop the registry's reference
 }
 
@@ -547,7 +600,8 @@ void FlushPending(H2Conn* c, Socket* s, uint32_t sid, StreamState* st,
                              (size_t)st->send_window,
                              (size_t)kOurMaxFrameSize});
     bool last = chunk == st->pending.size();
-    bool end_stream = last && st->pending_trailers.empty();
+    bool end_stream = last && st->pending_trailers.empty() &&
+                      !st->progressive;
     put_frame_header(frames, (uint32_t)chunk, F_DATA,
                      end_stream ? FLAG_END_STREAM : 0, sid);
     frames->append(st->pending.data(), chunk);
@@ -561,7 +615,14 @@ void FlushPending(H2Conn* c, Socket* s, uint32_t sid, StreamState* st,
     frames->append(st->pending_trailers);
     st->pending_trailers.clear();
   }
-  if (st->pending.empty() && st->pending_trailers.empty() && st->responded) {
+  if (st->pending.empty() && st->pending_trailers.empty() &&
+      st->responded && !st->progressive) {
+    if (!st->end_stream) {
+      // response finished first (END_STREAM already framed above):
+      // RST_STREAM(NO_ERROR) tells the peer to abandon the rest of its
+      // upload, RFC 9113 §8.1
+      put_rst_stream(frames, sid, 0);
+    }
     c->streams.erase(sid);
   }
 }
@@ -625,6 +686,9 @@ int H2ConnConsume(H2Conn* c, Socket* s, std::vector<H2Request>* out) {
             for (auto& kv : c->streams) {
               kv.second.send_window += delta;
             }
+            if (delta > 0) {
+              bump_window_butex(c);
+            }
           }
           // id 0x1 (HEADER_TABLE_SIZE) declares the PEER's decoder table;
           // our encoder never indexes, so nothing to adjust — and our
@@ -661,7 +725,8 @@ int H2ConnConsume(H2Conn* c, Socket* s, std::vector<H2Request>* out) {
             }
           }
         }
-        // windows reopened: flush anything queued
+        // windows reopened: flush anything queued, then wake parked
+        // progressive writers (their budget may have cleared)
         std::vector<uint32_t> sids;
         for (auto& kv : c->streams) sids.push_back(kv.first);
         for (uint32_t fsid : sids) {
@@ -670,6 +735,7 @@ int H2ConnConsume(H2Conn* c, Socket* s, std::vector<H2Request>* out) {
             FlushPending(c, s, fsid, &it->second, &reply);
           }
         }
+        bump_window_butex(c);
         break;
       }
       case F_HEADERS: {
@@ -805,9 +871,34 @@ int H2ConnConsume(H2Conn* c, Socket* s, std::vector<H2Request>* out) {
           n -= pad;
         }
         st.req.body.append((const char*)p + off, n - off);
-        if (st.req.body.size() > kMaxBodyBytes) {
-          if (!reply.empty()) write_frames(s, reply);
-          return FatalGoaway(s, sid, 11);
+        if (st.req.body.size() > max_body_bytes()) {
+          // over the body cap: a complete 413 response before the
+          // request ends, then RST_STREAM(NO_ERROR) per RFC 9113 §8.1
+          // so the client stops uploading instead of stalling once its
+          // stream window drains (we stop crediting an erased stream).
+          // Strictly per-stream: other streams on the connection and
+          // the connection window stay live.
+          std::string block;
+          block.push_back((char)0x08);  // literal, name = :status
+          block.push_back((char)3);
+          block += "413";
+          put_frame_header(&reply, (uint32_t)block.size(), F_HEADERS,
+                           FLAG_END_HEADERS | FLAG_END_STREAM, sid);
+          reply += block;
+          put_rst_stream(&reply, sid, 0 /*NO_ERROR*/);
+          c->streams.erase(sid);
+          // credit the CONNECTION window for this frame (the stream is
+          // gone, but its bytes came out of the shared window — without
+          // this, every 413 permanently shrinks it; later frames on the
+          // erased stream are credited by the not-found branch above)
+          if (len > 0) {
+            put_frame_header(&reply, 4, F_WINDOW_UPDATE, 0, 0);
+            reply.push_back((char)((len >> 24) & 0x7f));
+            reply.push_back((char)((len >> 16) & 0xff));
+            reply.push_back((char)((len >> 8) & 0xff));
+            reply.push_back((char)(len & 0xff));
+          }
+          break;
         }
         // replenish recv windows (conn + stream) by what we consumed
         if (len > 0) {
@@ -831,6 +922,9 @@ int H2ConnConsume(H2Conn* c, Socket* s, std::vector<H2Request>* out) {
       }
       case F_RST: {
         c->streams.erase(sid);
+        // a progressive writer may be parked on this stream's window:
+        // wake it so it observes the stream is gone
+        bump_window_butex(c);
         break;
       }
       case F_GOAWAY: {
@@ -849,6 +943,30 @@ int H2ConnConsume(H2Conn* c, Socket* s, std::vector<H2Request>* out) {
   return 0;
 }
 
+namespace {
+// :status pseudo-header (static table where possible) + header blob.
+void encode_status_headers(std::string* block, int status,
+                           const char* headers_blob) {
+  switch (status) {  // RFC 7541 static entries 8..14
+    case 200: block->push_back((char)0x88); break;
+    case 204: block->push_back((char)0x89); break;
+    case 206: block->push_back((char)0x8a); break;
+    case 304: block->push_back((char)0x8b); break;
+    case 400: block->push_back((char)0x8c); break;
+    case 404: block->push_back((char)0x8d); break;
+    case 500: block->push_back((char)0x8e); break;
+    default: {
+      // literal w/o indexing, name = static index 8 (:status)
+      block->push_back((char)0x08);
+      std::string v = std::to_string(status);
+      block->push_back((char)v.size());
+      *block += v;
+    }
+  }
+  encode_blob(block, headers_blob);
+}
+}  // namespace
+
 int H2Respond(H2Conn* c, Socket* s, uint32_t stream_id, int status,
               const char* headers_blob, const uint8_t* body,
               size_t body_len, const char* trailers_blob) {
@@ -858,26 +976,13 @@ int H2Respond(H2Conn* c, Socket* s, uint32_t stream_id, int status,
     return -1;  // client reset the stream
   }
   StreamState& st = it->second;
+  if (st.progressive || st.responded) {
+    return -1;  // already owned by a progressive response
+  }
   std::string frames;
   // response HEADERS
   std::string block;
-  switch (status) {  // RFC 7541 static entries 8..14
-    case 200: block.push_back((char)0x88); break;
-    case 204: block.push_back((char)0x89); break;
-    case 206: block.push_back((char)0x8a); break;
-    case 304: block.push_back((char)0x8b); break;
-    case 400: block.push_back((char)0x8c); break;
-    case 404: block.push_back((char)0x8d); break;
-    case 500: block.push_back((char)0x8e); break;
-    default: {
-      // literal w/o indexing, name = static index 8 (:status)
-      block.push_back((char)0x08);
-      std::string v = std::to_string(status);
-      block.push_back((char)v.size());
-      block += v;
-    }
-  }
-  encode_blob(&block, headers_blob);
+  encode_status_headers(&block, status, headers_blob);
   bool no_body = body_len == 0 && trailers_blob == nullptr;
   put_frame_header(&frames, (uint32_t)block.size(), F_HEADERS,
                    FLAG_END_HEADERS | (no_body ? FLAG_END_STREAM : 0),
@@ -885,6 +990,13 @@ int H2Respond(H2Conn* c, Socket* s, uint32_t stream_id, int status,
   frames += block;
   st.responded = true;
   if (no_body) {
+    if (!st.end_stream) {
+      // complete response before the request body ended: RFC 9113 §8.1
+      // says RST_STREAM(NO_ERROR) so the peer abandons the upload (we
+      // stop crediting the erased stream's window and a conformant
+      // sender would otherwise stall on it)
+      put_rst_stream(&frames, stream_id, 0);
+    }
     c->streams.erase(stream_id);
     write_frames(s, frames);
     return 0;
@@ -897,6 +1009,133 @@ int H2Respond(H2Conn* c, Socket* s, uint32_t stream_id, int status,
   }
   FlushPending(c, s, stream_id, &st, &frames);
   write_frames(s, frames);
+  return 0;
+}
+
+// --- progressive server responses (h2.h) -----------------------------------
+
+namespace {
+// Window-blocked bytes a progressive stream may buffer before its
+// writer parks: deep enough to ride out one client credit round-trip,
+// shallow enough that client flow control actually paces the handler.
+constexpr size_t kProgressiveHighWater = 256 * 1024;
+}  // namespace
+
+int H2RespondStart(H2Conn* c, Socket* s, uint32_t stream_id, int status,
+                   const char* headers_blob) {
+  std::lock_guard lk(c->mu);
+  auto it = c->streams.find(stream_id);
+  if (it == c->streams.end()) {
+    return -EPIPE;  // client reset the stream
+  }
+  StreamState& st = it->second;
+  if (st.responded || st.progressive) {
+    return -EINVAL;
+  }
+  st.progressive = true;
+  std::string frames;
+  std::string block;
+  encode_status_headers(&block, status, headers_blob);
+  put_frame_header(&frames, (uint32_t)block.size(), F_HEADERS,
+                   FLAG_END_HEADERS, stream_id);  // stream stays open
+  frames += block;
+  write_frames(s, frames);
+  return 0;
+}
+
+int H2StreamData(H2Conn* c, uint32_t stream_id, const uint8_t* data,
+                 size_t len, int64_t timeout_us) {
+  if (len == 0) {
+    return 0;
+  }
+  int64_t deadline = monotonic_us() + timeout_us;
+  size_t off = 0;
+  while (off < len) {
+    Socket* s = Socket::Address(c->sock_id);
+    if (s == nullptr) {
+      return -EPIPE;  // connection gone
+    }
+    int32_t seq;
+    {
+      std::lock_guard lk(c->mu);
+      auto it = c->streams.find(stream_id);
+      if (it == c->streams.end() || !it->second.progressive) {
+        s->Dereference();
+        return -EPIPE;  // stream RST / already closed
+      }
+      StreamState& st = it->second;
+      if (st.pending.size() < kProgressiveHighWater) {
+        // append AT MOST up to the high-water mark: one oversized
+        // write must not balloon st.pending past the bound — the
+        // remainder waits for the peer to drain what's queued, so per-
+        // stream memory stays capped at high-water + one frame
+        size_t room = kProgressiveHighWater - st.pending.size();
+        size_t take = len - off < room ? len - off : room;
+        st.pending.append((const char*)data + off, take);
+        off += take;
+        std::string frames;
+        FlushPending(c, s, stream_id, &st, &frames);
+        if (!frames.empty()) {
+          write_frames(s, frames);
+        }
+        s->Dereference();
+        continue;  // more to queue? re-check budget (flush may have
+                   // drained pending within the windows)
+      }
+      // over high water: park until the peer credits a window (or the
+      // stream/connection dies) — this is where client flow control
+      // reaches back and paces the producing handler
+      seq = butex_value(c->window_butex).load(std::memory_order_acquire);
+    }
+    s->Dereference();
+    int64_t left = deadline - monotonic_us();
+    if (left <= 0) {
+      return -ETIMEDOUT;
+    }
+    butex_wait(c->window_butex, seq,
+               left < 200 * 1000 ? left : 200 * 1000);
+  }
+  return 0;
+}
+
+int H2StreamClose(H2Conn* c, uint32_t stream_id,
+                  const char* trailers_blob) {
+  Socket* s = Socket::Address(c->sock_id);
+  if (s == nullptr) {
+    return -EPIPE;
+  }
+  std::lock_guard lk(c->mu);
+  auto it = c->streams.find(stream_id);
+  if (it == c->streams.end()) {
+    s->Dereference();
+    return 0;  // client reset first: nothing left to finish
+  }
+  StreamState& st = it->second;
+  st.progressive = false;
+  st.responded = true;
+  std::string frames;
+  if (trailers_blob != nullptr && trailers_blob[0] != '\0') {
+    std::string tblock;
+    encode_blob(&tblock, trailers_blob);
+    st.pending_trailers = std::move(tblock);
+  }
+  if (st.pending.empty() && st.pending_trailers.empty()) {
+    // nothing buffered and no trailers: a bare END_STREAM DATA frame
+    put_frame_header(&frames, 0, F_DATA, FLAG_END_STREAM, stream_id);
+    if (!st.end_stream) {
+      put_rst_stream(&frames, stream_id, 0);  // RFC 9113 §8.1
+    }
+    c->streams.erase(stream_id);
+  } else {
+    // FlushPending ends the stream (trailers or final DATA) and RSTs
+    // the unfinished request side when it drains — possibly on a later
+    // WINDOW_UPDATE if the peer's windows are currently exhausted
+    FlushPending(c, s, stream_id, &st, &frames);
+  }
+  if (!frames.empty()) {
+    write_frames(s, frames);
+  }
+  s->Dereference();
   return 0;
 }
 
@@ -1210,8 +1449,27 @@ void H2ClientOnMessages(Socket* s) {
                   .fetch_add(1, std::memory_order_release);
               butex_wake_all(st->data_butex);
             }
-            // stream-window credit comes from h2_client_stream_read:
-            // a slow reader deliberately throttles the server
+            // stream-window credit for DATA comes from
+            // h2_client_stream_read: a slow reader deliberately
+            // throttles the server.  Padding overhead (the pad-length
+            // byte + pad bytes, n - dlen) never reaches the reader, so
+            // it is credited AT ARRIVAL — a padding-heavy server would
+            // otherwise permanently shrink the 4MB stream window (every
+            // padded frame consumes n of it but only dlen ever gets
+            // credited back).  stream_unacked holds only bytes the
+            // reader consumed plus this overhead, so flushing it here
+            // cannot open the window for unread data.
+            st->stream_unacked += (uint64_t)(n - dlen);
+            if (!(flags & FLAG_END_STREAM) &&
+                st->stream_unacked >= (uint64_t)kClientStreamWindow / 2) {
+              put_frame_header(&reply, 4, F_WINDOW_UPDATE, 0, sid);
+              uint32_t inc = (uint32_t)st->stream_unacked;
+              reply.push_back((char)((inc >> 24) & 0x7f));
+              reply.push_back((char)(inc >> 16));
+              reply.push_back((char)(inc >> 8));
+              reply.push_back((char)inc);
+              st->stream_unacked = 0;
+            }
           } else {
             st->result.body.append((const char*)p + off, dlen);
             // unary consumes on arrival: credit the stream window so
@@ -1232,9 +1490,13 @@ void H2ClientOnMessages(Socket* s) {
             H2ClientCompleteLocked(c, sid, st, 0);
           }
         }
-        // replenish the connection window in 1MB slabs (streams got a
-        // 1GB initial window via SETTINGS and don't need per-stream
-        // updates for bodies under that)
+        // replenish the CONNECTION window in 1MB slabs.  (The conn
+        // window was opened to 1GB via WINDOW_UPDATE at create; each
+        // STREAM got a 4MB initial window via SETTINGS
+        // INITIAL_WINDOW_SIZE = kClientStreamWindow and is credited
+        // separately: unary streams on arrival, streaming reads from
+        // h2_client_stream_read — a slow reader throttles the server —
+        // and padding overhead at arrival, all in half-window slabs.)
         if (c->consumed_since_update >= (1 << 20)) {
           put_frame_header(&reply, 4, F_WINDOW_UPDATE, 0, 0);
           uint32_t inc = (uint32_t)c->consumed_since_update;

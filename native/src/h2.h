@@ -69,6 +69,23 @@ void H2RespondAsync(H2Conn* c, uint32_t stream_id, int status,
                     const char* headers_blob, const uint8_t* body,
                     size_t body_len, const char* trailers_blob);
 
+// --- progressive server responses on one h2 stream (the h1
+// ProgressiveAttachment's h2 face; gRPC server/bidi streaming rides it:
+// each yielded message flushes as DATA frames, trailers carry
+// grpc-status at generator exhaustion) -------------------------------------
+// Start: response HEADERS without END_STREAM.  Data: appends and
+// flushes DATA under the peer's flow-control windows; above a high-water
+// mark of window-blocked bytes the calling (usercode) thread parks until
+// the client credits the stream — client flow control paces the handler.
+// Close: drains, then trailers (or an empty END_STREAM DATA frame), plus
+// RST_STREAM(NO_ERROR) when the request body never ended (RFC 9113
+// §8.1).  All return 0 or -errno (-EPIPE once the stream/conn is gone).
+int H2RespondStart(H2Conn* c, Socket* s, uint32_t stream_id, int status,
+                   const char* headers_blob);
+int H2StreamData(H2Conn* c, uint32_t stream_id, const uint8_t* data,
+                 size_t len, int64_t timeout_us);
+int H2StreamClose(H2Conn* c, uint32_t stream_id, const char* trailers_blob);
+
 // --- HTTP/2 client (h2c prior knowledge; the client half of
 // policy/http2_rpc_protocol.cpp) ------------------------------------------
 // One connection multiplexes concurrent calls on odd stream ids; send

@@ -114,6 +114,16 @@ class IOBuf {
 
   size_t block_count() const { return refs_.size(); }
   const BlockRef& ref_at(size_t i) const { return refs_[i]; }
+  // Any single ref of at least n bytes?  (The egress rail's eligibility
+  // check: such a block is worth an IORING_OP_SEND_ZC of its own.)
+  bool has_block_ge(size_t n) const {
+    for (const auto& r : refs_) {
+      if (r.length >= n) {
+        return true;
+      }
+    }
+    return false;
+  }
 
  private:
   void push_ref(const BlockRef& r);

@@ -52,6 +52,14 @@ size_t native_metrics_dump(char* buf, size_t cap) {
   put("native_uring_accepts", relu(m.uring_accepts));
   put("native_uring_rearms", relu(m.uring_rearms));
   put("native_uring_active_recvs", rel(m.uring_active_recvs));
+  put("native_uring_sendzc_submitted", relu(m.uring_sendzc_submitted));
+  put("native_uring_sendzc_retired", relu(m.uring_sendzc_retired));
+  put("native_uring_sendzc_copied", relu(m.uring_sendzc_copied));
+  put("native_uring_sendzc_fixed", relu(m.uring_sendzc_fixed));
+  put("native_uring_sendzc_batches", relu(m.uring_sendzc_batches));
+  put("native_uring_sendzc_fallbacks", relu(m.uring_sendzc_fallbacks));
+  put("native_uring_zc_pool_slots", rel(m.uring_zc_pool_slots));
+  put("native_uring_zc_pool_in_use", rel(m.uring_zc_pool_in_use));
   put("tpu_h2d_transfers", (long long)t.h2d_transfers);
   put("tpu_d2h_transfers", (long long)t.d2h_transfers);
   put("tpu_h2d_bytes", (long long)t.h2d_bytes);

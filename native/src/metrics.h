@@ -59,6 +59,22 @@ struct NativeMetrics {
   std::atomic<uint64_t> uring_accepts{0};
   std::atomic<uint64_t> uring_rearms{0};       // multishot re-issues
   std::atomic<int64_t> uring_active_recvs{0};  // armed connections
+
+  // zero-copy egress rail (uring.cc SEND_ZC): submitted = SEND_ZC SQEs,
+  // retired = their zerocopy-notification CQEs (kernel done with the
+  // pages; block refs drop here), copied = notifications that reported a
+  // forced kernel copy (flips the rail back to writev), fixed =
+  // registered-buffer sends, fallbacks = rail-eligible batches that went
+  // through writev instead
+  std::atomic<uint64_t> uring_sendzc_submitted{0};
+  std::atomic<uint64_t> uring_sendzc_retired{0};
+  std::atomic<uint64_t> uring_sendzc_copied{0};
+  std::atomic<uint64_t> uring_sendzc_fixed{0};
+  std::atomic<uint64_t> uring_sendzc_batches{0};
+  std::atomic<uint64_t> uring_sendzc_fallbacks{0};
+  // registered landing-zone pool occupancy
+  std::atomic<int64_t> uring_zc_pool_slots{0};
+  std::atomic<int64_t> uring_zc_pool_in_use{0};
 };
 
 NativeMetrics& native_metrics();

@@ -2092,6 +2092,11 @@ namespace {
 
 struct PaState {
   SocketId sock = INVALID_SOCKET_ID;
+  // h2 binding: non-null => chunks go out as DATA frames on h2_sid via
+  // H2StreamData (the PaState owns one H2Conn reference, dropped when
+  // the generation finalizes); null => HTTP/1.1 chunked encoding
+  void* h2c = nullptr;
+  uint32_t h2_sid = 0;
   Butex* headers_sent = nullptr;  // 0 -> 1 headers on wire; -1 aborted
   std::atomic<bool> closed{false};
   // concurrent writers inside pa_write/pa_close: the slot returns to the
@@ -2123,6 +2128,10 @@ void PaMaybeFree(PaState* pa) {
     // the generation dies HERE, not at close: PaAbort must still be able
     // to address the state by its token to wake a closer waiting for
     // headers on a connection that just died
+    if (pa->h2c != nullptr) {
+      H2ConnRelease((H2Conn*)pa->h2c);
+      pa->h2c = nullptr;
+    }
     pa->version.fetch_add(1, std::memory_order_release);
     ResourcePool<PaState>::Return(pa->slot);
   }
@@ -2195,13 +2204,12 @@ uint64_t http_respond_progressive(uint64_t token, int status,
       ctx->version.load(std::memory_order_acquire) != ver) {
     return 0;
   }
-  if (ctx->h2_stream != 0) {
-    return 0;  // h1-only for now (h2 would use open DATA streams)
-  }
   PaState* pa = nullptr;
   uint32_t pa_slot = ResourcePool<PaState>::Get(&pa);
   pa->slot = pa_slot;
   pa->sock = ctx->sock;
+  pa->h2c = nullptr;
+  pa->h2_sid = 0;
   pa->writers.store(0, std::memory_order_relaxed);
   pa->finalized.store(false, std::memory_order_relaxed);
   pa->closed.store(false, std::memory_order_relaxed);
@@ -2211,26 +2219,54 @@ uint64_t http_respond_progressive(uint64_t token, int status,
   butex_value(pa->headers_sent).store(0, std::memory_order_relaxed);
   uint64_t pa_token = pa->token();
 
-  Socket* s = Socket::Address(ctx->sock);
-  if (s == nullptr) {
+  auto drop_pa = [&]() {
     pa->version.fetch_add(1, std::memory_order_release);
     ResourcePool<PaState>::Return(pa_slot);
-    return 0;
+    return (uint64_t)0;
+  };
+
+  if (ctx->h2_stream != 0) {
+    // HTTP/2: response HEADERS go out now (streams multiplex — no
+    // sequencer hold), chunks follow as DATA frames on this stream
+    H2Conn* c = H2ConnFind(ctx->sock);
+    if (c == nullptr) {
+      return drop_pa();
+    }
+    Socket* s = Socket::Address(ctx->sock);
+    if (s == nullptr) {
+      H2ConnRelease(c);
+      return drop_pa();
+    }
+    int rc = H2RespondStart(c, s, ctx->h2_stream, status, headers_blob);
+    s->Dereference();
+    if (rc != 0) {
+      H2ConnRelease(c);
+      return drop_pa();
+    }
+    pa->h2c = c;  // the PaState keeps this reference until finalize
+    pa->h2_sid = ctx->h2_stream;
+    // no sequencer in front of the frames: writable immediately
+    butex_value(pa->headers_sent).store(1, std::memory_order_release);
+  } else {
+    Socket* s = Socket::Address(ctx->sock);
+    if (s == nullptr) {
+      return drop_pa();
+    }
+    IOBuf head;
+    std::string h = "HTTP/1.1 " + std::to_string(status) + " ";
+    h += HttpStatusText(status);
+    h += "\r\n";
+    if (headers_blob != nullptr) {
+      h += headers_blob;
+    }
+    h += "Transfer-Encoding: chunked\r\nConnection: close\r\n\r\n";
+    head.append(h.data(), h.size());
+    ConnState::Ready entry;
+    entry.data = std::move(head);
+    entry.pa_token = pa_token;
+    ReleaseSequencedEntry(s, ctx->pipe_seq, std::move(entry));
+    s->Dereference();
   }
-  IOBuf head;
-  std::string h = "HTTP/1.1 " + std::to_string(status) + " ";
-  h += HttpStatusText(status);
-  h += "\r\n";
-  if (headers_blob != nullptr) {
-    h += headers_blob;
-  }
-  h += "Transfer-Encoding: chunked\r\nConnection: close\r\n\r\n";
-  head.append(h.data(), h.size());
-  ConnState::Ready entry;
-  entry.data = std::move(head);
-  entry.pa_token = pa_token;
-  ReleaseSequencedEntry(s, ctx->pipe_seq, std::move(entry));
-  s->Dereference();
 
   ctx->version.fetch_add(1, std::memory_order_release);
   ctx->payload.clear();
@@ -2238,6 +2274,7 @@ uint64_t http_respond_progressive(uint64_t token, int status,
   ctx->http_query.clear();
   ctx->http_headers.clear();
   ctx->is_http = false;
+  ctx->h2_stream = 0;
   ResourcePool<CallCtx>::Return(slot);
   return pa_token;
 }
@@ -2267,6 +2304,18 @@ int pa_write(uint64_t pa_token, const uint8_t* data, size_t len) {
   int rc;
   if (hv < 0) {
     rc = -TRPC_EFAILEDSOCKET;  // aborted: connection died pre-headers
+  } else if (pa->h2c != nullptr) {
+    // h2: DATA frames under the peer's flow control — this parks the
+    // writer when the client stops crediting the stream (pacing)
+    rc = H2StreamData((H2Conn*)pa->h2c, pa->h2_sid, data, len,
+                      30ll * 1000 * 1000);
+    if (rc != 0 && rc != -ETIMEDOUT) {
+      rc = -TRPC_EFAILEDSOCKET;
+    }
+    // -ETIMEDOUT passes through untranslated: a >30s flow-control stall
+    // on a LIVE stream is the peer exercising backpressure, not a dead
+    // socket — callers can end the stream with a proper status instead
+    // of a bare reset
   } else {
     Socket* s = Socket::Address(pa->sock);
     if (s == nullptr) {
@@ -2282,7 +2331,7 @@ int pa_write(uint64_t pa_token, const uint8_t* data, size_t len) {
   return rc;
 }
 
-int pa_close(uint64_t pa_token) {
+int pa_close_trailers(uint64_t pa_token, const char* trailers_blob) {
   PaState* pa;
   if (!PaEnterWriter(pa_token, &pa)) {
     return -EINVAL;
@@ -2299,17 +2348,27 @@ int pa_close(uint64_t pa_token) {
     butex_wait(pa->headers_sent, 0, 1000000);
   }
   if (hv >= 0) {
-    Socket* s = Socket::Address(pa->sock);
-    if (s != nullptr) {
-      IOBuf fin;
-      fin.append("0\r\n\r\n", 5);
-      CloseAfterWrite(s, std::move(fin));
-      s->Dereference();
+    if (pa->h2c != nullptr) {
+      // h2: trailing HEADERS (gRPC status) or bare END_STREAM; the
+      // connection lives on — streams multiplex
+      H2StreamClose((H2Conn*)pa->h2c, pa->h2_sid, trailers_blob);
+    } else {
+      // h1 chunked has no trailer negotiation (we never sent TE):
+      // trailers_blob is dropped; final chunk then active close
+      Socket* s = Socket::Address(pa->sock);
+      if (s != nullptr) {
+        IOBuf fin;
+        fin.append("0\r\n\r\n", 5);
+        CloseAfterWrite(s, std::move(fin));
+        s->Dereference();
+      }
     }
   }  // aborted: nothing to finalize
   PaExitWriter(pa);
   return 0;
 }
+
+int pa_close(uint64_t pa_token) { return pa_close_trailers(pa_token, nullptr); }
 
 int token_compress_type(uint64_t token) {
   uint32_t slot = (uint32_t)token;

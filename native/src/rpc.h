@@ -142,16 +142,21 @@ int server_register_protocol(Server* s, const char* name,
                              void* user);
 int proto_respond(uint64_t token, const uint8_t* data, size_t len);
 
-// ProgressiveAttachment (≙ progressive_attachment.h:32): turn an HTTP/1.x
-// request's response into a chunked stream.  Returns a pa handle (0 on
-// error; h2 unsupported).  pa_write frames one chunk (blocks until the
-// headers have reached the wire); pa_close sends the final chunk and
-// closes the connection.  The connection stops serving pipelined
-// responses once the stream begins.
+// ProgressiveAttachment (≙ progressive_attachment.h:32): turn a
+// request's response into a stream.  HTTP/1.x: Transfer-Encoding
+// chunked, connection closes at the end (the connection stops serving
+// pipelined responses once the stream begins).  HTTP/2: open DATA
+// frames on the request's stream, multiplexing untouched, with client
+// flow control pacing blocked pa_write calls.  Returns a pa handle (0
+// on error).  pa_write frames one chunk (blocks until the headers have
+// reached the wire, and on h2 while the peer's windows are full);
+// pa_close_trailers ends the stream — on h2 the trailers blob (e.g.
+// grpc-status) rides the trailing HEADERS; on h1 trailers are ignored.
 uint64_t http_respond_progressive(uint64_t token, int status,
                                   const char* headers_blob);
 int pa_write(uint64_t pa, const uint8_t* data, size_t len);
 int pa_close(uint64_t pa);
+int pa_close_trailers(uint64_t pa, const char* trailers_blob);
 // Require this credential (meta tag 13) on every TRPC request.
 void server_set_auth(Server* s, const uint8_t* secret, size_t len);
 // TLS on the shared port (PEM cert chain + key; optional client-cert

@@ -57,6 +57,7 @@ int Socket::Create(const SocketOptions& opts, SocketId* id_out) {
   s->is_h2.store(false, std::memory_order_relaxed);
   s->advertise_device_caps.store(false, std::memory_order_relaxed);
   s->peer_plane_uid.store(0, std::memory_order_relaxed);
+  s->sendzc_copied.store(false, std::memory_order_relaxed);
   s->corked = opts.corked;
   s->frame_bytes_hint = 0;
   s->frame_attach_hint = 0;
@@ -440,8 +441,15 @@ int Socket::WriteRaw(IOBuf&& data, Butex* notify) {
   req->next.store(nullptr, std::memory_order_relaxed);
   // corked: skip the inline write; the flush fiber runs after the other
   // ready fibers, so their writes chain onto the stack and drain as one
-  // writev (single-syscall batching on a shared client connection)
-  if (!corked) {
+  // writev (single-syscall batching on a shared client connection).
+  // Rail-bound writes (a block the zero-copy egress would SEND_ZC) skip
+  // it too: an inline writev would chop the big block's head off and
+  // send it through the copying path.
+  bool rail_bound = (!sendzc_copied.load(std::memory_order_acquire) ||
+                     uring_sendzc_forced()) &&
+                    uring_egress_ready() &&
+                    req->data.has_block_ge(uring_sendzc_threshold());
+  if (!corked && !rail_bound) {
   // we are the writer: one inline write attempt, then hand off
   if (!failed.load(std::memory_order_acquire)) {
     ssize_t n = req->data.cut_into_fd(fd);
@@ -550,7 +558,60 @@ void Socket::RunKeepWrite(WriteRequest* req) {
       ObjectPool<WriteRequest>::Return(req);
       req = next;
     }
-    // drain the merged batch
+    // drain the merged batch.  Large frames ride the zero-copy egress
+    // rail when the ring grants it: the WHOLE drained queue goes to the
+    // engine as one linked SQE chain (single io_uring_enter), big blocks
+    // as SEND_ZC, and this fiber parks on the ticket until the batch is
+    // on the wire — writer-ship is held throughout, so ordering with the
+    // writev fallback below can never interleave.
+    if (!merged.empty() && !s->failed.load(std::memory_order_acquire) &&
+        merged.has_block_ge(uring_sendzc_threshold())) {
+      bool route_ok = !s->sendzc_copied.load(std::memory_order_acquire) ||
+                      uring_sendzc_forced();
+      if (route_ok && uring_egress_ready()) {
+        size_t batch_bytes = merged.size();
+        SendTicket* t = uring_sendzc_submit(s->id(), s->fd, &merged);
+        if (t != nullptr) {
+          while (t->state.load(std::memory_order_acquire) == 0) {
+            if (s->failed.load(std::memory_order_acquire) &&
+                t->submitted.load(std::memory_order_acquire) != 0) {
+              // socket died under an already-submitted batch: the
+              // kernel holds the ops' file refs, so abandoning is safe
+              // (a recycled fd NUMBER can't reach this batch) — the
+              // failed-check below discards the rest of the queue, so
+              // ordering no longer matters.  Pre-submission we keep
+              // waiting: our socket ref pins the fd until the engine
+              // has consumed the SQEs.
+              break;
+            }
+            int32_t v = butex_value(t->done).load(std::memory_order_acquire);
+            if (t->state.load(std::memory_order_acquire) != 0) {
+              break;
+            }
+            butex_wait(t->done, v, 100 * 1000);
+          }
+          bool completed = t->state.load(std::memory_order_acquire) != 0;
+          int res = completed ? t->result : 0;
+          SendTicket::Drop(t);
+          if (completed) {
+            if (res < 0) {
+              s->SetFailed(-res);
+            } else {
+              s->bytes_out.fetch_add(batch_bytes,
+                                     std::memory_order_relaxed);
+            }
+          }
+        } else {
+          native_metrics().uring_sendzc_fallbacks.fetch_add(
+              1, std::memory_order_relaxed);
+        }
+      } else if (uring_enabled()) {
+        // rail-eligible batch the ring can't take: no SEND_ZC on this
+        // kernel, or this route's notifications reported kernel copies
+        native_metrics().uring_sendzc_fallbacks.fetch_add(
+            1, std::memory_order_relaxed);
+      }
+    }
     while (!merged.empty()) {
       if (s->failed.load(std::memory_order_acquire)) {
         merged.clear();

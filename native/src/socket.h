@@ -87,6 +87,11 @@ class Socket {
   // == our own tpu_plane_uid() means both ends share one PJRT client,
   // enabling handle-passing device frames on streams over this socket
   std::atomic<uint64_t> peer_plane_uid{0};
+  // a SEND_ZC notification on THIS connection reported the kernel
+  // copied anyway (loopback / non-SG route): the egress rail falls back
+  // to writev for this socket only — whether zerocopy works is a
+  // property of the route, not the process
+  std::atomic<bool> sendzc_copied{false};
   // opaque per-connection parser/pipelining state owned by the protocol
   // io_uring staging (uring.h RingFeed): when non-null, ReadToBuf drains
   // it instead of calling recv(2); freed at recycle time

@@ -125,10 +125,12 @@ bool sni_match(const std::string& pattern, const char* name) {
   if (pattern == lname) {
     return true;
   }
-  // "*.example.com" matches exactly one extra leading label
+  // "*.example.com" matches exactly one extra NON-EMPTY leading label:
+  // the degenerate ".example.com" (dot == 0) must not match — RFC 6125
+  // wildcards cover a label, not the absence of one
   if (pattern.size() > 2 && pattern[0] == '*' && pattern[1] == '.') {
     size_t dot = lname.find('.');
-    return dot != std::string::npos &&
+    return dot != std::string::npos && dot != 0 &&
            pattern.compare(1, std::string::npos, lname, dot,
                            std::string::npos) == 0;
   }
@@ -385,7 +387,16 @@ int tls_server_ctx_add_sni(void* base_ctx, const char* pattern,
                           (void (*)(void))servername_cb);
   s.SSL_CTX_ctrl((SSL_CTX*)base_ctx, kSSL_CTRL_SET_TLSEXT_SERVERNAME_ARG,
                  0, map);
-  map->entries.push_back(SniEntry{pattern, sub});
+  // lowercase ONCE at registration (hostnames are case-insensitive, RFC
+  // 6066/DNS): sni_match lowercases only the wire name, so an uppercase
+  // registered pattern would otherwise never match anything
+  std::string lpat(pattern);
+  for (char& ch : lpat) {
+    if (ch >= 'A' && ch <= 'Z') {
+      ch += 'a' - 'A';
+    }
+  }
+  map->entries.push_back(SniEntry{std::move(lpat), sub});
   return 0;
 }
 

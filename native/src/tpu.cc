@@ -18,12 +18,32 @@
 #include "heap_profiler.h"
 #include "object_pool.h"
 #include "timer_thread.h"
+#include "uring.h"
 
 #if defined(TRPC_HAVE_PJRT_HEADER)
 #include "xla/pjrt/c/pjrt_c_api.h"
 #endif
 
 namespace trpc {
+
+// D2H landing zones draw from the ring engine's registered-buffer pool
+// when the io_uring transport is up (≙ fabric-lib pre-registered
+// transfer buffers): the zone the DMA writes becomes an IOBuf user
+// block and leaves the host as a fixed-buffer SEND_ZC — the attachment
+// rides registered memory end to end with zero host copies.  Pool
+// exhausted / ring down: plain malloc, same lifecycle.
+namespace {
+char* zc_host_alloc(size_t len) {
+  void* p = uring_zc_alloc(len);
+  return p != nullptr ? (char*)p : (char*)hp_malloc(len);
+}
+}  // namespace
+
+void tpu_host_free(void* p) {
+  if (p != nullptr && !uring_zc_free(p)) {
+    hp_free(p);
+  }
+}
 
 #if defined(TRPC_HAVE_PJRT_HEADER)
 
@@ -679,14 +699,14 @@ static int tpu_d2h_alloc(TpuBufId id, char** mem_out, size_t* len_out) {
     // frees the landing zone unless the caller claimed it
     static void Drop(D2hCtx* c) {
       if (c->refs.fetch_sub(1, std::memory_order_acq_rel) == 1) {
-        hp_free(c->mem);
+        tpu_host_free(c->mem);
         butex_destroy(c->done);
         delete c;
       }
     }
   };
   D2hCtx* ctx = new D2hCtx{butex_create()};
-  ctx->mem = (char*)hp_malloc(len);
+  ctx->mem = zc_host_alloc(len);
   PJRT_Buffer_ToHostBuffer_Args args;
   memset(&args, 0, sizeof(args));
   args.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
@@ -776,10 +796,11 @@ int tpu_d2h_into_iobuf(TpuBufId id, IOBuf* out) {
   if (rc != 0) {
     return rc;
   }
-  // the malloc'd landing zone becomes an IOBuf user block: the socket
-  // writev sends from it with no further copies
+  // the landing zone becomes an IOBuf user block: the socket egress
+  // (fixed-buffer SEND_ZC on the ring, writev otherwise) sends from it
+  // with no further copies
   out->append_user_data(
-      mem, len, [](void* d, void*) { hp_free(d); }, nullptr);
+      mem, len, [](void* d, void*) { tpu_host_free(d); }, nullptr);
   return 0;
 }
 

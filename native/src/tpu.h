@@ -75,6 +75,10 @@ int tpu_d2h_into_iobuf(TpuBufId id, IOBuf* out);
 // caller (who free()s it) — the ctypes surface uses this to avoid a
 // second host copy.
 int tpu_d2h_raw(TpuBufId id, char** mem_out, size_t* len_out);
+// Free a d2h landing zone from tpu_d2h_raw (or any host block the plane
+// allocated): routes pool slots back to the ring's registered-buffer
+// pool and everything else to free(3).
+void tpu_host_free(void* p);
 
 // Device-to-device copy WITHIN this process's PJRT client (≙ the RDMA
 // template posting sends straight from registered blocks — no host

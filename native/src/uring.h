@@ -11,12 +11,30 @@
 // drains the staging instead of calling recv(2) — the parse path above
 // it (ServerOnMessages etc.) is unchanged.  Sockets fall back to the
 // epoll EventDispatcher transparently when the ring is unavailable.
+//
+// Zero-copy egress rail (SEND_ZC): when the kernel additionally speaks
+// IORING_OP_SEND_ZC, the socket write path hands whole drained write
+// queues to the ring as ONE linked SQE chain (single io_uring_enter):
+// large IOBuf blocks (>= uring_sendzc_threshold()) go out as SEND_ZC —
+// the engine holds their block refcounts until the kernel's second
+// (zerocopy-notification) CQE retires them, so block lifetime survives
+// socket close, call cancel and stream RST — and runs of small refs
+// gather into linked SENDMSG ops.  A registered-buffer pool
+// (io_uring_register_buffers) backs the provided-buffer recv ring and
+// hands out d2h landing zones (uring_zc_alloc), so device-plane
+// attachments ride fixed buffers end to end (IORING_RECVSEND_FIXED_BUF
+// skips the per-send page pinning).  Fallback is always the plain
+// writev path: kernel without SEND_ZC, ring down, or the zerocopy
+// notifications reporting that the kernel copied anyway (loopback and
+// non-SG routes do; a report flips THAT CONNECTION back to writev —
+// Socket::sendzc_copied — while NIC-backed peers keep the rail).
 #pragma once
 
 #include <cstddef>
 #include <cstdint>
 #include <mutex>
 
+#include "fiber.h"
 #include "iobuf.h"
 #include "socket.h"
 
@@ -59,5 +77,63 @@ void uring_cancel(SocketId id);
 // Tear down a listener's multishot accept.  Synchronous: on return no
 // accept callback can fire for this fd (safe to free its Server).
 void uring_remove_acceptor(int fd);
+
+// --- zero-copy egress rail -------------------------------------------------
+
+// Kernel speaks IORING_OP_SEND_ZC (probed via IORING_REGISTER_PROBE).
+bool uring_sendzc_available();
+
+// Python-facing switches (flags use_sendzc / sendzc_threshold_bytes).
+void uring_set_sendzc(bool on);
+void uring_set_sendzc_threshold(size_t bytes);
+size_t uring_sendzc_threshold();
+
+// True when the PROCESS can ride the rail: engine up, SEND_ZC
+// supported, flag on.  Callers additionally consult the per-connection
+// copied verdict (Socket::sendzc_copied, set when a zerocopy
+// notification reports the kernel copied on that route — writev is
+// strictly cheaper there) unless uring_sendzc_forced() pins the rail on
+// for A/B benchmarking.
+bool uring_egress_ready();
+bool uring_sendzc_forced();  // TRPC_SENDZC_FORCE=1
+
+// Waiter half of a batch submission.  The submitting fiber creates the
+// ticket (refs=2: itself + the engine), waits on `done` until `state`
+// becomes nonzero, reads `result` (0 or -errno for the whole batch) and
+// drops its ref; the engine signals and drops the other.  Whoever drops
+// the last ref frees the butex and the ticket, so neither side can wake
+// or wait on freed memory.  `submitted` flips once the batch's SQEs
+// have passed io_uring_enter: from then on the kernel holds its own
+// file references, so the waiter may abandon a failed socket without
+// risking the engine later submitting against a recycled fd number.
+struct SendTicket {
+  Butex* done = nullptr;
+  std::atomic<int> state{0};      // 0 in flight, 1 completed
+  std::atomic<int> submitted{0};  // SQEs consumed by the kernel
+  int result = 0;
+  std::atomic<int> refs{2};
+  static SendTicket* New();
+  static void Drop(SendTicket* t);
+};
+
+// Submit `*data` for fd as one linked SQE chain.  On success *data is
+// consumed (its block refs stay held until every zerocopy notification
+// CQE lands) and the returned ticket completes when the whole batch is
+// on the wire — wait on it, read result, Drop it.  On nullptr *data is
+// untouched and the caller falls back to writev.
+SendTicket* uring_sendzc_submit(SocketId id, int fd, IOBuf* data);
+
+// Registered-buffer pool: fixed-size host slots registered with the
+// ring at engine bring-up.  nullptr when the pool is exhausted, the
+// engine is down, or len exceeds the slot size — callers fall back to
+// plain malloc.  uring_zc_free returns false for foreign pointers (so
+// one free path can serve both allocators); uring_zc_buf_index maps a
+// [p, p+len) range to its registered-buffer index, -1 when it is not
+// (fully inside) a pool slot.
+void* uring_zc_alloc(size_t len);
+bool uring_zc_free(void* p);
+int uring_zc_buf_index(const void* p, size_t len);
+// Pool occupancy for /vars: total slots and slots currently handed out.
+void uring_zc_pool_stats(int64_t* slots, int64_t* in_use);
 
 }  // namespace trpc

@@ -40,6 +40,11 @@ from jax.extend import backend as _jex_backend  # noqa: E402
 
 _jex_backend.clear_backends()
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax: the XLA_FLAGS --xla_force_host_platform_device_count=8
+    # set above already provides the 8 virtual CPU devices
+    pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -38,12 +38,26 @@ def server():
         time.sleep(0.4)
         return b"too late"
 
+    # lockstep gates: the handler may only produce step i+1 after the
+    # test's CLIENT acked step i — possible only if each yielded message
+    # is flushed as DATA frames the moment it is produced
+    gates = [threading.Event() for _ in range(3)]
+    seen["gates"] = gates
+
+    def lockstep(cntl, msg):
+        yield b"step-0"
+        for i, g in enumerate(gates):
+            if not g.wait(10):
+                raise RuntimeError(f"client never acked step {i}")
+            yield b"step-%d" % (i + 1)
+
     srv.add_grpc_service("stream.Test", {
         "Big": ServerStreaming(
             lambda cntl, m: [b"A" * 2_000_000 for _ in range(3)]),
         "BidiEcho": BidiStreaming(bidi_echo),
         "Collect": ClientStreaming(collect),
         "FanOut": ServerStreaming(fan_out),
+        "Lockstep": ServerStreaming(lockstep),
         "TimeoutProbe": timeout_probe,
         "Slow": slow,
     })
@@ -220,4 +234,28 @@ class TestStockClientOwnServer:
             request_serializer=lambda b: b,
             response_deserializer=lambda b: b)
         assert list(stub(b"q")) == [b"q-0", b"q-1", b"q-2", b"q-3"]
+        ch.close()
+
+    def test_lockstep_server_streaming_against_grpcio_client(self, server):
+        """TRUE incremental flush: stock grpcio must receive step i while
+        the handler is still parked waiting for the test to ack it — a
+        server that buffers the whole generator deadlocks here (the
+        handler waits for an ack the client can never send)."""
+        srv, seen = server
+        gates = seen["gates"]
+        for g in gates:
+            g.clear()
+        ch = grpc.insecure_channel(f"127.0.0.1:{srv.port}")
+        stub = ch.unary_stream(
+            "/stream.Test/Lockstep",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b)
+        it = stub(b"go", timeout=15)
+        # step-0 arrives while the handler is blocked on gates[0]
+        assert next(it) == b"step-0"
+        for i, g in enumerate(gates):
+            g.set()  # ack: only now may the handler yield step i+1
+            assert next(it) == b"step-%d" % (i + 1)
+        with pytest.raises(StopIteration):
+            next(it)
         ch.close()

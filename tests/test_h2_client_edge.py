@@ -119,6 +119,69 @@ def test_early_end_stream_wins_over_unfinished_upload():
     assert p.out["scheme_http"]
 
 
+def test_padding_overhead_credited_to_stream_window():
+    """A padding-heavy server (RFC 9113 §6.1 FLAG_PADDED) consumes the
+    receive window by the FULL frame payload n while the reader only ever
+    consumes dlen bytes of data.  The client must credit the overhead
+    (n - dlen) back at arrival, or every padded frame permanently shrinks
+    the 4MB stream window and a conformant server stalls.  The peer
+    models the client's advertised windows exactly and only sends while
+    window remains — with the overhead lost, it starves and times out."""
+    F_WINDOW_UPDATE = 0x8
+    FLAG_PADDED = 0x8
+    data_piece = b"d" * 16
+    pad = 255
+    # frame payload: pad-length byte + data + pad bytes
+    padded_payload = bytes([pad]) + data_piece + b"\x00" * pad
+    per_frame = len(padded_payload)          # window cost: 272
+    nframes = (5 << 20) // per_frame         # ~5MB consumed, >4MB window
+
+    def peer(conn, out):
+        sid, _ = _await_headers(conn)
+        conn.sendall(_frame(F_HEADERS, FLAG_END_HEADERS, sid, b"\x88"))
+        window = 4 << 20  # client SETTINGS INITIAL_WINDOW_SIZE
+        credited = 0
+        sent = 0
+        batch = []
+        while sent < nframes:
+            if window < per_frame:
+                # starved: wait for stream-level credit (times out and
+                # raises without the padding-overhead fix)
+                typ, flags, fsid, payload = _read_frame(conn)
+                if typ == F_WINDOW_UPDATE and fsid == sid:
+                    inc = int.from_bytes(payload, "big") & 0x7FFFFFFF
+                    window += inc
+                    credited += inc
+                continue
+            batch.append(_frame(F_DATA, FLAG_PADDED, sid, padded_payload))
+            window -= per_frame
+            sent += 1
+            if len(batch) == 64 or sent == nframes:
+                conn.sendall(b"".join(batch))
+                batch = []
+        conn.sendall(_frame(F_DATA, FLAG_END_STREAM, sid, b"END"))
+        out["credited"] = credited
+        out["sent"] = sent
+
+    p = _Peer(peer)
+    ch = H2Channel(f"127.0.0.1:{p.port}")
+    st = ch.open_stream("POST", "/padded")
+    got = bytearray()
+    while True:
+        chunk = st.read(timeout_ms=30_000.0)
+        if chunk is None:
+            break
+        got += chunk
+    p.join()
+    st.destroy()
+    ch.close()
+    assert p.out["sent"] == nframes
+    assert bytes(got) == data_piece * nframes + b"END"
+    # the peer was necessarily starved below one window and revived by
+    # credits covering (mostly) padding overhead
+    assert p.out["credited"] > 0
+
+
 def test_hpack_state_survives_timed_out_stream():
     """Response headers for a stream the client already abandoned still
     mutate the connection-wide HPACK dynamic table; a later response

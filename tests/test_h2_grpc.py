@@ -267,6 +267,79 @@ class TestH2RawFrames:
         s.close()
 
 
+def test_early_413_rst_stops_upload_no_stall():
+    """Upload past the (env-shrunk) per-request body cap: the server
+    answers a complete 413 before the request body ends, then
+    RST_STREAM(NO_ERROR) per RFC 9113 §8.1 — the client learns to stop
+    uploading instead of stalling once the erased stream's window stops
+    being credited.  Strictly per-stream: no GOAWAY, and a second
+    request on the SAME connection still serves.  Subprocess server: the
+    cap is latched from the env on first use."""
+    import os
+    import socket as pysocket
+    import sys
+    import textwrap
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    code = textwrap.dedent("""\
+        import sys, time
+        sys.path.insert(0, %r)
+        from brpc_tpu.rpc.server import Server
+        srv = Server()
+        srv.add_echo_service()
+        srv.start("127.0.0.1:0")
+        print("PORT", srv.port, flush=True)
+        time.sleep(60)
+    """) % repo
+    env = dict(os.environ)
+    env["TRPC_H2_MAX_BODY"] = "65536"
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE, text=True, env=env)
+    try:
+        port = None
+        deadline = time.monotonic() + 30
+        line = proc.stdout.readline()
+        assert line.startswith("PORT "), line
+        port = int(line.split()[1])
+        s = pysocket.create_connection(("127.0.0.1", port), timeout=10)
+        s.sendall(b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n" + _frame(4, 0, 0))
+        post = (_hpack_lit(b":method", b"POST") +
+                _hpack_lit(b":path", b"/health") +
+                _hpack_lit(b":scheme", b"http") +
+                _hpack_lit(b":authority", b"t"))
+        s.sendall(_frame(1, 0x4, 1, post))  # END_HEADERS, request open
+        # upload well past the 64KB cap without ever half-closing
+        chunk = b"u" * 16384
+        for _ in range(6):
+            s.sendall(_frame(0, 0, 1, chunk))
+        frames = _read_frames(s, 1.5)
+        # complete response first: HEADERS with :status 413 + END_STREAM
+        resp = [(fl, p) for t, fl, sid, p in frames
+                if t == 1 and sid == 1]
+        assert resp, frames
+        fl, p = resp[0]
+        assert fl & 0x1, "response must END_STREAM"  # complete before RST
+        assert p == b"\x08\x03413", p  # literal :status 413
+        # then RST_STREAM(NO_ERROR), per-stream only — no GOAWAY
+        rsts = [p for t, fl, sid, p in frames if t == 3 and sid == 1]
+        assert rsts and int.from_bytes(rsts[0], "big") == 0, frames
+        assert not any(t == 7 for t, fl, sid, p in frames), "GOAWAY leaked"
+        # the connection still serves: a second, well-behaved stream
+        get = (_hpack_lit(b":method", b"GET") +
+               _hpack_lit(b":path", b"/health") +
+               _hpack_lit(b":scheme", b"http") +
+               _hpack_lit(b":authority", b"t"))
+        s.sendall(_frame(1, 0x5, 3, get))
+        frames2 = _read_frames(s, 1.5)
+        assert any(t == 0 and sid == 3 and p == b"OK\n"
+                   for t, fl, sid, p in frames2), frames2
+        s.close()
+        assert time.monotonic() < deadline
+    finally:
+        proc.kill()
+        proc.wait()
+
+
 class TestH2HeaderInjection:
     """RFC 9113 §8.2.1: field values with CR/LF/NUL are malformed — a
     client must not be able to inject fake header lines (e.g. a spoofed

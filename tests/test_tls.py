@@ -178,7 +178,9 @@ class TestSni:
             tls_sni=[
                 ("alpha.test", os.path.join(certs, "alpha.crt"),
                  os.path.join(certs, "alpha.key")),
-                ("bravo.test", os.path.join(certs, "bravo.crt"),
+                # registered MIXED-CASE on purpose: hostnames are
+                # case-insensitive, so registration must lowercase once
+                ("BRAVO.Test", os.path.join(certs, "bravo.crt"),
                  os.path.join(certs, "bravo.key")),
                 ("*.wild.test", os.path.join(certs, "wild.crt"),
                  os.path.join(certs, "wild.key")),
@@ -220,6 +222,25 @@ class TestSni:
             self._file_der(os.path.join(certs, "wild.crt"))
         # two labels deep does NOT match "*.wild.test" -> base cert
         assert self._leaf_der(port, "a.b.wild.test") == \
+            self._file_der(CERT)
+
+    def test_uppercase_registration_and_lookup_match(self, sni_server):
+        # pattern registered as "BRAVO.Test": lowercased at registration,
+        # and an uppercase wire name still selects it (RFC 6066)
+        certs = os.path.join(HERE, "certs")
+        port = sni_server.port
+        assert self._leaf_der(port, "bravo.test") == \
+            self._file_der(os.path.join(certs, "bravo.crt"))
+        assert self._leaf_der(port, "Bravo.TEST") == \
+            self._file_der(os.path.join(certs, "bravo.crt"))
+
+    def test_wildcard_rejects_empty_first_label(self, sni_server):
+        # degenerate ".wild.test" must NOT match "*.wild.test" (a
+        # wildcard covers a label, not the absence of one) -> base cert.
+        # bytes hostname: the str path idna-encodes and refuses the empty
+        # label client-side, but the wire allows it — exactly the foreign
+        # input the server must reject itself
+        assert self._leaf_der(sni_server.port, b".wild.test") == \
             self._file_der(CERT)
 
     def test_unmatched_name_falls_back_to_base_cert(self, sni_server):
