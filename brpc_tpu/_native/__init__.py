@@ -313,6 +313,24 @@ def _declare(L: ctypes.CDLL) -> None:
     L.trpc_set_usercode_max_inflight.argtypes = [c.c_int64]
     L.trpc_set_usercode_max_inflight.restype = None
 
+    # ingress fast path: run-to-completion dispatch + response corking
+    L.trpc_set_inline_dispatch.argtypes = [c.c_int]
+    L.trpc_set_inline_dispatch.restype = None
+    L.trpc_inline_dispatch_active.argtypes = []
+    L.trpc_inline_dispatch_active.restype = c.c_int
+    L.trpc_set_inline_budget_requests.argtypes = [c.c_int]
+    L.trpc_set_inline_budget_requests.restype = None
+    L.trpc_set_inline_budget_us.argtypes = [c.c_int64]
+    L.trpc_set_inline_budget_us.restype = None
+    L.trpc_token_arm_ns.argtypes = [c.c_uint64]
+    L.trpc_token_arm_ns.restype = c.c_int64
+    L.trpc_server_enable_redis_cache.argtypes = [c.c_void_p]
+    L.trpc_server_enable_redis_cache.restype = c.c_int
+    L.trpc_server_http_cache_put.argtypes = [c.c_void_p, c.c_char_p,
+                                             c.c_int, c.c_char_p,
+                                             c.c_char_p, c.c_size_t]
+    L.trpc_server_http_cache_put.restype = c.c_int
+
     # TLS (tls.h)
     L.trpc_tls_available.restype = c.c_int
     L.trpc_tls_error.restype = c.c_char_p

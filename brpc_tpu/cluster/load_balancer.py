@@ -227,6 +227,50 @@ class ConsistentHashMurmurLB(ConsistentHashLB):
         super().__init__(hasher=_hash_murmur)
 
 
+def _ketama_point(digest: bytes, j: int) -> int:
+    """32-bit continuum point j (0..3) of one MD5 digest — libketama's
+    byte order (≙ policy/hasher.cpp ketama points: four little-endian
+    u32 points carved from each 16-byte digest)."""
+    return ((digest[3 + j * 4] << 24) | (digest[2 + j * 4] << 16)
+            | (digest[1 + j * 4] << 8) | digest[j * 4])
+
+
+def _hash_ketama(data: bytes) -> int:
+    # request-code side of the continuum: point 0 of the MD5 digest
+    return _ketama_point(hashlib.md5(data).digest(), 0)
+
+
+class KetamaLB(ConsistentHashLB):
+    """Ketama continuum (reference replica-point semantics ≙
+    policy/hasher.cpp + the c_ketama arm of
+    consistent_hashing_load_balancer.cpp): each endpoint contributes
+    virtual points in groups of FOUR per MD5 digest of "endpoint-i", and
+    request codes land on the ring through the same 32-bit point formula
+    — so our placements agree with other libketama-compatible rings.
+    The ring walk itself is the base class's (_pick via _hash_ketama);
+    only the replica-point generation differs."""
+
+    name = "c_ketama"
+    replicas = 100  # rounded up to whole 4-point digest groups
+
+    def __init__(self):
+        super().__init__(hasher=_hash_ketama)
+
+    def _on_membership(self):
+        ring = []
+        for node in self.servers():
+            base = str(node.endpoint).encode()
+            groups = (self.replicas * max(node.weight, 1) + 3) // 4
+            for i in range(groups):
+                digest = hashlib.md5(base + b"-%d" % i).digest()
+                for j in range(4):
+                    ring.append((_ketama_point(digest, j), node))
+        ring.sort(key=lambda t: t[0])
+        with self._ring_lock:
+            self._ring = [h for h, _ in ring]
+            self._ring_nodes = [n for _, n in ring]
+
+
 @dataclass
 class _NodeStat:
     # EMA of latency + inflight count (≙ locality_aware_load_balancer.cpp
@@ -286,6 +330,7 @@ _LB_REGISTRY: Dict[str, Callable[[], LoadBalancer]] = {
     "wrandom": WeightedRandomLB,
     "c_md5": ConsistentHashLB,
     "c_murmurhash": ConsistentHashMurmurLB,
+    "c_ketama": KetamaLB,
     "la": LocalityAwareLB,
 }
 

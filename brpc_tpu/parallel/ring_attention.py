@@ -32,10 +32,18 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-_NEG = jnp.float32(-1e30)  # "masked" logit: finite so the online max stays
-                           # NaN-free even for fully-masked blocks
+from brpc_tpu.utils.jaxenv import shard_map_compat
+
+# "masked" logit: finite so the online max stays NaN-free even for
+# fully-masked blocks.  np.float32, NOT jnp: this module is imported
+# lazily inside a jit trace (models/transformer.py attention body), and
+# a module-level jnp constant materialized under tracing becomes a
+# DynamicJaxprTracer that leaks past the trace (UnexpectedTracerError on
+# the second jit).
+_NEG = np.float32(-1e30)
 
 
 def _ring_body(axis: str, n: int, idx, q, scale, causal, chunk, carry, step):
@@ -103,10 +111,10 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
         scale = 1.0 / (q.shape[-1] ** 0.5)
     spec = P("dp" if "dp" in mesh.axis_names else None, axis,
              "tp" if "tp" in mesh.axis_names else None, None)
-    fn = jax.shard_map(
+    shmap, nocheck = shard_map_compat()
+    fn = shmap(
         partial(_ring_shard, axis=axis, causal=causal, scale=scale),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, **nocheck)
     return fn(q, k, v)
 
 
@@ -151,8 +159,8 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp",
         scale = 1.0 / (q.shape[-1] ** 0.5)
     spec = P("dp" if "dp" in mesh.axis_names else None, axis,
              "tp" if "tp" in mesh.axis_names else None, None)
-    fn = jax.shard_map(
+    shmap, nocheck = shard_map_compat()
+    fn = shmap(
         partial(_ulysses_shard, axis=axis, causal=causal, scale=scale),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, **nocheck)
     return fn(q, k, v)

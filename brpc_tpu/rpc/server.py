@@ -11,6 +11,7 @@ details/usercode_backup_pool.cpp) and respond through trpc_respond.
 from __future__ import annotations
 
 import ctypes
+import os
 import threading
 import traceback
 from dataclasses import dataclass, field
@@ -59,6 +60,44 @@ flags.define_int32("usercode_max_inflight", 4096,
                    "before new ones get ELIMIT (0 = uncapped; "
                    "reloadable; the concurrency-limiter backstop)",
                    validator=_push_usercode_cap)
+
+
+def _push_inline_dispatch(value) -> bool:
+    lib().trpc_set_inline_dispatch(1 if value else 0)
+    return True
+
+
+def _push_inline_budget_requests(value) -> bool:
+    if value < 1:
+        return False
+    lib().trpc_set_inline_budget_requests(int(value))
+    return True
+
+
+def _push_inline_budget_us(value) -> bool:
+    if value < 1:
+        return False
+    lib().trpc_set_inline_budget_us(int(value))
+    return True
+
+
+flags.define_bool("inline_dispatch",
+                  os.environ.get("TRPC_INLINE_DISPATCH") != "0",
+                  "ingress fast path: short non-blocking handlers "
+                  "(native echo, HbmEcho without a DMA wait, native "
+                  "redis-cache commands, cached HTTP builtins) run to "
+                  "completion on the connection's parse fiber, and each "
+                  "drain's responses flush as one corked batch; off = "
+                  "spawned-path A/B baseline (TRPC_INLINE_DISPATCH=0)",
+                  validator=_push_inline_dispatch)
+flags.define_int32("inline_budget_requests", 512,
+                   "inline executions one parse drain may run before "
+                   "falling back to the spawned path (fairness cap; "
+                   "reloadable)", validator=_push_inline_budget_requests)
+flags.define_int32("inline_budget_us", 500,
+                   "µs of one parse drain spent inline before falling "
+                   "back to the spawned path (reloadable)",
+                   validator=_push_inline_budget_us)
 
 _HANDLER_CB = ctypes.CFUNCTYPE(
     None, ctypes.c_uint64, ctypes.c_char_p,
@@ -128,6 +167,10 @@ class Server:
         self._dump = dump_mod.RpcDumpContext()
         self.http = HttpDispatcher()
         self.http._server = self  # for the /rpc/<method> JSON bridge
+        # paths whose GET responses are pre-rendered into the native
+        # cached-response table at start() (ingress fast path: served
+        # inline on the parse fiber, never entering Python)
+        self._http_cacheable: list = []
 
     # -- registration (≙ Server::AddService) --------------------------------
 
@@ -192,6 +235,30 @@ class Server:
         self._cb_keepalive.append(cb)
         lib().trpc_server_set_redis_handler(
             self._handle, ctypes.cast(cb, ctypes.c_void_p), None)
+
+    def enable_native_redis_cache(self) -> None:
+        """Answer GET/SET/DEL/EXISTS/PING from a native in-memory store —
+        run-to-completion on the connection's parse fiber when the
+        ingress fast path grants it (the request never enters Python).
+        Commands outside that table still dispatch to the Python
+        RedisService if one is registered.  Call before start()."""
+        if self._started:
+            raise RuntimeError("enable_native_redis_cache after start")
+        rc = lib().trpc_server_enable_redis_cache(self._handle)
+        if rc != 0:
+            raise RuntimeError(f"enable_native_redis_cache failed ({rc})")
+
+    def cache_http_response(self, path: str) -> None:
+        """Mark a GET route as a cached-response builtin: at start() its
+        response is rendered ONCE through the normal dispatcher and
+        registered natively, so live GETs are answered inline on the
+        parse fiber with byte-identical framing.  Only for static
+        responses (e.g. /health); auth-enabled servers skip the cache
+        (the Python layer owns the credential check)."""
+        if self._started:
+            raise RuntimeError("cache_http_response after start")
+        if path not in self._http_cacheable:
+            self._http_cacheable.append(path)
 
     def add_thrift_service(self, service) -> None:
         """Make the shared port speak framed thrift (≙ brpc serving
@@ -361,6 +428,16 @@ class Server:
                     ctypes.string_at(att_p, att_len) if att_len else b"")
                 sp = span.start_span("server", cntl.method)
                 span.set_current(sp)
+                if sp is not None:
+                    # queue-inclusive arm stamp from the parse loop's
+                    # coarse clock (one native clock read per drain):
+                    # rpcz shows how long the request waited for a
+                    # usercode worker before this handler ran
+                    arm_ns = L.trpc_token_arm_ns(token)
+                    if arm_ns > 0:
+                        q_us = max(0, (t0 - arm_ns) // 1000)
+                        sp.annotate(f"usercode queue {q_us}us "
+                                    "(coarse-clock arm)")
                 out = handler(cntl, req)
                 resp, resp_att = b"", cntl.response_attachment
                 if isinstance(out, tuple):
@@ -489,6 +566,12 @@ class Server:
             1 if flags.get_flag("use_sendzc") else 0)
         lib().trpc_set_sendzc_threshold(
             int(flags.get_flag("sendzc_threshold_bytes")))
+        lib().trpc_set_inline_dispatch(
+            1 if flags.get_flag("inline_dispatch") else 0)
+        lib().trpc_set_inline_budget_requests(
+            int(flags.get_flag("inline_budget_requests")))
+        lib().trpc_set_inline_budget_us(
+            int(flags.get_flag("inline_budget_us")))
         if self.options.enable_builtin_services:
             from brpc_tpu.builtin import install_builtin_services
             install_builtin_services(self, self.http)
@@ -537,6 +620,31 @@ class Server:
         else:
             ip, _, port = address.rpartition(":")
             port = int(port)
+        if not self.options.auth:
+            # pre-render the cached builtin responses through the normal
+            # dispatcher: the native fast path then serves the exact
+            # bytes the Python handler would have produced
+            from brpc_tpu.rpc.http import ProgressiveAttachment
+            for cpath in self._http_cacheable:
+                try:
+                    resp = self.http.dispatch(
+                        HttpRequest(method="GET", path=cpath))
+                    if isinstance(resp, ProgressiveAttachment) or \
+                            resp.trailers or resp.status != 200:
+                        continue  # not a cacheable static response
+                    rc = lib().trpc_server_http_cache_put(
+                        self._handle, cpath.encode(), resp.status,
+                        pack_headers(resp.headers), resp.body,
+                        len(resp.body))
+                    if rc != 0:
+                        log.LOG(log.LOG_ERROR,
+                                "cache_http_response(%s) rejected by the "
+                                "native table (rc=%d); the route falls "
+                                "back to the Python dispatcher", cpath, rc)
+                except Exception:
+                    log.LOG(log.LOG_ERROR,
+                            "cache_http_response(%s) skipped:\n%s",
+                            cpath, traceback.format_exc())
         rc = lib().trpc_server_start(self._handle, ip.encode(), port)
         if rc != 0:
             raise OSError(-rc, f"server start failed on {address}")

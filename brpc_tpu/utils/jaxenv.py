@@ -30,3 +30,21 @@ def force_cpu_platform() -> None:
         print(f"warning: force_cpu_platform failed ({e!r}); "
               "jax may still select the tunneled platform",
               file=sys.stderr)
+
+
+def shard_map_compat():
+    """(shard_map, nocheck_kwargs) across jax generations.
+
+    jax >= 0.6 exports ``jax.shard_map`` with the ``check_vma`` kwarg;
+    0.4.x containers only have ``jax.experimental.shard_map`` with
+    ``check_rep``.  Callers splat the returned kwargs to disable the
+    replication/varying-mesh check in either generation — the parallel
+    layer must stay importable on both (test containers rotate between
+    jax builds; a bare ``from jax import shard_map`` kills collection
+    of every test that touches the mesh layer on the older ones).
+    """
+    import jax
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map, {"check_vma": False}
+    from jax.experimental.shard_map import shard_map  # noqa: PLC0415
+    return shard_map, {"check_rep": False}

@@ -166,6 +166,32 @@ void trpc_set_usercode_max_inflight(int64_t n) {
   set_usercode_max_inflight(n);
 }
 
+// Ingress fast path (run-to-completion dispatch + response corking):
+// reloadable A/B switch (TRPC_INLINE_DISPATCH env var seeds the default)
+// and the per-drain inline budget.
+void trpc_set_inline_dispatch(int on) { set_inline_dispatch(on); }
+int trpc_inline_dispatch_active() {
+  return inline_dispatch_enabled() ? 1 : 0;
+}
+void trpc_set_inline_budget_requests(int reqs) {
+  set_inline_budget_requests(reqs);
+}
+void trpc_set_inline_budget_us(int64_t us) { set_inline_budget_us(us); }
+// Coarse-clock arm time (ns) of a pending usercode request — the rpcz /
+// LatencyRecorder arm stamp, queue-inclusive; 0 for stale tokens.
+int64_t trpc_token_arm_ns(uint64_t token) { return token_arm_ns(token); }
+
+// Native redis cache + cached-response HTTP builtins (pre-start only).
+int trpc_server_enable_redis_cache(void* s) {
+  return server_enable_redis_cache((Server*)s);
+}
+int trpc_server_http_cache_put(void* s, const char* path, int status,
+                               const char* headers_blob,
+                               const uint8_t* body, size_t body_len) {
+  return server_http_cache_put((Server*)s, path, status, headers_blob,
+                               body, body_len);
+}
+
 void trpc_set_event_dispatcher_num(int n) {
   g_event_dispatcher_num.store(n, std::memory_order_relaxed);
 }

@@ -43,6 +43,20 @@ size_t native_metrics_dump(char* buf, size_t cap) {
   put("native_sockets_created", relu(m.sockets_created));
   put("native_socket_failures", relu(m.socket_failures));
   put("native_sequencer_parked", rel(m.sequencer_parked));
+  put("native_inline_dispatch_hits", relu(m.inline_dispatch_hits));
+  put("native_inline_dispatch_fallbacks", relu(m.inline_dispatch_fallbacks));
+  put("native_inline_dispatch_budget_trips",
+      relu(m.inline_dispatch_budget_trips));
+  put("native_batch_cork_flushes", relu(m.batch_cork_flushes));
+  put("native_batch_cork_responses", relu(m.batch_cork_responses));
+  {
+    // derived average (integer): how many responses one doorbell wakeup
+    // amortizes — the corking win in one number
+    long long fl = relu(m.batch_cork_flushes);
+    long long rs = relu(m.batch_cork_responses);
+    put("native_batch_cork_responses_per_flush", fl > 0 ? rs / fl : 0);
+  }
+  put("native_usercode_queue_ns_total", relu(m.usercode_queue_ns_total));
   put("native_parse_errors", relu(m.parse_errors));
   put("native_h2_connections", rel(m.h2_connections));
   put("native_mutex_contended", relu(m.mutex_contended));

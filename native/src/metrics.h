@@ -41,6 +41,27 @@ struct NativeMetrics {
   // Sustained growth means handlers complete far out of request order.
   std::atomic<int64_t> sequencer_parked{0};
 
+  // ingress fast path (rpc.cc ServerOnMessages): run-to-completion
+  // dispatch.  hits = requests executed inline on the parse fiber;
+  // fallbacks = inline-eligible requests routed to the spawned path
+  // (budget tripped or the fast path is flagged off); budget_trips =
+  // drains whose inline budget (requests or µs) ran out mid-batch.
+  std::atomic<uint64_t> inline_dispatch_hits{0};
+  std::atomic<uint64_t> inline_dispatch_fallbacks{0};
+  std::atomic<uint64_t> inline_dispatch_budget_trips{0};
+
+  // parse-batch response corking (socket.cc): while a parse drain holds
+  // the cork, responses pile onto the write queue with the doorbell held;
+  // the uncork flushes them as one writev/SEND_ZC chain.  responses =
+  // writes enqueued while corked; flushes = uncorks that had held bytes.
+  std::atomic<uint64_t> batch_cork_flushes{0};
+  std::atomic<uint64_t> batch_cork_responses{0};
+
+  // usercode arm-time accounting (rpc.cc CallCtx.arm_ns, stamped from the
+  // per-drain coarse clock): nanoseconds requests spent queued before a
+  // usercode worker picked them up
+  std::atomic<uint64_t> usercode_queue_ns_total{0};
+
   // protocol errors observed on input (both sides)
   std::atomic<uint64_t> parse_errors{0};
 

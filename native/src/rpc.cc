@@ -9,6 +9,7 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <poll.h>
+#include <stdlib.h>
 #include <string.h>
 #include <sys/socket.h>
 #include <sys/stat.h>
@@ -317,6 +318,9 @@ struct CallCtx {
   bool is_thrift = false;  // respond with a framed TBinaryProtocol message
   bool is_user_proto = false;  // user-registered protocol frame
   RedisHandlerCb rcb = nullptr;  // raw-blob cb (redis/thrift/user proto)
+  // Python-redis: first-argument key of this command (empty = key-less);
+  // same-key pipelined commands execute in order (ConnState.redis_key_q)
+  std::string redis_key;
   std::string http_path;
   std::string http_query;
   std::string http_headers;
@@ -330,6 +334,10 @@ struct CallCtx {
   // pipelining: position of this HTTP/RESP request on its connection;
   // responses release strictly in sequence (see ConnState)
   uint64_t pipe_seq = 0;
+  // arm time (coarse clock, ns) stamped when the request left the parse
+  // loop — the rpcz/LatencyRecorder arm stamp, read back via
+  // token_arm_ns; queue-inclusive without per-request clock syscalls
+  int64_t arm_ns = 0;
   uint32_t slot = 0;
   std::atomic<uint32_t> version{1};
   // cancellation (≙ server side of Controller::StartCancel +
@@ -356,6 +364,61 @@ std::atomic<int> g_usercode_workers{4};
 // queue without bound (≙ ConcurrencyLimiter, concurrency_limiter.h:29-44;
 // HTTP/RESP already cap per-connection at kMaxPipelined).
 std::atomic<int64_t> g_usercode_max_inflight{4096};
+
+// --- ingress fast path (run-to-completion dispatch) ------------------------
+// -1 = consult TRPC_INLINE_DISPATCH on first use (the bench A/B switch);
+// set_inline_dispatch overrides at runtime (reloadable flag).
+std::atomic<int> g_inline_dispatch{-1};
+// Per-drain inline budget: fall back to the spawned path after this many
+// inline executions or this many µs inside one drain, so one connection's
+// deep pipeline cannot starve the other sockets' parse fibers.
+std::atomic<int> g_inline_budget_reqs{512};
+std::atomic<int64_t> g_inline_budget_us{500};
+
+// Coarse clock: refreshed once per parse drain; every per-request
+// timestamp in the hot loop (budget checks, usercode arm times) reads
+// this instead of issuing its own clock syscall.
+std::atomic<int64_t> g_coarse_clock_ns{0};
+
+int64_t CoarseClockRefresh() {
+  int64_t t = monotonic_ns();
+  g_coarse_clock_ns.store(t, std::memory_order_relaxed);
+  return t;
+}
+
+// Tracks one drain's inline allowance.  take() grants run-to-completion
+// for one request; the first refusal of an enabled budget counts a trip.
+// The µs half re-reads the real clock only every 8th grant — between
+// checks the drain can overshoot by at most 8 short handler runs.
+struct InlineBudget {
+  int left;
+  int64_t deadline_ns;
+  bool enabled;
+  bool tripped = false;
+  uint32_t grants = 0;
+
+  InlineBudget(bool on, int64_t drain_start_ns) {
+    enabled = on;
+    left = g_inline_budget_reqs.load(std::memory_order_relaxed);
+    deadline_ns = drain_start_ns +
+                  g_inline_budget_us.load(std::memory_order_relaxed) * 1000;
+  }
+
+  bool take() {
+    if (!enabled || tripped) {
+      return false;
+    }
+    if (left <= 0 ||
+        (((++grants) & 7u) == 0 && monotonic_ns() > deadline_ns)) {
+      tripped = true;
+      native_metrics().inline_dispatch_budget_trips.fetch_add(
+          1, std::memory_order_relaxed);
+      return false;
+    }
+    --left;
+    return true;
+  }
+};
 
 // --- RPC cancellation registry (≙ Controller::StartCancel + server
 // NotifyOnCancel, controller.h:631,385-388) -------------------------------
@@ -497,6 +560,15 @@ class UsercodePool {
       lk.unlock();
       nm.usercode_queue_depth.fetch_sub(1, std::memory_order_relaxed);
       nm.usercode_running.fetch_add(1, std::memory_order_relaxed);
+      if (ctx->arm_ns > 0) {
+        // queue delay from the parse-loop arm stamp (worker-side clock
+        // read: off the hot parse fiber, one per dispatched request)
+        int64_t q_ns = monotonic_ns() - ctx->arm_ns;
+        if (q_ns > 0) {
+          nm.usercode_queue_ns_total.fetch_add((uint64_t)q_ns,
+                                               std::memory_order_relaxed);
+        }
+      }
       if (ctx->is_redis || ctx->is_thrift || ctx->is_user_proto) {
         ctx->rcb(ctx->token(), (const uint8_t*)ctx->payload.data(),
                  ctx->payload.size(), ctx->user);
@@ -535,6 +607,25 @@ struct ServiceHandler {
   void* user = nullptr;
 };
 
+// Native redis cache (server_enable_redis_cache): the GET/SET-class
+// command table the run-to-completion dispatch answers without leaving
+// the core (≙ a brpc C++ RedisService handling hot commands; redis-class
+// workloads are exactly where per-RPC software overhead dominates).  The
+// mutex guards ~one hash op per command; parse fibers of different
+// connections contend only under multi-connection redis load.
+struct RedisStore {
+  std::mutex mu;
+  std::unordered_map<std::string, std::string> kv;
+};
+
+// Pre-packed cached HTTP response (server_http_cache_put): both framing
+// variants rendered once at registration; serving appends block refs
+// (zero copy, zero formatting) under the response cork.
+struct CachedHttpResp {
+  IOBuf keep_alive;
+  IOBuf close_conn;
+};
+
 class Server {
  public:
   FlatMap<std::string, ServiceHandler> services;  // hot per-request lookup
@@ -554,6 +645,11 @@ class Server {
     void* user = nullptr;
   };
   std::vector<UserProto> user_protos;
+  // ingress fast-path tables: populated pre-start only (like
+  // user_protos), read lock-free by the parse loop
+  RedisStore* redis_store = nullptr;
+  FlatMap<std::string, CachedHttpResp> http_cache;
+  size_t http_cache_entries = 0;
   bool has_auth = false;
   std::string auth_secret;
   // TLS on the shared port: when set, connections whose first byte is a
@@ -606,8 +702,40 @@ struct ConnState {
   // socket writes happen OUTSIDE mu, yet stay in sequence order because
   // only the owner writes and it re-checks under mu between batches
   bool writer_active = false;
+  // Python-redis per-KEY execution ordering: the sequencer above only
+  // orders the replies — with data-dependent pipelines (SET k then
+  // GET k) concurrent usercode workers could run the GET first and
+  // read a value the SET hadn't written.  Commands naming the same
+  // first-argument key (the redis convention) execute in pipeline
+  // order: a map entry exists iff one command with that key is IN
+  // FLIGHT, and its deque holds the same-key waiters (redis_respond
+  // submits the next).  Key-less commands (PING-class) and distinct
+  // keys still run concurrently across the worker pool, so a slow
+  // handler never serializes an unrelated pipeline.
+  std::unordered_map<std::string, std::deque<CallCtx*>> redis_key_q;
+  // Native redis-cache execution ordering on the spawned fallback: once
+  // one cache command of this connection is running on a fallback fiber,
+  // every later cache command (inline-eligible or not) appends here and
+  // the fiber drains them in parse order — otherwise a budget-tripped
+  // "SET k" racing a next-drain inline "GET k" could read the store
+  // before the SET ran (replies would still sequence, masking it).
+  // Plain data (seq + argv); a dead connection's queue dies with the
+  // ConnState, nothing to release.
+  bool cache_fiber_active = false;
+  std::deque<std::pair<uint64_t, std::vector<std::string>>> cache_q;
 
   ~ConnState() {
+    // Python-redis commands still awaiting their key's turn when the
+    // connection died: nothing will execute them, return their slots
+    for (auto& kv : redis_key_q) {
+      for (CallCtx* c : kv.second) {
+        c->version.fetch_add(1, std::memory_order_release);
+        c->payload.clear();
+        c->redis_key.clear();
+        c->is_redis = false;
+        ResourcePool<CallCtx>::Return(c->slot);
+      }
+    }
     // responses still parked when the connection died
     if (!ready.empty()) {
       native_metrics().sequencer_parked.fetch_sub(
@@ -761,6 +889,211 @@ void SendResponse(SocketId sock_id, uint64_t correlation_id,
   s->Dereference();
 }
 
+// --- ingress fast-path executors -------------------------------------------
+
+// Hold the socket's response doorbell for one parse drain: every response
+// generated while this scope is open accumulates on the write queue and
+// flushes as one writev/SEND_ZC batch when the drain ends (any exit path
+// — the destructor is the flush doorbell).
+struct CorkScope {
+  Socket* s;
+  bool armed;
+  CorkScope(Socket* sock, bool on) : s(sock), armed(on) {
+    if (armed) {
+      s->Cork();
+    }
+  }
+  ~CorkScope() {
+    if (armed) {
+      s->Uncork();
+    }
+  }
+};
+
+// Spawned-path native echo: one fiber + one response write per request —
+// the pre-fast-path shape (and the TRPC_INLINE_DISPATCH=0 A/B baseline).
+struct EchoFiberArg {
+  SocketId sock;
+  uint64_t corr;
+  uint8_t compress;
+  IOBuf payload;
+  IOBuf attachment;
+};
+
+void EchoFiber(void* p) {
+  EchoFiberArg* a = (EchoFiberArg*)p;
+  SendResponse(a->sock, a->corr, 0, nullptr, std::move(a->payload),
+               std::move(a->attachment), 0, 0, a->compress);
+  a->payload.clear();
+  a->attachment.clear();
+  ObjectPool<EchoFiberArg>::Return(a);
+}
+
+// HBM echo per-request context — pooled (object_pool.h) instead of a heap
+// new/delete per request; the DMA waits park this fiber, never the
+// connection's parse loop.
+struct HbmEchoArg {
+  SocketId sock;
+  uint64_t corr;
+  IOBuf payload;
+  IOBuf attachment;
+};
+
+void HbmEchoFiber(void* p) {
+  HbmEchoArg* a = (HbmEchoArg*)p;
+  IOBuf resp_attach;
+  int32_t err = 0;
+  const char* etext = nullptr;
+  if (!a->attachment.empty()) {
+    if (!tpu_plane_available()) {
+      err = TRPC_EINTERNAL;
+      etext = "device plane unavailable";
+    } else {
+      TpuBufId id = tpu_h2d_from_iobuf(a->attachment, 0);
+      if (id == 0 || tpu_buf_wait(id, tpu_d2d_timeout_us()) != 0 ||
+          tpu_d2h_into_iobuf(id, &resp_attach) != 0) {
+        err = TRPC_EINTERNAL;
+        etext = "device transfer failed";
+      }
+      if (id != 0) {
+        tpu_buf_free(id);
+      }
+    }
+  }
+  SendResponse(a->sock, a->corr, err, etext, std::move(a->payload),
+               std::move(resp_attach));
+  a->payload.clear();
+  a->attachment.clear();
+  ObjectPool<HbmEchoArg>::Return(a);
+}
+
+// True when the native redis cache owns this command (name + arity).
+// Everything else falls through to the registered Python handler.
+bool RedisCacheHandles(const std::vector<std::string>& argv) {
+  if (argv.empty()) {
+    return false;
+  }
+  const std::string& c = argv[0];
+  switch (c.size()) {
+    case 3:
+      return (strcasecmp(c.c_str(), "GET") == 0 && argv.size() == 2) ||
+             (strcasecmp(c.c_str(), "SET") == 0 && argv.size() == 3) ||
+             (strcasecmp(c.c_str(), "DEL") == 0 && argv.size() >= 2);
+    case 4:
+      return strcasecmp(c.c_str(), "PING") == 0 && argv.size() <= 2;
+    case 6:
+      return strcasecmp(c.c_str(), "EXISTS") == 0 && argv.size() >= 2;
+    default:
+      return false;
+  }
+}
+
+// Execute one cache-owned command; reply is fully RESP-encoded.  Short
+// and non-blocking by construction — run-to-completion safe.
+void RedisCacheExec(RedisStore* st, const std::vector<std::string>& argv,
+                    IOBuf* reply) {
+  const std::string& c = argv[0];
+  if (strcasecmp(c.c_str(), "GET") == 0) {
+    std::lock_guard lk(st->mu);
+    auto it = st->kv.find(argv[1]);
+    if (it == st->kv.end()) {
+      reply->append("$-1\r\n", 5);
+    } else {
+      char h[24];
+      int n = snprintf(h, sizeof(h), "$%zu\r\n", it->second.size());
+      reply->append(h, (size_t)n);
+      reply->append(it->second.data(), it->second.size());
+      reply->append("\r\n", 2);
+    }
+    return;
+  }
+  if (strcasecmp(c.c_str(), "SET") == 0) {
+    {
+      std::lock_guard lk(st->mu);
+      st->kv[argv[1]] = argv[2];
+    }
+    reply->append("+OK\r\n", 5);
+    return;
+  }
+  if (strcasecmp(c.c_str(), "DEL") == 0 ||
+      strcasecmp(c.c_str(), "EXISTS") == 0) {
+    bool del = (c[0] == 'D' || c[0] == 'd');
+    size_t n = 0;
+    std::lock_guard lk(st->mu);
+    for (size_t i = 1; i < argv.size(); ++i) {
+      if (del) {
+        n += st->kv.erase(argv[i]);
+      } else {
+        n += st->kv.count(argv[i]);
+      }
+    }
+    char h[24];
+    int len = snprintf(h, sizeof(h), ":%zu\r\n", n);
+    reply->append(h, (size_t)len);
+    return;
+  }
+  // PING [msg]
+  if (argv.size() == 2) {
+    char h[24];
+    int n = snprintf(h, sizeof(h), "$%zu\r\n", argv[1].size());
+    reply->append(h, (size_t)n);
+    reply->append(argv[1].data(), argv[1].size());
+    reply->append("\r\n", 2);
+  } else {
+    reply->append("+PONG\r\n", 7);
+  }
+}
+
+// Spawned-path cache command: budget tripped (or fast path off) — same
+// execution, on its own fiber, reply still released through the
+// sequencer.  Addressing the socket first pins the Server (server_destroy
+// WaitRecycle's every connection before freeing the store).
+struct RedisCacheFiberArg {
+  SocketId sock;
+  uint64_t seq;
+  RedisStore* store;
+  std::vector<std::string> argv;
+};
+
+void RedisCacheFiber(void* p) {
+  RedisCacheFiberArg* a = (RedisCacheFiberArg*)p;
+  Socket* s = Socket::Address(a->sock);
+  if (s != nullptr) {
+    IOBuf reply;
+    RedisCacheExec(a->store, a->argv, &reply);
+    ReleaseSequenced(s, a->seq, std::move(reply), false);
+    // drain the cache commands that queued behind this one (see
+    // ConnState.cache_q): they execute here IN PARSE ORDER, and the
+    // parse loop keeps appending while cache_fiber_active — the
+    // empty-check and the active-clear are one critical section, so a
+    // command enqueued after our last pop is seen, and one enqueued
+    // after the clear takes the inline/spawn path afresh.
+    ConnState* cs = (ConnState*)s->parse_state;
+    if (cs != nullptr) {
+      while (true) {
+        uint64_t seq;
+        std::vector<std::string> argv;
+        {
+          std::lock_guard lk(cs->mu);
+          if (cs->cache_q.empty()) {
+            cs->cache_fiber_active = false;
+            break;
+          }
+          seq = cs->cache_q.front().first;
+          argv = std::move(cs->cache_q.front().second);
+          cs->cache_q.pop_front();
+        }
+        IOBuf r;
+        RedisCacheExec(a->store, argv, &r);
+        ReleaseSequenced(s, seq, std::move(r), false);
+      }
+    }
+    s->Dereference();
+  }
+  a->argv.clear();
+  ObjectPool<RedisCacheFiberArg>::Return(a);
+}
+
 // Constant-time credential compare (≙ VerifyCredential; not data-dependent
 // so EAUTH timing leaks neither length progress nor a matching prefix).
 bool ConstantTimeEq(const std::string& a, const std::string& b) {
@@ -815,9 +1148,43 @@ void DispatchHttp(Socket* s, Server* srv, HttpRequest&& req) {
   ctx->req_stream_window = 0;
   ctx->accepted_stream = 0;
   ctx->pipe_seq = seq;
+  ctx->arm_ns = coarse_now_ns();
   ctx->hcb = srv->http_cb;
   ctx->user = srv->http_user;
   UsercodePool::Instance().Submit(ctx);
+}
+
+// Cached-response HTTP builtin: serve a pre-packed response inline on
+// the parse fiber (GET, empty query, auth-less server, HTTP/1.x only —
+// the Python dispatcher renders identical bytes for everything this
+// declines).  Returns true when the response was released.
+bool TryServeCachedHttp(Socket* s, Server* srv, const HttpRequest& req,
+                        InlineBudget* budget) {
+  if (srv->http_cache_entries == 0 || srv->has_auth ||
+      req.method != "GET" || !req.query.empty()) {
+    return false;
+  }
+  CachedHttpResp* ce = srv->http_cache.find(req.path);
+  if (ce == nullptr || !srv->running.load(std::memory_order_acquire)) {
+    return false;
+  }
+  NativeMetrics& nm = native_metrics();
+  if (!budget->take()) {
+    nm.inline_dispatch_fallbacks.fetch_add(1, std::memory_order_relaxed);
+    return false;  // usercode path renders the same bytes
+  }
+  nm.inline_dispatch_hits.fetch_add(1, std::memory_order_relaxed);
+  srv->nrequests.fetch_add(1, std::memory_order_relaxed);
+  ConnState* cs = GetConnState(s);
+  uint64_t seq;
+  {
+    std::lock_guard lk(cs->mu);
+    seq = cs->next_dispatch++;
+  }
+  IOBuf resp;
+  resp.append(req.keep_alive ? ce->keep_alive : ce->close_conn);  // refs
+  ReleaseSequenced(s, seq, std::move(resp), !req.keep_alive);
+  return true;
 }
 
 // One parsed HTTP/2 request → usercode pool (streams are multiplexed by
@@ -859,6 +1226,7 @@ void DispatchH2(Socket* s, Server* srv, H2Request&& req) {
   ctx->req_stream_id = 0;
   ctx->req_stream_window = 0;
   ctx->accepted_stream = 0;
+  ctx->arm_ns = coarse_now_ns();
   ctx->hcb = srv->http_cb;
   ctx->user = srv->http_user;
   UsercodePool::Instance().Submit(ctx);
@@ -905,6 +1273,14 @@ void ServerOnMessages(Socket* s) {
       }
     }
   }
+  // Ingress fast path: one coarse-clock read arms this drain's inline
+  // budget, and the cork holds the response doorbell so everything the
+  // drain produces (sequencer releases, error responses, the echo batch)
+  // leaves as one flush when the scope closes — K pipelined requests cost
+  // one wakeup + one egress submission instead of K.
+  bool fast = inline_dispatch_enabled();
+  InlineBudget budget(fast, CoarseClockRefresh());
+  CorkScope cork_scope(s, fast);
   // connections that completed the h2 preface stay h2 for life (is_h2
   // gates the registry mutex off the non-h2 hot path)
   IOBuf batched_out;  // echo responses of this read event, flushed once
@@ -981,7 +1357,8 @@ void ServerOnMessages(Socket* s) {
         }
         break;  // rest of the connection handled by the h2 path above
       }
-      if (LooksLikeRedis(s->read_buf) && srv->redis_cb != nullptr) {
+      if (LooksLikeRedis(s->read_buf) &&
+          (srv->redis_cb != nullptr || srv->redis_store != nullptr)) {
         // RESP commands pipeline: dispatch concurrently up to the cap,
         // replies release in command order through the sequencer
         ConnState* cs = GetConnState(s);
@@ -1037,6 +1414,77 @@ void ServerOnMessages(Socket* s) {
           continue;
         }
         srv->nrequests.fetch_add(1, std::memory_order_relaxed);
+        if (srv->redis_store != nullptr && RedisCacheHandles(argv)) {
+          // native-cache command: run to completion on this parse fiber
+          // under the budget, or on a spawned fiber past it — either way
+          // the reply releases through the sequencer in command order,
+          // and EXECUTION keeps parse order too: while a fallback fiber
+          // is in flight, later cache commands (even inline-eligible
+          // ones) append to its queue instead of overtaking it (a
+          // pipelined SET must be visible to the GET behind it).
+          uint64_t rseq;
+          bool queued = false;
+          {
+            std::lock_guard lk(cs->mu);
+            rseq = cs->next_dispatch++;
+            if (cs->cache_fiber_active) {
+              cs->cache_q.emplace_back(rseq, std::move(argv));
+              queued = true;
+            }
+          }
+          NativeMetrics& nm = native_metrics();
+          if (queued) {
+            nm.inline_dispatch_fallbacks.fetch_add(
+                1, std::memory_order_relaxed);
+            continue;
+          }
+          if (budget.take()) {
+            nm.inline_dispatch_hits.fetch_add(1, std::memory_order_relaxed);
+            IOBuf reply;
+            RedisCacheExec(srv->redis_store, argv, &reply);
+            ReleaseSequenced(s, rseq, std::move(reply), false);
+          } else {
+            nm.inline_dispatch_fallbacks.fetch_add(1,
+                                                   std::memory_order_relaxed);
+            RedisCacheFiberArg* fa = ObjectPool<RedisCacheFiberArg>::Get();
+            fa->sock = s->id();
+            fa->seq = rseq;
+            fa->store = srv->redis_store;
+            fa->argv = std::move(argv);
+            {
+              std::lock_guard lk(cs->mu);
+              cs->cache_fiber_active = true;
+            }
+            fiber_t rf;
+            if (fiber_start(&rf, RedisCacheFiber, fa) != 0) {
+              // no fiber: run to completion here after all (nothing can
+              // have queued behind us yet — only this parse fiber
+              // appends, so the flag flips straight back)
+              {
+                std::lock_guard lk(cs->mu);
+                cs->cache_fiber_active = false;
+              }
+              IOBuf reply;
+              RedisCacheExec(fa->store, fa->argv, &reply);
+              ReleaseSequenced(s, rseq, std::move(reply), false);
+              fa->argv.clear();
+              ObjectPool<RedisCacheFiberArg>::Return(fa);
+            }
+          }
+          continue;
+        }
+        if (srv->redis_cb == nullptr) {
+          // store-only server, command outside the cache table
+          IOBuf err;
+          err.append("-ERR unknown command\r\n", 22);
+          uint64_t rseq;
+          {
+            std::lock_guard lk(cs->mu);
+            rseq = cs->next_dispatch++;
+          }
+          ReleaseSequenced(s, rseq, std::move(err), false);
+          continue;
+        }
         CallCtx* rctx = nullptr;
         uint32_t rslot = ResourcePool<CallCtx>::Get(&rctx);
         rctx->slot = rslot;
@@ -1058,9 +1506,28 @@ void ServerOnMessages(Socket* s) {
           std::lock_guard lk(cs->mu);
           rctx->pipe_seq = cs->next_dispatch++;
         }
+        rctx->arm_ns = coarse_now_ns();
         rctx->rcb = srv->redis_cb;
         rctx->user = srv->redis_user;
-        UsercodePool::Instance().Submit(rctx);
+        // per-KEY execution ordering (see ConnState.redis_key_q): run
+        // now unless an earlier command of this connection naming the
+        // SAME first-argument key is still in flight — data-dependent
+        // pipelines (SET k then GET k) keep pipeline order while
+        // key-less and distinct-key commands stay concurrent across
+        // the worker pool (redis_respond chains the next waiter)
+        rctx->redis_key = argv.size() >= 2 ? argv[1] : std::string();
+        bool submit_now = true;
+        if (!rctx->redis_key.empty()) {
+          std::lock_guard lk(cs->mu);
+          auto [kit, fresh] = cs->redis_key_q.try_emplace(rctx->redis_key);
+          if (!fresh) {
+            kit->second.push_back(rctx);
+            submit_now = false;
+          }
+        }
+        if (submit_now) {
+          UsercodePool::Instance().Submit(rctx);
+        }
         continue;
       }
       // Framed thrift TBinaryProtocol (≙ policy/thrift_protocol.cpp:763
@@ -1139,6 +1606,7 @@ void ServerOnMessages(Socket* s) {
           std::lock_guard lk(tcs->mu);
           tctx->pipe_seq = tcs->next_dispatch++;
         }
+        tctx->arm_ns = coarse_now_ns();
         tctx->rcb = srv->thrift_cb;
         tctx->user = srv->thrift_user;
         UsercodePool::Instance().Submit(tctx);
@@ -1244,6 +1712,7 @@ void ServerOnMessages(Socket* s) {
             std::lock_guard lk(ucs->mu);
             uctx->pipe_seq = ucs->next_dispatch++;
           }
+          uctx->arm_ns = coarse_now_ns();
           uctx->rcb = (RedisHandlerCb)up.handler;
           uctx->user = up.user;
           UsercodePool::Instance().Submit(uctx);
@@ -1279,6 +1748,9 @@ void ServerOnMessages(Socket* s) {
         flush();
         s->SetFailed(TRPC_EREQUEST);
         return;
+      }
+      if (TryServeCachedHttp(s, srv, hreq, &budget)) {
+        continue;  // answered inline from the cached-response table
       }
       DispatchHttp(s, srv, std::move(hreq));
       continue;
@@ -1364,66 +1836,80 @@ void ServerOnMessages(Socket* s) {
       // device plane): the attachment DMAs host->HBM, then HBM->host
       // into the response — the RPC payload round-trips device memory
       // with no extra host copies (single-block attachments are
-      // pointer-identity DMA sources).  Runs on its own fiber so the
-      // DMA waits park a fiber, not this connection's parse loop.
-      struct HbmEchoArg {
-        SocketId sock;
-        uint64_t corr;
-        IOBuf payload;
-        IOBuf attachment;
-      };
-      auto* a = new HbmEchoArg{s->id(), meta.correlation_id,
-                               std::move(payload), std::move(attachment)};
-      fiber_t f;
-      int frc = fiber_start(&f, [](void* p) {
-        HbmEchoArg* a = (HbmEchoArg*)p;
-        IOBuf resp_attach;
-        int32_t err = 0;
-        const char* etext = nullptr;
-        if (!a->attachment.empty()) {
-          if (!tpu_plane_available()) {
-            err = TRPC_EINTERNAL;
-            etext = "device plane unavailable";
-          } else {
-            TpuBufId id = tpu_h2d_from_iobuf(a->attachment, 0);
-            if (id == 0 || tpu_buf_wait(id, 30 * 1000 * 1000) != 0 ||
-                tpu_d2h_into_iobuf(id, &resp_attach) != 0) {
-              err = TRPC_EINTERNAL;
-              etext = "device transfer failed";
-            }
-            if (id != 0) {
-              tpu_buf_free(id);
-            }
+      // pointer-identity DMA sources).  With no attachment there is no
+      // DMA wait to park on, so the request is run-to-completion
+      // eligible; otherwise it runs on its own fiber so the DMA waits
+      // park a fiber, not this connection's parse loop.
+      if (attachment.empty()) {
+        if (budget.take()) {
+          native_metrics().inline_dispatch_hits.fetch_add(
+              1, std::memory_order_relaxed);
+          RpcMeta rmeta;
+          rmeta.correlation_id = meta.correlation_id;
+          rmeta.flags = 1;  // response
+          if (s->advertise_device_caps.load(std::memory_order_acquire)) {
+            rmeta.device_caps = ServerDeviceCaps();
+            rmeta.plane_uid = tpu_plane_uid();
           }
+          PackFrame(&batched_out, rmeta, std::move(payload), IOBuf());
+          continue;
         }
-        SendResponse(a->sock, a->corr, err, etext, std::move(a->payload),
-                     std::move(resp_attach));
-        delete a;
-      }, a);
-      if (frc != 0) {
-        delete a;
+        native_metrics().inline_dispatch_fallbacks.fetch_add(
+            1, std::memory_order_relaxed);
+      }
+      HbmEchoArg* a = ObjectPool<HbmEchoArg>::Get();
+      a->sock = s->id();
+      a->corr = meta.correlation_id;
+      a->payload = std::move(payload);
+      a->attachment = std::move(attachment);
+      fiber_t f;
+      if (fiber_start(&f, HbmEchoFiber, a) != 0) {
+        a->payload.clear();
+        a->attachment.clear();
+        ObjectPool<HbmEchoArg>::Return(a);
         SendResponse(s->id(), meta.correlation_id, TRPC_EINTERNAL,
                      "no fiber", IOBuf(), IOBuf());
       }
       continue;
     }
     if (h.kind == 0) {
-      // native echo: pack the response into the batch buffer; one Write
-      // (= one syscall) flushes every response of this read event
-      // (≙ the reference processing all cut messages then writing —
-      // syscall amortization is the single-core win)
-      RpcMeta rmeta;
-      rmeta.correlation_id = meta.correlation_id;
-      rmeta.flags = 1;  // response
-      // the echoed payload is byte-identical, so a compressed request
-      // produces an equally-compressed response: carry the type through
-      rmeta.compress_type = meta.compress_type;
-      if (s->advertise_device_caps.load(std::memory_order_acquire)) {
-        rmeta.device_caps = ServerDeviceCaps();
-        rmeta.plane_uid = tpu_plane_uid();
+      if (budget.take()) {
+        // native echo, run to completion: pack the response into the
+        // batch buffer; one Write (= one syscall) flushes every response
+        // of this read event (≙ the reference processing all cut
+        // messages then writing — syscall amortization is the
+        // single-core win)
+        native_metrics().inline_dispatch_hits.fetch_add(
+            1, std::memory_order_relaxed);
+        RpcMeta rmeta;
+        rmeta.correlation_id = meta.correlation_id;
+        rmeta.flags = 1;  // response
+        // the echoed payload is byte-identical, so a compressed request
+        // produces an equally-compressed response: carry the type through
+        rmeta.compress_type = meta.compress_type;
+        if (s->advertise_device_caps.load(std::memory_order_acquire)) {
+          rmeta.device_caps = ServerDeviceCaps();
+          rmeta.plane_uid = tpu_plane_uid();
+        }
+        PackFrame(&batched_out, rmeta, std::move(payload),
+                  std::move(attachment));
+      } else {
+        // spawned path (budget tripped, or the fast path is flagged off
+        // for the A/B): one fiber + one response write per request —
+        // wire bytes identical, per-request software overhead restored
+        native_metrics().inline_dispatch_fallbacks.fetch_add(
+            1, std::memory_order_relaxed);
+        EchoFiberArg* a = ObjectPool<EchoFiberArg>::Get();
+        a->sock = s->id();
+        a->corr = meta.correlation_id;
+        a->compress = meta.compress_type;
+        a->payload = std::move(payload);
+        a->attachment = std::move(attachment);
+        fiber_t f;
+        if (fiber_start(&f, EchoFiber, a) != 0) {
+          EchoFiber(a);  // no fiber: answer on this fiber instead
+        }
       }
-      PackFrame(&batched_out, rmeta, std::move(payload),
-                std::move(attachment));
     } else {
       if (!UsercodeAdmit()) {
         // flood of requests into a slow handler pool: reject instead of
@@ -1450,6 +1936,7 @@ void ServerOnMessages(Socket* s) {
       ctx->method = std::move(meta.method);
       ctx->payload = payload.to_string();
       ctx->attachment = attachment.to_string();
+      ctx->arm_ns = coarse_now_ns();
       ctx->cb = h.cb;
       ctx->user = h.user;
       // cancellation surface: the call is findable by (sock, corr) until
@@ -1572,6 +2059,37 @@ void server_set_redis_handler(Server* s, RedisHandlerCb cb, void* user) {
   s->redis_user = user;
 }
 
+int server_enable_redis_cache(Server* s) {
+  if (s->running.load(std::memory_order_acquire)) {
+    return -EBUSY;  // the parse loop reads the pointer lock-free
+  }
+  if (s->redis_store == nullptr) {
+    s->redis_store = new RedisStore();
+  }
+  return 0;
+}
+
+int server_http_cache_put(Server* s, const char* path, int status,
+                          const char* headers_blob, const uint8_t* body,
+                          size_t body_len) {
+  if (s->running.load(std::memory_order_acquire)) {
+    return -EBUSY;  // pre-start only (lock-free parse-loop reads)
+  }
+  if (path == nullptr || path[0] != '/') {
+    return -EINVAL;
+  }
+  CachedHttpResp ce;
+  PackHttpResponse(&ce.keep_alive, status, headers_blob, body, body_len,
+                   true);
+  PackHttpResponse(&ce.close_conn, status, headers_blob, body, body_len,
+                   false);
+  if (s->http_cache.find(path) == nullptr) {
+    s->http_cache_entries++;
+  }
+  s->http_cache.insert(path, std::move(ce));
+  return 0;
+}
+
 int redis_respond(uint64_t token, const uint8_t* data, size_t len) {
   uint32_t slot = (uint32_t)token;
   uint32_t ver = (uint32_t)(token >> 32);
@@ -1585,10 +2103,35 @@ int redis_respond(uint64_t token, const uint8_t* data, size_t len) {
     IOBuf reply;
     reply.append(data, len);
     ReleaseSequenced(s, ctx->pipe_seq, std::move(reply), false);
+    // this command's turn is over: if it named a key, hand that key's
+    // next queued same-key command to the worker pool, or retire the
+    // key's in-flight marker (the held socket reference keeps the
+    // ConnState alive here).  On a dead socket the queue stays frozen
+    // and ~ConnState returns the slots.
+    CallCtx* next = nullptr;
+    if (!ctx->redis_key.empty()) {
+      ConnState* cs = (ConnState*)s->parse_state;
+      if (cs != nullptr) {
+        std::lock_guard lk(cs->mu);
+        auto kit = cs->redis_key_q.find(ctx->redis_key);
+        if (kit != cs->redis_key_q.end()) {
+          if (kit->second.empty()) {
+            cs->redis_key_q.erase(kit);
+          } else {
+            next = kit->second.front();
+            kit->second.pop_front();
+          }
+        }
+      }
+    }
+    if (next != nullptr) {
+      UsercodePool::Instance().Submit(next);
+    }
     s->Dereference();
   }
   ctx->version.fetch_add(1, std::memory_order_release);
   ctx->payload.clear();
+  ctx->redis_key.clear();
   ctx->is_redis = false;
   ResourcePool<CallCtx>::Return(slot);
   return 0;
@@ -1916,6 +2459,7 @@ void server_destroy(Server* s) {
     Socket::WaitRecycled(id);
   }
   Socket::WaitRecycled(s->listen_sock);
+  delete s->redis_store;
   delete s;
 }
 
@@ -2003,7 +2547,7 @@ void CloseAfterWriteFiber(void* a) {
   // generation fully recycles.
   Socket::WaitRecycled(arg->id);
   butex_destroy(arg->done);
-  delete arg;
+  ObjectPool<CloseWaitArg>::Return(arg);
 }
 
 // "Connection: close": actively close once the response is on the wire.
@@ -2016,11 +2560,13 @@ void CloseAfterWrite(Socket* s, IOBuf&& resp) {
     s->SetFailed(TRPC_ESTOP);
     return;
   }
-  CloseWaitArg* arg = new CloseWaitArg{s->id(), done};
+  CloseWaitArg* arg = ObjectPool<CloseWaitArg>::Get();
+  arg->id = s->id();
+  arg->done = done;
   fiber_t f;
   if (fiber_start(&f, CloseAfterWriteFiber, arg) != 0) {
     butex_destroy(done);
-    delete arg;
+    ObjectPool<CloseWaitArg>::Return(arg);
     s->SetFailed(TRPC_ESTOP);
   }
 }
@@ -3207,6 +3753,45 @@ void set_usercode_workers(int n) {
 
 void set_usercode_max_inflight(int64_t n) {
   g_usercode_max_inflight.store(n, std::memory_order_relaxed);
+}
+
+void set_inline_dispatch(int on) {
+  g_inline_dispatch.store(on ? 1 : 0, std::memory_order_release);
+}
+
+bool inline_dispatch_enabled() {
+  int v = g_inline_dispatch.load(std::memory_order_acquire);
+  if (v < 0) {
+    // first use: the TRPC_INLINE_DISPATCH env var is the A/B switch
+    const char* e = getenv("TRPC_INLINE_DISPATCH");
+    v = (e != nullptr && e[0] == '0' && e[1] == '\0') ? 0 : 1;
+    g_inline_dispatch.store(v, std::memory_order_release);
+  }
+  return v != 0;
+}
+
+void set_inline_budget_requests(int reqs) {
+  g_inline_budget_reqs.store(reqs > 0 ? reqs : 1,
+                             std::memory_order_relaxed);
+}
+
+void set_inline_budget_us(int64_t us) {
+  g_inline_budget_us.store(us > 0 ? us : 1, std::memory_order_relaxed);
+}
+
+int64_t coarse_now_ns() {
+  int64_t t = g_coarse_clock_ns.load(std::memory_order_relaxed);
+  return t != 0 ? t : CoarseClockRefresh();
+}
+
+int64_t token_arm_ns(uint64_t token) {
+  CallCtx* ctx = ResourcePool<CallCtx>::Address((uint32_t)token);
+  if (ctx == nullptr ||
+      ctx->version.load(std::memory_order_acquire) !=
+          (uint32_t)(token >> 32)) {
+    return 0;
+  }
+  return ctx->arm_ns;
 }
 
 void channel_set_connection_type(Channel* c, int t) {

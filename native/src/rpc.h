@@ -230,6 +230,50 @@ void set_usercode_workers(int n);
 // ELIMIT (≙ ConcurrencyLimiter).  0 = uncapped.  Reloadable.
 void set_usercode_max_inflight(int64_t n);
 
+// --- ingress fast path (run-to-completion dispatch + response corking) -----
+
+// Short non-blocking handlers (native echo, HbmEcho without a DMA wait,
+// native redis-cache commands, cached HTTP builtins) execute inline on the
+// connection's parse fiber under a per-drain budget, and every response
+// produced during one drain flushes as a single batch (the socket cork).
+// Off = every such request takes the spawned fiber / usercode path and
+// responses flush individually — the A/B baseline.  Default: on, unless
+// the TRPC_INLINE_DISPATCH env var is "0".  Reloadable.
+void set_inline_dispatch(int on);
+bool inline_dispatch_enabled();
+// Per-drain inline budget: after `reqs` inline executions or `us`
+// microseconds inside one drain, remaining work falls back to the spawned
+// path (fairness: one connection's deep pipeline must not starve the
+// others).  Reloadable.
+void set_inline_budget_requests(int reqs);
+void set_inline_budget_us(int64_t us);
+
+// Coarse clock: one monotonic_ns() per parse drain, shared by budget
+// checks and request arm-times (≙ rpcz/LatencyRecorder arm stamps without
+// per-request clock syscalls in the hot loop).
+int64_t coarse_now_ns();
+
+// Arm time (coarse, ns) stamped when a usercode request was parsed off
+// the wire; 0 for a stale token.  Queue-inclusive latency = now - arm.
+int64_t token_arm_ns(uint64_t token);
+
+// Native redis cache: GET/SET/DEL/EXISTS/PING execute against an
+// in-memory native store — inline on the parse fiber when the fast path
+// grants it, on a spawned fiber otherwise; commands outside the table
+// still dispatch to the registered Python handler (≙ brpc's C++
+// RedisService answering hot commands without leaving the core).
+// Pre-start only.
+int server_enable_redis_cache(Server* s);
+
+// Cached-response HTTP builtin: a GET of `path` (empty query) is answered
+// inline from a pre-packed response instead of the usercode pool — wire
+// bytes identical to PackHttpResponse(status, headers_blob, body).
+// Skipped when server auth is enabled (the Python layer owns the
+// credential check) and for HTTP/2 streams.  Pre-start only.
+int server_http_cache_put(Server* s, const char* path, int status,
+                          const char* headers_blob, const uint8_t* body,
+                          size_t body_len);
+
 struct CallResult {
   int32_t error_code = 0;
   std::string error_text;

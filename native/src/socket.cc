@@ -59,6 +59,9 @@ int Socket::Create(const SocketOptions& opts, SocketId* id_out) {
   s->peer_plane_uid.store(0, std::memory_order_relaxed);
   s->sendzc_copied.store(false, std::memory_order_relaxed);
   s->corked = opts.corked;
+  s->cork_depth.store(0, std::memory_order_relaxed);
+  s->cork_held.store(false, std::memory_order_relaxed);
+  s->cork_anchor = nullptr;
   s->frame_bytes_hint = 0;
   s->frame_attach_hint = 0;
   s->tls = nullptr;
@@ -203,6 +206,73 @@ void Socket::TryRecycle(uint32_t odd_ver) {
 }
 
 void Socket::SetFailed(int err) {
+  // Flush a parked cork chain BEFORE marking failure: those responses
+  // (an h2 GOAWAY ahead of this EPROTO, pipelined replies ahead of a
+  // poison request) were produced while the socket was healthy and went
+  // out inline pre-cork — the shutdown below would silently discard
+  // them.  The drain must be SYNCHRONOUS: handing the chain to a
+  // KeepWrite fiber would let it run after the shutdown and discard the
+  // lot.  The exchange claims the anchor against Uncork (and against a
+  // concurrent SetFailed); a recursive SetFailed from the flush's own
+  // write error sees cork_held false and proceeds straight on.
+  if (cork_held.exchange(false, std::memory_order_seq_cst)) {
+    WriteRequest* req = cork_anchor;
+    cork_anchor = nullptr;
+    native_metrics().batch_cork_flushes.fetch_add(
+        1, std::memory_order_relaxed);
+    // bounded inline drain (RunKeepWrite's absorb/release protocol minus
+    // the blocking waits — SetFailed must stay prompt): push what the
+    // kernel takes NOW; what it refuses dies with the socket, the same
+    // best-effort envelope as the pre-cork one-inline-attempt-per-write
+    IOBuf merged;
+    std::vector<Butex*> notifies;
+    while (true) {
+      while (true) {
+        merged.append(std::move(req->data));
+        if (req->notify != nullptr) {
+          notifies.push_back(req->notify);
+        }
+        WriteRequest* next = req->next.load(std::memory_order_relaxed);
+        if (next == nullptr) {
+          break;  // req is the newest absorbed; keep it as the CAS anchor
+        }
+        native_metrics().write_requests_queued.fetch_sub(
+            1, std::memory_order_relaxed);
+        ObjectPool<WriteRequest>::Return(req);
+        req = next;
+      }
+      while (!merged.empty() && !failed.load(std::memory_order_acquire)) {
+        ssize_t n = merged.cut_into_fd(fd);
+        if (n > 0) {
+          bytes_out.fetch_add((uint64_t)n, std::memory_order_relaxed);
+          continue;
+        }
+        if (n < 0 && errno == EINTR) {
+          continue;
+        }
+        break;  // EAGAIN or a real error: one best-effort push, then go
+      }
+      merged.clear();
+      for (Butex* b : notifies) {
+        butex_value(b).fetch_add(1, std::memory_order_release);
+        butex_wake_all(b);
+      }
+      notifies.clear();
+      WriteRequest* expected = req;
+      if (write_head.compare_exchange_strong(expected, nullptr,
+                                             std::memory_order_acq_rel)) {
+        native_metrics().write_requests_queued.fetch_sub(
+            1, std::memory_order_relaxed);
+        ObjectPool<WriteRequest>::Return(req);
+        break;
+      }
+      WriteRequest* fifo = GrabNewer(req);
+      native_metrics().write_requests_queued.fetch_sub(
+          1, std::memory_order_relaxed);
+      ObjectPool<WriteRequest>::Return(req);
+      req = fifo;
+    }
+  }
   bool expected = false;
   if (!failed.compare_exchange_strong(expected, true,
                                       std::memory_order_acq_rel)) {
@@ -432,6 +502,13 @@ int Socket::WriteRaw(IOBuf&& data, Butex* notify) {
       1, std::memory_order_relaxed);
   req->data = std::move(data);
   req->notify = notify;
+  // snapshot before the exchange: a cork that starts later simply misses
+  // this write (it goes out inline — best-effort batching, never stale)
+  bool cork_active = cork_depth.load(std::memory_order_acquire) > 0;
+  if (cork_active) {
+    native_metrics().batch_cork_responses.fetch_add(
+        1, std::memory_order_relaxed);
+  }
   req->next.store(UNCONNECTED, std::memory_order_relaxed);
   WriteRequest* prev = write_head.exchange(req, std::memory_order_acq_rel);
   if (prev != nullptr) {
@@ -439,6 +516,51 @@ int Socket::WriteRaw(IOBuf&& data, Butex* notify) {
     return 0;          // the current writer will pick it up
   }
   req->next.store(nullptr, std::memory_order_relaxed);
+  if (cork_active) {
+    // doorbell held: park the queue for the Uncork flush.  anchor is
+    // published by the cork_held store; exactly one actor claims it —
+    // Uncork, or us if the cork lifted before Uncork saw the hold.
+    // The handshake is Dekker-shaped (we store cork_held then load
+    // cork_depth; Uncork decrements cork_depth then exchanges
+    // cork_held), so all four accesses are seq_cst: with anything
+    // weaker, StoreLoad reordering lets our depth load see the cork
+    // still open while Uncork's exchange misses our not-yet-visible
+    // hold — both sides bail and the parked chain is stranded until
+    // the NEXT drain's Uncork, which never comes for a quiet
+    // request-response peer waiting on this very reply.
+    cork_anchor = req;
+    cork_held.store(true, std::memory_order_seq_cst);
+    if (cork_depth.load(std::memory_order_seq_cst) > 0) {
+      return 0;  // Uncork will flush
+    }
+    if (!cork_held.exchange(false, std::memory_order_seq_cst)) {
+      return 0;  // Uncork raced us and took the flush
+    }
+    cork_anchor = nullptr;
+  }
+  return OwnerFlush(req);
+}
+
+void Socket::Cork() {
+  cork_depth.fetch_add(1, std::memory_order_seq_cst);
+}
+
+void Socket::Uncork() {
+  // seq_cst pair of the WriteRaw park (see the Dekker note there)
+  if (cork_depth.fetch_sub(1, std::memory_order_seq_cst) != 1) {
+    return;  // nested cork still open
+  }
+  if (!cork_held.exchange(false, std::memory_order_seq_cst)) {
+    return;  // no writer parked during this scope
+  }
+  WriteRequest* req = cork_anchor;
+  cork_anchor = nullptr;
+  native_metrics().batch_cork_flushes.fetch_add(1,
+                                                std::memory_order_relaxed);
+  OwnerFlush(req);
+}
+
+int Socket::OwnerFlush(WriteRequest* req) {
   // corked: skip the inline write; the flush fiber runs after the other
   // ready fibers, so their writes chain onto the stack and drain as one
   // writev (single-syscall batching on a shared client connection).

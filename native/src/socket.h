@@ -101,6 +101,17 @@ class Socket {
   void* parse_state = nullptr;
   void (*parse_state_free)(void*) = nullptr;
   bool corked = false;  // see SocketOptions.corked
+  // Parse-batch response corking (≙ the reference batching all responses
+  // of one InputMessenger cut into a single Socket::Write): while
+  // cork_depth > 0 the first writer to take head ownership parks the
+  // queue instead of writing (doorbell held) — Uncork() flushes the
+  // accumulated chain as ONE writev/SEND_ZC batch.  cork_anchor is the
+  // parked owner request, published via the cork_held release-store and
+  // claimed by exactly one actor (Uncork, or a producer that observes
+  // the cork lifted before Uncork saw its hold).
+  std::atomic<int32_t> cork_depth{0};
+  std::atomic<bool> cork_held{false};
+  WriteRequest* cork_anchor = nullptr;
   // TLS engine (tls.h TlsState*), set by the server sniff (first record
   // byte 0x16) or the client dial.  When set, ReadToBuf decrypts into
   // read_buf and Write encrypts before the wait-free queue — every
@@ -145,6 +156,13 @@ class Socket {
   int Write(IOBuf&& data, Butex* notify = nullptr);
   int WriteRaw(IOBuf&& data, Butex* notify = nullptr);
 
+  // Hold/release the response doorbell around one parse drain.  Writes
+  // issued while corked accumulate on the wait-free queue; the matching
+  // Uncork flushes them in one batch.  Cork/Uncork pairs nest; every
+  // exit path of a drain must Uncork (use a scope guard).
+  void Cork();
+  void Uncork();
+
   // Called by the dispatcher on EPOLLIN/EPOLLOUT.
   static void StartInputEvent(SocketId id);
   static void HandleEpollOut(SocketId id);
@@ -158,6 +176,7 @@ class Socket {
   static void KeepWriteFiber(void* arg);
   void RunKeepWrite(WriteRequest* req);  // drain loop (fiber or inline)
   WriteRequest* GrabNewer(WriteRequest* anchor);  // see .cc
+  int OwnerFlush(WriteRequest* req);  // write-as-owner tail of WriteRaw
   void TryRecycle(uint32_t odd_ver);
 };
 

@@ -29,6 +29,7 @@
 
 #include "common.h"
 #include "execution_queue.h"
+#include "metrics.h"
 #include "fiber.h"
 #include "fiber_sync.h"
 #include "iobuf.h"
@@ -1435,6 +1436,149 @@ static void test_profiler_races() {
          (unsigned long long)dumps.load());
 }
 
+// --- 17. ingress fast path: inline dispatch races ---------------------------
+// Races the run-to-completion dispatch against everything that can
+// interleave with it: the spawned fallback (tiny budgets trip mid-drain),
+// the reloadable A/B switch flipping under live traffic, client-side
+// cancels claiming calls while responses are in flight, and raw-socket
+// clients that pipeline deeply then close abruptly mid-drain (the corked
+// flush must discard cleanly on the failed socket).
+static void test_inline_dispatch_races() {
+  set_inline_dispatch(1);
+  set_inline_budget_requests(2);  // trips on nearly every pipelined drain
+  set_inline_budget_us(50);
+  Server* srv = server_create();
+  server_add_service(srv, "Echo", 0, nullptr, nullptr);
+  CHECK_TRUE(server_enable_redis_cache(srv) == 0);
+  CHECK_TRUE(server_start(srv, "127.0.0.1", 0) == 0);
+  int port = server_port(srv);
+
+  std::atomic<bool> stop{false};
+  std::atomic<uint64_t> ok{0}, failed{0}, cancels_won{0};
+  std::atomic<uint64_t> live_call{0};  // canceller's target cell
+  std::vector<std::thread> ts;
+
+  // the A/B switch and the budget flip live under traffic
+  ts.emplace_back([&] {
+    int v = 0;
+    while (!stop.load(std::memory_order_acquire)) {
+      v ^= 1;
+      set_inline_dispatch(v);
+      set_inline_budget_requests(v != 0 ? 2 : 64);
+      usleep(700);
+    }
+  });
+
+  // TRPC echo callers: inline vs spawned decided per drain
+  for (int t = 0; t < 3; ++t) {
+    ts.emplace_back([&, t] {
+      Channel* ch = channel_create("127.0.0.1", port);
+      channel_set_connection_type(ch, t % 2);
+      channel_set_connect_timeout(ch, 100 * 1000);
+      std::string payload(64, 'q');
+      CallResult res;
+      while (!stop.load(std::memory_order_acquire)) {
+        uint64_t id = 0;
+        int rc = channel_call(ch, "Echo", (const uint8_t*)payload.data(),
+                              payload.size(), nullptr, 0, 200 * 1000, &res,
+                              0, 0, t == 0 ? &id : nullptr);
+        if (t == 0 && id != 0) {
+          live_call.store(id, std::memory_order_release);
+        }
+        if (rc == 0) {
+          ok.fetch_add(1);
+        } else {
+          failed.fetch_add(1);
+        }
+      }
+      channel_destroy(ch);
+    });
+  }
+
+  // canceller: claims the published call id while its response races back
+  ts.emplace_back([&] {
+    while (!stop.load(std::memory_order_acquire)) {
+      uint64_t id = live_call.load(std::memory_order_acquire);
+      if (id != 0 && call_cancel(id) == 0) {
+        cancels_won.fetch_add(1);
+      }
+      usleep(200);
+    }
+  });
+
+  // raw RESP + TRPC pipeliners: burst a deep pipeline at the parse loop,
+  // read a little, then close mid-stream — the corked drain's flush and
+  // the spawned fallbacks race the dying socket
+  for (int t = 0; t < 2; ++t) {
+    ts.emplace_back([&, t] {
+      std::string burst;
+      if (t == 0) {
+        for (int i = 0; i < 32; ++i) {
+          char cmd[64];
+          int n = snprintf(cmd, sizeof(cmd),
+                           "*3\r\n$3\r\nSET\r\n$4\r\nk%03d\r\n$2\r\nvv\r\n",
+                           i);
+          burst.append(cmd, (size_t)n);
+          burst += "*2\r\n$3\r\nGET\r\n$4\r\nnope\r\n*1\r\n$4\r\nPING\r\n";
+        }
+      } else {
+        for (int i = 0; i < 32; ++i) {
+          RpcMeta m;
+          m.method = "Echo";
+          m.correlation_id = 0x10000u + (uint32_t)i;  // responses ignored
+          IOBuf payload, frame;
+          payload.append("ping-pipelined", 14);
+          PackFrame(&frame, m, std::move(payload), IOBuf());
+          burst += frame.to_string();
+        }
+      }
+      while (!stop.load(std::memory_order_acquire)) {
+        int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+        sockaddr_in addr;
+        memset(&addr, 0, sizeof(addr));
+        addr.sin_family = AF_INET;
+        addr.sin_port = htons((uint16_t)port);
+        addr.sin_addr.s_addr = inet_addr("127.0.0.1");
+        if (::connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+          ::close(fd);
+          usleep(1000);
+          continue;
+        }
+        (void)!::write(fd, burst.data(), burst.size());
+        char sink[512];
+        (void)!::read(fd, sink, sizeof(sink));  // then slam the door
+        ::close(fd);
+      }
+    });
+  }
+
+  usleep(3200 * 1000);
+  stop.store(true, std::memory_order_release);
+  for (auto& t : ts) {
+    t.join();
+  }
+  server_destroy(srv);
+  set_inline_dispatch(1);  // restore defaults for later scenarios
+  set_inline_budget_requests(512);
+  set_inline_budget_us(500);
+  NativeMetrics& nm = native_metrics();
+  uint64_t hits = nm.inline_dispatch_hits.load();
+  uint64_t fallbacks = nm.inline_dispatch_fallbacks.load();
+  uint64_t trips = nm.inline_dispatch_budget_trips.load();
+  uint64_t corked = nm.batch_cork_flushes.load();
+  CHECK_TRUE(ok.load() > 0);
+  CHECK_TRUE(hits > 0);        // inline path exercised
+  CHECK_TRUE(fallbacks > 0);   // spawned fallback exercised
+  CHECK_TRUE(trips > 0);       // tiny budget actually tripped mid-drain
+  CHECK_TRUE(corked > 0);      // corked flushes happened
+  printf("ok inline_dispatch_races ok=%llu failed=%llu cancels=%llu "
+         "hits=%llu fallbacks=%llu trips=%llu corked=%llu\n",
+         (unsigned long long)ok.load(), (unsigned long long)failed.load(),
+         (unsigned long long)cancels_won.load(), (unsigned long long)hits,
+         (unsigned long long)fallbacks, (unsigned long long)trips,
+         (unsigned long long)corked);
+}
+
 int main() {
   fiber_runtime_init(4);
   test_butex_churn();
@@ -1446,6 +1590,7 @@ int main() {
   test_call_timeout_races();
   test_cancel_races();
   test_socketmap_races();
+  test_inline_dispatch_races();
   test_restart_storm();
   test_h2_client_storm();
   test_uring_churn();

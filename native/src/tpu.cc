@@ -45,6 +45,33 @@ void tpu_host_free(void* p) {
   }
 }
 
+namespace {
+// Env-tunable wait budget shared by the bounded device waits (µs;
+// unparseable/negative values keep the safe default).  Compiled
+// unconditionally: the HbmEcho handler (rpc.cc) budgets its waits with
+// tpu_d2d_timeout_us even on PJRT-less builds.
+int64_t env_wait_budget_us(const char* name) {
+  int64_t budget_us = 30 * 1000 * 1000;
+  const char* bv = getenv(name);
+  if (bv != nullptr && bv[0] != '\0') {
+    int64_t v = strtoll(bv, nullptr, 10);
+    if (v > 0) {
+      budget_us = v;
+    }
+  }
+  return budget_us;
+}
+}  // namespace
+
+int64_t tpu_d2d_timeout_us() {
+  // parsed once per process: this sits on the per-request HbmEcho path,
+  // and getenv is a linear environ scan.  (The d2h budget below stays a
+  // per-call getenv on purpose — test_tpu_plane.py flips it mid-process
+  // between transfer attempts.)
+  static const int64_t cached = env_wait_budget_us("TRPC_TPU_D2D_TIMEOUT_US");
+  return cached;
+}
+
 #if defined(TRPC_HAVE_PJRT_HEADER)
 
 namespace {
@@ -583,7 +610,7 @@ TpuBufId tpu_d2d(TpuBufId src_id, int dst_device) {
   }
   // the source must be resident before CopyToDevice (PJRT would queue it
   // anyway; waiting here keeps the error attribution crisp)
-  int rc = wait_ready_pinned(src, 30 * 1000 * 1000);
+  int rc = wait_ready_pinned(src, tpu_d2d_timeout_us());
   if (rc != 0 || src->buf == nullptr) {
     set_plane_error(rc == -ETIMEDOUT
                         ? "d2d: source never became resident"
@@ -744,16 +771,7 @@ static int tpu_d2h_alloc(TpuBufId id, char** mem_out, size_t* len_out) {
   // BOUNDED wait for the copy event: a plugin that drops the event must
   // not park a usercode-pool thread forever (that silently shrinks the
   // handler pool).  Budget tunable for tests via TRPC_TPU_D2H_TIMEOUT_US.
-  int64_t budget_us = 30 * 1000 * 1000;
-  {
-    const char* bv = getenv("TRPC_TPU_D2H_TIMEOUT_US");
-    if (bv != nullptr && bv[0] != '\0') {
-      int64_t v = strtoll(bv, nullptr, 10);
-      if (v > 0) {  // unparseable/negative: keep the safe default
-        budget_us = v;
-      }
-    }
-  }
+  int64_t budget_us = env_wait_budget_us("TRPC_TPU_D2H_TIMEOUT_US");
   int64_t ev_deadline = monotonic_us() + budget_us;
   bool timed_out = false;
   while (butex_value(ctx->done).load(std::memory_order_acquire) == 0) {

@@ -66,6 +66,13 @@ TpuBufId tpu_h2d_from_iobuf(const IOBuf& buf, int device_index);
 int tpu_buf_wait(TpuBufId id, int64_t timeout_us);
 int64_t tpu_buf_size(TpuBufId id);  // -1 if stale
 
+// Residency-wait budget (µs, default 30s) for device-to-device copies and
+// the HbmEcho handler's transfer waits, tunable via the
+// TRPC_TPU_D2D_TIMEOUT_US env var — mirror of the d2h path's
+// TRPC_TPU_D2H_TIMEOUT_US (a plugin that drops an event must not park a
+// fiber forever; tests shrink it to exercise the timeout paths).
+int64_t tpu_d2d_timeout_us();
+
 // Asynchronously DMA the device buffer into one fresh host IOBuf block
 // appended to `out` (the block is the DMA target — no extra host copy;
 // the socket writev sends straight from it).  Blocks in the calling

@@ -80,6 +80,57 @@ class TestLoadBalancers:
                     and lb.select(request_code=code).endpoint.port != port)
         assert moved == 0
 
+    def test_ketama_distribution_balanced(self):
+        # ≙ policy/hasher.cpp ketama points (4 u32 points per MD5 digest):
+        # 100 replica points per unit weight spread 5 nodes within a
+        # tight band of the 1/5 ideal share
+        lb = create_load_balancer("c_ketama")
+        lb.add_servers_in_batch(_nodes(*range(1, 6)))
+        got = collections.Counter(
+            lb.select(request_code=code).endpoint.port
+            for code in range(4000))
+        assert set(got) == set(range(1, 6))
+        for port, count in got.items():
+            assert 480 <= count <= 1120, (port, got)  # mean 800 ± 40%
+
+    def test_ketama_weight_scales_share(self):
+        lb = create_load_balancer("c_ketama")
+        heavy = ServerNode(EndPoint(ip="127.0.0.1", port=1), weight=3)
+        light = ServerNode(EndPoint(ip="127.0.0.1", port=2), weight=1)
+        lb.add_servers_in_batch([heavy, light])
+        got = collections.Counter(
+            lb.select(request_code=code).endpoint.port
+            for code in range(4000))
+        # 3x the continuum points → ~3x the keys
+        assert 2.0 < got[1] / got[2] < 4.5, got
+
+    def test_ketama_removal_remaps_only_victims_keys(self):
+        nodes = _nodes(*range(1, 6))
+        lb = create_load_balancer("c_ketama")
+        lb.add_servers_in_batch(nodes)
+        where = {code: lb.select(request_code=code).endpoint.port
+                 for code in range(2000)}
+        # same code → same node, always
+        for code, port in list(where.items())[:200]:
+            assert lb.select(request_code=code).endpoint.port == port
+        victim_port = where[0]
+        lb.remove_server(nodes[victim_port - 1])
+        # keys on surviving nodes never move...
+        moved = sum(1 for code, port in where.items()
+                    if port != victim_port
+                    and lb.select(request_code=code).endpoint.port != port)
+        assert moved == 0
+        # ...and the victim's keys spread across ALL survivors (the
+        # 4-points-per-digest continuum interleaves nodes finely enough
+        # that no single survivor inherits the whole arc)
+        inherited = collections.Counter(
+            lb.select(request_code=code).endpoint.port
+            for code, port in where.items() if port == victim_port)
+        survivors = set(range(1, 6)) - {victim_port}
+        assert set(inherited) == survivors
+        for port, count in inherited.items():
+            assert count >= 0.05 * sum(inherited.values()), (port, inherited)
+
     def test_locality_aware_prefers_fast(self):
         lb = create_load_balancer("la")
         fast, slow = _nodes(1, 2)

@@ -635,8 +635,14 @@ class TestHeapAndContentionEndpoints:
             assert heap.startswith("heap profile:")
             assert "trpc::" in heap.split("# symbolized", 1)[1]
             assert growth.startswith("heap profile:")
+            # both dumps disclose the seam-only sampling scope on line 2
+            # (operators must not read a clean dump as "process is lean")
+            for dump in (heap, growth):
+                assert dump.splitlines()[1].startswith(
+                    "# scope: framework allocation seams only"), dump[:300]
         finally:
-            _get(server.port, "/pprof/heap?disable=1").read()
+            out = _get(server.port, "/pprof/heap?disable=1").read()
+            assert b"framework allocation seams only" in out
 
     def test_pprof_contention(self, server):
         body = _get(server.port, "/pprof/contention").read().decode()
