@@ -62,7 +62,31 @@ def main() -> int:
                     help="back-to-back writev vs SEND_ZC table at "
                          "512KB/1MB/4MB attachments (one subprocess per "
                          "arm: the rail's state is process-global)")
+    ap.add_argument("--client-cork-ab", action="store_true",
+                    help="back-to-back client-cork A/B at the echo grid's "
+                         "concurrency-256 config (one subprocess per arm: "
+                         "TRPC_CLIENT_CORK=0 vs 1, --repeat honored)")
     args = ap.parse_args()
+
+    if args.client_cork_ab:
+        me = os.path.abspath(__file__)
+        table = {}
+        for arm, extra in (("uncorked", {"TRPC_CLIENT_CORK": "0"}),
+                           ("corked", {"TRPC_CLIENT_CORK": "1"})):
+            env = dict(os.environ)
+            env.update(extra)
+            cmd = [sys.executable, me, "--no-scaling",
+                   "--repeat", str(max(1, args.repeat))]
+            if args.brief:
+                cmd.append("--brief")
+            try:
+                r = subprocess.run(cmd, capture_output=True, text=True,
+                                   timeout=900, env=env)
+                table[arm] = json.loads(r.stdout.strip().splitlines()[-1])
+            except Exception as e:  # noqa: BLE001 — arm recorded null
+                table[arm] = {"error": str(e)}
+        print(json.dumps({"metric": "client_cork_ab", "table": table}))
+        return 0
 
     if args.attach_ab:
         me = os.path.abspath(__file__)
@@ -114,6 +138,10 @@ def main() -> int:
     # spawned dispatch path (fiber per request, per-response flushes)
     inline_on = os.environ.get("TRPC_INLINE_DISPATCH") != "0"
     L.trpc_set_inline_dispatch(1 if inline_on else 0)
+    # client egress fast path A/B switch: TRPC_CLIENT_CORK=0 restores
+    # plain per-request writes (no doorbell window on channel_call)
+    cork_on = os.environ.get("TRPC_CLIENT_CORK") != "0"
+    L.trpc_set_client_cork(1 if cork_on else 0)
 
     # in-process echo server with the native echo handler (no Python in
     # the hot path), then the native multi-fiber client loop against it
@@ -261,6 +289,10 @@ def main() -> int:
             "native_inline_dispatch_fallbacks"),
         "cork_responses_per_flush": native_counter(
             "native_batch_cork_responses_per_flush"),
+        "client_cork": "on" if bool(L.trpc_client_cork_active()) else "off",
+        "client_cork_windows": native_counter("native_client_cork_windows"),
+        "client_inline_completes": native_counter(
+            "native_client_inline_completes"),
     }
     if reps > 1:
         result["rows"] = row_stats

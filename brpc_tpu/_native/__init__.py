@@ -301,6 +301,10 @@ def _declare(L: ctypes.CDLL) -> None:
     L.trpc_stream_buf_free.restype = None
     L.trpc_stream_close.argtypes = [c.c_uint64]
     L.trpc_stream_close.restype = c.c_int
+    L.trpc_stream_rst.argtypes = [c.c_uint64, c.c_int32]
+    L.trpc_stream_rst.restype = c.c_int
+    L.trpc_stream_rst_code.argtypes = [c.c_uint64]
+    L.trpc_stream_rst_code.restype = c.c_int32
     L.trpc_stream_destroy.argtypes = [c.c_uint64]
     L.trpc_stream_destroy.restype = None
     L.trpc_stream_remote_closed.argtypes = [c.c_uint64]
@@ -312,6 +316,16 @@ def _declare(L: ctypes.CDLL) -> None:
 
     L.trpc_set_usercode_max_inflight.argtypes = [c.c_int64]
     L.trpc_set_usercode_max_inflight.restype = None
+
+    # client egress fast path: request corking + serialize-once fan-out
+    L.trpc_set_client_cork.argtypes = [c.c_int]
+    L.trpc_set_client_cork.restype = None
+    L.trpc_client_cork_active.argtypes = []
+    L.trpc_client_cork_active.restype = c.c_int
+    L.trpc_fanout_call.argtypes = [
+        c.POINTER(c.c_void_p), c.c_int, c.c_char_p, c.c_char_p, c.c_size_t,
+        c.c_char_p, c.c_size_t, c.c_int64, c.POINTER(c.c_void_p)]
+    L.trpc_fanout_call.restype = c.c_int
 
     # ingress fast path: run-to-completion dispatch + response corking
     L.trpc_set_inline_dispatch.argtypes = [c.c_int]

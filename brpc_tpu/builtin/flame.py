@@ -1,0 +1,150 @@
+"""Self-contained flamegraph SVG from folded-stack text (≙ the reference
+rendering /hotspots flamegraphs — but where brpc embeds flamegraph.pl's
+output via an external viz pipeline, this emits a plain SVG directly:
+no JavaScript, no external tools, every <rect> carries an SVG-native
+<title> tooltip, so the one response body is the whole artifact).
+
+Input format: one stack per line, frames joined by ';', whitespace, then
+an integer value — the exact output of /hotspots, /pprof/profile and the
+"# symbolized" tail of /pprof/heap//pprof/growth:
+
+    main (x.py:1);work (x.py:9);hot (y.py:3) 42
+"""
+
+from __future__ import annotations
+
+import html
+from typing import Dict, List, Optional, Tuple
+
+
+class _Node:
+    __slots__ = ("name", "value", "children")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+        self.children: Dict[str, "_Node"] = {}
+
+
+def parse_folded(text: str, leaf_first: bool = False) -> _Node:
+    """Folded lines -> merged call tree.  `leaf_first` reverses each
+    stack (the heap profiler folds leaf-to-root; flame layout wants the
+    root at the bottom)."""
+    root = _Node("all")
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        stack_part, _, value_part = line.rpartition(" ")
+        if not stack_part:
+            continue
+        try:
+            value = int(float(value_part))
+        except ValueError:
+            continue
+        if value <= 0:
+            continue
+        frames = [f for f in stack_part.split(";") if f]
+        if not frames:
+            continue
+        if leaf_first:
+            frames.reverse()
+        root.value += value
+        node = root
+        for frame in frames[:96]:  # bound pathological depth
+            child = node.children.get(frame)
+            if child is None:
+                child = node.children[frame] = _Node(frame)
+            child.value += value
+            node = child
+    return root
+
+
+def _color(name: str) -> str:
+    """Deterministic warm fill per frame name (classic flame palette)."""
+    h = 2166136261
+    for ch in name:
+        h = ((h ^ ord(ch)) * 16777619) & 0xFFFFFFFF
+    r = 205 + (h & 0x3F) % 50
+    g = 70 + ((h >> 8) & 0xFF) % 120
+    b = ((h >> 20) & 0x3F) % 60
+    return f"rgb({r},{g},{b})"
+
+
+def folded_to_svg(text: str, title: str = "flame graph",
+                  width: int = 1200, leaf_first: bool = False,
+                  unit: str = "samples") -> str:
+    """Render folded-stack text as one self-contained SVG document."""
+    root = parse_folded(text, leaf_first=leaf_first)
+    row_h = 17
+    font = 11
+    # depth of the merged tree bounds the canvas height
+    def depth_of(n: _Node) -> int:
+        return 1 + max((depth_of(c) for c in n.children.values()),
+                       default=0)
+    depth = depth_of(root)
+    height = (depth + 2) * row_h + 26
+    rects: List[str] = []
+
+    def emit(n: _Node, x: float, w: float, level: int) -> None:
+        y = height - (level + 1) * row_h - 4
+        label = html.escape(n.name, quote=True)
+        tip = f"{label} ({n.value} {unit})"
+        # clip the RAW name first, escape after: clipping escaped text
+        # could cut an entity (&lt; -> &l..) and break the whole XML
+        clipped = html.escape(_clip(n.name, w, font), quote=True)
+        rects.append(
+            f'<g><title>{tip}</title>'
+            f'<rect x="{x:.2f}" y="{y}" width="{max(w, 0.3):.2f}" '
+            f'height="{row_h - 1}" fill="{_color(n.name)}" rx="1"/>'
+            + (f'<text x="{x + 2:.2f}" y="{y + row_h - 5}" '
+               f'font-size="{font}" font-family="monospace" '
+               f'fill="#000">{clipped}</text>'
+               if w >= 35 else "")
+            + "</g>")
+        if not n.children or n.value <= 0:
+            return
+        cx = x
+        for name in sorted(n.children):
+            c = n.children[name]
+            cw = w * (c.value / n.value)
+            emit(c, cx, cw, level + 1)
+            cx += cw
+
+    if root.value > 0:
+        emit(root, 8.0, width - 16.0, 0)
+    body = "\n".join(rects) if rects else (
+        '<text x="10" y="40" font-size="13" font-family="monospace">'
+        "no samples</text>")
+    esc_title = html.escape(title, quote=True)
+    return (
+        f'<?xml version="1.0" standalone="no"?>\n'
+        f'<svg xmlns="http://www.w3.org/2000/svg" version="1.1" '
+        f'width="{width}" height="{height}" '
+        f'viewBox="0 0 {width} {height}">\n'
+        f'<rect x="0" y="0" width="{width}" height="{height}" '
+        f'fill="#fdf6e3"/>\n'
+        f'<text x="{width / 2:.0f}" y="17" text-anchor="middle" '
+        f'font-size="14" font-family="monospace">{esc_title}</text>\n'
+        f"{body}\n</svg>\n")
+
+
+def _clip(label: str, w: float, font: int) -> str:
+    """Trim a label to what fits inside its rect (≈0.62em per mono char)."""
+    fit = max(int(w / (font * 0.62)) - 1, 0)
+    if len(label) <= fit:
+        return label
+    if fit <= 2:
+        return ""
+    return label[: fit - 2] + ".."
+
+
+def heap_symbolized_tail(dump_text: str) -> str:
+    """The folded '# symbolized' section of a /pprof/heap or
+    /pprof/growth dump (leaf-first lines; empty if absent)."""
+    marker = "# symbolized"
+    idx = dump_text.find(marker)
+    if idx < 0:
+        return ""
+    nl = dump_text.find("\n", idx)
+    return dump_text[nl + 1:] if nl >= 0 else ""

@@ -38,9 +38,10 @@ _SERVICES = [
     ("/metrics", "Prometheus text exposition"),
     ("/fibers", "fiber runtime counters (≙ /bthreads)"),
     ("/rpcz", "sampled RPC spans (?trace_id=, ?max_scan=)"),
-    ("/hotspots", "collapsed-stack CPU samples (?seconds=)"),
+    ("/hotspots", "collapsed-stack CPU samples (?seconds=, ?view=flame)"),
     ("/pprof/profile", "native SIGPROF profile (?seconds=, ?hz=)"),
-    ("/pprof/heap", "sampled live heap (?interval=; first hit enables)"),
+    ("/pprof/heap", "sampled live heap (?interval=; first hit enables; "
+                    "?view=flame)"),
     ("/pprof/growth", "cumulative allocation profile"),
     ("/pprof/contention", "sampled lock-wait stacks (always on)"),
     ("/sockets", "every live socket in the process"),
@@ -179,7 +180,16 @@ def _hotspots_locked(req: HttpRequest) -> HttpResponse:
         time.sleep(interval)
     lines = [f"{k} {v}" for k, v in
              sorted(counts.items(), key=lambda kv: -kv[1])]
-    return HttpResponse.text("\n".join(lines) + "\n")
+    folded = "\n".join(lines) + "\n"
+    if req.query_params().get("view") == "flame":
+        # self-contained SVG straight from the folded text — no external
+        # viz.js, tooltips are SVG-native <title> elements
+        from brpc_tpu.builtin import flame
+        svg = flame.folded_to_svg(
+            folded, title=f"/hotspots ({seconds:g}s of Python stacks)")
+        return HttpResponse(200, {"Content-Type": "image/svg+xml"},
+                            svg.encode())
+    return HttpResponse.text(folded)
 
 
 def _pprof_profile(req: HttpRequest) -> HttpResponse:
@@ -271,6 +281,17 @@ def _heap_profile(req: HttpRequest, growth: bool) -> HttpResponse:
     finally:
         if out:
             L.trpc_profiler_free(out)
+    if req.query_params().get("view") == "flame":
+        # the dump's "# symbolized" tail is already folded (leaf-first)
+        from brpc_tpu.builtin import flame
+        which = "growth" if growth else "heap"
+        svg = flame.folded_to_svg(
+            flame.heap_symbolized_tail(text),
+            title=f"/pprof/{which} (bytes by allocation stack; "
+                  "framework seams only)",
+            leaf_first=True, unit="bytes")
+        return HttpResponse(200, {"Content-Type": "image/svg+xml"},
+                            svg.encode())
     return HttpResponse.text(_with_seam_scope_note(text))
 
 

@@ -131,6 +131,84 @@ class ParallelChannel:
         if pool is not None:
             pool.shutdown(wait=False)
 
+    def _native_fanout_attempt(self, pending, results, cntl,
+                               fail_codes) -> bool:
+        """Serialize-once fast path: when every mapped member is a plain
+        rpc.Channel with a direct native sub-channel and the mapper
+        broadcast identical SubCalls, issue the whole group through ONE
+        native channel_fanout_call — the request body is serialized once
+        and shared as refcounted IOBuf blocks across all N frames
+        (native_fanout_shared_serializations counts exactly 1 per group),
+        and sub-responses are completed by the arriving parse fibers
+        instead of trampolining through a pool thread per sub-response.
+        Returns False when the group is not eligible (heterogeneous
+        members, per-member payloads, cluster/compressed channels) — the
+        caller then takes the thread-pool path.  Failed members are left
+        as None in `results` with their native error code recorded in
+        `fail_codes[i]`, so the caller can apply each channel's OWN retry
+        policy before re-issuing anything."""
+        if not pending:
+            return True
+        from brpc_tpu.rpc.channel import Channel as _RpcChannel
+        from brpc_tpu.rpc.channel import native_fanout
+        first_sc = pending[0][1]
+        subs = []
+        for i, sc in pending:
+            ch = self._subs[i][0]
+            if (not isinstance(ch, _RpcChannel) or ch._cluster is not None
+                    or ch._sub is None
+                    or ch.options.request_compress_type
+                    # backup-request hedging lives in Channel.call's
+                    # _backup_race — a member that asked for it must not
+                    # silently lose the hedge to the native wave
+                    or ch.options.backup_request_ms is not None
+                    or cntl.backup_request_ms is not None):
+                return False
+            if (sc.method != first_sc.method
+                    or sc.payload != first_sc.payload
+                    or sc.attachment != first_sc.attachment):
+                return False  # not a broadcast: nothing to share
+            subs.append(ch._sub)
+        timeout_ms = (cntl.timeout_ms if cntl.timeout_ms is not None
+                      else self.timeout_ms)
+        # observability parity with Channel.call: one rpcz span per
+        # sub-call, the class-wide client LatencyRecorder, and the tpu://
+        # transport-settled announcement — the fast path must not make
+        # the metrics the parity docs key on silently vanish
+        import time as _t
+        from brpc_tpu.rpc import span as span_mod
+        sps = [span_mod.start_span("client", first_sc.method)
+               for _ in pending]
+        t0 = _t.monotonic_ns()
+        try:
+            outs = native_fanout(subs, first_sc.method.encode(),
+                                 first_sc.payload, first_sc.attachment,
+                                 int(timeout_ms * 1000))
+        except Exception:
+            for sp in sps:
+                if sp is not None:
+                    span_mod.finish_span(sp, errors.EINTERNAL)
+            return False  # e.g. a member closed mid-call: slow path
+        lat_us = (_t.monotonic_ns() - t0) // 1000
+        any_ok = False
+        for k, ((i, _), (code, text, data, _att)) in enumerate(
+                zip(pending, outs)):
+            if sps[k] is not None:
+                span_mod.finish_span(sps[k], code)
+            if code == 0:
+                results[i] = data
+                any_ok = True
+                self._subs[i][0]._check_transport_settled()
+            else:
+                fail_codes[i] = (code, text)
+        # the native API times the GROUP, not each member: record that
+        # wall-clock ONCE (recording it per member would weight every
+        # sample at the slowest member's latency and inflate the
+        # class-wide rpc_client percentiles N-fold)
+        if any_ok and _RpcChannel._latency is not None:
+            _RpcChannel._latency.record(lat_us)
+        return True
+
     def call(self, method: str, payload: bytes = b"",
              attachment: bytes = b"",
              cntl: Optional[Controller] = None) -> bytes:
@@ -144,11 +222,18 @@ class ParallelChannel:
         results: List[Optional[bytes]] = [None] * n
         first_err: List[Optional[errors.RpcError]] = [None]
 
-        def one(i: int, sub_call: SubCall):
+        import time as _t
+        start = _t.monotonic()
+        total_ms = (cntl.timeout_ms if cntl.timeout_ms is not None
+                    else self.timeout_ms)
+
+        def one(i: int, sub_call: SubCall, max_retry=None,
+                timeout_ms=None):
             ch, _ = self._subs[i]
             sub_cntl = Controller()
-            sub_cntl.timeout_ms = (cntl.timeout_ms if cntl.timeout_ms
-                                   is not None else self.timeout_ms)
+            sub_cntl.timeout_ms = (total_ms if timeout_ms is None
+                                   else timeout_ms)
+            sub_cntl.max_retry = max_retry  # None = the channel's own
             try:
                 results[i] = ch.call(sub_call.method, sub_call.payload,
                                      attachment=sub_call.attachment,
@@ -157,10 +242,41 @@ class ParallelChannel:
                 if first_err[0] is None:
                     first_err[0] = e
 
-        futures = self._submit_all(
-            one, [(i, sc) for i, sc in enumerate(mapped) if sc is not None])
-        for f in futures:
-            f.result()
+        pending = [(i, sc) for i, sc in enumerate(mapped) if sc is not None]
+        fail_codes: Dict[int, Tuple[int, str]] = {}
+        if self._native_fanout_attempt(pending, results, cntl, fail_codes):
+            # happy path done natively.  The unhappy tail re-runs through
+            # the per-sub path ONLY where that channel's own retry policy
+            # says the error is retriable — re-issuing a timed-out
+            # non-idempotent call would execute it twice (the default
+            # policy deliberately excludes ERPCTIMEDOUT, channel.py).
+            from brpc_tpu.rpc.channel import _default_retry
+            retriable = []
+            for i, sc in pending:
+                if results[i] is not None:
+                    continue
+                code, text = fail_codes.get(i, (errors.EINTERNAL, ""))
+                ch = self._subs[i][0]
+                policy = (getattr(ch, "options", None)
+                          and ch.options.retry_policy) or _default_retry
+                # the native wave spent attempt #1 AND part of the clock:
+                # the fallback gets the REMAINING attempt budget and the
+                # REMAINING deadline, so a max_retry=0 channel executes
+                # exactly once and the group never exceeds its timeout
+                budget = (cntl.max_retry if cntl.max_retry is not None
+                          else ch.options.max_retry)
+                left_ms = total_ms - (_t.monotonic() - start) * 1e3
+                probe = Controller()
+                probe.error_code, probe.error_text = code, text
+                if budget > 0 and left_ms > 1.0 and policy.do_retry(probe):
+                    retriable.append((i, sc, budget - 1, left_ms))
+                elif first_err[0] is None:
+                    first_err[0] = errors.RpcError(code, text)
+            pending = retriable
+        if pending:
+            futures = self._submit_all(one, pending)
+            for f in futures:
+                f.result()
         mapped_n = sum(1 for sc in mapped if sc is not None)
         ok_n = sum(1 for i, sc in enumerate(mapped)
                    if sc is not None and results[i] is not None)

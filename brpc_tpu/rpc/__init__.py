@@ -8,4 +8,4 @@ from brpc_tpu.rpc.controller import Controller  # noqa: F401
 from brpc_tpu.rpc.channel import Channel, ChannelOptions  # noqa: F401
 from brpc_tpu.rpc.server import Server, ServerOptions  # noqa: F401
 from brpc_tpu.rpc.stream import (  # noqa: F401
-    Stream, StreamClosed, StreamTimeout)
+    Stream, StreamClosed, StreamReset, StreamTimeout)

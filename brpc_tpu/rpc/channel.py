@@ -12,17 +12,34 @@ naming+load-balancing (cluster layer), and circuit-breaker feedback.
 from __future__ import annotations
 
 import ctypes
+import os
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Callable, Optional, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple
 
 from brpc_tpu._native import lib
 from brpc_tpu.metrics import bvar
 from brpc_tpu.rpc import errors
 from brpc_tpu.rpc.controller import Controller
+from brpc_tpu.utils import flags
 from brpc_tpu.utils import logging as log
 from brpc_tpu.utils.endpoint import EndPoint, str2endpoint
+
+
+def _push_client_cork(value) -> bool:
+    lib().trpc_set_client_cork(1 if value else 0)
+    return True
+
+
+flags.define_bool("client_cork",
+                  os.environ.get("TRPC_CLIENT_CORK") != "0",
+                  "client egress fast path: requests hold the socket "
+                  "doorbell (Socket::Cork/Uncork) around the write, so "
+                  "concurrent callers sharing one connection leave as a "
+                  "single writev/SEND_ZC chain; off = plain per-request "
+                  "writes, the TRPC_CLIENT_CORK=0 A/B baseline",
+                  validator=_push_client_cork)
 
 
 @dataclass
@@ -136,6 +153,45 @@ class _NativeCall:
                 attachment if attachment else None, len(attachment),
                 timeout_us, ctypes.byref(result))
         return _unpack_result(L, rc, result)
+
+
+def native_fanout(subs: Sequence["SubChannel"], method: bytes,
+                  payload: bytes, attachment: bytes, timeout_us: int
+                  ) -> List[Tuple[int, str, bytes, bytes]]:
+    """Serialize-once fan-out: ONE native call issues len(subs) sub-calls
+    whose frames share a single serialization of `payload`/`attachment`
+    as refcounted IOBuf blocks (rpc.cc channel_fanout_call; counted by
+    native_fanout_shared_serializations).  Responses complete on the
+    arriving parse fibers and are harvested here by one thread — no pool
+    thread per sub-call.  Returns one (code, text, data, attachment)
+    tuple per sub, in order.  Raises RpcError if any sub is closed."""
+    L = lib()
+    n = len(subs)
+    if n == 0:
+        return []
+    acquired = []
+    results = (ctypes.c_void_p * n)()
+    try:
+        # in-flight accounting on every member, so a concurrent close()
+        # cannot free a native handle under the group call
+        for s in subs:
+            with s._lock:
+                if s._closed:
+                    raise errors.RpcError(errors.EFAILEDSOCKET,
+                                          "channel closed")
+                s._inflight += 1
+            acquired.append(s)
+        handles = (ctypes.c_void_p * n)(*[s._handle for s in subs])
+        L.trpc_fanout_call(handles, n, method, payload, len(payload),
+                           attachment if attachment else None,
+                           len(attachment), timeout_us, results)
+    finally:
+        for s in acquired:
+            with s._lock:
+                s._inflight -= 1
+                if s._inflight == 0:
+                    s._drained.notify_all()
+    return [_unpack_result(L, 0, results[i]) for i in range(n)]
 
 
 class SubChannel:
@@ -526,6 +582,10 @@ class Channel:
                       else self.options.timeout_ms)
         timeout_us = int(timeout_ms * 1000)
         handle = lib().trpc_stream_create(window or _stream.DEFAULT_WINDOW)
+        # arm the cancellation window like call() does: start_cancel from
+        # another thread claims the handshake's published id, and the
+        # server propagates the cancel to the accepted stream as an RST
+        cntl._call_id_buf = ctypes.c_uint64(0)
         # the cluster path keeps its LB/breaker/health bookkeeping (the
         # handshake is a normal one-attempt call with a stream attached)
         if self._cluster is not None:
@@ -534,7 +594,8 @@ class Channel:
                 stream_handle=handle)
         else:
             code, text, data, att = self._sub.call_once(
-                method.encode(), payload, attachment, timeout_us, handle)
+                method.encode(), payload, attachment, timeout_us, handle,
+                cancel_buf=cntl._call_id_buf)
         cntl.error_code, cntl.error_text = code, text
         cntl.response_attachment = att
         if code != 0:

@@ -583,6 +583,10 @@ class Server:
         # PendingCall occupancy, sequencer backlog, usercode queue, ...)
         from brpc_tpu.metrics.native import install_native_metrics
         install_native_metrics()
+        # periodic bvar dump-to-file (≙ FLAGS_bvar_dump): idles unless
+        # bvar_dump_file / TRPC_BVAR_DUMP_FILE names a target
+        from brpc_tpu.metrics import dumper as _dumper
+        _dumper.ensure_started()
         self._install_http()
         if self.options.auth:
             lib().trpc_server_set_auth(self._handle, self.options.auth,

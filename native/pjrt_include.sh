@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Echo the PJRT C API include dir (empty if absent) — ONE probe shared
+# by the no-cmake build fallbacks (build.sh, build_sanitized.sh), so the
+# Release and sanitizer trees can never disagree on TRPC_HAVE_PJRT_HEADER.
+# cmake builds keep their own find_path in CMakeLists.txt.
+if [[ -n "${PJRT_INCLUDE_DIR:-}" ]]; then
+  echo "${PJRT_INCLUDE_DIR}"
+  exit 0
+fi
+python3 - <<'EOF' 2>/dev/null || true
+import glob
+for pat in ("/opt/venv/lib/python3*/site-packages/tensorflow/include",
+            "/usr/local/lib/python3*/site-packages/tensorflow/include",
+            "/usr/lib/python3*/site-packages/tensorflow/include"):
+    for d in sorted(glob.glob(pat)):
+        if glob.glob(d + "/xla/pjrt/c/pjrt_c_api.h"):
+            print(d)
+            raise SystemExit
+EOF

@@ -6,6 +6,8 @@
 #include <cstdlib>
 #include <cstring>
 
+#include <vector>
+
 #include "fiber.h"
 #include "fiber_sync.h"
 #include "h2.h"
@@ -532,6 +534,28 @@ int trpc_channel_call_cancelable(void* c, const char* method,
   return rc;
 }
 
+// Client egress fast path: request corking A/B switch (TRPC_CLIENT_CORK
+// env seeds the default; reloadable).
+void trpc_set_client_cork(int on) { set_client_cork(on); }
+int trpc_client_cork_active() { return client_cork_enabled() ? 1 : 0; }
+
+// Serialize-once fan-out: one request body serialized once, shared as
+// refcounted blocks across n sub-calls (one per channels[i]); results[i]
+// receives a CallResult handle the caller frees with trpc_result_destroy
+// (read error_code per sub).  Returns the number of failed sub-calls.
+int trpc_fanout_call(void** channels, int n, const char* method,
+                     const uint8_t* req, size_t req_len,
+                     const uint8_t* attach, size_t attach_len,
+                     int64_t timeout_us, void** results) {
+  std::vector<CallResult*> outs((size_t)(n > 0 ? n : 0));
+  for (int i = 0; i < n; ++i) {
+    outs[(size_t)i] = new CallResult();
+    results[i] = outs[(size_t)i];
+  }
+  return channel_fanout_call((Channel**)channels, n, method, req, req_len,
+                             attach, attach_len, timeout_us, outs.data());
+}
+
 int trpc_call_cancel(uint64_t call_id) { return call_cancel(call_id); }
 
 // Server-side cancellation observation (≙ IsCanceled/NotifyOnCancel).
@@ -603,6 +627,12 @@ int trpc_stream_read_device(uint64_t h, int dst_device, int64_t timeout_us,
   return stream_read_device(h, dst_device, timeout_us, out, len_out);
 }
 int trpc_stream_close(uint64_t h) { return stream_close(h); }
+// Abortive close carrying an error code; the peer's reads surface it
+// (never a clean EOF) and trpc_stream_rst_code reports the code.
+int trpc_stream_rst(uint64_t h, int32_t error_code) {
+  return stream_rst(h, error_code);
+}
+int32_t trpc_stream_rst_code(uint64_t h) { return stream_rst_code(h); }
 void trpc_stream_destroy(uint64_t h) { stream_destroy(h); }
 int trpc_stream_remote_closed(uint64_t h) { return stream_remote_closed(h); }
 int trpc_stream_failed(uint64_t h) { return stream_failed(h); }

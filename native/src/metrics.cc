@@ -57,6 +57,17 @@ size_t native_metrics_dump(char* buf, size_t cap) {
     put("native_batch_cork_responses_per_flush", fl > 0 ? rs / fl : 0);
   }
   put("native_usercode_queue_ns_total", relu(m.usercode_queue_ns_total));
+  put("native_client_cork_windows", relu(m.client_cork_windows));
+  put("native_client_inline_completes", relu(m.client_inline_completes));
+  put("native_client_budget_yields", relu(m.client_budget_yields));
+  put("native_fanout_calls", relu(m.fanout_calls));
+  put("native_fanout_subcalls", relu(m.fanout_subcalls));
+  put("native_fanout_shared_serializations",
+      relu(m.fanout_shared_serializations));
+  put("native_stream_rsts_sent", relu(m.stream_rsts_sent));
+  put("native_stream_rsts_received", relu(m.stream_rsts_received));
+  put("native_stream_device_local_rail", relu(m.stream_device_local_rail));
+  put("native_stream_device_host_rail", relu(m.stream_device_host_rail));
   put("native_parse_errors", relu(m.parse_errors));
   put("native_h2_connections", rel(m.h2_connections));
   put("native_mutex_contended", relu(m.mutex_contended));

@@ -62,6 +62,36 @@ struct NativeMetrics {
   // usercode worker picked them up
   std::atomic<uint64_t> usercode_queue_ns_total{0};
 
+  // client egress fast path (rpc.cc channel_call / channel_fanout_call):
+  // cork_windows = Cork/Uncork brackets held around client request writes
+  // (TRPC_CLIENT_CORK); inline_completes = unary responses completed
+  // run-to-completion on the client parse fiber (butex woken directly,
+  // no trampoline fiber)
+  std::atomic<uint64_t> client_cork_windows{0};
+  std::atomic<uint64_t> client_inline_completes{0};
+  // client drains whose per-drain budget ran out (the drain flushed its
+  // cork and yielded once) — kept SEPARATE from the server's
+  // inline_dispatch_budget_trips so the ingress A/B stays readable
+  std::atomic<uint64_t> client_budget_yields{0};
+
+  // serialize-once fan-out (rpc.cc channel_fanout_call): calls = fan-out
+  // groups issued; subcalls = member RPCs those groups fanned into;
+  // shared_serializations = request bodies serialized ONCE and shared as
+  // refcounted IOBuf blocks across the group (1 per fan-out call — N
+  // sub-calls previously cost N serializations)
+  std::atomic<uint64_t> fanout_calls{0};
+  std::atomic<uint64_t> fanout_subcalls{0};
+  std::atomic<uint64_t> fanout_shared_serializations{0};
+
+  // stream RST frames (stream.cc): abortive close carrying an error code
+  std::atomic<uint64_t> stream_rsts_sent{0};
+  std::atomic<uint64_t> stream_rsts_received{0};
+  // device-frame rail selection (stream.cc stream_write_device): local =
+  // handle passed, both ends share one PJRT client; host = explicit d2h
+  // landing zone rides the wire (the cross-host rail)
+  std::atomic<uint64_t> stream_device_local_rail{0};
+  std::atomic<uint64_t> stream_device_host_rail{0};
+
   // protocol errors observed on input (both sides)
   std::atomic<uint64_t> parse_errors{0};
 

@@ -212,6 +212,14 @@ bool PeekFrameLayout(const IOBuf& buf, size_t* total, size_t* attach_off) {
   }
   *total = 12 + (size_t)meta_size + body_size;
   *attach_off = *total;
+  // size heuristic: the meta decode below only informs the ATTACHMENT
+  // landing hint, which ArmTrpcFrameHints ignores for frames under
+  // kBigBlockThreshold — skip it for small frames so the per-chunk peek
+  // on small-frame pipelines costs a 12-byte header read, not a TLV walk
+  // (measured in BENCH_NOTES.md "frame-hint peek cost")
+  if (*total < IOBuf::kBigBlockThreshold) {
+    return true;
+  }
   if (buf.size() >= 12 + (size_t)meta_size) {
     std::string ms;
     ms.resize(meta_size);
@@ -330,7 +338,12 @@ struct CallCtx {
   // stream_accept() for the response meta
   uint64_t req_stream_id = 0;
   uint64_t req_stream_window = 0;
-  uint64_t accepted_stream = 0;
+  // atomic: written by the handler thread (stream_accept) concurrently
+  // with the parse fiber reading it to propagate an RPC cancel onto the
+  // attached stream (MarkCanceledLocked) — the value race is benign (an
+  // accept racing the cancel is caught by respond()'s error path), but
+  // the access itself must not be a data race
+  std::atomic<uint64_t> accepted_stream{0};
   // pipelining: position of this HTTP/RESP request on its connection;
   // responses release strictly in sequence (see ConnState)
   uint64_t pipe_seq = 0;
@@ -375,6 +388,14 @@ std::atomic<int> g_inline_dispatch{-1};
 std::atomic<int> g_inline_budget_reqs{512};
 std::atomic<int64_t> g_inline_budget_us{500};
 
+// --- client egress fast path (request corking) -----------------------------
+// -1 = consult TRPC_CLIENT_CORK on first use (the bench A/B switch);
+// set_client_cork overrides at runtime (reloadable flag).  While on,
+// channel_call/channel_fanout_call hold the socket doorbell around the
+// request write, and the client parse fiber completes responses under the
+// same per-drain budget discipline as the server ingress path.
+std::atomic<int> g_client_cork{-1};
+
 // Coarse clock: refreshed once per parse drain; every per-request
 // timestamp in the hot loop (budget checks, usercode arm times) reads
 // this instead of issuing its own clock syscall.
@@ -396,12 +417,20 @@ struct InlineBudget {
   bool enabled;
   bool tripped = false;
   uint32_t grants = 0;
+  // where a trip is counted: the server ingress counter by default; the
+  // client response drain passes its own (native_client_budget_yields)
+  // so the PR-3 ingress A/B diagnostic stays unpolluted
+  std::atomic<uint64_t>* trip_counter;
 
-  InlineBudget(bool on, int64_t drain_start_ns) {
+  InlineBudget(bool on, int64_t drain_start_ns,
+               std::atomic<uint64_t>* trips = nullptr) {
     enabled = on;
     left = g_inline_budget_reqs.load(std::memory_order_relaxed);
     deadline_ns = drain_start_ns +
                   g_inline_budget_us.load(std::memory_order_relaxed) * 1000;
+    trip_counter = trips != nullptr
+                       ? trips
+                       : &native_metrics().inline_dispatch_budget_trips;
   }
 
   bool take() {
@@ -411,8 +440,7 @@ struct InlineBudget {
     if (left <= 0 ||
         (((++grants) & 7u) == 0 && monotonic_ns() > deadline_ns)) {
       tripped = true;
-      native_metrics().inline_dispatch_budget_trips.fetch_add(
-          1, std::memory_order_relaxed);
+      trip_counter->fetch_add(1, std::memory_order_relaxed);
       return false;
     }
     --left;
@@ -449,41 +477,57 @@ void UnregisterInflight(SocketId sid, uint64_t corr) {
 }
 
 // g_cancel_mu must be held (see the registry comment for why that makes
-// the version check race-free against respond()).
-void MarkCanceledLocked(uint64_t token) {
+// the version check race-free against respond()).  Returns the call's
+// accepted-stream handle (0 if none) so the CALLER can propagate the
+// cancel as a stream RST AFTER releasing g_cancel_mu — stream_rst writes
+// to the socket, and a write-triggered SetFailed re-enters
+// CancelAllOnSocket, which takes this very mutex.
+uint64_t MarkCanceledLocked(uint64_t token) {
   CallCtx* ctx = ResourcePool<CallCtx>::Address((uint32_t)token);
   if (ctx == nullptr ||
       ctx->version.load(std::memory_order_acquire) != (uint32_t)(token >> 32)) {
-    return;
+    return 0;
   }
   ctx->canceled.store(true, std::memory_order_release);
   if (ctx->cancel_butex != nullptr) {
     butex_value(ctx->cancel_butex).store(1, std::memory_order_release);
     butex_wake_all(ctx->cancel_butex);
   }
+  return ctx->accepted_stream.load(std::memory_order_acquire);
 }
 
 // A cancel notice (meta flags bit1) arrived for (sid, corr).
 void CancelInflight(SocketId sid, uint64_t corr) {
-  std::lock_guard lk(g_cancel_mu);
-  auto it = g_inflight_calls.find(sid);
-  if (it == g_inflight_calls.end()) {
-    return;
+  uint64_t rst_stream = 0;
+  {
+    std::lock_guard lk(g_cancel_mu);
+    auto it = g_inflight_calls.find(sid);
+    if (it == g_inflight_calls.end()) {
+      return;
+    }
+    auto jt = it->second.find(corr);
+    if (jt == it->second.end()) {
+      return;
+    }
+    rst_stream = MarkCanceledLocked(jt->second);
+    it->second.erase(jt);
+    if (it->second.empty()) {
+      g_inflight_calls.erase(it);
+    }
   }
-  auto jt = it->second.find(corr);
-  if (jt == it->second.end()) {
-    return;
-  }
-  MarkCanceledLocked(jt->second);
-  it->second.erase(jt);
-  if (it->second.empty()) {
-    g_inflight_calls.erase(it);
+  if (rst_stream != 0) {
+    // the canceled RPC's accepted stream is orphaned: the canceling
+    // client completed its call locally and will never bind/read — an
+    // RST (not a clean CLOSE) tells the handler's readers/writers why
+    stream_rst(rst_stream, TRPC_ECANCELED);
   }
 }
 
 // The connection died: every in-flight call on it is implicitly canceled
 // (the peer can never receive the response — ≙ NotifyOnCancel firing on
-// client disconnect).
+// client disconnect).  No stream RSTs here: streams bound to the dead
+// socket already fail through StreamsOnSocketFailed (-ECONNRESET is the
+// right surface for a broken connection; RST is for an EXPLICIT abort).
 void CancelAllOnSocket(SocketId sid) {
   std::lock_guard lk(g_cancel_mu);
   auto it = g_inflight_calls.find(sid);
@@ -3042,6 +3086,43 @@ PendingCall* ClaimPending(uint64_t corr,
   return pc;
 }
 
+// Arm a fresh PendingCall for one attempt (shared by channel_call and
+// channel_fanout_call so the arm protocol can never drift between the
+// two issue paths): reset the result fields, bind the connection, then
+// release-store ARMED.  Returns the attempt's correlation id.
+uint64_t ArmPendingCall(PendingCall* pc, uint32_t slot, SocketId sid) {
+  pc->slot = slot;
+  if (pc->done == nullptr) {
+    pc->done = butex_create();
+  }
+  butex_value(pc->done).store(0, std::memory_order_release);
+  pc->error_code = 0;
+  pc->error_text.clear();
+  pc->response.clear();
+  pc->attachment.clear();
+  pc->stream_id = 0;
+  pc->stream_window = 0;
+  pc->compress_type = 0;
+  pc->sock_id.store(sid, std::memory_order_relaxed);
+  uint32_t ver = (uint32_t)(pc->vs.load(std::memory_order_relaxed) >> 32);
+  pc->vs.store(((uint64_t)ver << 32) | PC_ARMED, std::memory_order_release);
+  native_metrics().pending_calls.fetch_add(1, std::memory_order_relaxed);
+  return ((uint64_t)ver << 32) | slot;
+}
+
+// Recycle a completed call's slot (results already copied out, sweep
+// list already unlinked): bump the version BEFORE returning to the pool
+// so a late response with this corr can never match the recycled slot.
+void ReleasePendingCall(PendingCall* pc, uint32_t slot) {
+  pc->response.clear();
+  pc->attachment.clear();
+  uint32_t ver = (uint32_t)(pc->vs.load(std::memory_order_relaxed) >> 32);
+  pc->vs.store(((uint64_t)(ver + 1) << 32) | PC_FREE,
+               std::memory_order_release);
+  native_metrics().pending_calls.fetch_sub(1, std::memory_order_relaxed);
+  ResourcePool<PendingCall>::Return(slot);
+}
+
 }  // namespace
 
 class Channel;
@@ -3069,6 +3150,11 @@ enum TransportState {
 // strictly in request order on a connection — FIFO correlation, unlike
 // TRPC's correlation ids).  Refcounted: caller + completer; a timeout
 // abandons by failing the connection, whose sweep completes the entry.
+// Pooled (ObjectPool slot per call, like the server-side request args):
+// the butex survives recycling, so a call costs no butex create/destroy
+// and no heap churn.  Late actors always hold a ref, so a slot can only
+// return to the pool after every pointer to it is gone — the same
+// lifetime contract the old delete relied on.
 struct HttpPending {
   Butex* done = nullptr;
   std::atomic<int> refs{2};
@@ -3081,10 +3167,28 @@ struct HttpPending {
   void* chunk_user = nullptr;
 };
 
+HttpPending* AcquireHttpPending() {
+  HttpPending* p = ObjectPool<HttpPending>::Get();
+  if (p->done == nullptr) {
+    p->done = butex_create();
+  }
+  butex_value(p->done).store(0, std::memory_order_release);
+  p->refs.store(2, std::memory_order_relaxed);
+  p->error = 0;
+  p->error_text.clear();
+  p->is_head = false;
+  p->chunk_cb = nullptr;
+  p->chunk_user = nullptr;
+  return p;
+}
+
 void HttpPendingUnref(HttpPending* p) {
   if (p->refs.fetch_sub(1, std::memory_order_acq_rel) == 1) {
-    butex_destroy(p->done);
-    delete p;
+    // drop the response's heap before pooling the slot (a parked slot
+    // must not pin a large body)
+    p->resp = HttpResponseMsg();
+    p->error_text.clear();
+    ObjectPool<HttpPending>::Return(p);
   }
 }
 
@@ -3258,13 +3362,25 @@ void ClientConnFailed(Socket* s) {
 }
 
 // edge_fn of client-side sockets: parse responses, wake callers
-// (≙ ProcessRpcResponse + bthread_id unlock/destroy).
+// (≙ ProcessRpcResponse + bthread_id unlock/destroy).  The client half
+// of the PR-3 ingress fast path: unary responses complete RUN-TO-
+// COMPLETION on this parse fiber (slice the IOBuf, fill the PendingCall,
+// wake the waiter's butex directly — no trampoline fiber), the doorbell
+// is corked for the drain so frames written DURING it (stale-response
+// stream closes, device-probe answers) flush as one batch, and the
+// per-drain budget yields between bursts so one connection's deep
+// response pipeline cannot starve the other sockets' parse fibers.
 void ChannelOnMessages(Socket* s) {
   bool eof = false;
   ssize_t n = s->ReadToBuf(&eof);
   if (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
     eof = true;  // dead connection; drain buffered responses first
   }
+  bool fast = client_cork_enabled();
+  NativeMetrics& nm = native_metrics();
+  InlineBudget budget(fast, CoarseClockRefresh(),
+                      &nm.client_budget_yields);
+  CorkScope cork_scope(s, fast);
   while (true) {
     RpcMeta meta;
     IOBuf payload, attachment;
@@ -3276,6 +3392,18 @@ void ChannelOnMessages(Socket* s) {
     if (rc < 0) {
       s->SetFailed(TRPC_EREQUEST);
       return;
+    }
+    if (fast && !budget.take()) {
+      // budget spent mid-pipeline: flush the held doorbell and yield
+      // once — other ready fibers run, then this drain resumes with a
+      // fresh budget (the client analog of the server's spawned-path
+      // fallback; there is no user code here, only completion work, so
+      // yielding IS the fairness release)
+      s->Uncork();
+      fiber_yield();
+      s->Cork();
+      budget = InlineBudget(fast, CoarseClockRefresh(),
+                            &nm.client_budget_yields);
     }
     if (meta.stream_frame_type != STREAM_FRAME_NONE) {
       // a device frame's tensor body rides as the attachment (single
@@ -3328,6 +3456,7 @@ void ChannelOnMessages(Socket* s) {
     pc->compress_type = meta.compress_type;
     butex_value(pc->done).store(1, std::memory_order_release);
     butex_wake_all(pc->done);
+    nm.client_inline_completes.fetch_add(1, std::memory_order_relaxed);
   }
   if (eof) {
     s->SetFailed(ECONNRESET);
@@ -3716,6 +3845,53 @@ Socket* AcquireConn(Channel* c, int* rc_out) {
   }
 }
 
+// Warm-only acquire for the fan-out issue loop: returns a ref-held live
+// connection WITHOUT ever dialing (nullptr = cold — the caller dials
+// those members concurrently, so one unreachable member's connect
+// timeout can never stack onto another's).  single: the lock-free
+// cached-socket fast path; pooled: pop the free list; short: always
+// cold by definition.
+Socket* AcquireWarm(Channel* c) {
+  if (c->conn_type == 2) {
+    return nullptr;
+  }
+  if (c->conn_type == 1) {
+    while (true) {
+      SocketId sid = INVALID_SOCKET_ID;
+      {
+        std::lock_guard lk(c->pool_mu);
+        if (!c->pool_free.empty()) {
+          sid = c->pool_free.back();
+          c->pool_free.pop_back();
+        }
+      }
+      if (sid == INVALID_SOCKET_ID) {
+        return nullptr;
+      }
+      Socket* s = Socket::Address(sid);
+      if (s != nullptr && !s->failed.load(std::memory_order_acquire) &&
+          !((ClientConn*)s->user)->closing.load(
+              std::memory_order_acquire)) {
+        return s;
+      }
+      if (s != nullptr) {
+        s->Dereference();
+      }
+    }
+  }
+  SocketId cached = c->cached_sock.load(std::memory_order_acquire);
+  if (cached != INVALID_SOCKET_ID) {
+    Socket* s = Socket::Address(cached);
+    if (s != nullptr && !s->failed.load(std::memory_order_acquire)) {
+      return s;
+    }
+    if (s != nullptr) {
+      s->Dereference();
+    }
+  }
+  return nullptr;
+}
+
 }  // namespace
 
 Channel* channel_create(const char* ip, int port) {
@@ -3766,6 +3942,21 @@ bool inline_dispatch_enabled() {
     const char* e = getenv("TRPC_INLINE_DISPATCH");
     v = (e != nullptr && e[0] == '0' && e[1] == '\0') ? 0 : 1;
     g_inline_dispatch.store(v, std::memory_order_release);
+  }
+  return v != 0;
+}
+
+void set_client_cork(int on) {
+  g_client_cork.store(on ? 1 : 0, std::memory_order_release);
+}
+
+bool client_cork_enabled() {
+  int v = g_client_cork.load(std::memory_order_acquire);
+  if (v < 0) {
+    // first use: the TRPC_CLIENT_CORK env var is the A/B switch
+    const char* e = getenv("TRPC_CLIENT_CORK");
+    v = (e != nullptr && e[0] == '0' && e[1] == '\0') ? 0 : 1;
+    g_client_cork.store(v, std::memory_order_release);
   }
   return v != 0;
 }
@@ -3877,24 +4068,7 @@ int channel_call(Channel* c, const char* method, const uint8_t* req,
   SocketId sid = s->id();
   PendingCall* pc = nullptr;
   uint32_t slot = ResourcePool<PendingCall>::Get(&pc);
-  pc->slot = slot;
-  if (pc->done == nullptr) {
-    pc->done = butex_create();
-  }
-  butex_value(pc->done).store(0, std::memory_order_release);
-  pc->error_code = 0;
-  pc->error_text.clear();
-  pc->response.clear();
-  pc->attachment.clear();
-  pc->stream_id = 0;
-  pc->stream_window = 0;
-  pc->compress_type = 0;
-  pc->sock_id.store(sid, std::memory_order_relaxed);
-  uint32_t ver =
-      (uint32_t)(pc->vs.load(std::memory_order_relaxed) >> 32);
-  pc->vs.store(((uint64_t)ver << 32) | PC_ARMED, std::memory_order_release);
-  native_metrics().pending_calls.fetch_add(1, std::memory_order_relaxed);
-  uint64_t corr = ((uint64_t)ver << 32) | slot;
+  uint64_t corr = ArmPendingCall(pc, slot, sid);
   if (call_id_out != nullptr) {
     // published BEFORE the request hits the wire: a concurrent
     // call_cancel(corr) from another thread is valid from this point on
@@ -3925,7 +4099,23 @@ int channel_call(Channel* c, const char* method, const uint8_t* req,
     attachment.append(attach, attach_len);
   }
   PackFrame(&frame, meta, std::move(payload), std::move(attachment));
+  // Request corking (the client half of the PR-3 doorbell): hold the
+  // cork across the write so K concurrent callers sharing this
+  // single/pooled connection chain onto one parked flush — K pipelined
+  // requests leave as ONE writev/SEND_ZC batch instead of K syscalls.
+  // The bracket covers only the enqueue (never the response wait), so an
+  // uncontended call costs one atomic pair, and SetFailed's synchronous
+  // cork drain keeps failure semantics identical to the uncorked arm.
+  bool cork = client_cork_enabled();
+  if (cork) {
+    native_metrics().client_cork_windows.fetch_add(
+        1, std::memory_order_relaxed);
+    s->Cork();
+  }
   rc = s->Write(std::move(frame));
+  if (cork) {
+    s->Uncork();
+  }
   // the socket ref is held until after SweepUnlink: it pins `conn`
   // (freed only at socket recycle, which waits out this ref)
   int result;
@@ -3977,18 +4167,10 @@ int channel_call(Channel* c, const char* method, const uint8_t* req,
     out->attachment = pc->attachment.to_string();
     out->compress_type = pc->compress_type;
   }
-  pc->response.clear();
-  pc->attachment.clear();
   c->last_transport.store(conn->transport.load(std::memory_order_acquire),
                           std::memory_order_release);
   conn->SweepUnlink(pc);
-  // bump the version before returning to the pool: a late response with
-  // this corr can never match the recycled slot
-  uint32_t ver2 = (uint32_t)(pc->vs.load(std::memory_order_relaxed) >> 32);
-  pc->vs.store(((uint64_t)(ver2 + 1) << 32) | PC_FREE,
-               std::memory_order_release);
-  native_metrics().pending_calls.fetch_sub(1, std::memory_order_relaxed);
-  ResourcePool<PendingCall>::Return(slot);
+  ReleasePendingCall(pc, slot);
   if (conn->short_lived && !(stream != 0 && result == 0)) {
     // one call per connection — unless a stream now rides it (then the
     // socket lives until the stream closes / channel_destroy)
@@ -3998,6 +4180,191 @@ int channel_call(Channel* c, const char* method, const uint8_t* req,
   }
   s->Dereference();
   return result;
+}
+
+// Serialize-once fan-out (see rpc.h).  Mirrors channel_call's issue/wait/
+// harvest pipeline, restructured for a group: ONE serialization shared
+// across N frames as refcounted blocks, doorbells corked across the whole
+// issue loop (same-socket members chain into one flush), and one caller
+// thread harvesting responses the parse fibers completed inline — the
+// reference's ParallelChannel spawns nothing per sub-response either
+// (merge runs where the response arrives, parallel_channel.h:127).
+int channel_fanout_call(Channel** chans, int n, const char* method,
+                        const uint8_t* req, size_t req_len,
+                        const uint8_t* attach, size_t attach_len,
+                        int64_t timeout_us, CallResult** outs) {
+  if (n <= 0) {
+    return 0;
+  }
+  NativeMetrics& nm = native_metrics();
+  nm.fanout_calls.fetch_add(1, std::memory_order_relaxed);
+  nm.fanout_subcalls.fetch_add((uint64_t)n, std::memory_order_relaxed);
+  // serialize ONCE: every sub-frame below appends these buffers by
+  // BlockRef (IOBuf copy = block refcount bump, zero byte copies); the
+  // socket write path holds its own refs until the bytes are on the wire
+  IOBuf shared_payload, shared_attach;
+  if (req != nullptr && req_len > 0) {
+    shared_payload.append(req, req_len);
+  }
+  if (attach != nullptr && attach_len > 0) {
+    shared_attach.append(attach, attach_len);
+  }
+  nm.fanout_shared_serializations.fetch_add(1, std::memory_order_relaxed);
+
+  struct Sub {
+    Socket* s = nullptr;
+    ClientConn* conn = nullptr;
+    PendingCall* pc = nullptr;
+    uint32_t slot = 0;
+    uint64_t corr = 0;
+    IOBuf frame;
+  };
+  std::vector<Sub> subs((size_t)n);
+  int64_t deadline = timeout_us > 0 ? monotonic_us() + timeout_us : -1;
+  // Phase 1 — acquire + arm + pack, NO corks held yet: a cold member's
+  // dial must not park earlier members' already-corked frames behind
+  // it.  Warm members resolve through the lock-free fast path; COLD
+  // members dial CONCURRENTLY (one short-lived thread each, exactly the
+  // shape the replaced thread-pool path had), so one unreachable
+  // member's connect timeout bounds the group instead of stacking —
+  // [deadA, deadB, good] completes `good` in one RTT and spends the
+  // fail_limit budget on the dead members only.
+  std::vector<int> cold;
+  for (int i = 0; i < n; ++i) {
+    subs[(size_t)i].s = AcquireWarm(chans[i]);
+  }
+  for (int i = 0; i < n; ++i) {
+    if (subs[(size_t)i].s == nullptr) {
+      cold.push_back(i);
+    }
+  }
+  if (!cold.empty()) {
+    std::vector<std::thread> dialers;
+    dialers.reserve(cold.size());
+    for (int i : cold) {
+      dialers.emplace_back([&subs, chans, i, deadline] {
+        if (deadline >= 0 && monotonic_us() >= deadline) {
+          return;  // harvested below as a connect failure
+        }
+        int arc = 0;
+        subs[(size_t)i].s = AcquireConn(chans[i], &arc);
+      });
+    }
+    for (auto& t : dialers) {
+      t.join();
+    }
+  }
+  for (int i = 0; i < n; ++i) {
+    CallResult* out = outs[i];
+    Sub& sb = subs[(size_t)i];
+    if (sb.s == nullptr) {
+      out->error_code = TRPC_EFAILEDSOCKET;
+      out->error_text = "connect failed";
+      continue;
+    }
+    sb.conn = (ClientConn*)sb.s->user;
+    PendingCall* pc = nullptr;
+    uint32_t slot = ResourcePool<PendingCall>::Get(&pc);
+    sb.pc = pc;
+    sb.slot = slot;
+    sb.corr = ArmPendingCall(pc, slot, sb.s->id());
+    sb.conn->SweepLink(pc);
+    RpcMeta meta;
+    meta.method = method;
+    meta.correlation_id = sb.corr;
+    meta.auth = chans[i]->auth;
+    if (chans[i]->device_plane) {
+      meta.device_caps = 1;
+      meta.plane_uid = tpu_plane_uid();
+    }
+    IOBuf payload = shared_payload;  // BlockRef share, not a serialization
+    IOBuf attachment = shared_attach;
+    PackFrame(&sb.frame, meta, std::move(payload), std::move(attachment));
+  }
+  // Phase 2 — every connection is live: cork each distinct socket once
+  // and enqueue the whole group, so members resolving to one shared
+  // connection (same endpoint through the SocketMap) leave as a single
+  // writev/SEND_ZC chain
+  bool cork = client_cork_enabled();
+  std::vector<Socket*> corked;
+  for (int i = 0; i < n; ++i) {
+    Sub& sb = subs[(size_t)i];
+    if (sb.pc == nullptr) {
+      continue;
+    }
+    if (cork && std::find(corked.begin(), corked.end(), sb.s) ==
+                    corked.end()) {
+      nm.client_cork_windows.fetch_add(1, std::memory_order_relaxed);
+      sb.s->Cork();
+      corked.push_back(sb.s);
+    }
+    int wrc = sb.s->Write(std::move(sb.frame));
+    if (wrc != 0) {
+      // failed to enqueue: complete this sub now — unless the failure
+      // sweep already claimed it, in which case IT flips the butex and
+      // the harvest below simply waits for that
+      if (ClaimPending(sb.corr) == sb.pc) {
+        sb.pc->error_code = TRPC_EFAILEDSOCKET;
+        sb.pc->error_text = "write failed";
+        butex_value(sb.pc->done).store(1, std::memory_order_release);
+        butex_wake_all(sb.pc->done);
+      }
+    }
+  }
+  for (Socket* s : corked) {
+    s->Uncork();  // the group's doorbell: one flush per distinct socket
+  }
+
+  // Phase 3 — harvest under the ONE shared deadline.  Waiting the subs
+  // out in index order costs nothing extra: they were all issued above,
+  // so total wait = slowest member, and every response was already
+  // delivered inline by its connection's parse fiber.
+  int failures = 0;
+  for (int i = 0; i < n; ++i) {
+    Sub& sb = subs[(size_t)i];
+    CallResult* out = outs[i];
+    if (sb.pc == nullptr) {
+      ++failures;  // connect failed; outs[i] already filled
+      continue;
+    }
+    PendingCall* pc = sb.pc;
+    while (butex_value(pc->done).load(std::memory_order_acquire) == 0) {
+      int64_t left = deadline < 0 ? -1 : deadline - monotonic_us();
+      if (deadline >= 0 && left <= 0) {
+        if (ClaimPending(sb.corr) == pc) {
+          pc->error_code = TRPC_ERPCTIMEDOUT;
+          pc->error_text = "rpc timeout";
+          break;
+        }
+        // a racer claimed it and is filling results: bounded wait
+        while (butex_value(pc->done).load(std::memory_order_acquire) == 0) {
+          butex_wait(pc->done, 0, 1000);
+        }
+        break;
+      }
+      butex_wait(pc->done, 0, left);
+    }
+    out->error_code = pc->error_code;
+    out->error_text = pc->error_text;
+    out->response = pc->response.to_string();
+    out->attachment = pc->attachment.to_string();
+    out->compress_type = pc->compress_type;
+    if (pc->error_code != 0) {
+      ++failures;
+    }
+    chans[i]->last_transport.store(
+        sb.conn->transport.load(std::memory_order_acquire),
+        std::memory_order_release);
+    sb.conn->SweepUnlink(pc);
+    ReleasePendingCall(pc, sb.slot);
+    if (sb.conn->short_lived) {
+      sb.s->SetFailed(TRPC_ESTOP);  // one call per short connection
+    } else if (chans[i]->conn_type == 1) {
+      ReleasePooled(chans[i], sb.s);
+    }
+    sb.s->Dereference();
+  }
+  return failures;
 }
 
 int call_cancel(uint64_t call_id) {
@@ -4131,8 +4498,7 @@ int http_client_call(Channel* c, const char* method, const char* target,
     return TRPC_EFAILEDSOCKET;
   }
   ClientConn* conn = (ClientConn*)s->user;
-  HttpPending* p = new HttpPending();
-  p->done = butex_create();
+  HttpPending* p = AcquireHttpPending();
   p->is_head = strcmp(method, "HEAD") == 0;
   p->chunk_cb = chunk_cb;
   p->chunk_user = chunk_user;

@@ -333,6 +333,31 @@ int channel_call(Channel* c, const char* method, const uint8_t* req,
 // completing/completed (≙ Controller::StartCancel, controller.h:631).
 int call_cancel(uint64_t call_id);
 
+// --- client egress fast path (mirror of the PR-3 ingress fast path) --------
+
+// Request corking: channel_call/channel_fanout_call hold the socket's
+// response doorbell (Socket::Cork/Uncork) around each request write, so K
+// concurrent callers sharing one single/pooled connection leave as ONE
+// writev/SEND_ZC chain instead of K syscalls.  Off = every request takes
+// the plain write path — the A/B baseline.  Default: on, unless the
+// TRPC_CLIENT_CORK env var is "0".  Reloadable.
+void set_client_cork(int on);
+bool client_cork_enabled();
+
+// Serialize-once fan-out (≙ ParallelChannel issuing N sub-calls,
+// parallel_channel.h:185 — here the request body is serialized ONCE and
+// its refcounted IOBuf blocks are shared across all N frames; the egress
+// rail already holds block refs until the bytes are on the wire, so
+// lifetime is solved).  Issues one sub-call per channel, all corked, then
+// waits for all of them under one shared deadline; responses complete on
+// the arriving parse fibers (no per-sub-response trampoline fiber) and
+// land in outs[i].  Returns the number of failed sub-calls (0 = all
+// succeeded); outs[i].error_code carries each failure.
+int channel_fanout_call(Channel** chans, int n, const char* method,
+                        const uint8_t* req, size_t req_len,
+                        const uint8_t* attach, size_t attach_len,
+                        int64_t timeout_us, CallResult** outs);
+
 // Server side (≙ Controller::IsCanceled/NotifyOnCancel,
 // controller.h:385-388): 1 = the peer canceled this call (or its
 // connection died), 0 = still wanted, -1 = stale token (already

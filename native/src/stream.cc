@@ -18,6 +18,7 @@
 
 #include "common.h"
 #include "fiber.h"
+#include "metrics.h"
 #include "object_pool.h"
 #include "heap_profiler.h"
 #include "rpc.h"
@@ -78,6 +79,12 @@ struct Stream {
   bool local_closed = false;   // we sent CLOSE (no more writes)
   bool remote_closed = false;  // peer sent CLOSE (reads drain then EOF)
   bool sock_failed = false;
+  // abortive close (STREAM_FRAME_RST): unlike CLOSE, queued data is
+  // discarded and reads error out instead of draining to a clean EOF.
+  // rst_code carries the wire error code (set by whichever side reset).
+  bool local_rst = false;
+  bool remote_rst = false;
+  int32_t rst_code = 0;
 
   // flow control: cumulative counters; writer waits on ack_butex
   uint64_t bytes_sent = 0;
@@ -165,7 +172,7 @@ void bump_wake(Butex* b) {
 // receive-side h2d a zero-copy DMA from the socket block.
 int send_stream_frame(SocketId sock, uint64_t peer_id, uint8_t frame_type,
                       IOBuf&& payload, IOBuf&& attachment,
-                      uint64_t feedback_bytes) {
+                      uint64_t feedback_bytes, int32_t error_code = 0) {
   Socket* s = Socket::Address(sock);
   if (s == nullptr) {
     return -ECONNRESET;
@@ -174,6 +181,7 @@ int send_stream_frame(SocketId sock, uint64_t peer_id, uint8_t frame_type,
   meta.stream_id = peer_id;
   meta.stream_frame_type = frame_type;
   meta.feedback_bytes = feedback_bytes;
+  meta.error_code = error_code;  // RST frames carry the abort reason
   IOBuf frame;
   PackFrame(&frame, meta, std::move(payload), std::move(attachment));
   int rc = s->Write(std::move(frame));
@@ -196,13 +204,33 @@ int wait_bump(Butex* b, int32_t seen, int64_t deadline_us) {
   return 0;
 }
 
+// Pooled (ObjectPool slot per queued frame, like the server-side request
+// args): stream sends are per-message hot-path work, and the pool slab
+// keeps them off the global allocator.  acquire/release reset the fields
+// a recycled slot could leak into the next frame.
 struct StreamSendTask {
-  SocketId sock;
-  uint64_t peer;
+  SocketId sock = INVALID_SOCKET_ID;
+  uint64_t peer = 0;
   uint8_t type = STREAM_FRAME_DATA;
+  int32_t error_code = 0;  // RST frames: the abort reason
   IOBuf payload;
   IOBuf attachment;  // device frame body (host rail)
 };
+
+StreamSendTask* acquire_send_task() {
+  StreamSendTask* t = ObjectPool<StreamSendTask>::Get();
+  t->sock = INVALID_SOCKET_ID;
+  t->peer = 0;
+  t->type = STREAM_FRAME_DATA;
+  t->error_code = 0;
+  return t;
+}
+
+void release_send_task(StreamSendTask* t) {
+  t->payload.clear();
+  t->attachment.clear();
+  ObjectPool<StreamSendTask>::Return(t);
+}
 
 void RunStreamSend(void*, void* targ) {
   StreamSendTask* t = (StreamSendTask*)targ;
@@ -224,11 +252,11 @@ void RunStreamSend(void*, void* targ) {
   // write contract
   int rc = send_stream_frame(t->sock, t->peer, t->type,
                              std::move(t->payload),
-                             std::move(t->attachment), 0);
+                             std::move(t->attachment), 0, t->error_code);
   if (rc != 0 && passed != 0) {
     tpu_buf_free(passed);
   }
-  delete t;
+  release_send_task(t);
 }
 
 }  // namespace
@@ -251,6 +279,9 @@ StreamHandle stream_create(uint64_t window_bytes) {
   st->local_closed = false;
   st->remote_closed = false;
   st->sock_failed = false;
+  st->local_rst = false;
+  st->remote_rst = false;
+  st->rst_code = 0;
   st->bytes_sent = st->bytes_acked = 0;
   st->rq.clear();
   st->rq_bytes = 0;
@@ -311,6 +342,10 @@ int stream_submit(StreamHandle h, uint64_t credit, uint8_t type,
     if (st == nullptr) {
       return -EINVAL;
     }
+    if (st->local_rst || st->remote_rst) {
+      st->mu.unlock();
+      return -ECONNABORTED;  // abortive close: distinct from clean EPIPE
+    }
     if (!st->connected || st->local_closed) {
       st->mu.unlock();
       return -EPIPE;
@@ -337,7 +372,7 @@ int stream_submit(StreamHandle h, uint64_t credit, uint8_t type,
       // RACING writers was never defined (same as the reference, where
       // order is set at socket-queue entry).
       st->bytes_sent += credit;
-      StreamSendTask* t = new StreamSendTask();
+      StreamSendTask* t = acquire_send_task();
       t->sock = st->sock;
       t->peer = st->remote_id;
       t->type = type;
@@ -398,6 +433,16 @@ int stream_write_device(StreamHandle h, uint64_t buf, int64_t timeout_us) {
       s->Dereference();
     }
   }
+  // rail selection is an explicit, counted decision (the cross-host
+  // test keys on it): local = handle passing inside one PJRT client,
+  // host = d2h landing zone on the wire — never a silent pick
+  if (local_rail) {
+    native_metrics().stream_device_local_rail.fetch_add(
+        1, std::memory_order_relaxed);
+  } else {
+    native_metrics().stream_device_host_rail.fetch_add(
+        1, std::memory_order_relaxed);
+  }
   IOBuf payload, attachment;
   std::string hdr;
   hdr.push_back(local_rail ? (char)1 : (char)0);
@@ -438,6 +483,12 @@ int stream_pop(StreamHandle h, int64_t deadline, bool want_device,
     Stream* st = address_locked(h);
     if (st == nullptr) {
       return -EINVAL;
+    }
+    if (st->remote_rst || st->local_rst) {
+      // abortive close: NOT a clean EOF — the queue was discarded when
+      // the reset landed, and the carried code is in stream_rst_code
+      st->mu.unlock();
+      return -ECONNABORTED;
     }
     if (!st->rq.empty()) {
       if (st->rq.front().device != want_device) {
@@ -574,7 +625,7 @@ int stream_close(StreamHandle h) {
   // CLOSE rides the same ExecutionQueue as DATA so it can never
   // overtake this thread's earlier writes (submitted outside mu, like
   // stream_write, so the inline-drain fallback never runs under it)
-  StreamSendTask* t = new StreamSendTask();
+  StreamSendTask* t = acquire_send_task();
   t->sock = sock;
   t->peer = peer;
   t->type = STREAM_FRAME_CLOSE;
@@ -584,6 +635,65 @@ int stream_close(StreamHandle h) {
   // wake writers parked on a full window so they observe local_closed
   bump_wake(ab);
   return 0;
+}
+
+int stream_rst(StreamHandle h, int32_t error_code) {
+  if (error_code <= 0) {
+    // carried codes are strictly positive so readers can distinguish
+    // "reset with code" from "never reset" (0) and from the dead-handle
+    // sentinel (-EINVAL); a reset must never look clean either way
+    error_code = TRPC_ECANCELED;
+  }
+  Stream* st = address_locked(h);
+  if (st == nullptr) {
+    return -EINVAL;
+  }
+  if (st->local_rst || st->remote_rst) {
+    st->mu.unlock();
+    return 0;  // already reset (either direction): idempotent
+  }
+  st->local_rst = true;
+  st->local_closed = true;
+  st->rst_code = error_code;
+  // abortive: this side's unread queue dies with the stream
+  for (const RqMsg& m : st->rq) {
+    drop_rq_msg(m);
+  }
+  st->rq.clear();
+  st->rq_bytes = 0;
+  bool send = st->connected && !st->sock_failed;
+  SocketId sock = st->sock;
+  uint64_t peer = st->remote_id;
+  Butex* ab = st->ack_butex;
+  Butex* rb = st->recv_butex;
+  st->mu.unlock();
+  if (send) {
+    // Sent DIRECTLY (value-copied socket id; Address inside is ABA-safe),
+    // NOT through the per-stream send queue: stream_rst is reachable from
+    // a NON-owner — the parse fiber propagating an RPC cancel (rpc.cc
+    // CancelInflight) — which can race the owner's stream_destroy, and a
+    // q->Submit here could land on a recycled queue mid-Init.  An RST
+    // overtaking queued DATA is fine by construction: the reset is
+    // abortive and the peer drops post-RST DATA/DEVICE arrivals.
+    send_stream_frame(sock, peer, STREAM_FRAME_RST, IOBuf(), IOBuf(), 0,
+                      error_code);
+    native_metrics().stream_rsts_sent.fetch_add(1,
+                                                std::memory_order_relaxed);
+  }
+  // readers AND writers observe the reset, not a timeout
+  bump_wake(ab);
+  bump_wake(rb);
+  return 0;
+}
+
+int32_t stream_rst_code(StreamHandle h) {
+  Stream* st = address_locked(h);
+  if (st == nullptr) {
+    return -EINVAL;
+  }
+  int32_t v = st->rst_code;
+  st->mu.unlock();
+  return v;
 }
 
 void stream_mark_failed(StreamHandle h) {
@@ -709,6 +819,14 @@ void StreamHandleFrame(Socket* s, const RpcMeta& meta, IOBuf&& payload) {
   }
   switch (meta.stream_frame_type) {
     case STREAM_FRAME_DATA: {
+      if (st->local_rst || st->remote_rst) {
+        // abortive close already happened: late in-flight frames are
+        // dropped, never queued — stream_pop returns -ECONNABORTED
+        // before touching rq, so anything queued here would pin memory
+        // until destroy
+        st->mu.unlock();
+        break;
+      }
       RqMsg m;
       m.bytes = payload.to_string();
       m.credit = m.bytes.size();
@@ -719,6 +837,13 @@ void StreamHandleFrame(Socket* s, const RpcMeta& meta, IOBuf&& payload) {
       break;
     }
     case STREAM_FRAME_DEVICE: {
+      if (st->local_rst || st->remote_rst) {
+        // same as DATA — and a local-rail frame still owns its passed
+        // HBM handle, which must be freed, not parked on a dead queue
+        st->mu.unlock();
+        drop_rq_msg(dm);
+        break;
+      }
       st->rq.push_back(std::move(dm));
       st->rq_bytes += st->rq.back().credit;
       st->mu.unlock();
@@ -731,6 +856,29 @@ void StreamHandleFrame(Socket* s, const RpcMeta& meta, IOBuf&& payload) {
       bump_wake(st->recv_butex);
       bump_wake(st->ack_butex);
       break;
+    case STREAM_FRAME_RST: {
+      // abortive close from the peer: surface the carried code as the
+      // read error (never a clean EOF) and discard everything queued —
+      // unread local-rail frames still own passed device handles
+      st->remote_rst = true;
+      st->local_closed = true;  // writes after a reset are pointless
+      if (st->rst_code == 0) {
+        // wire-forged non-positive codes coerce like stream_rst's own
+        st->rst_code =
+            meta.error_code > 0 ? meta.error_code : TRPC_ECANCELED;
+      }
+      for (const RqMsg& m : st->rq) {
+        drop_rq_msg(m);
+      }
+      st->rq.clear();
+      st->rq_bytes = 0;
+      st->mu.unlock();
+      native_metrics().stream_rsts_received.fetch_add(
+          1, std::memory_order_relaxed);
+      bump_wake(st->recv_butex);
+      bump_wake(st->ack_butex);
+      break;
+    }
     case STREAM_FRAME_FEEDBACK:
       if (meta.feedback_bytes > st->bytes_acked) {
         st->bytes_acked = meta.feedback_bytes;

@@ -41,6 +41,11 @@ enum StreamFrameType : uint8_t {
   // from the tag-15 handshake) and the receiver copies dev→dev with no
   // host landing zone.
   STREAM_FRAME_DEVICE = 4,
+  // abortive close carrying an error code in the frame meta's error_code
+  // (≙ the reference's RST on StreamIds, streaming_rpc_protocol.cpp
+  // policy frames): queued data is DISCARDED on both ends, reads surface
+  // the carried code instead of a clean EOF, writes fail -ECONNABORTED.
+  STREAM_FRAME_RST = 5,
 };
 
 // Create the local half (client side, before the handshake RPC).
@@ -64,14 +69,16 @@ StreamHandle stream_accept_on(SocketId sock, uint64_t remote_id,
 
 // Write one message.  Blocks (butex) while the flow-control window is
 // full.  Returns 0, or -EAGAIN on timeout, -EPIPE if the peer closed,
-// -ECONNRESET if the connection failed, -EINVAL on a dead handle.
+// -ECONNRESET if the connection failed, -ECONNABORTED if either side
+// reset the stream (stream_rst), -EINVAL on a dead handle.
 int stream_write(StreamHandle h, const uint8_t* data, size_t len,
                  int64_t timeout_us);
 
 // Read one message into *out (malloc'd; free with stream_buf_free).
 // Returns message length, 0 on clean EOF (peer closed and queue drained),
-// -EAGAIN on timeout, -ECONNRESET if the connection failed, -EINVAL on a
-// dead handle.
+// -EAGAIN on timeout, -ECONNRESET if the connection failed,
+// -ECONNABORTED after an RST (the carried code is in stream_rst_code —
+// a reset NEVER reads as clean EOF), -EINVAL on a dead handle.
 ssize_t stream_read(StreamHandle h, int64_t timeout_us, uint8_t** out);
 void stream_buf_free(uint8_t* p);
 
@@ -98,6 +105,19 @@ int stream_read_device(StreamHandle h, int dst_device, int64_t timeout_us,
 
 // Send CLOSE to the peer and forbid further writes (reads still drain).
 int stream_close(StreamHandle h);
+
+// Abortive close: send RST carrying `error_code` (strictly positive;
+// non-positive values are coerced to ECANCELED so "reset" can never be
+// mistaken for a clean close OR for the never-reset/dead-handle
+// sentinels below), discard this side's unread queue, forbid further
+// writes, and wake all parked readers/writers.  The peer's reads return
+// -ECONNABORTED (never clean EOF) and stream_rst_code() reports the
+// carried code there.
+int stream_rst(StreamHandle h, int32_t error_code);
+
+// The (always-positive) error code carried by a received or locally
+// sent RST; 0 when the stream was never reset, -EINVAL on a dead handle.
+int32_t stream_rst_code(StreamHandle h);
 
 // Release the handle (implies close if not already closed).
 void stream_destroy(StreamHandle h);

@@ -1579,6 +1579,313 @@ static void test_inline_dispatch_races() {
          (unsigned long long)corked);
 }
 
+// Races the client egress fast path against everything that interleaves
+// with it: concurrent callers corking one shared (single-type) connection,
+// the TRPC_CLIENT_CORK A/B switch flipping under live traffic, fan-out
+// groups sharing one serialization across members, short-lived connections
+// whose SetFailed must drain a parked cork synchronously, and a canceller
+// claiming published call ids while corked requests are still parked —
+// exactly the corked-write-vs-cancel/SetFailed class the round-5 one-shot
+// ASAN abort warns about.
+static void test_client_fastpath_races() {
+  set_client_cork(1);
+  Server* srv = server_create();
+  server_add_service(srv, "Echo", 0, nullptr, nullptr);
+  CHECK_TRUE(server_start(srv, "127.0.0.1", 0) == 0);
+  int port = server_port(srv);
+
+  std::atomic<bool> stop{false};
+  std::atomic<uint64_t> ok{0}, failed{0}, fan_ok{0}, fan_bad{0};
+  std::atomic<uint64_t> cancels_won{0};
+  std::atomic<uint64_t> live_call{0};
+  std::vector<std::thread> ts;
+
+  // the A/B switch flips under live traffic (reloadable flag)
+  ts.emplace_back([&] {
+    int v = 0;
+    while (!stop.load(std::memory_order_acquire)) {
+      set_client_cork(v ^= 1);
+      usleep(900);
+    }
+  });
+
+  // concurrent callers sharing ONE single-type channel: their corked
+  // writes chain onto each other's parked flush
+  {
+    Channel* shared_ch = channel_create("127.0.0.1", port);
+    channel_set_connect_timeout(shared_ch, 100 * 1000);
+    for (int t = 0; t < 3; ++t) {
+      ts.emplace_back([&, t] {
+        std::string payload(48, (char)('a' + t));
+        CallResult res;
+        while (!stop.load(std::memory_order_acquire)) {
+          uint64_t id = 0;
+          int rc = channel_call(shared_ch, "Echo",
+                                (const uint8_t*)payload.data(),
+                                payload.size(), nullptr, 0, 200 * 1000,
+                                &res, 0, 0, t == 0 ? &id : nullptr);
+          if (t == 0 && id != 0) {
+            live_call.store(id, std::memory_order_release);
+          }
+          if (rc == 0) {
+            if (res.response != payload) {
+              fan_bad.fetch_add(1);
+            }
+            ok.fetch_add(1);
+          } else {
+            failed.fetch_add(1);
+          }
+        }
+      });
+    }
+    // short-type caller: every call's SetFailed races parked corks
+    ts.emplace_back([&] {
+      Channel* ch = channel_create("127.0.0.1", port);
+      channel_set_connection_type(ch, 2);
+      channel_set_connect_timeout(ch, 100 * 1000);
+      CallResult res;
+      while (!stop.load(std::memory_order_acquire)) {
+        if (channel_call(ch, "Echo", (const uint8_t*)"s", 1, nullptr, 0,
+                         200 * 1000, &res) == 0) {
+          ok.fetch_add(1);
+        } else {
+          failed.fetch_add(1);
+        }
+      }
+      channel_destroy(ch);
+    });
+    // canceller: claims the published id while its corked request may
+    // still be parked behind the doorbell
+    ts.emplace_back([&] {
+      while (!stop.load(std::memory_order_acquire)) {
+        uint64_t id = live_call.load(std::memory_order_acquire);
+        if (id != 0 && call_cancel(id) == 0) {
+          cancels_won.fetch_add(1);
+        }
+        usleep(300);
+      }
+    });
+    // fan-out groups: one serialization shared across 4 members (two of
+    // them the SAME shared channel — same-socket members must chain into
+    // one corked flush), mixed with a pooled member
+    ts.emplace_back([&] {
+      Channel* pooled = channel_create("127.0.0.1", port);
+      channel_set_connection_type(pooled, 1);
+      channel_set_connect_timeout(pooled, 100 * 1000);
+      Channel* own = channel_create("127.0.0.1", port);
+      channel_set_connect_timeout(own, 100 * 1000);
+      std::string body(96, 'F');
+      while (!stop.load(std::memory_order_acquire)) {
+        Channel* group[4] = {shared_ch, pooled, own, shared_ch};
+        CallResult slots[4];
+        CallResult* outs[4] = {&slots[0], &slots[1], &slots[2], &slots[3]};
+        int failures = channel_fanout_call(
+            group, 4, "Echo", (const uint8_t*)body.data(), body.size(),
+            nullptr, 0, 500 * 1000, outs);
+        for (int i = 0; i < 4; ++i) {
+          if (slots[i].error_code == 0 && slots[i].response != body) {
+            fan_bad.fetch_add(1);
+          }
+        }
+        if (failures == 0) {
+          fan_ok.fetch_add(1);
+        }
+      }
+      channel_destroy(pooled);
+      channel_destroy(own);
+    });
+    usleep(3200 * 1000);
+    stop.store(true, std::memory_order_release);
+    for (auto& t : ts) {
+      t.join();
+    }
+    channel_destroy(shared_ch);
+  }
+  server_destroy(srv);
+  set_client_cork(1);  // restore the default for later scenarios
+  NativeMetrics& nm = native_metrics();
+  CHECK_TRUE(ok.load() > 0);
+  CHECK_TRUE(fan_ok.load() > 0);
+  CHECK_TRUE(fan_bad.load() == 0);
+  CHECK_TRUE(nm.client_cork_windows.load() > 0);
+  CHECK_TRUE(nm.fanout_shared_serializations.load() > 0);
+  CHECK_TRUE(nm.fanout_shared_serializations.load() <
+             nm.fanout_subcalls.load());  // N subcalls share 1 serialization
+  printf("ok client_fastpath_races ok=%llu failed=%llu fanouts=%llu "
+         "cancels=%llu cork_windows=%llu shared_ser=%llu subcalls=%llu\n",
+         (unsigned long long)ok.load(), (unsigned long long)failed.load(),
+         (unsigned long long)fan_ok.load(),
+         (unsigned long long)cancels_won.load(),
+         (unsigned long long)nm.client_cork_windows.load(),
+         (unsigned long long)nm.fanout_shared_serializations.load(),
+         (unsigned long long)nm.fanout_subcalls.load());
+}
+
+// Races RST against DATA, CLOSE and DEVICE frames plus local readers/
+// writers/resetters on one stream: the abortive close must discard queues
+// exactly once (device frames still own passed HBM handles), surface as a
+// read ERROR (never clean EOF), and stay idempotent against a racing
+// remote RST / local stream_rst / stream_destroy.
+static void test_stream_rst_races() {
+  bool have_plane = ensure_fake_plane("stream_rst_races");
+  static std::string tensor(2048, '\x5a');  // static: outlives the DMAs
+
+  for (int round = 0; round < 24; ++round) {
+    int sp[2];
+    CHECK_TRUE(socketpair(AF_UNIX, SOCK_STREAM | SOCK_NONBLOCK, 0, sp) == 0);
+    SocketOptions sopts;
+    sopts.fd = sp[0];
+    SocketId sid;
+    CHECK_TRUE(Socket::Create(sopts, &sid) == 0);
+    Socket* sock = Socket::Address(sid);
+    CHECK_TRUE(sock != nullptr);
+    if (have_plane) {
+      sock->peer_plane_uid.store(tpu_plane_uid());
+    }
+
+    StreamHandle r = stream_create(1u << 20);
+    stream_bind(r, sid, /*remote_id=*/1, 1u << 20);
+    std::atomic<bool> stop{false};
+    std::atomic<uint64_t> reads{0}, aborted_reads{0};
+    std::vector<std::thread> ts;
+
+    ts.emplace_back([&] {  // DATA injector
+      int i = 0;
+      while (!stop.load(std::memory_order_acquire)) {
+        RpcMeta meta;
+        meta.stream_id = r;
+        meta.stream_frame_type = STREAM_FRAME_DATA;
+        IOBuf p;
+        p.append("datadata", 8);
+        StreamHandleFrame(sock, meta, std::move(p));
+        if ((++i & 63) == 0) {
+          usleep(100);
+        }
+      }
+    });
+    if (have_plane) {
+      ts.emplace_back([&] {  // DEVICE injector (local-rail passed handles)
+        while (!stop.load(std::memory_order_acquire)) {
+          TpuBufId id = tpu_h2d(tensor.data(), tensor.size(), 0, nullptr,
+                                nullptr);
+          if (id == 0) {
+            continue;
+          }
+          RpcMeta meta;
+          meta.stream_id = r;
+          meta.stream_frame_type = STREAM_FRAME_DEVICE;
+          IOBuf p;
+          std::string hdr;
+          hdr.push_back((char)1);
+          for (int b = 0; b < 8; ++b) {
+            hdr.push_back((char)((uint64_t)tensor.size() >> (8 * b)));
+          }
+          for (int b = 0; b < 8; ++b) {
+            hdr.push_back((char)(id >> (8 * b)));
+          }
+          p.append(hdr.data(), hdr.size());
+          StreamHandleFrame(sock, meta, std::move(p));
+          usleep(50);
+        }
+      });
+    }
+    ts.emplace_back([&] {  // CLOSE / remote-RST injector
+      usleep(500 + (round % 7) * 300);
+      RpcMeta meta;
+      meta.stream_id = r;
+      meta.stream_frame_type =
+          (round & 1) ? STREAM_FRAME_RST : STREAM_FRAME_CLOSE;
+      meta.error_code = 4242;
+      StreamHandleFrame(sock, meta, IOBuf());
+    });
+    ts.emplace_back([&] {  // local resetter races the remote one
+      usleep(500 + (round % 5) * 400);
+      stream_rst(r, 1313);
+    });
+    ts.emplace_back([&] {  // local writer: must fail ECONNABORTED post-RST
+      while (!stop.load(std::memory_order_acquire)) {
+        int rc = stream_write(r, (const uint8_t*)"w", 1, 5 * 1000);
+        if (rc == -ECONNABORTED || rc == -EPIPE || rc == -EINVAL) {
+          break;
+        }
+      }
+    });
+    ts.emplace_back([&] {  // sp[1] drainer: the socket's bytes must flow
+      char sink[4096];
+      while (!stop.load(std::memory_order_acquire)) {
+        ssize_t n = ::read(sp[1], sink, sizeof(sink));
+        if (n == 0) {
+          break;
+        }
+        if (n < 0) {
+          usleep(200);
+        }
+      }
+    });
+    // reader on this thread: drains until the reset/close surfaces
+    int dev = 0;
+    while (true) {
+      uint8_t* out = nullptr;
+      ssize_t n = stream_read(r, 20 * 1000, &out);
+      if (n > 0) {
+        reads.fetch_add(1);
+        stream_buf_free(out);
+        continue;
+      }
+      if (n == -EPROTO) {  // device frame at the head: read it as one
+        uint64_t buf = 0, len = 0;
+        int rc = stream_read_device(r, dev ^= 1, 20 * 1000, &buf, &len);
+        if (rc == 0) {
+          tpu_buf_free(buf);
+          reads.fetch_add(1);
+          continue;
+        }
+        if (rc == -ECONNABORTED) {
+          aborted_reads.fetch_add(1);
+          CHECK_TRUE(stream_rst_code(r) != 0);
+          break;
+        }
+        if (rc == -EAGAIN) {
+          continue;
+        }
+        break;
+      }
+      if (n == -ECONNABORTED) {
+        // the reset surfaced as an ERROR (not clean EOF) with its code
+        aborted_reads.fetch_add(1);
+        CHECK_TRUE(stream_rst_code(r) != 0);
+        break;
+      }
+      if (n == 0) {
+        // clean EOF can only come from the CLOSE rounds: an RST must
+        // never read as a clean close
+        CHECK_TRUE((round & 1) == 0);
+        break;
+      }
+      if (n == -EAGAIN) {
+        continue;
+      }
+      break;  // -ECONNRESET/-EINVAL under teardown races: acceptable
+    }
+    stop.store(true, std::memory_order_release);
+    ::shutdown(sp[1], SHUT_RDWR);
+    for (auto& t : ts) {
+      t.join();
+    }
+    stream_destroy(r);
+    sock->SetFailed(ECONNRESET);
+    sock->Dereference();
+    Socket::WaitRecycled(sid);
+    ::close(sp[1]);
+  }
+  NativeMetrics& nm = native_metrics();
+  CHECK_TRUE(nm.stream_rsts_received.load() +
+                 nm.stream_rsts_sent.load() > 0);
+  printf("ok stream_rst_races rsts_sent=%llu rsts_recv=%llu\n",
+         (unsigned long long)nm.stream_rsts_sent.load(),
+         (unsigned long long)nm.stream_rsts_received.load());
+}
+
 int main() {
   fiber_runtime_init(4);
   test_butex_churn();
@@ -1591,12 +1898,14 @@ int main() {
   test_cancel_races();
   test_socketmap_races();
   test_inline_dispatch_races();
+  test_client_fastpath_races();
   test_restart_storm();
   test_h2_client_storm();
   test_uring_churn();
   test_sendzc_races();
   test_tpu_plane_races();
   test_stream_device_races();
+  test_stream_rst_races();
   test_sni_handshake_races();
   test_profiler_races();
   if (g_failures == 0) {

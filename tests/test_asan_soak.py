@@ -1,0 +1,81 @@
+"""ASAN soak harness (VERDICT Weak #1): loop build-asan/test_stress under
+N CPU-hog sibling processes, reproducing the full-suite contention that
+surfaced the round-5 one-shot load-dependent ASAN abort — a deterministic
+hunting ground instead of waiting for CI luck.
+
+Opt-in and slow-marked: it spends minutes by design.
+
+    BRPC_TPU_ASAN_SOAK=1 python -m pytest tests/test_asan_soak.py -m slow
+    BRPC_TPU_ASAN_SOAK_RUNS=N     soak iterations        (default 3)
+    BRPC_TPU_ASAN_SOAK_HOGS=N     CPU-hog siblings       (default ncpu)
+
+Wired into the sanitizer gate (BENCH_NOTES.md "Sanitizer gate"): when the
+gate's one-shot run aborts, rerun HERE with the same report-to-file
+plumbing until the abort reproduces.
+"""
+
+import glob
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_HOG = "while True:\n pass"
+
+
+def _build_asan():
+    r = subprocess.run(
+        ["bash", os.path.join(REPO, "native", "build_sanitized.sh"),
+         "address"], capture_output=True, text=True, timeout=900)
+    if r.returncode == 3:
+        pytest.skip("no address sanitizer toolchain/runtime: "
+                    f"{(r.stdout + r.stderr)[-200:]}")
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+@pytest.mark.slow
+def test_asan_stress_soak_under_cpu_contention():
+    if not os.environ.get("BRPC_TPU_ASAN_SOAK"):
+        pytest.skip("opt-in: set BRPC_TPU_ASAN_SOAK=1 (minutes by design)")
+    _build_asan()
+    build_dir = os.path.join(REPO, "native", "build-asan")
+    exe = os.path.join(build_dir, "test_stress")
+    runs = int(os.environ.get("BRPC_TPU_ASAN_SOAK_RUNS", "3"))
+    nhogs = int(os.environ.get("BRPC_TPU_ASAN_SOAK_HOGS",
+                               str(os.cpu_count() or 1)))
+    log_stem = os.path.join(build_dir, "soak-report")
+    hogs = [subprocess.Popen([sys.executable, "-c", _HOG],
+                             stdout=subprocess.DEVNULL,
+                             stderr=subprocess.DEVNULL)
+            for _ in range(nhogs)]
+    try:
+        for it in range(max(1, runs)):
+            for stale in glob.glob(log_stem + "*"):
+                os.unlink(stale)
+            env = dict(os.environ)
+            prior = env.get("ASAN_OPTIONS", "")
+            env["ASAN_OPTIONS"] = (prior + ":" if prior else "") + \
+                f"log_path={log_stem}"
+            out = subprocess.run([exe], capture_output=True, text=True,
+                                 timeout=900, env=env)
+            report = ""
+            for path in sorted(glob.glob(log_stem + "*")):
+                with open(path, errors="replace") as f:
+                    report += (f"\n--- {os.path.basename(path)} ---\n"
+                               + f.read())
+            assert out.returncode == 0, (
+                f"soak iteration {it + 1}/{runs} under {nhogs} CPU hogs "
+                f"rc={out.returncode}\n"
+                f"stdout tail:\n{out.stdout[-2000:]}\n"
+                f"stderr tail:\n{out.stderr[-2000:]}\n"
+                f"FULL sanitizer report:{report or ' (none written)'}")
+            assert "ALL STRESS TESTS PASSED" in out.stdout, \
+                out.stdout[-2000:]
+    finally:
+        for h in hogs:
+            h.kill()
+        for h in hogs:
+            h.wait()

@@ -1,0 +1,112 @@
+"""Self-contained flamegraph SVG (brpc_tpu/builtin/flame.py + the
+?view=flame portal wiring): well-formed SVG straight from the folded
+text of a LIVE server — no external viz tooling (VERDICT Missing #6)."""
+
+import urllib.request
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from brpc_tpu.builtin import flame
+from brpc_tpu.rpc.channel import Channel
+from brpc_tpu.rpc.server import Server
+
+SVG_NS = "{http://www.w3.org/2000/svg}"
+
+
+def _parse_svg(body: bytes) -> ET.Element:
+    root = ET.fromstring(body)  # raises on malformed XML
+    assert root.tag == f"{SVG_NS}svg", root.tag
+    return root
+
+
+class TestFoldedToSvg:
+    def test_renders_merged_tree(self):
+        folded = ("main;work;hot 30\n"
+                  "main;work;cold 10\n"
+                  "main;idle 60\n")
+        root = _parse_svg(flame.folded_to_svg(folded).encode())
+        rects = root.findall(f".//{SVG_NS}rect")
+        # background + all/main/work/hot/cold/idle
+        assert len(rects) >= 6
+        titles = [t.text for t in root.findall(f".//{SVG_NS}title")]
+        assert any("hot (30 samples)" in t for t in titles), titles
+        assert any("main (100 samples)" in t for t in titles), titles
+
+    def test_leaf_first_reversal(self):
+        # heap-profile order: allocation site first, root last
+        folded = "alloc_leaf;caller;main_root 4096\n"
+        svg = flame.folded_to_svg(folded, leaf_first=True, unit="bytes")
+        root = _parse_svg(svg.encode())
+        # y grows downward: the root frame must sit BELOW the leaf
+        ys = {}
+        for g in root.findall(f".//{SVG_NS}g"):
+            title = g.find(f"{SVG_NS}title").text
+            rect = g.find(f"{SVG_NS}rect")
+            ys[title.split(" (")[0]] = float(rect.get("y"))
+        assert ys["main_root"] > ys["alloc_leaf"]
+
+    def test_empty_input_is_still_well_formed(self):
+        _parse_svg(flame.folded_to_svg("").encode())
+        _parse_svg(flame.folded_to_svg("# only a comment\n").encode())
+
+    def test_xml_escaping(self):
+        folded = 'f<i>&"x" (a.py:1);g 5\n'
+        _parse_svg(flame.folded_to_svg(folded).encode())
+
+    def test_clipped_template_frames_stay_well_formed(self):
+        # clipping must happen BEFORE escaping: a label cut mid-entity
+        # ('&lt;' -> '&l..') would make the whole document unparseable
+        frames = ";".join(f"std::vector<int<long>>::op{i}<&x>"
+                          for i in range(6))
+        svg = flame.folded_to_svg(frames + " 100\n", width=320)
+        root = _parse_svg(svg.encode())
+        for t in root.findall(f".//{SVG_NS}text"):
+            assert "&l" not in (t.text or "") or ";" in (t.text or "")
+
+
+@pytest.fixture()
+def live_server():
+    srv = Server()
+    srv.add_echo_service()
+    srv.start("127.0.0.1:0")
+    yield srv
+    srv.destroy()
+
+
+def _get(port: int, path: str) -> tuple:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+        return r.headers.get("Content-Type", ""), r.read()
+
+
+class TestPortalFlame:
+    def test_hotspots_flame_is_well_formed_svg(self, live_server):
+        ctype, body = _get(live_server.port,
+                           "/hotspots?seconds=0.3&view=flame")
+        assert ctype.startswith("image/svg+xml"), ctype
+        root = _parse_svg(body)
+        # the sampler always sees at least its own sampling stack
+        assert root.findall(f".//{SVG_NS}rect")
+
+    def test_pprof_heap_flame_is_well_formed_svg(self, live_server):
+        # first hit enables the sampler; traffic creates sampled seams
+        _get(live_server.port, "/pprof/heap?interval=4096")
+        ch = Channel(f"127.0.0.1:{live_server.port}")
+        for i in range(64):
+            payload = bytes(2048)
+            assert ch.call("Echo.echo", payload) == payload
+        ch.close()
+        ctype, body = _get(live_server.port, "/pprof/heap?view=flame")
+        assert ctype.startswith("image/svg+xml"), ctype
+        _parse_svg(body)
+        ctype2, body2 = _get(live_server.port, "/pprof/growth?view=flame")
+        assert ctype2.startswith("image/svg+xml"), ctype2
+        _parse_svg(body2)
+        # turn the sampler back off for the rest of the suite
+        _get(live_server.port, "/pprof/heap?disable=1")
+
+    def test_plain_text_views_unchanged(self, live_server):
+        ctype, body = _get(live_server.port, "/hotspots?seconds=0.2")
+        assert ctype.startswith("text/plain"), ctype
+        assert b"<svg" not in body

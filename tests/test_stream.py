@@ -7,8 +7,8 @@ import time
 
 import pytest
 
-from brpc_tpu.rpc import (Channel, RpcError, Server, Stream, StreamClosed,
-                          StreamTimeout, errors)
+from brpc_tpu.rpc import (Channel, ChannelOptions, RpcError, Server, Stream,
+                          StreamClosed, StreamTimeout, errors)
 
 
 @pytest.fixture()
@@ -269,4 +269,132 @@ def test_destroyed_handle_is_dead(stream_server):
         st.write(b"x")
     with pytest.raises(StreamClosed):
         st.read(timeout_s=0.1)
+    ch.close()
+
+
+# --- RST: abortive close carrying an error code (VERDICT Missing #3) -------
+
+
+@pytest.fixture()
+def rst_server():
+    from brpc_tpu.rpc.controller import Controller  # noqa: F401
+    s = Server()
+    state = {"streams": [], "threads": [], "events": []}
+
+    def open_stream(cntl, req):
+        st = cntl.accept_stream()
+        state["streams"].append(st)
+        return b"accepted"
+
+    def rst_after_one(cntl, req):
+        """Write one message, then RST with a specific code."""
+        st = cntl.accept_stream()
+
+        def run():
+            st.write(b"before-reset")
+            time.sleep(0.1)
+            st.rst(4242)
+
+        t = threading.Thread(target=run, daemon=True)
+        state["threads"].append(t)
+        t.start()
+        return b"ok"
+
+    def accept_and_observe_cancel(cntl, req):
+        """Accept, park IN-HANDLER on cancel (the response must not go
+        out before the cancel claims the call), record what the stream
+        read surfaces afterwards."""
+        st = cntl.accept_stream()
+        ev = threading.Event()
+        state["events"].append(ev)
+        cntl.wait_cancel(timeout_s=15)
+        try:
+            st.read(timeout_s=5)
+            state["observed"] = "data"
+        except Exception as e:
+            state["observed"] = (type(e).__name__,
+                                 getattr(e, "code", None))
+        ev.set()
+        cntl.set_failed(errors.EINTERNAL, "was canceled")
+        return None
+
+    s.add_service("Open", open_stream)
+    s.add_service("RstAfterOne", rst_after_one)
+    s.add_service("CancelMe", accept_and_observe_cancel)
+    s.start("127.0.0.1:0")
+    yield s, state
+    for st in state["streams"]:
+        st.destroy()
+    s.stop()
+    s.destroy()
+
+
+def test_rst_surfaces_as_error_with_code_not_eof(rst_server):
+    from brpc_tpu.rpc import StreamReset
+    srv, _ = rst_server
+    ch = Channel(f"127.0.0.1:{srv.port}")
+    resp, st = ch.create_stream("RstAfterOne", b"")
+    assert resp == b"ok"
+    # data queued BEFORE the reset may be consumed or discarded (the RST
+    # is abortive) — but the terminal condition must be StreamReset with
+    # the carried code, never a clean EOF (None)
+    saw_reset = False
+    try:
+        for _ in range(3):
+            msg = st.read(timeout_s=5)
+            assert msg is not None, "RST must not read as clean EOF"
+    except StreamReset as e:
+        saw_reset = True
+        assert e.code == 4242, e.code
+    assert saw_reset
+    assert st.rst_code == 4242
+    # writes after the reset fail with the same surface
+    with pytest.raises(StreamReset):
+        st.write(b"post-reset")
+    st.destroy()
+    ch.close()
+
+
+def test_local_rst_propagates_to_peer(rst_server):
+    from brpc_tpu.rpc import StreamReset
+    srv, state = rst_server
+    ch = Channel(f"127.0.0.1:{srv.port}")
+    resp, st = ch.create_stream("Open", b"")
+    assert resp == b"accepted"
+    server_st = state["streams"][-1]
+    st.rst(999)  # client-initiated abort
+    deadline = time.time() + 5
+    with pytest.raises(StreamReset) as ei:
+        while time.time() < deadline:
+            server_st.read(timeout_s=5)
+    assert ei.value.code == 999
+    st.destroy()
+    ch.close()
+
+
+def test_rpc_cancel_propagates_rst_to_accepted_stream(rst_server):
+    from brpc_tpu.rpc.controller import Controller
+    srv, state = rst_server
+    ch = Channel(f"127.0.0.1:{srv.port}",
+                 ChannelOptions(max_retry=0, timeout_ms=20000))
+    cntl = Controller()
+    result = {}
+
+    def call():
+        try:
+            ch.create_stream("CancelMe", b"", cntl=cntl)
+        except RpcError as e:
+            result["code"] = e.code
+
+    t = threading.Thread(target=call)
+    t.start()
+    time.sleep(0.4)  # let the handler accept and park on wait_cancel
+    cntl.start_cancel()
+    t.join(10)
+    assert result.get("code") == errors.ECANCELED
+    assert state["events"], "handler never parked on cancel"
+    assert state["events"][-1].wait(10), "handler never observed the cancel"
+    # the accepted stream was RST (ECANCELED), not silently orphaned
+    assert state.get("observed") == ("StreamReset", errors.ECANCELED), \
+        state.get("observed")
     ch.close()
