@@ -293,6 +293,10 @@ def main() -> int:
         "client_cork_windows": native_counter("native_client_cork_windows"),
         "client_inline_completes": native_counter(
             "native_client_inline_completes"),
+        # schedule perturbation MUST be off (0) for bench-of-record: a
+        # nonzero seed means the run measured the fuzzing mode, not the
+        # runtime (BENCH_NOTES.md "Schedule replay")
+        "sched_seed": int(L.trpc_sched_seed()),
     }
     if reps > 1:
         result["rows"] = row_stats

@@ -385,6 +385,15 @@ def _declare(L: ctypes.CDLL) -> None:
     # native metrics seam + profiler (metrics.h, profiler.h)
     L.trpc_native_metrics_dump.argtypes = [c.c_char_p, c.c_size_t]
     L.trpc_native_metrics_dump.restype = c.c_size_t
+    # schedule perturbation / replay (native/src/sched_perturb.h)
+    L.trpc_sched_set_seed.argtypes = [c.c_uint64]
+    L.trpc_sched_set_seed.restype = None
+    L.trpc_sched_seed.argtypes = []
+    L.trpc_sched_seed.restype = c.c_uint64
+    L.trpc_sched_trace_hash.argtypes = []
+    L.trpc_sched_trace_hash.restype = c.c_uint64
+    L.trpc_sched_trace_dump.argtypes = [c.c_char_p, c.c_size_t]
+    L.trpc_sched_trace_dump.restype = c.c_size_t
     L.trpc_profiler_start.argtypes = [c.c_int]
     L.trpc_profiler_start.restype = c.c_int
     # void* out-pointer (not c_char_p: ctypes would convert to bytes and

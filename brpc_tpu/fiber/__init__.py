@@ -9,13 +9,58 @@ the scheduler and all hot-path fibers live in C++.
 from __future__ import annotations
 
 import ctypes
+import os
 from typing import Callable, Dict, Optional
 
 from brpc_tpu._native import FIBER_FN, lib
 from brpc_tpu.metrics import bvar
+from brpc_tpu.utils import flags
 
 _started = False
 _stats_vars = []
+
+
+def _push_sched_seed(value) -> bool:
+    if int(value) < 0:
+        return False
+    lib().trpc_sched_set_seed(int(value))
+    return True
+
+
+def _env_seed() -> int:
+    # base-0 like the C side's strtoull (hex/octal seeds mean the same
+    # thing on both surfaces), and garbage degrades to 0 like strtoull
+    # instead of crashing every brpc_tpu.fiber import
+    try:
+        return int(os.environ.get("TRPC_SCHED_SEED", "0") or "0", 0)
+    except ValueError:
+        return 0
+
+
+flags.define_int64("sched_seed", _env_seed(),
+                   "schedule perturbation seed (native/src/sched_perturb"
+                   ".h): nonzero arms seeded yield injection + steal/wake "
+                   "shuffles in the fiber runtime so schedule-dependent "
+                   "bugs replay from the seed (BENCH_NOTES.md 'Schedule "
+                   "replay'); 0 = off — REQUIRED off for bench-of-record",
+                   validator=_push_sched_seed)
+
+
+def sched_seed() -> int:
+    """The active schedule-perturbation seed (0 = perturbation off)."""
+    return int(lib().trpc_sched_seed())
+
+
+def sched_trace_hash() -> int:
+    """Replay fingerprint of the worker lanes' decision streams."""
+    return int(lib().trpc_sched_trace_hash())
+
+
+def sched_trace_dump() -> str:
+    """Per-lane decision counters + event-ring tails (diagnostics)."""
+    buf = ctypes.create_string_buffer(1 << 14)
+    n = lib().trpc_sched_trace_dump(buf, len(buf))
+    return buf.raw[:n].decode(errors="replace")
 
 
 def init(num_workers: int = 0) -> int:

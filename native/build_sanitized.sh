@@ -2,18 +2,33 @@
 # Build the instrumented stress binary: build_sanitized.sh <thread|address>
 # -> native/build-{tsan|asan}/test_stress, from the LIVE sources.
 #
+# build_sanitized.sh <flavor> --sweep N [base-seed] additionally runs the
+# seed sweep on the freshly built tree: N full gate runs, each under a
+# distinct TRPC_SCHED_SEED (schedule perturbation; BENCH_NOTES.md
+# "Schedule replay") — the on-demand hunt for schedule-dependent
+# sanitizer aborts.
+#
 # Primary path: cmake -DSANITIZE=... + ninja (incremental).  Fallback for
 # containers without a build system: direct g++ with the same flags, with
 # a timestamp check standing in for incrementality.  Exit 3 means "no
 # sanitizer toolchain/runtime here" (callers skip, not fail).
 set -euo pipefail
 cd "$(dirname "$0")"
-flavor="${1:?usage: build_sanitized.sh <thread|address>}"
+flavor="${1:?usage: build_sanitized.sh <thread|address> [--sweep N [base]]}"
 case "$flavor" in
   thread)  dir=build-tsan ;;
   address) dir=build-asan ;;
   *) echo "flavor must be thread or address" >&2; exit 2 ;;
 esac
+
+run_sweep_if_asked() {
+  if [[ "${2:-}" == "--sweep" ]]; then
+    # forward N, optional base, and any trailing scenario filters
+    # verbatim (test_stress parses the tail itself)
+    : "${3:?--sweep needs N}"
+    exec "$dir/test_stress" --sweep "${@:3}"
+  fi
+}
 
 if command -v cmake >/dev/null 2>&1 && command -v ninja >/dev/null 2>&1; then
   if [[ ! -f "$dir/build.ninja" ]]; then
@@ -28,6 +43,7 @@ if command -v cmake >/dev/null 2>&1 && command -v ninja >/dev/null 2>&1; then
     echo "$out" >&2
     exit 1
   fi
+  run_sweep_if_asked "$@"
   exit 0
 fi
 
@@ -56,6 +72,7 @@ exe="$dir/test_stress"
 if [[ -x "$exe" ]]; then
   newest=$(find src CMakeLists.txt -newer "$exe" -print -quit 2>/dev/null)
   if [[ -z "$newest" ]]; then
+    run_sweep_if_asked "$@"
     exit 0
   fi
 fi
@@ -84,4 +101,5 @@ if [[ -n "${PJRT_INC}" && ! -f "$dir/libpjrt_fake.so" ]]; then
   ${CXX} -std=c++17 -O1 -g -fPIC -pthread -I"${PJRT_INC}" \
     -shared src/pjrt_fake.cc -o "$dir/libpjrt_fake.so" || true
 fi
+run_sweep_if_asked "$@"
 exit 0

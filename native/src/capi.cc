@@ -17,6 +17,7 @@
 #include "profiler.h"
 #include "crc32c.h"
 #include "rpc.h"
+#include "sched_perturb.h"
 #include "snappy.h"
 #include "socket.h"
 #include "stream.h"
@@ -646,6 +647,19 @@ int64_t trpc_stream_pending_bytes(uint64_t h) {
 // Python bvar registry; ≙ the reference's self-instrumenting bvars).
 size_t trpc_native_metrics_dump(char* buf, size_t cap) {
   return native_metrics_dump(buf, cap);
+}
+
+// --- schedule perturbation / replay (sched_perturb.h) -----------------------
+
+// Seed the schedule-fuzzing mode (0 disables; the `sched_seed`
+// reloadable flag pushes through here).  The trace hash is the replay
+// fingerprint: same seed + fixed scenario => same hash
+// (tests/test_sched_replay.py).
+void trpc_sched_set_seed(uint64_t seed) { sched_perturb_set_seed(seed); }
+uint64_t trpc_sched_seed() { return sched_perturb_seed(); }
+uint64_t trpc_sched_trace_hash() { return sched_trace_hash(); }
+size_t trpc_sched_trace_dump(char* buf, size_t cap) {
+  return sched_trace_dump(buf, cap);
 }
 
 int trpc_profiler_start(int hz) { return profiler_start(hz); }

@@ -16,6 +16,7 @@
 
 #include "context.h"
 #include "object_pool.h"
+#include "sched_perturb.h"
 #include "timer_thread.h"
 #include "work_stealing_queue.h"
 
@@ -219,7 +220,14 @@ bool steal_task(TaskGroup* self, fiber_t* out) {
   if (n <= 1) {
     return false;
   }
-  uint64_t seed = fast_rand();
+  uint64_t seed;
+  if (TRPC_UNLIKELY(sched_perturb_enabled())) {
+    // seeded victim order: the probe sequence becomes part of the replay
+    // trace instead of depending on this thread's xorshift state
+    seed = sched_perturb_next(SCHED_PP_STEAL);
+  } else {
+    seed = fast_rand();
+  }
   for (size_t i = 0; i < 2 * n; ++i) {
     TaskGroup* victim = g_control.groups[(seed + i) % n];
     if (victim == self) {
@@ -286,7 +294,22 @@ void ready_to_run(TaskMeta* m) {
     return;
   }
   TaskGroup* g = tls_group;
+  bool perturb = TRPC_UNLIKELY(sched_perturb_enabled());
   if (g != nullptr) {
+    if (perturb) {
+      // placement detour (1 in 4): route through a seeded victim's
+      // remote queue instead of the local rq — which worker resumes the
+      // fiber, and when, becomes a seed-driven decision
+      uint64_t v = sched_perturb_next(SCHED_PP_PLACE);
+      if ((v & 3) == 0) {
+        TaskGroup* target =
+            g_control.groups[(v >> 2) % g_control.groups.size()];
+        std::lock_guard<std::mutex> lk(target->remote_mu);
+        target->remote_rq.push_back(m->tid());
+        g_control.pl.Signal(1);
+        return;
+      }
+    }
     if (TRPC_UNLIKELY(!g->rq.Push(m->tid()))) {
       std::lock_guard<std::mutex> lk(g->remote_mu);
       g->remote_rq.push_back(m->tid());
@@ -297,7 +320,14 @@ void ready_to_run(TaskMeta* m) {
     std::lock_guard<std::mutex> lk(target->remote_mu);
     target->remote_rq.push_back(m->tid());
   }
-  g_control.pl.Signal(1);
+  if (perturb &&
+      (sched_perturb_next(SCHED_PP_PARK) & 7) == 0) {
+    // wake widening: rouse every parked worker, not just one — the race
+    // for the single new task runs under maximal contention
+    g_control.pl.Signal((int)g_control.groups.size());
+  } else {
+    g_control.pl.Signal(1);
+  }
 }
 
 // Runs on the worker (main) stack right after a fiber switches out
@@ -442,6 +472,7 @@ void worker_main(TaskGroup* g) {
   snprintf(name, sizeof(name), "trpc_w%d", g->index);
   pthread_setname_np(pthread_self(), name);
   tls_group = g;
+  sched_perturb_bind_lane(g->index);  // this worker's replay lane
 #if defined(TRPC_ASAN)
   {
     pthread_attr_t attr;
@@ -705,6 +736,17 @@ int butex_wake_some(Butex* b, int limit) {
     ++woken;
   }
   b->mu.unlock();
+  if (TRPC_UNLIKELY(sched_perturb_enabled()) && nrun > 1) {
+    // wake-order shuffle (Fisher-Yates on the batch): which waiter runs
+    // first becomes a seeded decision instead of list order
+    for (int i = nrun - 1; i > 0; --i) {
+      int j = (int)(sched_perturb_next(SCHED_PP_WAKE) %
+                    (uint64_t)(i + 1));
+      TaskMeta* tmp = to_run[i];
+      to_run[i] = to_run[j];
+      to_run[j] = tmp;
+    }
+  }
   for (int i = 0; i < nsig; ++i) {
     PthreadSync* ps = to_signal[i]->psync;
     // notify while holding wmu: the waiter can only pass its wait (and
@@ -716,6 +758,12 @@ int butex_wake_some(Butex* b, int limit) {
   }
   for (int i = 0; i < nrun; ++i) {
     ready_to_run(to_run[i]);
+  }
+  if (TRPC_UNLIKELY(sched_perturb_enabled()) && woken > 0 &&
+      sched_perturb_point(SCHED_PP_WAKE)) {
+    // waker pause (same-thread: a context switch here could migrate a
+    // caller that holds a plain mutex — see sched_perturb.h policy)
+    std::this_thread::yield();
   }
   return woken;
 }
@@ -808,6 +856,11 @@ int fiber_start(fiber_t* out, FiberFn fn, void* arg) {
     *out = m->tid();
   }
   ready_to_run(m);
+  if (TRPC_UNLIKELY(sched_perturb_enabled()) &&
+      sched_perturb_point(SCHED_PP_SPAWN)) {
+    // spawner pause: let a peer worker claim the new fiber first
+    std::this_thread::yield();
+  }
   return 0;
 }
 
@@ -828,6 +881,10 @@ int fiber_start_bound(int group_idx, fiber_t* out, FiberFn fn, void* arg) {
     *out = m->tid();
   }
   ready_to_run(m);  // bound: routes to home_group's bound queue
+  if (TRPC_UNLIKELY(sched_perturb_enabled()) &&
+      sched_perturb_point(SCHED_PP_SPAWN)) {
+    std::this_thread::yield();  // see fiber_start's spawner pause
+  }
   return 0;
 }
 

@@ -2,6 +2,7 @@
 
 #include <stdio.h>
 
+#include "sched_perturb.h"
 #include "tpu.h"
 
 namespace trpc {
@@ -85,6 +86,24 @@ size_t native_metrics_dump(char* buf, size_t cap) {
   put("native_uring_sendzc_fallbacks", relu(m.uring_sendzc_fallbacks));
   put("native_uring_zc_pool_slots", rel(m.uring_zc_pool_slots));
   put("native_uring_zc_pool_in_use", rel(m.uring_zc_pool_in_use));
+  put("native_sched_perturb_yields", relu(m.sched_perturb_yields));
+  put("native_sched_perturb_steal_shuffles",
+      relu(m.sched_perturb_steal_shuffles));
+  put("native_sched_perturb_wake_shuffles",
+      relu(m.sched_perturb_wake_shuffles));
+  {
+    // unsigned on purpose: a seed >= 2^63 must round-trip through a
+    // captured /vars artifact (it IS the replay key)
+    int n = snprintf(buf + off, off < cap ? cap - off : 0,
+                     "native_sched_seed %llu\n",
+                     (unsigned long long)sched_perturb_seed());
+    if (n > 0) {
+      off += (size_t)n;
+      if (off > cap) {
+        off = cap;
+      }
+    }
+  }
   put("tpu_h2d_transfers", (long long)t.h2d_transfers);
   put("tpu_d2h_transfers", (long long)t.d2h_transfers);
   put("tpu_h2d_bytes", (long long)t.h2d_bytes);

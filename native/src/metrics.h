@@ -126,6 +126,15 @@ struct NativeMetrics {
   // registered landing-zone pool occupancy
   std::atomic<int64_t> uring_zc_pool_slots{0};
   std::atomic<int64_t> uring_zc_pool_in_use{0};
+
+  // schedule perturbation (sched_perturb.cc, TRPC_SCHED_SEED): yields =
+  // injected pauses/spins/budget truncations at instrumented seams;
+  // steal_shuffles = seeded steal-victim + placement-detour draws;
+  // wake_shuffles = butex wake-order shuffles + parking-lot wake
+  // widenings.  All zero when perturbation is off (bench-of-record).
+  std::atomic<uint64_t> sched_perturb_yields{0};
+  std::atomic<uint64_t> sched_perturb_steal_shuffles{0};
+  std::atomic<uint64_t> sched_perturb_wake_shuffles{0};
 };
 
 NativeMetrics& native_metrics();

@@ -30,6 +30,7 @@
 #include "metrics.h"
 #include "object_pool.h"
 #include "redis.h"
+#include "sched_perturb.h"
 #include "stream.h"
 #include "timer_thread.h"
 #include "tls.h"
@@ -426,6 +427,13 @@ struct InlineBudget {
                std::atomic<uint64_t>* trips = nullptr) {
     enabled = on;
     left = g_inline_budget_reqs.load(std::memory_order_relaxed);
+    if (TRPC_UNLIKELY(on && sched_perturb_enabled())) {
+      // schedule fuzzing: a seeded budget truncation moves the
+      // inline-vs-spawned dispatch boundary around the drain — the
+      // parse fiber hands off mid-pipeline at seed-chosen points
+      left = 1 + (int)(sched_perturb_next(SCHED_PP_DISPATCH) %
+                       (uint64_t)left);
+    }
     deadline_ns = drain_start_ns +
                   g_inline_budget_us.load(std::memory_order_relaxed) * 1000;
     trip_counter = trips != nullptr
@@ -3939,6 +3947,7 @@ bool inline_dispatch_enabled() {
   int v = g_inline_dispatch.load(std::memory_order_acquire);
   if (v < 0) {
     // first use: the TRPC_INLINE_DISPATCH env var is the A/B switch
+    // (flag-cached: resolved once into g_inline_dispatch)
     const char* e = getenv("TRPC_INLINE_DISPATCH");
     v = (e != nullptr && e[0] == '0' && e[1] == '\0') ? 0 : 1;
     g_inline_dispatch.store(v, std::memory_order_release);
@@ -3954,6 +3963,7 @@ bool client_cork_enabled() {
   int v = g_client_cork.load(std::memory_order_acquire);
   if (v < 0) {
     // first use: the TRPC_CLIENT_CORK env var is the A/B switch
+    // (flag-cached: resolved once into g_client_cork)
     const char* e = getenv("TRPC_CLIENT_CORK");
     v = (e != nullptr && e[0] == '0' && e[1] == '\0') ? 0 : 1;
     g_client_cork.store(v, std::memory_order_release);

@@ -1,0 +1,277 @@
+// sched_perturb.cc — seeded schedule perturbation + replay trace
+// (see sched_perturb.h for the model and the injection policy).
+#include "sched_perturb.h"
+
+#include <stdlib.h>
+
+#include <cstdio>
+#include <mutex>
+
+#include "metrics.h"
+
+namespace trpc {
+
+namespace {
+
+constexpr int kWorkerLanes = 256;  // fiber workers; hashed for replay
+constexpr int kRingSize = 64;      // per-lane event ring (power of two)
+constexpr uint64_t kFnvBasis = 0xcbf29ce484222325ULL;
+constexpr uint64_t kFnvPrime = 0x100000001b3ULL;
+
+inline uint64_t splitmix64(uint64_t* x) {
+  uint64_t z = (*x += 0x9e3779b97f4a7c15ULL);
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+  return z ^ (z >> 31);
+}
+
+// One decision stream.  Owner-thread written; hash/count read from
+// foreign threads (trace dump) — hence relaxed atomics, not plain words.
+struct alignas(64) Lane {
+  std::atomic<uint64_t> rng{0};
+  std::atomic<uint64_t> ndecisions{0};
+  std::atomic<uint64_t> hash{kFnvBasis};
+  std::atomic<uint32_t> ring[kRingSize];  // (point << 28) | draw bits
+
+  void Seed(uint64_t seed, int lane_id) {
+    // distinct stream per lane: fold the lane id through one mix round
+    uint64_t s = seed ^ (0x9e3779b97f4a7c15ULL * (uint64_t)(lane_id + 1));
+    splitmix64(&s);
+    rng.store(s, std::memory_order_relaxed);
+    ndecisions.store(0, std::memory_order_relaxed);
+    hash.store(kFnvBasis, std::memory_order_relaxed);
+    for (int i = 0; i < kRingSize; ++i) {
+      ring[i].store(0, std::memory_order_relaxed);
+    }
+  }
+
+  uint64_t Draw(int point) {
+    uint64_t s = rng.load(std::memory_order_relaxed);
+    uint64_t v = splitmix64(&s);
+    rng.store(s, std::memory_order_relaxed);
+    uint64_t n = ndecisions.load(std::memory_order_relaxed);
+    uint32_t ev = ((uint32_t)(point & 0xf) << 28) |
+                  ((uint32_t)v & 0x0fffffffu);
+    ring[n & (kRingSize - 1)].store(ev, std::memory_order_relaxed);
+    ndecisions.store(n + 1, std::memory_order_relaxed);
+    uint64_t h = hash.load(std::memory_order_relaxed);
+    h = (h ^ (uint64_t)(uint8_t)point) * kFnvPrime;
+    h = (h ^ (v & 0xff)) * kFnvPrime;
+    h = (h ^ ((v >> 8) & 0xff)) * kFnvPrime;
+    hash.store(h, std::memory_order_relaxed);
+    return v;
+  }
+};
+
+Lane g_worker_lanes[kWorkerLanes];
+std::atomic<uint64_t> g_seed{0};
+std::mutex g_seed_mu;
+
+// foreign threads (engine/timer/API callers): private lanes, seeded from
+// the global seed + a registration nonce; counted but never hashed (a
+// foreign thread's position in the interleaving is not seed-determined)
+std::atomic<int> g_foreign_nonce{0};
+
+thread_local Lane* tls_lane = nullptr;       // worker lanes only
+thread_local Lane tls_foreign_lane;
+thread_local bool tls_foreign_seeded = false;
+
+inline Lane* MyLane() {
+  if (tls_lane != nullptr) {
+    return tls_lane;
+  }
+  if (!tls_foreign_seeded) {
+    tls_foreign_seeded = true;
+    int nonce = g_foreign_nonce.fetch_add(1, std::memory_order_relaxed);
+    tls_foreign_lane.Seed(g_seed.load(std::memory_order_acquire),
+                          kWorkerLanes + nonce);
+  }
+  return &tls_foreign_lane;
+}
+
+}  // namespace
+
+namespace sched_internal {
+
+std::atomic<int> g_sched_mode{-1};
+
+int ResolveSchedMode() {
+  std::lock_guard<std::mutex> lk(g_seed_mu);
+  int m = g_sched_mode.load(std::memory_order_acquire);
+  if (m >= 0) {
+    return m;  // another thread resolved (or set_seed ran) first
+  }
+  // first use: TRPC_SCHED_SEED is the arming switch (flag-cached: read
+  // exactly once per process; sched_perturb_set_seed overrides later)
+  uint64_t seed = 0;
+  const char* e = getenv("TRPC_SCHED_SEED");
+  if (e != nullptr && e[0] != '\0') {
+    seed = strtoull(e, nullptr, 0);
+  }
+  g_seed.store(seed, std::memory_order_release);
+  for (int i = 0; i < kWorkerLanes; ++i) {
+    g_worker_lanes[i].Seed(seed, i);
+  }
+  m = seed != 0 ? 1 : 0;
+  g_sched_mode.store(m, std::memory_order_release);
+  return m;
+}
+
+}  // namespace sched_internal
+
+void sched_perturb_set_seed(uint64_t seed) {
+  std::lock_guard<std::mutex> lk(g_seed_mu);
+  g_seed.store(seed, std::memory_order_release);
+  for (int i = 0; i < kWorkerLanes; ++i) {
+    g_worker_lanes[i].Seed(seed, i);
+  }
+  g_foreign_nonce.store(0, std::memory_order_relaxed);
+  sched_internal::g_sched_mode.store(seed != 0 ? 1 : 0,
+                                     std::memory_order_release);
+}
+
+uint64_t sched_perturb_seed() {
+  (void)sched_perturb_enabled();  // force env resolution
+  return g_seed.load(std::memory_order_acquire);
+}
+
+void sched_perturb_bind_lane(int lane) {
+  if (lane >= 0 && lane < kWorkerLanes) {
+    tls_lane = &g_worker_lanes[lane];
+    return;
+  }
+  // beyond the lane table a worker degrades to a private (unhashed)
+  // stream — say so, or the trace hash would claim replay coverage it
+  // doesn't have
+  static std::atomic<bool> warned{false};
+  if (!warned.exchange(true, std::memory_order_relaxed)) {
+    fprintf(stderr,
+            "[sched_perturb] worker %d exceeds the %d replay lanes: its "
+            "draws are untracked by the trace hash\n",
+            lane, kWorkerLanes);
+  }
+}
+
+bool sched_perturb_point(int point) {
+  uint64_t v = MyLane()->Draw(point);
+  bool fire = (v & 7) == 0;
+  if (fire) {
+    native_metrics().sched_perturb_yields.fetch_add(
+        1, std::memory_order_relaxed);
+  }
+  return fire;
+}
+
+uint64_t sched_perturb_next(int point) {
+  NativeMetrics& nm = native_metrics();
+  switch (point) {
+    case SCHED_PP_STEAL:
+    case SCHED_PP_PLACE:
+      nm.sched_perturb_steal_shuffles.fetch_add(1,
+                                                std::memory_order_relaxed);
+      break;
+    case SCHED_PP_WAKE:
+    case SCHED_PP_PARK:
+      nm.sched_perturb_wake_shuffles.fetch_add(1,
+                                               std::memory_order_relaxed);
+      break;
+    default:  // DISPATCH truncation et al. count as injected yields
+      nm.sched_perturb_yields.fetch_add(1, std::memory_order_relaxed);
+      break;
+  }
+  return MyLane()->Draw(point);
+}
+
+void sched_perturb_spin(int point) {
+  uint64_t v = MyLane()->Draw(point);
+  native_metrics().sched_perturb_yields.fetch_add(
+      1, std::memory_order_relaxed);
+  // 0..4095 pause iterations: long enough to swing lock-free races,
+  // short enough to stay off profiles
+  for (uint64_t i = v & 0xfff; i > 0; --i) {
+#if defined(__x86_64__)
+    __builtin_ia32_pause();
+#else
+    std::atomic_signal_fence(std::memory_order_seq_cst);
+#endif
+  }
+}
+
+uint64_t sched_trace_hash() {
+  uint64_t h = kFnvBasis;
+  auto mix = [&h](uint64_t v) {
+    for (int b = 0; b < 8; ++b) {
+      h = (h ^ ((v >> (8 * b)) & 0xff)) * kFnvPrime;
+    }
+  };
+  mix(g_seed.load(std::memory_order_acquire));
+  for (int i = 0; i < kWorkerLanes; ++i) {
+    Lane& l = g_worker_lanes[i];
+    uint64_t n = l.ndecisions.load(std::memory_order_relaxed);
+    if (n == 0) {
+      continue;  // untouched lanes contribute nothing (worker count may
+                 // vary across hosts without changing the hash shape)
+    }
+    mix((uint64_t)i);
+    mix(n);
+    mix(l.hash.load(std::memory_order_relaxed));
+  }
+  return h;
+}
+
+void sched_trace_reset() {
+  std::lock_guard<std::mutex> lk(g_seed_mu);
+  uint64_t seed = g_seed.load(std::memory_order_acquire);
+  for (int i = 0; i < kWorkerLanes; ++i) {
+    g_worker_lanes[i].Seed(seed, i);
+  }
+}
+
+SchedTraceStats sched_trace_stats() {
+  SchedTraceStats s{};
+  s.seed = sched_perturb_seed();
+  for (int i = 0; i < kWorkerLanes; ++i) {
+    s.decisions +=
+        g_worker_lanes[i].ndecisions.load(std::memory_order_relaxed);
+  }
+  s.hash = sched_trace_hash();
+  return s;
+}
+
+size_t sched_trace_dump(char* buf, size_t cap) {
+  size_t off = 0;
+  auto put = [&](const char* fmt, auto... args) {
+    if (off < cap) {
+      size_t space = cap - off;
+      int n = snprintf(buf + off, space, fmt, args...);
+      if (n > 0) {
+        // on truncation snprintf wrote space-1 chars + NUL: count only
+        // the chars, or the caller fwrite()s a stray NUL into artifacts
+        off += (size_t)n < space ? (size_t)n : space - 1;
+      }
+    }
+  };
+  SchedTraceStats st = sched_trace_stats();
+  put("sched_seed=%llu decisions=%llu trace_hash=%016llx\n",
+      (unsigned long long)st.seed, (unsigned long long)st.decisions,
+      (unsigned long long)st.hash);
+  for (int i = 0; i < kWorkerLanes; ++i) {
+    Lane& l = g_worker_lanes[i];
+    uint64_t n = l.ndecisions.load(std::memory_order_relaxed);
+    if (n == 0) {
+      continue;
+    }
+    put("lane %d: n=%llu hash=%016llx tail=[", i, (unsigned long long)n,
+        (unsigned long long)l.hash.load(std::memory_order_relaxed));
+    uint64_t from = n > 8 ? n - 8 : 0;
+    for (uint64_t k = from; k < n; ++k) {
+      uint32_t ev = l.ring[k & (kRingSize - 1)].load(
+          std::memory_order_relaxed);
+      put("%s%u:%07x", k == from ? "" : " ", ev >> 28, ev & 0x0fffffffu);
+    }
+    put("]\n");
+  }
+  return off;
+}
+
+}  // namespace trpc

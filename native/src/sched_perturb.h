@@ -1,0 +1,119 @@
+// sched_perturb.h — seeded schedule-perturbation + deterministic-replay
+// mode for the fiber runtime (ROADMAP item 5: make sanitizer failures
+// reproduce on demand instead of waiting for CI luck).
+//
+// Model: TRPC_SCHED_SEED=<nonzero> (or the `sched_seed` reloadable flag)
+// arms a per-lane SplitMix64 stream — one independent lane per fiber
+// worker, plus private lanes for foreign threads (engine thread, timer
+// thread, API callers).  Instrumented seams consult their lane's stream
+// to decide whether to inject a pause, shuffle a wake/steal order, widen
+// a race window, or truncate an inline-dispatch budget.  Every draw is
+// appended to the lane's trace (decision counter + event ring + FNV-1a
+// running hash), so a lane's decision sequence is a PURE FUNCTION of
+// (seed, lane, workload): the same seed on a fixed single-worker
+// scenario replays byte-identically (proven by test_stress sched_proof /
+// tests/test_sched_replay.py), and on multi-worker scenarios the same
+// seed re-runs the same per-lane decision streams — the practical replay
+// lever for schedule-dependent sanitizer reports (BENCH_NOTES.md
+// "Schedule replay").
+//
+// Injection policy — pauses, never context switches: seams like butex
+// wake and fiber spawn are routinely reached while the caller holds a
+// plain std::mutex, and an injected fiber switch could resume the fiber
+// on a DIFFERENT pthread, making the eventual unlock undefined behavior.
+// So seams perturb with same-thread pauses (sched_yield / bounded spins),
+// placement re-routing (ready_to_run detours through a remote queue),
+// order shuffles (wake lists, steal victims), and budget truncation
+// (inline dispatch) — all of which change cross-thread interleavings
+// without changing which pthread owns the stack.
+//
+// Off by default and ~free when off: one relaxed-ish atomic load behind
+// TRPC_UNLIKELY at each seam.  Bench-of-record runs MUST keep it off
+// (bench.py surfaces the active seed in its JSON line).
+#pragma once
+
+#include <atomic>
+#include <cstddef>
+#include <cstdint>
+
+#include "common.h"
+
+namespace trpc {
+
+// Instrumented seams.  One id per class of scheduling decision; the ids
+// are stable (they feed the trace hash — renumbering changes replays).
+enum SchedPerturbPoint : int {
+  SCHED_PP_SPAWN = 0,      // fiber_start: spawner pause after enqueue
+  SCHED_PP_WAKE = 1,       // butex wake: wake-order shuffle + waker pause
+  SCHED_PP_STEAL = 2,      // steal_task: victim probe order
+  SCHED_PP_PARK = 3,       // parking-lot wake widening (Signal 1 -> all)
+  SCHED_PP_DISPATCH = 4,   // parse-fiber inline-dispatch budget truncation
+  SCHED_PP_CQE = 5,        // uring engine: CQE drain batch boundary
+  SCHED_PP_STEAL_CAS = 6,  // work-stealing deque: top-read->CAS window
+  SCHED_PP_WRITE = 7,      // socket write: cork-snapshot->exchange window
+  SCHED_PP_PLACE = 8,      // ready_to_run: local rq vs remote-queue detour
+  SCHED_PP_COUNT = 9,
+};
+
+namespace sched_internal {
+extern std::atomic<int> g_sched_mode;  // -1 unresolved, 0 off, 1 on
+int ResolveSchedMode();
+}  // namespace sched_internal
+
+// Fast gate for every seam (resolves TRPC_SCHED_SEED once per process;
+// flag-cached: the env read happens only on the first call).
+inline bool sched_perturb_enabled() {
+  int m = sched_internal::g_sched_mode.load(std::memory_order_acquire);
+  if (TRPC_UNLIKELY(m < 0)) {
+    m = sched_internal::ResolveSchedMode();
+  }
+  return m != 0;
+}
+
+// Install a seed at runtime (the `sched_seed` reloadable flag / the
+// TRPC_SCHED_SEED env on first use).  0 disables perturbation.  Reseeding
+// resets every lane's stream and trace; do it between scenarios, not
+// under live traffic (lanes are owner-thread state).
+void sched_perturb_set_seed(uint64_t seed);
+uint64_t sched_perturb_seed();
+
+// Workers bind their lane index once at thread start (fiber.cc
+// worker_main).  Foreign threads need no binding: they draw from private
+// per-thread lanes that are counted but excluded from the replay hash
+// (their interleaving is not a function of the seed).
+void sched_perturb_bind_lane(int lane);
+
+// "Perturb here?" — draws once from the caller's lane; true ~1 in 8.
+// Counted into native_sched_perturb_yields when it fires.
+bool sched_perturb_point(int point);
+
+// Raw seeded draw for shuffles (steal victim order, wake order, budget
+// truncation).  Counted into the matching native_sched_perturb_* counter.
+uint64_t sched_perturb_next(int point);
+
+// Bounded seeded busy-wait (~0-4k pause iterations): widens lock-free
+// race windows (deque CAS) without any scheduling side effects.
+void sched_perturb_spin(int point);
+
+// --- replay trace ----------------------------------------------------------
+
+// Hash of the WORKER lanes' decision streams (lane id, per-lane FNV-1a
+// hash, decision count).  On a fixed single-worker scenario this is a
+// pure function of the seed — the determinism contract tested by
+// tests/test_sched_replay.py.
+uint64_t sched_trace_hash();
+void sched_trace_reset();
+
+struct SchedTraceStats {
+  uint64_t seed;
+  uint64_t decisions;  // total draws, worker lanes only
+  uint64_t hash;       // == sched_trace_hash()
+};
+SchedTraceStats sched_trace_stats();
+
+// Human-readable per-lane counters + the tail of each worker lane's
+// event ring (newest last).  For abort diagnostics: test_stress prints
+// this from the sanitizer death callback.  Returns bytes written.
+size_t sched_trace_dump(char* buf, size_t cap);
+
+}  // namespace trpc

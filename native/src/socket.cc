@@ -14,6 +14,7 @@
 #include <vector>
 
 #include "metrics.h"
+#include "sched_perturb.h"
 #include "tls.h"
 #include "uring.h"
 #include "object_pool.h"
@@ -510,6 +511,13 @@ int Socket::WriteRaw(IOBuf&& data, Butex* notify) {
         1, std::memory_order_relaxed);
   }
   req->next.store(UNCONNECTED, std::memory_order_relaxed);
+  if (TRPC_UNLIKELY(sched_perturb_enabled()) &&
+      sched_perturb_point(SCHED_PP_WRITE)) {
+    // widen the cork-snapshot -> exchange window: the park/Uncork/
+    // SetFailed handshake (the round-5 abort's suspect class) runs
+    // under seed-controlled timing
+    std::this_thread::yield();
+  }
   WriteRequest* prev = write_head.exchange(req, std::memory_order_acq_rel);
   if (prev != nullptr) {
     req->next.store(prev, std::memory_order_release);  // newest -> ... -> oldest

@@ -15,10 +15,12 @@
 // Each scenario is time-bounded so the whole binary stays <60s under TSAN.
 #include <arpa/inet.h>
 #include <assert.h>
+#include <fcntl.h>
 #include <netinet/in.h>
 #include <stdio.h>
 #include <string.h>
 #include <sys/socket.h>
+#include <sys/wait.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -36,10 +38,16 @@
 #include "rpc.h"
 #include "h2.h"
 #include "heap_profiler.h"
+#include "sched_perturb.h"
 #include "stream.h"
 #include "tls.h"
 #include "tpu.h"
 #include "uring.h"
+
+#if defined(__SANITIZE_ADDRESS__) || defined(__SANITIZE_THREAD__)
+#include <sanitizer/common_interface_defs.h>
+#define TRPC_STRESS_SANITIZED 1
+#endif
 
 using namespace trpc;
 
@@ -1886,28 +1894,419 @@ static void test_stream_rst_races() {
          (unsigned long long)nm.stream_rsts_received.load());
 }
 
-int main() {
+// --- armed-perturbation machinery races --------------------------------------
+// The sanitized gate runs unseeded, which would leave every
+// sched_perturb_enabled() branch dead — a race inside the replay tooling
+// itself (placement detours through remote_mu, wake shuffles, CAS-window
+// spins, Lane ring writes racing the death callback's trace reads,
+// reseed-under-traffic) would first fire during a real debugging
+// session, corrupting the exact artifact the mode exists to produce.  So
+// the gate arms the mode HERE: a cross-thread storm over every seam
+// class with concurrent trace readers and a seed toggler, seed restored
+// afterwards so later scenarios run unperturbed.
+static void test_sched_perturb_races() {
+  uint64_t prev_seed = sched_perturb_seed();
+  sched_perturb_set_seed(0xfeedbeefULL);
+  std::atomic<bool> stop{false};
+  std::vector<std::thread> ts;
+  // trace readers: the sanitizer death callback's exact access pattern
+  // (foreign-thread reads of every lane's hash/ring) races worker draws
+  for (int t = 0; t < 2; ++t) {
+    ts.emplace_back([&] {
+      char buf[4096];
+      while (!stop.load(std::memory_order_acquire)) {
+        sched_trace_dump(buf, sizeof(buf));
+        sched_trace_hash();
+        usleep(500);
+      }
+    });
+  }
+  // seed toggler: reseed + mode flips under live draws (the reloadable
+  // `sched_seed` flag's hot path)
+  ts.emplace_back([&] {
+    uint64_t s = 1;
+    while (!stop.load(std::memory_order_acquire)) {
+      sched_perturb_set_seed(++s % 5 == 0 ? 0 : s);  // off windows too
+      usleep(1500);
+    }
+  });
+  // spawn/join storms from foreign pthreads: spawn pauses, placement
+  // detours, park widenings, steal-victim draws, deque CAS spins
+  std::atomic<uint64_t> ran{0};
+  for (int t = 0; t < 3; ++t) {
+    ts.emplace_back([&] {
+      auto body = [](void* p) {
+        for (int k = 0; k < 4; ++k) {
+          fiber_yield();
+        }
+        ((std::atomic<uint64_t>*)p)->fetch_add(1);
+      };
+      while (!stop.load(std::memory_order_acquire)) {
+        fiber_t fids[8];
+        for (int j = 0; j < 8; ++j) {
+          fiber_start(&fids[j], body, &ran);
+        }
+        for (int j = 0; j < 8; ++j) {
+          fiber_join(fids[j]);
+        }
+      }
+    });
+  }
+  // butex ping-pong pairs: wake-order shuffles + waker pauses
+  PingPong pp;
+  pp.a = butex_create();
+  pp.b = butex_create();
+  pp.limit = 400;
+  fiber_t f1, f2;
+  fiber_start(&f1, pp_fiber, &pp);
+  fiber_start(&f2, pp_peer, &pp);
+  // live echo traffic: write-enqueue seams, inline-budget truncation,
+  // CQE drain caps when the ring transport is up
+  Server* srv = server_create();
+  server_add_service(srv, "Echo", 0, nullptr, nullptr);
+  CHECK_TRUE(server_start(srv, "127.0.0.1", 0) == 0);
+  int port = server_port(srv);
+  std::atomic<uint64_t> ok{0};
+  ts.emplace_back([&] {
+    Channel* ch = channel_create("127.0.0.1", port);
+    std::string payload(64, 'z');
+    CallResult res;
+    while (!stop.load(std::memory_order_acquire)) {
+      if (channel_call(ch, "Echo", (const uint8_t*)payload.data(),
+                       payload.size(), nullptr, 0, 200 * 1000, &res) == 0) {
+        ok.fetch_add(1);
+      }
+    }
+    channel_destroy(ch);
+  });
+  usleep(1500 * 1000);
+  stop.store(true, std::memory_order_release);
+  fiber_join(f1);
+  fiber_join(f2);
+  for (auto& t : ts) {
+    t.join();
+  }
+  server_destroy(srv);
+  butex_destroy(pp.a);
+  butex_destroy(pp.b);
+  CHECK_TRUE(pp.rounds.load() == pp.limit);
+  CHECK_TRUE(ran.load() > 0);
+  CHECK_TRUE(ok.load() > 0);
+  sched_perturb_set_seed(prev_seed);  // later scenarios run as configured
+  printf("ok sched_perturb_races fibers=%llu calls=%llu\n",
+         (unsigned long long)ran.load(), (unsigned long long)ok.load());
+}
+
+// --- schedule-replay proof ---------------------------------------------------
+// Deterministic replay contract (tests/test_sched_replay.py): ONE worker
+// plus a fixed fiber-only workload makes the worker lane's decision
+// stream — and hence sched_trace_hash() — a pure function of
+// TRPC_SCHED_SEED.  No timers, no sockets, no foreign wakers: every
+// perturbation draw happens serially on the single worker.  Run as the
+// SOLE scenario (`test_stress sched_proof`): it must own runtime init.
+
+struct ProofPong {
+  Butex* a;
+  Butex* b;
+  int limit;
+};
+
+static void proof_ping(void* p) {
+  ProofPong* pp = (ProofPong*)p;
+  for (int i = 0; i < pp->limit; ++i) {
+    butex_value(pp->a).fetch_add(1, std::memory_order_release);
+    butex_wake_all(pp->a);
+    while (butex_value(pp->b).load(std::memory_order_acquire) < i + 1) {
+      butex_wait(pp->b, butex_value(pp->b).load(), -1);  // no timer
+    }
+  }
+}
+
+static void proof_pong(void* p) {
+  ProofPong* pp = (ProofPong*)p;
+  for (int i = 0; i < pp->limit; ++i) {
+    while (butex_value(pp->a).load(std::memory_order_acquire) < i + 1) {
+      butex_wait(pp->a, butex_value(pp->a).load(), -1);
+    }
+    butex_value(pp->b).fetch_add(1, std::memory_order_release);
+    butex_wake_all(pp->b);
+  }
+}
+
+static void proof_yielder(void* p) {
+  (void)p;
+  for (int k = 0; k < 12; ++k) {
+    fiber_yield();
+  }
+}
+
+static void proof_root(void* p) {
+  (void)p;
+  fiber_t kids[16];
+  for (int i = 0; i < 16; ++i) {
+    fiber_start(&kids[i], proof_yielder, nullptr);
+  }
+  ProofPong pp;
+  pp.a = butex_create();
+  pp.b = butex_create();
+  pp.limit = 50;
+  fiber_t f1, f2;
+  fiber_start(&f1, proof_ping, &pp);
+  fiber_start(&f2, proof_pong, &pp);
+  for (int i = 0; i < 16; ++i) {
+    fiber_join(kids[i]);
+  }
+  fiber_join(f1);
+  fiber_join(f2);
+  butex_destroy(pp.a);
+  butex_destroy(pp.b);
+}
+
+static void test_sched_proof() {
+  if (fiber_runtime_started()) {
+    printf("skip sched_proof (runtime already up; run as the sole "
+           "scenario)\n");
+    return;
+  }
+  fiber_runtime_init(1);
+  fiber_t root;
+  fiber_start(&root, proof_root, nullptr);
+  fiber_join(root);
+  SchedTraceStats st = sched_trace_stats();
+  CHECK_TRUE(st.seed == 0 || st.decisions > 0);
+  printf("ok sched_proof decisions=%llu\n",
+         (unsigned long long)st.decisions);
+  printf("sched_trace_hash=%016llx\n", (unsigned long long)st.hash);
+}
+
+// --- scenario registry + driver ---------------------------------------------
+// The default (no-args) run IS the sanitized gate: tools/lint.py
+// enforces that every test_*_races function above appears in this table,
+// so a scenario can never silently drop out of TSAN/ASAN coverage.
+
+struct Scenario {
+  const char* name;
+  void (*fn)();
+};
+
+static const Scenario kScenarios[] = {
+    {"butex_churn", test_butex_churn},
+    {"fiber_sync", test_fiber_sync},
+    {"execution_queue", test_execution_queue},
+    {"bound_jump_storm", test_bound_jump_storm},
+    {"fiber_storm", test_fiber_storm},
+    {"iobuf_sharing", test_iobuf_sharing},
+    {"call_timeout_races", test_call_timeout_races},
+    {"cancel_races", test_cancel_races},
+    {"socketmap_races", test_socketmap_races},
+    {"inline_dispatch_races", test_inline_dispatch_races},
+    {"client_fastpath_races", test_client_fastpath_races},
+    {"restart_storm", test_restart_storm},
+    {"h2_client_storm", test_h2_client_storm},
+    {"uring_churn", test_uring_churn},
+    {"sendzc_races", test_sendzc_races},
+    {"tpu_plane_races", test_tpu_plane_races},
+    {"stream_device_races", test_stream_device_races},
+    {"stream_rst_races", test_stream_rst_races},
+    {"sni_handshake_races", test_sni_handshake_races},
+    {"profiler_races", test_profiler_races},
+    {"sched_perturb_races", test_sched_perturb_races},
+};
+constexpr int kNumScenarios = (int)(sizeof(kScenarios) / sizeof(kScenarios[0]));
+
+static char g_exe_path[512] = "./test_stress";
+
+// Printed on EVERY run (and echoed by the sanitizer death callback): a
+// one-shot abort must leave its replay seed in the captured output.
+static void print_seed_banner() {
+  uint64_t seed = sched_perturb_seed();
+  if (seed != 0) {
+    printf("sched_seed=%llu (schedule perturbation ON; replay: "
+           "TRPC_SCHED_SEED=%llu %s [scenario])\n",
+           (unsigned long long)seed, (unsigned long long)seed, g_exe_path);
+  } else {
+    printf("sched_seed=0 (perturbation off; TRPC_SCHED_SEED=<n> to "
+           "fuzz schedules)\n");
+  }
+}
+
+#if defined(TRPC_STRESS_SANITIZED)
+static void sched_death_callback() {
+  // the process is about to die on a sanitizer report: restate the seed
+  // and the trace tail on stderr so the failure artifact is replayable
+  char buf[4096];
+  size_t n = sched_trace_dump(buf, sizeof(buf));
+  fprintf(stderr, "\n--- schedule trace at sanitizer abort ---\n");
+  fwrite(buf, 1, n, stderr);
+  fprintf(stderr, "replay: TRPC_SCHED_SEED=%llu %s [scenario]\n",
+          (unsigned long long)sched_perturb_seed(), g_exe_path);
+}
+#endif
+
+// Seed sweep (`--sweep N [base] [scenario...]`): re-exec this binary once
+// per seed, hunting schedule-dependent aborts.  Child stdout/stderr land
+// in a per-seed log (sanitizer reports still follow ASAN_OPTIONS
+// log_path, which children inherit); pass logs are deleted, failures
+// keep theirs and print the replay line.
+static int run_sweep(int n, uint64_t base, char** scenarios,
+                     int nscenarios) {
+  int failures = 0;
+  for (int i = 0; i < n; ++i) {
+    uint64_t seed = base + (uint64_t)i;
+    char seedstr[32];
+    snprintf(seedstr, sizeof(seedstr), "%llu", (unsigned long long)seed);
+    char logpath[600];
+    snprintf(logpath, sizeof(logpath), "%s.sweep-%llu.log", g_exe_path,
+             (unsigned long long)seed);
+    std::vector<char*> child_argv;
+    child_argv.push_back(g_exe_path);
+    for (int s = 0; s < nscenarios; ++s) {
+      child_argv.push_back(scenarios[s]);
+    }
+    child_argv.push_back(nullptr);
+    pid_t pid = fork();
+    if (pid == 0) {
+      setenv("TRPC_SCHED_SEED", seedstr, 1);
+      int fd = open(logpath, O_WRONLY | O_CREAT | O_TRUNC, 0644);
+      if (fd >= 0) {
+        dup2(fd, 1);
+        dup2(fd, 2);
+        close(fd);
+      }
+      execv(g_exe_path, child_argv.data());
+      _exit(127);
+    }
+    if (pid < 0) {
+      printf("sweep seed=%llu fork failed\n", (unsigned long long)seed);
+      return 2;
+    }
+    int status = 0;
+    waitpid(pid, &status, 0);
+    bool ok = WIFEXITED(status) && WEXITSTATUS(status) == 0;
+    if (ok) {
+      printf("sweep seed=%llu ok\n", (unsigned long long)seed);
+      fflush(stdout);
+      unlink(logpath);
+    } else {
+      ++failures;
+      printf("SWEEP HIT seed=%llu status=%d log=%s\n"
+             "  replay: TRPC_SCHED_SEED=%llu %s",
+             (unsigned long long)seed, status, logpath,
+             (unsigned long long)seed, g_exe_path);
+      for (int s = 0; s < nscenarios; ++s) {
+        printf(" %s", scenarios[s]);
+      }
+      printf("\n");
+      FILE* f = fopen(logpath, "r");
+      if (f != nullptr) {
+        fseek(f, 0, SEEK_END);
+        long sz = ftell(f);
+        long from = sz > 4000 ? sz - 4000 : 0;
+        fseek(f, from, SEEK_SET);
+        char tail[4001];
+        size_t got = fread(tail, 1, 4000, f);
+        tail[got] = '\0';
+        printf("--- log tail ---\n%s\n---\n", tail);
+        fclose(f);
+      }
+      fflush(stdout);
+    }
+  }
+  printf("sweep done: %d/%d seeds failed (base=%llu)\n", failures, n,
+         (unsigned long long)base);
+  return failures > 0 ? 1 : 0;
+}
+
+int main(int argc, char** argv) {
+  {
+    ssize_t n = readlink("/proc/self/exe", g_exe_path,
+                         sizeof(g_exe_path) - 1);
+    if (n > 0) {
+      g_exe_path[n] = '\0';
+    }
+  }
+#if defined(TRPC_STRESS_SANITIZED)
+  __sanitizer_set_death_callback(sched_death_callback);
+#endif
+  if (argc > 1 && strcmp(argv[1], "--list") == 0) {
+    for (int i = 0; i < kNumScenarios; ++i) {
+      printf("%s\n", kScenarios[i].name);
+    }
+    printf("sched_proof\n");
+    return 0;
+  }
+  if (argc > 1 && strcmp(argv[1], "--sweep") == 0) {
+    if (argc < 3) {
+      fprintf(stderr,
+              "usage: %s --sweep N [base-seed] [scenario...]\n", argv[0]);
+      return 2;
+    }
+    int n = atoi(argv[2]);
+    if (n < 1) {
+      fprintf(stderr, "--sweep N must be a positive integer (got %s): a "
+                      "0-iteration sweep would report a clean hunt that "
+                      "ran nothing\n", argv[2]);
+      return 2;
+    }
+    uint64_t base = 1;
+    int rest = 3;
+    if (argc > 3 && argv[3][0] >= '0' && argv[3][0] <= '9') {
+      base = strtoull(argv[3], nullptr, 0);
+      rest = 4;
+    }
+    return run_sweep(n, base, argv + rest, argc - rest);
+  }
+  print_seed_banner();
+  // named-scenario mode: sched_proof owns its (single-worker) runtime
+  // bring-up, so it must be the sole scenario of its process — in EITHER
+  // order: run first it would silently pin every later scenario to one
+  // worker, erasing the cross-worker schedules they exist to cover
+  if (argc > 2) {
+    for (int a = 1; a < argc; ++a) {
+      if (strcmp(argv[a], "sched_proof") == 0) {
+        fprintf(stderr,
+                "sched_proof must run alone (its 1-worker runtime would "
+                "starve the other scenarios of cross-worker schedules)\n");
+        return 2;
+      }
+    }
+  }
+  if (argc > 1) {
+    int rc = 0;
+    for (int a = 1; a < argc; ++a) {
+      if (strcmp(argv[a], "sched_proof") == 0) {
+        test_sched_proof();
+        continue;
+      }
+      bool found = false;
+      for (int i = 0; i < kNumScenarios; ++i) {
+        if (strcmp(argv[a], kScenarios[i].name) == 0) {
+          if (!fiber_runtime_started()) {
+            fiber_runtime_init(4);
+          }
+          kScenarios[i].fn();
+          found = true;
+          break;
+        }
+      }
+      if (!found) {
+        fprintf(stderr, "unknown scenario: %s (try --list)\n", argv[a]);
+        rc = 2;
+      }
+    }
+    if (rc == 0 && g_failures == 0) {
+      printf("ALL STRESS TESTS PASSED\n");
+      return 0;
+    }
+    if (g_failures > 0) {
+      printf("%d FAILURES\n", g_failures);
+    }
+    return rc != 0 ? rc : 1;
+  }
   fiber_runtime_init(4);
-  test_butex_churn();
-  test_fiber_sync();
-  test_execution_queue();
-  test_bound_jump_storm();
-  test_fiber_storm();
-  test_iobuf_sharing();
-  test_call_timeout_races();
-  test_cancel_races();
-  test_socketmap_races();
-  test_inline_dispatch_races();
-  test_client_fastpath_races();
-  test_restart_storm();
-  test_h2_client_storm();
-  test_uring_churn();
-  test_sendzc_races();
-  test_tpu_plane_races();
-  test_stream_device_races();
-  test_stream_rst_races();
-  test_sni_handshake_races();
-  test_profiler_races();
+  for (int i = 0; i < kNumScenarios; ++i) {
+    kScenarios[i].fn();
+  }
   if (g_failures == 0) {
     printf("ALL STRESS TESTS PASSED\n");
     return 0;

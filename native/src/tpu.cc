@@ -251,6 +251,8 @@ int tpu_plane_init(const char* plugin_path) {
     return 0;
   }
   std::vector<std::string> candidates;
+  // flag-cached: boot-path read inside tpu_plane_init (idempotent; the
+  // p.up guard above makes this once per process)
   const char* env = getenv("TRPC_PJRT_PLUGIN");
   if (plugin_path != nullptr && plugin_path[0] != '\0') {
     candidates.push_back(plugin_path);  // explicit arg: authoritative
@@ -320,6 +322,7 @@ int tpu_plane_init(const char* plugin_path) {
     bool is_str = false;
   };
   std::vector<Opt> opts;
+  // flag-cached: boot-path read inside tpu_plane_init (once per process)
   const char* ospec = getenv("TRPC_PJRT_OPTIONS");
   if (ospec != nullptr && ospec[0] != '\0') {
     std::string spec = ospec;

@@ -26,6 +26,7 @@
 #include <vector>
 
 #include "metrics.h"
+#include "sched_perturb.h"
 
 // --- uapi compat -----------------------------------------------------------
 // The engine tracks io_uring uapi newer than some build hosts ship in
@@ -205,6 +206,10 @@ class RingEngine {
 
  private:
   RingEngine() {
+    // flag-cached: the ONE env read for debug logging — every later
+    // site consults debug_ (a per-CQE getenv was a hot-path environ
+    // scan, flagged by tools/lint.py)
+    debug_ = getenv("TRPC_URING_DEBUG") != nullptr;
     struct io_uring_params p;
     memset(&p, 0, sizeof(p));
     int fd = sys_io_uring_setup(kEntries, &p);
@@ -262,13 +267,15 @@ class RingEngine {
         MAP_ANONYMOUS | MAP_PRIVATE, -1, 0);
     zc_slots_ = kZcPoolSlotsDefault;
     zc_slot_size_ = kZcSlotBytesDefault;
+    // flag-cached: engine-ctor reads (the singleton constructs once per
+    // process; the values live in zc_slots_/zc_slot_size_ after)
     if (const char* e = getenv("TRPC_ZC_POOL_SLOTS")) {
       long v = strtol(e, nullptr, 10);
       if (v >= 0 && v <= 256) {
         zc_slots_ = (int)v;
       }
     }
-    if (const char* e = getenv("TRPC_ZC_SLOT_BYTES")) {
+    if (const char* e = getenv("TRPC_ZC_SLOT_BYTES")) {  // flag-cached: ditto
       long long v = strtoll(e, nullptr, 10);
       if (v >= 4096 && v <= (1ll << 30)) {
         zc_slot_size_ = (size_t)v;
@@ -295,7 +302,7 @@ class RingEngine {
     reg.ring_entries = kNumBufs;
     reg.bgid = kBufGroup;
     int rrc = sys_io_uring_register(fd, kRegPbufRing, &reg, 1);
-    if (getenv("TRPC_URING_DEBUG"))
+    if (debug_)
       fprintf(stderr, "[uring] pbuf register rc=%d on fd=%d ring_addr=%p\n",
               rrc, fd, (void*)buf_ring_);
     if (rrc != 0) {
@@ -375,7 +382,7 @@ class RingEngine {
       }
       zc_registered_ = sys_io_uring_register(fd, kRegBuffers, iovs.data(),
                                              (unsigned)zc_slots_) == 0;
-      if (debug_ || getenv("TRPC_URING_DEBUG")) {
+      if (debug_) {
         fprintf(stderr, "[uring] fixed-buffer register %s (%d x %zu)\n",
                 zc_registered_ ? "ok" : "FAILED", zc_slots_, zc_slot_size_);
       }
@@ -897,7 +904,6 @@ class RingEngine {
   }
 
   void Loop() {
-    if (getenv("TRPC_URING_DEBUG")) debug_ = true;
     if (debug_) fprintf(stderr, "[uring] loop start ring_fd=%d\n", ring_fd_);
     ArmWake();
     Submit();
@@ -906,7 +912,17 @@ class RingEngine {
       uint32_t head = cq_head_->load(std::memory_order_acquire);
       uint32_t tail = cq_tail_->load(std::memory_order_acquire);
       bool rearm_wake = false;
-      while (head != tail) {
+      uint32_t drain_budget = UINT32_MAX;
+      if (TRPC_UNLIKELY(sched_perturb_enabled())) {
+        // seeded drain-batch cap: CQE batch boundaries — and the
+        // Drain()/Submit() interleave between batches — become
+        // seed-driven; leftover CQEs return on the next iteration
+        drain_budget = 1 + (uint32_t)(sched_perturb_next(SCHED_PP_CQE) & 7);
+        if (sched_perturb_point(SCHED_PP_CQE)) {
+          std::this_thread::yield();  // engine-thread pause
+        }
+      }
+      while (head != tail && drain_budget-- != 0) {
         io_uring_cqe* cqe = &cqes_[head & cq_mask_];
         uint64_t tag = cqe->user_data & kTagMask;
         if (debug_) fprintf(stderr, "[uring] cqe ud=%llx res=%d flags=%x\n",

@@ -4,6 +4,7 @@
 #pragma once
 
 #include "common.h"
+#include "sched_perturb.h"
 
 namespace trpc {
 
@@ -67,6 +68,11 @@ class WorkStealingQueue {
     uint64_t b = bottom_.load(std::memory_order_acquire);
     while (t < b) {
       T v = buf_[t & mask_];
+      if (TRPC_UNLIKELY(sched_perturb_enabled())) {
+        // widen the top-read -> CAS window: the thief-vs-owner race on
+        // the last element runs under seed-controlled timing
+        sched_perturb_spin(SCHED_PP_STEAL_CAS);
+      }
       if (top_.compare_exchange_strong(t, t + 1, std::memory_order_seq_cst,
                                        std::memory_order_relaxed)) {
         *out = v;

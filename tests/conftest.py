@@ -22,6 +22,12 @@ if _stash is not None:
     # plugin keys its relay-tunnel contract on it, native/src/tpu.cc)
     os.environ["_AXON_POOL_IPS_STASH"] = _stash
 
+# Same env-leak class: a developer replaying a schedule-dependent abort
+# (BENCH_NOTES.md "Schedule replay") may leave TRPC_SCHED_SEED exported —
+# tier-1 must run unperturbed regardless (the seed-sweep/soak harnesses
+# and test_sched_replay set the seed explicitly per subprocess).
+os.environ.pop("TRPC_SCHED_SEED", None)
+
 # FORCE cpu, not setdefault: the driver exports JAX_PLATFORMS=axon, and
 # with the registration trigger popped above that platform no longer
 # exists in subprocesses — leaving it selected breaks every jax init

@@ -3,15 +3,23 @@ N CPU-hog sibling processes, reproducing the full-suite contention that
 surfaced the round-5 one-shot load-dependent ASAN abort — a deterministic
 hunting ground instead of waiting for CI luck.
 
+Every iteration now also sweeps TRPC_SCHED_SEED (schedule perturbation,
+native/src/sched_perturb.h): the schedule varies seed-by-seed instead of
+relying only on CPU-hog timing noise, and EVERY attempted seed is
+appended to the artifact log (build-asan/soak-seeds.log) so a future
+abort replays from its recorded seed (BENCH_NOTES.md "Schedule replay").
+
 Opt-in and slow-marked: it spends minutes by design.
 
     BRPC_TPU_ASAN_SOAK=1 python -m pytest tests/test_asan_soak.py -m slow
     BRPC_TPU_ASAN_SOAK_RUNS=N     soak iterations        (default 3)
     BRPC_TPU_ASAN_SOAK_HOGS=N     CPU-hog siblings       (default ncpu)
+    BRPC_TPU_ASAN_SOAK_SEED=B     first sweep seed       (default 1)
 
 Wired into the sanitizer gate (BENCH_NOTES.md "Sanitizer gate"): when the
 gate's one-shot run aborts, rerun HERE with the same report-to-file
-plumbing until the abort reproduces.
+plumbing until the abort reproduces, then pin it with
+TRPC_SCHED_SEED=<logged seed>.
 """
 
 import glob
@@ -46,7 +54,9 @@ def test_asan_stress_soak_under_cpu_contention():
     runs = int(os.environ.get("BRPC_TPU_ASAN_SOAK_RUNS", "3"))
     nhogs = int(os.environ.get("BRPC_TPU_ASAN_SOAK_HOGS",
                                str(os.cpu_count() or 1)))
+    seed_base = int(os.environ.get("BRPC_TPU_ASAN_SOAK_SEED", "1"))
     log_stem = os.path.join(build_dir, "soak-report")
+    seed_log = os.path.join(build_dir, "soak-seeds.log")
     hogs = [subprocess.Popen([sys.executable, "-c", _HOG],
                              stdout=subprocess.DEVNULL,
                              stderr=subprocess.DEVNULL)
@@ -55,12 +65,24 @@ def test_asan_stress_soak_under_cpu_contention():
         for it in range(max(1, runs)):
             for stale in glob.glob(log_stem + "*"):
                 os.unlink(stale)
+            # one seed per iteration: the schedule varies by SEED, not
+            # just by hog timing noise — and the seed is on record
+            # BEFORE the run, so an abort is replayable even if the
+            # process dies without flushing anything else
+            seed = seed_base + it
+            with open(seed_log, "a") as f:
+                f.write(f"iteration={it + 1}/{runs} "
+                        f"TRPC_SCHED_SEED={seed} attempting\n")
             env = dict(os.environ)
+            env["TRPC_SCHED_SEED"] = str(seed)
             prior = env.get("ASAN_OPTIONS", "")
             env["ASAN_OPTIONS"] = (prior + ":" if prior else "") + \
                 f"log_path={log_stem}"
             out = subprocess.run([exe], capture_output=True, text=True,
                                  timeout=900, env=env)
+            with open(seed_log, "a") as f:
+                f.write(f"iteration={it + 1}/{runs} "
+                        f"TRPC_SCHED_SEED={seed} rc={out.returncode}\n")
             report = ""
             for path in sorted(glob.glob(log_stem + "*")):
                 with open(path, errors="replace") as f:
@@ -69,6 +91,8 @@ def test_asan_stress_soak_under_cpu_contention():
             assert out.returncode == 0, (
                 f"soak iteration {it + 1}/{runs} under {nhogs} CPU hogs "
                 f"rc={out.returncode}\n"
+                f"REPLAY: TRPC_SCHED_SEED={seed} {exe}  (all attempted "
+                f"seeds: {seed_log})\n"
                 f"stdout tail:\n{out.stdout[-2000:]}\n"
                 f"stderr tail:\n{out.stderr[-2000:]}\n"
                 f"FULL sanitizer report:{report or ' (none written)'}")

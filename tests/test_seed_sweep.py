@@ -1,0 +1,92 @@
+"""Seed sweep over the sanitized stress gates (ISSUE 6 tentpole): run
+every TSAN/ASAN scenario across N schedule-perturbation seeds, hunting
+the round-5 one-shot ASAN abort.  Any hit MUST reproduce from its logged
+seed — that reproduction is asserted here, turning "we saw an abort once"
+into "here is the seed that replays it".
+
+Slow-marked and excluded from tier-1 timing (tier-1 runs -m 'not slow'):
+
+    python -m pytest tests/test_seed_sweep.py -m slow
+    BRPC_TPU_SEED_SWEEP_SEEDS=N   seeds per flavor   (default 32)
+    BRPC_TPU_SEED_SWEEP_BASE=B    first seed         (default 1)
+
+Equivalent CLI: native/build_sanitized.sh <flavor> --sweep N [base].
+"""
+
+import glob
+import os
+import re
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _build(flavor: str) -> str:
+    r = subprocess.run(
+        ["bash", os.path.join(REPO, "native", "build_sanitized.sh"),
+         flavor], capture_output=True, text=True, timeout=900)
+    if r.returncode == 3:
+        pytest.skip(f"no {flavor} sanitizer toolchain/runtime: "
+                    f"{(r.stdout + r.stderr)[-200:]}")
+    assert r.returncode == 0, r.stdout + r.stderr
+    return os.path.join(
+        REPO, "native", "build-" + ("tsan" if flavor == "thread"
+                                    else "asan"), "test_stress")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("flavor", ["thread", "address"])
+def test_seed_sweep_all_scenarios(flavor):
+    """>= 32 seeds x the full scenario gate per sanitizer tree; every hit
+    must replay from its seed (the acceptance criterion)."""
+    if os.environ.get("BRPC_TPU_SKIP_SANITIZERS"):
+        pytest.skip("sanitizer runs disabled by env")
+    exe = _build(flavor)
+    build_dir = os.path.dirname(exe)
+    seeds = int(os.environ.get("BRPC_TPU_SEED_SWEEP_SEEDS", "32"))
+    base = int(os.environ.get("BRPC_TPU_SEED_SWEEP_BASE", "1"))
+    env = dict(os.environ)
+    opt_var = "TSAN_OPTIONS" if flavor == "thread" else "ASAN_OPTIONS"
+    log_stem = os.path.join(build_dir, "sweep-sanitizer-report")
+    for stale in glob.glob(log_stem + "*"):
+        os.unlink(stale)
+    prior = env.get(opt_var, "")
+    env[opt_var] = (prior + ":" if prior else "") + f"log_path={log_stem}"
+    # generous budget: seeds x full gate, each run itself time-bounded
+    out = subprocess.run([exe, "--sweep", str(seeds), str(base)],
+                         capture_output=True, text=True,
+                         timeout=int(os.environ.get(
+                             "BRPC_TPU_SEED_SWEEP_TIMEOUT", "5400")),
+                         env=env)
+    hits = [int(m) for m in
+            re.findall(r"SWEEP HIT seed=(\d+)", out.stdout)]
+    if out.returncode == 0:
+        assert not hits, out.stdout[-2000:]
+        assert f"sweep done: 0/{seeds}" in out.stdout, out.stdout[-2000:]
+        return
+    # a hit: the whole point of the mode — it must REPLAY from its seed
+    assert hits, (f"sweep rc={out.returncode} with no recorded hit\n"
+                  f"{out.stdout[-3000:]}\n{out.stderr[-2000:]}")
+    replays = {}
+    for seed in hits:
+        renv = dict(env)
+        renv["TRPC_SCHED_SEED"] = str(seed)
+        r = subprocess.run([exe], capture_output=True, text=True,
+                           timeout=600, env=renv)
+        replays[seed] = r.returncode
+    report = ""
+    for path in sorted(glob.glob(log_stem + "*")):
+        with open(path, errors="replace") as f:
+            report += f"\n--- {os.path.basename(path)} ---\n" + f.read()
+    nonreproducing = [s for s, rc in replays.items() if rc == 0]
+    pytest.fail(
+        f"seed sweep found schedule-dependent failures: seeds {hits}\n"
+        f"replay outcomes (seed -> rc, nonzero = reproduced): {replays}\n"
+        f"non-reproducing seeds (replay contract broken!): "
+        f"{nonreproducing or 'none — every hit replays from its seed'}\n"
+        f"pin the reproducing interleaving as a named regression "
+        f"scenario in native/src/test_stress.cc\n"
+        f"sweep tail:\n{out.stdout[-3000:]}\n"
+        f"FULL sanitizer report:{report or ' (none written)'}")

@@ -1,0 +1,315 @@
+#!/usr/bin/env python
+"""Repo-local static analysis gate (ISSUE 6): machine-check the
+concurrency/runtime conventions that reviewers used to eyeball.  Runs as
+a tier-1 pytest (tests/test_lint.py) and stand-alone:
+
+    python tools/lint.py [--repo ROOT] [--reference ROOT]
+
+Rules:
+
+  flags        every TRPC_* env var read in C++ (getenv) is resolved once
+               per process — the call sits in a `static` initializer or
+               carries a `flag-cached` comment within the 6 preceding
+               lines — and every TRPC_* name appearing as a string
+               literal in product code is registered in
+               tools/flags_manifest.txt (and vice versa: no stale
+               manifest entries).  Intentional per-call reloads escape
+               with `lint:allow-uncached-getenv` + a reason.
+  citations    every `≙ path[:line]` citation whose path is repo-local
+               (starts with a repo top-level dir) resolves to a real file
+               (and a real line) in THIS repo; citations into the
+               reference tree resolve under --reference / $TRPC_REFERENCE_ROOT
+               when that root exists (absent on most containers — then
+               only the format is checked).
+  scenarios    every `test_*_races` scenario defined in
+               native/src/test_stress.cc is registered in its kScenarios
+               table — i.e. actually runs in the TSAN/ASAN gate — and the
+               table never names a function that doesn't exist.
+  allocations  no raw `new` / `malloc` inside the parse/dispatch hot-path
+               functions (they must draw from the object pools, the PR-3
+               invariant); legitimate seams escape with a
+               `lint:allow-alloc(reason)` comment on the line.
+
+The checks are deliberately line-level heuristics, not a C++ parser: the
+escape annotations make intent explicit at the use site, which is the
+point — conventions stay visible next to the code they govern.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+from typing import Dict, List, NamedTuple, Optional, Set
+
+
+class Violation(NamedTuple):
+    rule: str
+    path: str   # repo-relative
+    line: int   # 1-based; 0 = whole file
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+# files scanned for C++ getenv caching (product code only: test drivers
+# and the fake PJRT plugin — a test peer — are out of scope)
+_CPP_EXCLUDE = ("test_core.cc", "test_stress.cc", "pjrt_fake.cc")
+
+# parse/dispatch hot-path regions: raw allocations here bypass the pools
+_HOT_REGIONS = {
+    "native/src/rpc.cc": ["ServerOnMessages", "ChannelOnMessages"],
+    "native/src/socket.cc": ["WriteRaw", "ReadToBuf"],
+}
+
+_GETENV_RE = re.compile(r'getenv\(\s*"(TRPC_[A-Z0-9_]+)"')
+_LITERAL_RE = re.compile(r'"(TRPC_[A-Z0-9_]+)"')
+_CITE_PATH_RE = re.compile(
+    r"([A-Za-z0-9_][A-Za-z0-9_./-]*\.(?:h|cc|cpp|c|py|S|md|sh))"
+    r"(?::(\d+))?")
+_RACES_DEF_RE = re.compile(r"static\s+void\s+(test_(\w*_races))\s*\(")
+_REGISTRY_RE = re.compile(r'\{\s*"(\w+)"\s*,\s*test_(\w+)\s*\}')
+_ALLOC_RE = re.compile(r"(?:\bnew\b(?!\w)|\bmalloc\s*\()")
+
+_REPO_TOP_DIRS = ("brpc_tpu", "native", "tests", "tools", "examples")
+
+
+def _read_lines(path: str) -> List[str]:
+    with open(path, encoding="utf-8", errors="replace") as f:
+        return f.read().splitlines()
+
+
+def _walk(root: str, subdir: str, exts) -> List[str]:
+    out = []
+    base = os.path.join(root, subdir)
+    for dirpath, _dirnames, filenames in os.walk(base):
+        for name in sorted(filenames):
+            if name.endswith(tuple(exts)):
+                out.append(os.path.relpath(os.path.join(dirpath, name),
+                                           root))
+    return out
+
+
+def _load_manifest(root: str, violations: List[Violation]) -> Set[str]:
+    rel = os.path.join("tools", "flags_manifest.txt")
+    path = os.path.join(root, rel)
+    names: Set[str] = set()
+    if not os.path.exists(path):
+        violations.append(Violation(
+            "flags", rel, 0, "flags manifest missing (every TRPC_* env "
+            "flag must be registered here)"))
+        return names
+    for i, line in enumerate(_read_lines(path), 1):
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        name = line.split()[0]
+        if not re.fullmatch(r"TRPC_[A-Z0-9_]+", name):
+            violations.append(Violation(
+                "flags", rel, i, f"malformed manifest entry {name!r}"))
+            continue
+        names.add(name)
+    return names
+
+
+def _check_flags(root: str, violations: List[Violation]) -> None:
+    manifest = _load_manifest(root, violations)
+    seen: Set[str] = set()
+
+    cpp_files = [p for p in _walk(root, os.path.join("native", "src"),
+                                  (".cc", ".h"))
+                 if os.path.basename(p) not in _CPP_EXCLUDE]
+    py_files = _walk(root, "brpc_tpu", (".py",))
+    if os.path.exists(os.path.join(root, "bench.py")):
+        py_files.append("bench.py")
+    literal_files = cpp_files + py_files
+    if os.path.exists(os.path.join(
+            root, "native", "src", "pjrt_fake.cc")):
+        # the fake plugin's TRPC_FAKE_* knobs still register in the
+        # manifest even though its getenv style is out of scope
+        literal_files.append(os.path.join("native", "src", "pjrt_fake.cc"))
+
+    for rel in literal_files:
+        lines = _read_lines(os.path.join(root, rel))
+        for i, line in enumerate(lines, 1):
+            for name in _LITERAL_RE.findall(line):
+                seen.add(name)
+                if name not in manifest:
+                    violations.append(Violation(
+                        "flags", rel, i,
+                        f"{name} not registered in "
+                        f"tools/flags_manifest.txt"))
+
+    for rel in cpp_files:
+        lines = _read_lines(os.path.join(root, rel))
+        for i, line in enumerate(lines, 1):
+            m = _GETENV_RE.search(line)
+            if m is None:
+                continue
+            if "lint:allow-uncached-getenv" in line:
+                continue
+            context = lines[max(0, i - 7):i]  # the line + 6 above
+            if any("static" in c or "flag-cached" in c
+                   or "lint:allow-uncached-getenv" in c for c in context):
+                continue
+            violations.append(Violation(
+                "flags", rel, i,
+                f"getenv(\"{m.group(1)}\") is not visibly cached per "
+                f"process: put it in a static initializer, add a "
+                f"'flag-cached' comment naming where the value is "
+                f"cached, or escape with lint:allow-uncached-getenv "
+                f"(reason)"))
+
+    for name in sorted(manifest - seen):
+        violations.append(Violation(
+            "flags", os.path.join("tools", "flags_manifest.txt"), 0,
+            f"stale manifest entry {name}: no product code reads it"))
+
+
+def _check_citations(root: str, reference_root: Optional[str],
+                     violations: List[Violation]) -> None:
+    files = _walk(root, os.path.join("native", "src"),
+                  (".cc", ".h", ".S"))
+    files += _walk(root, "brpc_tpu", (".py",))
+    have_ref = reference_root is not None and os.path.isdir(reference_root)
+    for rel in files:
+        lines = _read_lines(os.path.join(root, rel))
+        for i, line in enumerate(lines, 1):
+            if "≙" not in line:
+                continue
+            cited = line.split("≙", 1)[1]
+            for m in _CITE_PATH_RE.finditer(cited):
+                path, lineno = m.group(1), m.group(2)
+                top = path.split("/", 1)[0]
+                if top in _REPO_TOP_DIRS or \
+                        os.path.exists(os.path.join(root, top)):
+                    target_root = root
+                elif have_ref:
+                    target_root = reference_root
+                else:
+                    continue  # reference tree absent: format-only
+                target = os.path.join(target_root, path)
+                if not os.path.exists(target):
+                    violations.append(Violation(
+                        "citations", rel, i,
+                        f"stale ≙ citation: {path} does not exist under "
+                        f"{os.path.basename(target_root) or target_root}"))
+                    continue
+                if lineno is not None:
+                    n = len(_read_lines(target))
+                    if int(lineno) > n:
+                        violations.append(Violation(
+                            "citations", rel, i,
+                            f"stale ≙ citation: {path}:{lineno} is past "
+                            f"EOF ({n} lines)"))
+
+
+def _check_scenarios(root: str, violations: List[Violation]) -> None:
+    rel = os.path.join("native", "src", "test_stress.cc")
+    path = os.path.join(root, rel)
+    if not os.path.exists(path):
+        return
+    text = "\n".join(_read_lines(path))
+    defs = {m.group(2): m.group(1)
+            for m in _RACES_DEF_RE.finditer(text)}
+    registered = {m.group(1): m.group(2)
+                  for m in _REGISTRY_RE.finditer(text)}
+    all_fns = set(re.findall(r"static\s+void\s+test_(\w+)\s*\(", text))
+    for name in sorted(defs):
+        if name not in registered:
+            violations.append(Violation(
+                "scenarios", rel, 0,
+                f"stress scenario {defs[name]} is defined but not "
+                f"registered in kScenarios — it never runs in the "
+                f"TSAN/ASAN gate"))
+    for name, fn in sorted(registered.items()):
+        if fn not in all_fns:
+            violations.append(Violation(
+                "scenarios", rel, 0,
+                f"kScenarios entry \"{name}\" points at test_{fn}, "
+                f"which is not defined"))
+
+
+def _function_body(lines: List[str], name: str):
+    """(start, end) 0-based line span of `name`'s definition, by brace
+    matching from the definition line; None when not found."""
+    sig = re.compile(r"^[A-Za-z_][\w:<>,*&\s]*\b" + re.escape(name) +
+                     r"\s*\(")
+    for i, line in enumerate(lines):
+        if not sig.match(line):
+            continue
+        depth = 0
+        opened = False
+        for j in range(i, len(lines)):
+            for ch in lines[j]:
+                if ch == "{":
+                    depth += 1
+                    opened = True
+                elif ch == "}":
+                    depth -= 1
+            if opened and depth <= 0:
+                return (i, j)
+        return (i, len(lines) - 1)
+    return None
+
+
+def _check_allocations(root: str, violations: List[Violation]) -> None:
+    for rel, fns in _HOT_REGIONS.items():
+        path = os.path.join(root, rel)
+        if not os.path.exists(path):
+            continue
+        lines = _read_lines(path)
+        for fn in fns:
+            span = _function_body(lines, fn)
+            if span is None:
+                violations.append(Violation(
+                    "allocations", rel, 0,
+                    f"hot-path function {fn} not found (update "
+                    f"tools/lint.py _HOT_REGIONS after renames)"))
+                continue
+            for i in range(span[0], span[1] + 1):
+                line = lines[i]
+                code = line.split("//", 1)[0]
+                if "lint:allow-alloc" in line:
+                    continue
+                if _ALLOC_RE.search(code):
+                    violations.append(Violation(
+                        "allocations", rel, i + 1,
+                        f"raw allocation in hot-path {fn}: draw from an "
+                        f"object pool, or escape with "
+                        f"lint:allow-alloc(reason)"))
+
+
+def run_lint(repo_root: str,
+             reference_root: Optional[str] = None) -> List[Violation]:
+    violations: List[Violation] = []
+    _check_flags(repo_root, violations)
+    _check_citations(repo_root, reference_root, violations)
+    _check_scenarios(repo_root, violations)
+    _check_allocations(repo_root, violations)
+    return violations
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    default_repo = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    ap.add_argument("--repo", default=default_repo)
+    ap.add_argument("--reference",
+                    default=os.environ.get("TRPC_REFERENCE_ROOT",
+                                           "/root/reference"))
+    args = ap.parse_args()
+    violations = run_lint(args.repo, args.reference)
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"{len(violations)} lint violation(s)")
+        return 1
+    print("lint clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
