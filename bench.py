@@ -293,6 +293,26 @@ def main() -> int:
         "client_cork_windows": native_counter("native_client_cork_windows"),
         "client_inline_completes": native_counter(
             "native_client_inline_completes"),
+        # runtime sharding (ISSUE 7): bench-of-record runs record the
+        # active shard count (TRPC_SHARDS, boot-frozen); per-shard
+        # accept/dispatch/inline/cork counters prove the partitioning —
+        # on a sharded run the work must actually spread
+        "shards": int(L.trpc_shard_count()),
+        "cross_shard_hops": int(L.trpc_cross_shard_hops()),
+        "per_shard": {
+            str(k): {
+                "accepts": native_counter(f"native_shard{k}_accepts"),
+                "dispatches": native_counter(
+                    f"native_shard{k}_dispatches"),
+                "inline_hits": native_counter(
+                    f"native_shard{k}_inline_hits"),
+                "cork_flushes": native_counter(
+                    f"native_shard{k}_cork_flushes"),
+                "ring_cqes": native_counter(
+                    f"native_shard{k}_ring_cqes"),
+            }
+            for k in range(int(L.trpc_shard_count()))
+        },
         # schedule perturbation MUST be off (0) for bench-of-record: a
         # nonzero seed means the run measured the fuzzing mode, not the
         # runtime (BENCH_NOTES.md "Schedule replay")

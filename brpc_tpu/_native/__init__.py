@@ -251,6 +251,17 @@ def _declare(L: ctypes.CDLL) -> None:
     L.trpc_set_event_dispatcher_num.argtypes = [c.c_int]
     L.trpc_set_event_dispatcher_num.restype = None
 
+    # runtime sharding (native/src/shard.h): boot-frozen shard count +
+    # SO_REUSEPORT listener gate + cross-shard hop counter
+    L.trpc_set_shards.argtypes = [c.c_int]
+    L.trpc_set_shards.restype = c.c_int
+    L.trpc_shard_count.restype = c.c_int
+    L.trpc_set_reuseport.argtypes = [c.c_int]
+    L.trpc_set_reuseport.restype = c.c_int
+    L.trpc_reuseport_enabled.restype = c.c_int
+    L.trpc_current_shard.restype = c.c_int
+    L.trpc_cross_shard_hops.restype = c.c_uint64
+
     # channel
     L.trpc_channel_create.argtypes = [c.c_char_p, c.c_int]
     L.trpc_channel_create.restype = c.c_void_p

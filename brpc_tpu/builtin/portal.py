@@ -37,7 +37,8 @@ _SERVICES = [
     ("/connections", "live server connections"),
     ("/metrics", "Prometheus text exposition"),
     ("/fibers", "fiber runtime counters (≙ /bthreads)"),
-    ("/rpcz", "sampled RPC spans (?trace_id=, ?max_scan=)"),
+    ("/rpcz", "sampled RPC spans (?trace_id=, ?max_scan=, ?time= reads "
+              "persisted spans back from disk)"),
     ("/hotspots", "collapsed-stack CPU samples (?seconds=, ?view=flame)"),
     ("/pprof/profile", "native SIGPROF profile (?seconds=, ?hz=)"),
     ("/pprof/heap", "sampled live heap (?interval=; first hit enables; "
@@ -499,8 +500,25 @@ def install_builtin_services(server, dispatcher: HttpDispatcher) -> None:
             tid = int(trace_id, 16) if trace_id else None
         except ValueError:
             return HttpResponse.text(f"bad trace_id {trace_id!r}\n", 400)
-        spans = _span.recent_spans(
-            int(params.get("max_scan", "100")), tid)
+        max_scan = int(params.get("max_scan", "100"))
+        at = params.get("time")
+        if at is not None:
+            # time-keyed DISK read-back (≙ browsing persisted spans,
+            # span.cpp:672): spans at/before <epoch seconds>, straight
+            # from the rotated recordio segments — they survive restarts
+            try:
+                at_ts = float(at)
+            except ValueError:
+                return HttpResponse.text(f"bad time {at!r}\n", 400)
+            if not _span.persisting():
+                return HttpResponse.text(
+                    "span persistence is off (set the rpcz_persist_dir "
+                    "flag)\n", 400)
+            spans = _span.read_persisted(at_ts, max_scan)
+            if tid is not None:
+                spans = [s for s in spans if s.trace_id == tid]
+            return HttpResponse.json([s.describe() for s in spans])
+        spans = _span.recent_spans(max_scan, tid)
         return HttpResponse.json([s.describe() for s in spans])
 
     d.register("/status", _status)

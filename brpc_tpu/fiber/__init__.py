@@ -157,6 +157,24 @@ def worker_index() -> int:
     return int(lib().trpc_fiber_worker_index())
 
 
+def shards() -> int:
+    """Boot-frozen runtime shard count (native/src/shard.h; 1 = the
+    unsharded pre-shard runtime)."""
+    return int(lib().trpc_shard_count())
+
+
+def current_shard() -> int:
+    """Shard of the calling context (-1 off-worker — Python control
+    threads are off-worker unless running inside a fiber)."""
+    return int(lib().trpc_current_shard())
+
+
+def cross_shard_hops() -> int:
+    """Cross-shard mailbox hops so far; the echo hot path keeps this
+    near zero (hops are naming/teardown/aggregation traffic)."""
+    return int(lib().trpc_cross_shard_hops())
+
+
 def join(fid: int) -> None:
     lib().trpc_fiber_join(fid)
 

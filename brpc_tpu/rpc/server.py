@@ -31,6 +31,42 @@ flags.define_int32("event_dispatcher_num", 1,
                    "number of epoll dispatcher threads (the reference's "
                    "event_dispatcher_num); set before the first "
                    "server/channel starts")
+
+
+def _parse_boot_int(env: str, default: int) -> int:
+    try:
+        return int(os.environ.get(env, "") or default)
+    except ValueError:
+        return default
+
+
+def _push_shards(value) -> bool:
+    if not 1 <= value <= 8:
+        return False
+    # boot-frozen natively: once the fiber runtime started, the native
+    # side refuses the change (-EBUSY) — surface that as a flag error
+    return lib().trpc_set_shards(int(value)) == 0
+
+
+def _push_reuseport(value) -> bool:
+    return lib().trpc_set_reuseport(1 if value else 0) == 0
+
+
+flags.define_int32("shards", min(max(_parse_boot_int("TRPC_SHARDS", 1), 1), 8),
+                   "runtime shard count (native/src/shard.h): N "
+                   "independent reactors — per-shard io_uring/epoll, "
+                   "SO_REUSEPORT listeners, shard-pinned fiber groups, "
+                   "cross-shard mailbox.  Boot-time only (frozen at the "
+                   "first fiber runtime init); 1 = the pre-shard runtime, "
+                   "wire- and behavior-identical",
+                   validator=_push_shards, reloadable=False)
+flags.define_bool("reuseport",
+                  os.environ.get("TRPC_REUSEPORT") != "0",
+                  "with shards>1: every shard accepts on its own "
+                  "SO_REUSEPORT fd (kernel hashes connections across "
+                  "them); off = one listener, connections round-robin "
+                  "across shards.  Boot-time only",
+                  validator=_push_reuseport, reloadable=False)
 flags.define_int32("usercode_workers", 4,
                    "pthreads running Python handlers")
 flags.define_bool("use_io_uring", False,
@@ -553,6 +589,14 @@ class Server:
 
     def start(self, address: str = "127.0.0.1:0") -> int:
         from brpc_tpu import fiber
+        # push the shard config BEFORE the fiber runtime starts (the
+        # count freezes at the first fiber_runtime_init); a later start
+        # in the same process keeps the frozen value — trpc_set_shards
+        # returning EBUSY with an unchanged flag is fine, only a CHANGE
+        # after freeze is an error (the validator already rejected it)
+        lib().trpc_set_shards(int(flags.get_flag("shards")))
+        lib().trpc_set_reuseport(
+            1 if flags.get_flag("reuseport") else 0)
         fiber.init(self.options.num_workers)
         lib().trpc_set_usercode_workers(
             int(flags.get_flag("usercode_workers")))

@@ -4,16 +4,25 @@ server-side in ProcessRpcRequest; free-text Annotate (span.h:80); sampling
 throttled by bvar::Collector, collector.h:41 COLLECTOR_SAMPLING_BASE;
 browsed through the /rpcz builtin service, builtin/rpcz_service.cpp).
 
-TPU build differences: spans live in an in-process ring (the reference
-persists to leveldb — operators here scrape /rpcz or read
-``recent_spans()``), and sampling is a plain token bucket refilled per
-second.  Span creation is off unless the ``enable_rpcz`` flag is on
-(≙ --enable_rpcz).
+TPU build differences: spans live in an in-process ring, and — when
+``rpcz_persist_dir`` names a directory — finished spans additionally
+spill to disk through the shared Collector (metrics/collector.py, the
+≙ bvar::Collector background service) into length-prefixed recordio
+files (utils/recordio.py) with size-based rotation, a time-keyed index
+(index.txt: file min_ts max_ts count) and age-based expiry — the
+capability of the reference persisting spans to leveldb with
+span_db.cpp's time-indexed browsing (≙ span.cpp:476-494,672:
+ForkAndSaveTo + the leveldb SpanDB).  ``/rpcz?time=<epoch>`` reads back
+from disk, so sampled spans survive a process restart.  Sampling is a
+plain token bucket refilled per second.  Span creation is off unless the
+``enable_rpcz`` flag is on (≙ --enable_rpcz).
 """
 
 from __future__ import annotations
 
 import itertools
+import json
+import os
 import random
 import threading
 import time
@@ -22,11 +31,24 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 from brpc_tpu.utils import flags
+from brpc_tpu.utils import recordio
 
 flags.define_bool("enable_rpcz", False, "collect rpcz spans")
 flags.define_int32("rpcz_max_samples_per_second", 16384,
                    "span sampling budget (≙ COLLECTOR_SAMPLING_BASE)")
 flags.define_int32("rpcz_keep_spans", 10000, "ring size of kept spans")
+flags.define_string("rpcz_persist_dir", "",
+                    "directory for rpcz span spill files (recordio, "
+                    "rotated + time-indexed + expired); empty = spans "
+                    "live only in the in-memory ring (≙ the reference "
+                    "persisting spans via SpanDB/leveldb)")
+flags.define_int32("rpcz_persist_rotate_bytes", 1 << 20,
+                   "rotate the active span spill file past this size "
+                   "(each rotation adds a time-keyed index entry)")
+flags.define_int32("rpcz_persist_expiry_s", 24 * 3600,
+                   "delete span spill files whose newest span is older "
+                   "than this (checked at rotation and at read time; "
+                   "≙ the reference's --span_keeping_seconds)")
 
 _id_gen = itertools.count(random.getrandbits(48) << 8)
 _tls = threading.local()
@@ -103,6 +125,209 @@ class _Store:
 _store = _Store()
 
 
+# --- disk spill (≙ span.cpp:476-494,672: spans forked to the collector
+# and persisted; browsed back by time) --------------------------------------
+
+
+def _span_to_payload(s: Span) -> bytes:
+    return json.dumps({
+        "trace_id": s.trace_id, "span_id": s.span_id,
+        "parent_span_id": s.parent_span_id, "kind": s.kind,
+        "method": s.method, "remote_side": s.remote_side,
+        "start_ts": s.start_ts, "latency_us": s.latency_us,
+        "error_code": s.error_code, "annotations": s.annotations,
+    }).encode()
+
+
+def _span_from_payload(payload: bytes) -> Optional[Span]:
+    try:
+        d = json.loads(payload.decode())
+        return Span(trace_id=int(d["trace_id"]), span_id=int(d["span_id"]),
+                    parent_span_id=int(d.get("parent_span_id", 0)),
+                    kind=d.get("kind", "server"),
+                    method=d.get("method", ""),
+                    remote_side=d.get("remote_side", ""),
+                    start_ts=float(d.get("start_ts", 0.0)),
+                    latency_us=int(d.get("latency_us", 0)),
+                    error_code=int(d.get("error_code", 0)),
+                    annotations=list(d.get("annotations", [])))
+    except (ValueError, KeyError, TypeError):
+        return None  # torn/foreign record: recordio already resynced
+
+
+class _Persister:
+    """Span spill files under ``rpcz_persist_dir``:
+
+        spans-<ms>.rio   rotated recordio segments (utils/recordio.py)
+        index.txt        one line per SEALED segment: name min max count
+
+    Writes arrive on the Collector thread only (on_collected); reads
+    (read_persisted) take the same lock, flush the active segment and
+    scan index entries whose [min_ts, max_ts] window is relevant — the
+    time-keyed lookup that makes /rpcz?time= skip cold segments."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._writer: Optional[recordio.RecordWriter] = None
+        self._path = ""        # active segment (not yet in the index)
+        self._min_ts = 0.0
+        self._max_ts = 0.0
+        self._count = 0
+        self._seq = 0          # disambiguates same-millisecond rotations
+
+    def _dir(self) -> str:
+        d = str(flags.get_flag("rpcz_persist_dir") or "")
+        # normalized: a trailing slash must not defeat the active-segment
+        # dir comparison in write() (it would seal+reopen per span)
+        return os.path.normpath(d) if d else ""
+
+    def _index_path(self, d: str) -> str:
+        return os.path.join(d, "index.txt")
+
+    def _open_locked(self, d: str, first_ts: float) -> None:
+        os.makedirs(d, exist_ok=True)
+        self._seq += 1
+        name = f"spans-{int(first_ts * 1000)}-{os.getpid()}-{self._seq}.rio"
+        self._path = os.path.join(d, name)
+        self._writer = recordio.RecordWriter(self._path)
+        self._min_ts = first_ts
+        self._max_ts = first_ts
+        self._count = 0
+
+    def _seal_locked(self, d: str) -> None:
+        if self._writer is None:
+            return
+        self._writer.close()
+        with open(self._index_path(d), "a", encoding="utf-8") as f:
+            f.write(f"{os.path.basename(self._path)} {self._min_ts:.6f} "
+                    f"{self._max_ts:.6f} {self._count}\n")
+        self._writer = None
+        self._path = ""
+
+    def _expire_locked(self, d: str) -> None:
+        """Drop sealed segments whose newest span aged out; rewrite the
+        index without them."""
+        idx = self._index_path(d)
+        if not os.path.exists(idx):
+            return
+        horizon = time.time() - int(flags.get_flag("rpcz_persist_expiry_s"))
+        keep, dropped = [], []
+        with open(idx, encoding="utf-8") as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) != 4:
+                    continue
+                if float(parts[2]) >= horizon:
+                    keep.append(line)
+                else:
+                    dropped.append(parts[0])
+        if not dropped:
+            return
+        for name in dropped:
+            try:
+                os.unlink(os.path.join(d, name))
+            except OSError:
+                pass
+        tmp = idx + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.writelines(keep)
+        os.replace(tmp, idx)  # atomic: readers never see a half index
+
+    def write(self, s: Span) -> None:
+        d = self._dir()
+        if not d:
+            return
+        with self._lock:
+            if self._writer is not None and \
+                    not self._path.startswith(d + os.sep):
+                self._seal_locked(os.path.dirname(self._path))  # dir moved
+            if self._writer is None:
+                self._open_locked(d, s.start_ts)
+            self._writer.write(_span_to_payload(s))
+            self._min_ts = min(self._min_ts, s.start_ts)
+            self._max_ts = max(self._max_ts, s.start_ts)
+            self._count += 1
+            if self._writer.tell() >= int(
+                    flags.get_flag("rpcz_persist_rotate_bytes")):
+                self._seal_locked(d)
+                self._expire_locked(d)
+
+    def read(self, at_ts: float, limit: int) -> List[Span]:
+        """Spans with start_ts <= at_ts, newest first, from disk — the
+        restart-surviving read path behind /rpcz?time=."""
+        d = self._dir()
+        if not d or not os.path.isdir(d):
+            return []
+        with self._lock:
+            if self._writer is not None:
+                self._writer.flush()  # the active segment is readable
+            active = self._path
+            self._expire_locked(d)
+            candidates: List[str] = []
+            sealed: set = set()  # EVERY indexed name, kept or time-skipped
+            idx = self._index_path(d)
+            if os.path.exists(idx):
+                with open(idx, encoding="utf-8") as f:
+                    for line in f:
+                        parts = line.split()
+                        if len(parts) != 4:
+                            continue
+                        sealed.add(parts[0])
+                        # time-keyed skip: a segment strictly newer than
+                        # the asked time can hold no matching span
+                        if float(parts[1]) <= at_ts:
+                            candidates.append(os.path.join(d, parts[0]))
+            if active and self._min_ts <= at_ts:
+                candidates.append(active)
+            # crash recovery: an unsealed segment from a previous process
+            # has no index entry — scan for orphans.  Exclusion must use
+            # the FULL sealed set: a time-skipped sealed segment is not
+            # an orphan, and re-adding it here would defeat the
+            # time-keyed pruning (reading every cold segment anyway).
+            for name in sorted(os.listdir(d)):
+                if name.startswith("spans-") and name.endswith(".rio") \
+                        and name not in sealed and \
+                        os.path.join(d, name) != active:
+                    candidates.append(os.path.join(d, name))
+            candidates = list(dict.fromkeys(candidates))
+        out: List[Span] = []
+        for path in candidates:
+            try:
+                for payload in recordio.read_records(path):
+                    s = _span_from_payload(payload)
+                    if s is not None and s.start_ts <= at_ts:
+                        out.append(s)
+            except OSError:
+                continue  # expired between listing and reading
+        out.sort(key=lambda s: s.start_ts, reverse=True)
+        return out[:limit]
+
+
+_persister = _Persister()
+
+
+class _SpanSample:
+    """Collected adapter: the hot path pays one Collector submit; the
+    recordio write happens on the collector thread (≙ bvar::Collected)."""
+
+    def __init__(self, span: Span):
+        self._span = span
+
+    def on_collected(self) -> None:
+        _persister.write(self._span)
+
+
+def persisting() -> bool:
+    return bool(flags.get_flag("rpcz_persist_dir"))
+
+
+def read_persisted(at_ts: Optional[float] = None,
+                   limit: int = 100) -> List[Span]:
+    """Disk read-back for /rpcz?time= (spans survive restarts)."""
+    return _persister.read(at_ts if at_ts is not None else time.time(),
+                           limit)
+
+
 def enabled() -> bool:
     return bool(flags.get_flag("enable_rpcz"))
 
@@ -126,6 +351,12 @@ def finish_span(span: Optional[Span], error_code: int = 0) -> None:
     span.latency_us = int((time.time() - span.start_ts) * 1e6)
     span.error_code = error_code
     _store.add(span)
+    if persisting():
+        # spill through the shared Collector (rate-limited background
+        # service): the RPC path pays one budget check + deque append,
+        # the recordio write runs on the collector thread
+        from brpc_tpu.metrics.collector import global_collector
+        global_collector().submit(_SpanSample(span))
 
 
 def set_current(span: Optional[Span]) -> None:

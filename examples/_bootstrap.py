@@ -14,6 +14,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 if os.environ.get("JAX_PLATFORMS") == "cpu":
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
+# Examples pin shards=1 (ISSUE 7): they are single-connection demos whose
+# output tests/test_examples.py asserts on — an inherited TRPC_SHARDS
+# from a sharded-suite sweep must not change their runtime shape.
+os.environ["TRPC_SHARDS"] = "1"
+
 from brpc_tpu.utils.jaxenv import force_cpu_platform  # noqa: E402
 
 force_cpu_platform()

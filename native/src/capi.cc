@@ -18,6 +18,7 @@
 #include "crc32c.h"
 #include "rpc.h"
 #include "sched_perturb.h"
+#include "shard.h"
 #include "snappy.h"
 #include "socket.h"
 #include "stream.h"
@@ -198,6 +199,18 @@ int trpc_server_http_cache_put(void* s, const char* path, int status,
 void trpc_set_event_dispatcher_num(int n) {
   g_event_dispatcher_num.store(n, std::memory_order_relaxed);
 }
+
+// Multi-reactor runtime sharding (shard.h): boot-time shard count
+// (TRPC_SHARDS env seeds the default; frozen once the fiber runtime
+// starts — returns -EBUSY after) and the SO_REUSEPORT listener gate.
+int trpc_set_shards(int n) { return shard_set_count(n); }
+int trpc_shard_count() { return shard_count(); }
+int trpc_set_reuseport(int on) { return shard_set_reuseport(on); }
+int trpc_reuseport_enabled() { return shard_reuseport_enabled() ? 1 : 0; }
+// Shard of the calling context (-1 off-worker) and the cross-shard hop
+// counter (mailbox traffic — near zero on the echo path by design).
+int trpc_current_shard() { return current_shard(); }
+uint64_t trpc_cross_shard_hops() { return cross_shard_hops(); }
 
 // io_uring transport (FORK RingListener ≙ socket.h:360): opt-in; falls
 // back to epoll transparently when the kernel refuses the ring.

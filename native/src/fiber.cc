@@ -17,6 +17,7 @@
 #include "context.h"
 #include "object_pool.h"
 #include "sched_perturb.h"
+#include "shard.h"
 #include "timer_thread.h"
 #include "work_stealing_queue.h"
 
@@ -95,6 +96,11 @@ struct TaskMeta {
   bool bound = false;
   int home_group = -1;
   int jump_target = -1;
+  // worker this fiber last ran on (-1 = never ran): off-worker wakes on
+  // a sharded runtime re-place the fiber inside ITS shard group instead
+  // of a random one — without this, every timer/epollout/engine-thread
+  // wake would silently migrate fibers across reactors
+  int last_group = -1;
 
   fiber_t tid() const {
     return ((uint64_t)version.load(std::memory_order_relaxed) << 32) | slot;
@@ -212,6 +218,15 @@ TaskControl& control() {
 #define g_control control()
 thread_local TaskGroup* tls_group = nullptr;
 
+// Shard partition (ISSUE 7): fixed at fiber_runtime_init from
+// shard_count().  Worker w belongs to shard (w % g_nshards); 1 = the
+// pre-shard runtime (no confinement, no group routing).
+int g_nshards = 1;
+
+inline int shard_of_worker(int widx) {
+  return g_nshards > 1 ? widx % g_nshards : 0;
+}
+
 void worker_main(TaskGroup* g);
 
 // steal one task from any other group (random probing, ≙ steal_task).
@@ -228,9 +243,14 @@ bool steal_task(TaskGroup* self, fiber_t* out) {
   } else {
     seed = fast_rand();
   }
+  // shard confinement: a worker only steals inside its own shard group —
+  // cross-shard work moves exclusively through the shard mailbox
+  // (shard.h), keeping each socket's lifecycle on its owning reactor
+  int self_shard = shard_of_worker(self->index);
   for (size_t i = 0; i < 2 * n; ++i) {
     TaskGroup* victim = g_control.groups[(seed + i) % n];
-    if (victim == self) {
+    if (victim == self ||
+        shard_of_worker(victim->index) != self_shard) {
       continue;
     }
     if (victim->rq.Steal(out)) {
@@ -241,6 +261,9 @@ bool steal_task(TaskGroup* self, fiber_t* out) {
   // remote queues
   for (size_t i = 0; i < n; ++i) {
     TaskGroup* victim = g_control.groups[(seed + i) % n];
+    if (shard_of_worker(victim->index) != self_shard) {
+      continue;
+    }
     std::lock_guard<std::mutex> lk(victim->remote_mu);
     if (!victim->remote_rq.empty()) {
       *out = victim->remote_rq.front();
@@ -304,9 +327,17 @@ void ready_to_run(TaskMeta* m) {
       if ((v & 3) == 0) {
         TaskGroup* target =
             g_control.groups[(v >> 2) % g_control.groups.size()];
-        std::lock_guard<std::mutex> lk(target->remote_mu);
-        target->remote_rq.push_back(m->tid());
-        g_control.pl.Signal(1);
+        {
+          std::lock_guard<std::mutex> lk(target->remote_mu);
+          target->remote_rq.push_back(m->tid());
+        }
+        // sharded: the detour may cross shard groups (deliberately — it
+        // exercises cross-shard handoff under perturbation), and only
+        // the target's group can consume it — wake everyone, like the
+        // bound push below
+        g_control.pl.Signal(g_nshards > 1
+                                ? (int)g_control.groups.size()
+                                : 1);
         return;
       }
     }
@@ -315,10 +346,30 @@ void ready_to_run(TaskMeta* m) {
       g->remote_rq.push_back(m->tid());
     }
   } else {
-    TaskGroup* target =
-        g_control.groups[fast_rand() % g_control.groups.size()];
-    std::lock_guard<std::mutex> lk(target->remote_mu);
-    target->remote_rq.push_back(m->tid());
+    // off-worker wake (timer thread, epoll dispatcher, uring engine,
+    // API callers): on a sharded runtime a fiber that already ran stays
+    // in ITS shard — a random group would migrate it across reactors on
+    // every such wake, leaking the shard-affinity invariant without a
+    // mailbox hop.  Fibers that never ran (fresh off-worker spawns)
+    // have no affinity and stay random.
+    TaskGroup* target;
+    if (g_nshards > 1 && m->last_group >= 0 &&
+        (size_t)m->last_group < g_control.groups.size()) {
+      target = g_control.groups[m->last_group];
+    } else {
+      target = g_control.groups[fast_rand() % g_control.groups.size()];
+    }
+    {
+      std::lock_guard<std::mutex> lk(target->remote_mu);
+      target->remote_rq.push_back(m->tid());
+    }
+    if (g_nshards > 1) {
+      // steal confinement means ONLY the target's shard group can run
+      // this fiber; a single wake could land on a worker that cannot
+      // see it (the bound-push stranding hazard) — wake them all
+      g_control.pl.Signal((int)g_control.groups.size());
+      return;
+    }
   }
   if (perturb &&
       (sched_perturb_next(SCHED_PP_PARK) & 7) == 0) {
@@ -456,6 +507,7 @@ void run_fiber(TaskGroup* g, fiber_t tid) {
     return;  // already finished (stale tid)
   }
   g->cur = m;
+  m->last_group = g->index;  // shard affinity for off-worker wakes
   // single-writer counter: plain load+store keeps the lock-prefixed RMW
   // off the context-switch hot path; stats reads stay race-free
   g->nswitch.store(g->nswitch.load(std::memory_order_relaxed) + 1,
@@ -791,6 +843,14 @@ int fiber_runtime_init(int num_workers) {
       num_workers = 4;
     }
   }
+  // shard partition: freeze the boot-time count and guarantee every
+  // shard at least one worker (a 1-core host forcing shards=4 runs
+  // oversubscribed — the structural-proof mode, ISSUE 7)
+  shard_freeze();
+  g_nshards = shard_count();
+  if (num_workers < g_nshards) {
+    num_workers = g_nshards;
+  }
   for (int i = 0; i < num_workers; ++i) {
     TaskGroup* g = new TaskGroup();
     g->index = i;
@@ -828,6 +888,7 @@ TaskMeta* fiber_create_common(FiberFn fn, void* arg) {
   m->bound = false;
   m->home_group = -1;
   m->jump_target = -1;
+  m->last_group = -1;  // pooled TaskMeta: clear the previous fiber's affinity
   m->stack = ObjectPool<StackMem>::Get();
   m->sp = tctx_make(m->stack->base, kStackSize, fiber_entry);
 #if defined(TRPC_ASAN)
@@ -937,6 +998,72 @@ int fiber_jump_group(int target_idx) {
 int fiber_worker_index() {
   TaskGroup* g = tls_group;
   return g != nullptr ? g->index : -1;
+}
+
+int fiber_shard_count() { return g_nshards; }
+
+int fiber_current_shard() {
+  TaskGroup* g = tls_group;
+  return g != nullptr ? shard_of_worker(g->index) : -1;
+}
+
+int fiber_worker_for_shard(int shard) {
+  size_t n = g_control.groups.size();
+  if (n == 0 || g_nshards <= 1) {
+    return n > 0 ? 0 : -1;
+  }
+  if (shard < 0 || shard >= g_nshards) {
+    return -1;
+  }
+  // workers of `shard` are {shard, shard + n_shards, ...}: round-robin
+  // within that arithmetic progression
+  size_t per = (n - (size_t)shard + (size_t)g_nshards - 1) /
+               (size_t)g_nshards;  // ceil((n - shard) / nshards)
+  static std::atomic<uint64_t> rr{0};
+  size_t i = per > 0
+                 ? (size_t)(rr.fetch_add(1, std::memory_order_relaxed) %
+                            (uint64_t)per)
+                 : 0;
+  return shard + (int)(i * (size_t)g_nshards);
+}
+
+int fiber_start_shard(int shard, fiber_t* out, FiberFn fn, void* arg) {
+  if (TRPC_UNLIKELY(!fiber_runtime_started())) {
+    fiber_runtime_init(0);
+  }
+  if (g_nshards <= 1) {
+    return fiber_start(out, fn, arg);  // unsharded: identical behavior
+  }
+  TaskGroup* g = tls_group;
+  if (g != nullptr && shard_of_worker(g->index) == shard) {
+    // already inside the shard: the plain local enqueue (steal
+    // confinement keeps it in the group)
+    return fiber_start(out, fn, arg);
+  }
+  int widx = fiber_worker_for_shard(shard);
+  if (widx < 0) {
+    return EINVAL;
+  }
+  TaskMeta* m = fiber_create_common(fn, arg);
+  if (m == nullptr) {
+    return ENOMEM;
+  }
+  if (out != nullptr) {
+    *out = m->tid();
+  }
+  TaskGroup* target = g_control.groups[(size_t)widx];
+  {
+    std::lock_guard<std::mutex> lk(target->remote_mu);
+    target->remote_rq.push_back(m->tid());
+  }
+  // only the target shard's group can consume this: wake-all (the bound
+  // push stranding hazard, see ready_to_run)
+  g_control.pl.Signal((int)g_control.groups.size());
+  if (TRPC_UNLIKELY(sched_perturb_enabled()) &&
+      sched_perturb_point(SCHED_PP_SPAWN)) {
+    std::this_thread::yield();  // see fiber_start's spawner pause
+  }
+  return 0;
 }
 
 int fiber_register_worker_hook(void (*fn)(void*, int), void* user) {

@@ -41,6 +41,16 @@ int fiber_start_bound(int group_idx, fiber_t* out, FiberFn fn, void* arg);
 int fiber_jump_group(int target_idx);
 // Index of the worker running the caller, -1 off-worker.
 int fiber_worker_index();
+// --- shard partition (shard.h, ISSUE 7) -------------------------------------
+// With shard_count() > 1 the workers split into groups: worker w belongs
+// to shard (w % n), stealing is confined to the group, and
+// fiber_start_shard places a fiber on a worker of the given shard (local
+// enqueue when the caller is already in it; stolen only within it).
+// With n == 1 everything below degenerates to the unsharded behavior.
+int fiber_shard_count();     // partition active on the runtime (1 = off)
+int fiber_current_shard();   // shard of the calling worker, -1 off-worker
+int fiber_worker_for_shard(int shard);  // rr within the shard's group
+int fiber_start_shard(int shard, fiber_t* out, FiberFn fn, void* arg);
 // Register fn(user, worker_idx), polled by idle workers before they
 // park — external event sources integrate without their own threads.
 // Max 8 hooks, never unregistered (process-lifetime modules).

@@ -1633,7 +1633,7 @@ void* h2_client_create_tls(const char* ip, int port,
     s->tls = tls_st;
     s->tls_checked = true;
     write_frames(s, hello);
-    EventDispatcher::Instance().AddConsumer(c->sock, fd);
+    EventDispatcher::Instance().AddConsumer(c->sock, fd, s->shard);
     s->Dereference();
   } else if (tls_st != nullptr) {
     tls_state_free(tls_st);

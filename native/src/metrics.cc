@@ -3,6 +3,7 @@
 #include <stdio.h>
 
 #include "sched_perturb.h"
+#include "shard.h"
 #include "tpu.h"
 
 namespace trpc {
@@ -113,6 +114,12 @@ size_t native_metrics_dump(char* buf, size_t cap) {
   put("tpu_zero_copy_sends", (long long)t.zero_copy_sends);
   put("tpu_live_buffers", (long long)t.live_buffers);
   put("tpu_errors", (long long)t.errors);
+  // per-shard agents folded at read time (shard.h): shard count, hop
+  // counter, and the per-shard accept/dispatch/ring/mailbox counters
+  off += shard_metrics_dump(buf + off, cap > off ? cap - off : 0);
+  if (off > cap) {
+    off = cap;
+  }
   return off;
 }
 

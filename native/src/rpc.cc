@@ -31,6 +31,7 @@
 #include "object_pool.h"
 #include "redis.h"
 #include "sched_perturb.h"
+#include "shard.h"
 #include "stream.h"
 #include "timer_thread.h"
 #include "tls.h"
@@ -709,9 +710,18 @@ class Server {
   // working beside them (≙ brpc serving SSL and plain on one port)
   void* tls_ctx = nullptr;
   std::string tls_verify_ca;  // mTLS CA, inherited by SNI sub-ctxs
-  int listen_fd = -1;
-  bool ring_acceptor = false;  // accepts flow through the io_uring engine
-  SocketId listen_sock = INVALID_SOCKET_ID;
+  // Listeners: one per shard with SO_REUSEPORT sharding (shard.h), else
+  // exactly one.  deque: accept callbacks hold stable pointers into it.
+  // `shard` is the accepted connections' owning shard; -1 = round-robin
+  // (single listener on a sharded runtime with TRPC_REUSEPORT=0).
+  struct Listener {
+    Server* srv = nullptr;
+    int shard = 0;
+    int fd = -1;
+    SocketId sock = INVALID_SOCKET_ID;
+    bool ring = false;  // accepts flow through the shard's io_uring engine
+  };
+  std::deque<Listener> listeners;
   int port = 0;
   std::atomic<bool> running{false};
   std::atomic<uint64_t> nrequests{0};
@@ -1226,6 +1236,8 @@ bool TryServeCachedHttp(Socket* s, Server* srv, const HttpRequest& req,
     return false;  // usercode path renders the same bytes
   }
   nm.inline_dispatch_hits.fetch_add(1, std::memory_order_relaxed);
+  shard_counters(s->shard).inline_hits.fetch_add(1,
+                                                 std::memory_order_relaxed);
   srv->nrequests.fetch_add(1, std::memory_order_relaxed);
   ConnState* cs = GetConnState(s);
   uint64_t seq;
@@ -1492,6 +1504,8 @@ void ServerOnMessages(Socket* s) {
           }
           if (budget.take()) {
             nm.inline_dispatch_hits.fetch_add(1, std::memory_order_relaxed);
+            shard_counters(s->shard).inline_hits.fetch_add(
+                1, std::memory_order_relaxed);
             IOBuf reply;
             RedisCacheExec(srv->redis_store, argv, &reply);
             ReleaseSequenced(s, rseq, std::move(reply), false);
@@ -1896,6 +1910,8 @@ void ServerOnMessages(Socket* s) {
         if (budget.take()) {
           native_metrics().inline_dispatch_hits.fetch_add(
               1, std::memory_order_relaxed);
+          shard_counters(s->shard).inline_hits.fetch_add(
+              1, std::memory_order_relaxed);
           RpcMeta rmeta;
           rmeta.correlation_id = meta.correlation_id;
           rmeta.flags = 1;  // response
@@ -1932,6 +1948,8 @@ void ServerOnMessages(Socket* s) {
         // messages then writing — syscall amortization is the
         // single-core win)
         native_metrics().inline_dispatch_hits.fetch_add(
+            1, std::memory_order_relaxed);
+        shard_counters(s->shard).inline_hits.fetch_add(
             1, std::memory_order_relaxed);
         RpcMeta rmeta;
         rmeta.correlation_id = meta.correlation_id;
@@ -2028,10 +2046,25 @@ void ServerConnFailed(Socket* s) {
 // One accepted fd -> a connection Socket wired to the parse path.  The
 // epoll acceptor AND the io_uring RingListener both land here; only the
 // readiness plumbing differs (AddConsumer vs multishot RECV).
-void ServerAdoptConnection(Server* srv, int fd) {
+// `listener_shard` pins the connection to the accepting listener's shard
+// (SO_REUSEPORT sharding); -1 = round-robin across shards.
+void ServerAdoptConnection(Server* srv, int fd, int listener_shard) {
   fd_set_nodelay(fd);
+  int shard = 0;
+  if (shard_count() > 1) {
+    // single-listener sharding (TRPC_REUSEPORT=0): adopted connections
+    // round-robin on a DEDICATED counter — the process-wide rr is shared
+    // with client dials, whose interleaving would skew the accept split
+    static std::atomic<uint64_t> adopt_rr{0};
+    shard = listener_shard >= 0
+                ? listener_shard
+                : (int)(adopt_rr.fetch_add(1, std::memory_order_relaxed) %
+                        (uint64_t)shard_count());
+  }
+  shard_counters(shard).accepts.fetch_add(1, std::memory_order_relaxed);
   SocketOptions opts;
   opts.fd = fd;
+  opts.shard = shard;
   opts.edge_fn = ServerOnMessages;
   opts.user = srv;
   opts.on_failed = ServerConnFailed;
@@ -2066,21 +2099,23 @@ void ServerAdoptConnection(Server* srv, int fd) {
       uring_add_recv(id, fd) == 0) {
     return;  // ring receives feed this socket; no epoll registration
   }
-  EventDispatcher::Instance().AddConsumer(id, fd);
+  EventDispatcher::Instance().AddConsumer(id, fd, shard);
 }
 
 void RingOnAccept(void* user, int fd) {
-  ServerAdoptConnection((Server*)user, fd);
+  Server::Listener* l = (Server::Listener*)user;
+  ServerAdoptConnection(l->srv, fd, l->shard);
 }
 
 void OnNewConnections(Socket* listen_s) {
+  Server::Listener* l = (Server::Listener*)listen_s->user;
   while (true) {
     int fd = accept4(listen_s->fd, nullptr, nullptr,
                      SOCK_NONBLOCK | SOCK_CLOEXEC);
     if (fd < 0) {
       return;  // EAGAIN or error: either way, wait for the next edge
     }
-    ServerAdoptConnection((Server*)listen_s->user, fd);
+    ServerAdoptConnection(l->srv, fd, l->shard);
   }
 }
 
@@ -2405,57 +2440,111 @@ int server_start(Server* s, const char* ip, int port) {
       return -e;
     }
     s->port = 0;
-    s->listen_fd = fd;
+    // unix sockets have no SO_REUSEPORT sharding: one listener; on a
+    // sharded runtime the adopted connections round-robin (shard = -1)
+    s->listeners.push_back(Server::Listener{
+        s, shard_count() > 1 ? -1 : 0, fd, INVALID_SOCKET_ID, false});
+    Server::Listener& l = s->listeners.back();
     SocketOptions opts;
     opts.fd = fd;
+    opts.shard = 0;
     opts.edge_fn = OnNewConnections;
-    opts.user = s;
-    if (Socket::Create(opts, &s->listen_sock) != 0) {
+    opts.user = &l;
+    if (Socket::Create(opts, &l.sock) != 0) {
       ::close(fd);
+      s->listeners.pop_back();
       return -ENOMEM;
     }
-    EventDispatcher::Instance().AddConsumer(s->listen_sock, fd);
+    EventDispatcher::Instance().AddConsumer(l.sock, fd, 0);
     s->running.store(true);
     return 0;
   }
-  int fd = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
-  if (fd < 0) {
-    return -errno;
+  // TCP: with a sharded runtime + TRPC_REUSEPORT (default), EVERY shard
+  // accepts on its own SO_REUSEPORT fd — the kernel hashes connections
+  // across the listeners, and each shard's accepts/reads/dispatch run on
+  // its own reactor (≙ the reference's per-EventDispatcher acceptors;
+  // "RPC Considered Harmful"'s per-core I/O partitioning).
+  int nshards = shard_count();
+  bool rp_shards = nshards > 1 && shard_reuseport_enabled();
+  int nlisten = rp_shards ? nshards : 1;
+  size_t first_listener = s->listeners.size();  // restart reuses the deque
+  for (int k = 0; k < nlisten; ++k) {
+    int fd =
+        ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
+    if (fd < 0) {
+      break;
+    }
+    fd_set_reuseaddr(fd);
+    if (rp_shards) {
+      int one = 1;
+      if (setsockopt(fd, SOL_SOCKET, SO_REUSEPORT, &one, sizeof(one)) !=
+          0) {
+        ::close(fd);
+        if (k == 0) {
+          // kernel/sandbox without SO_REUSEPORT: degrade to ONE plain
+          // listener with round-robin adoption (the TRPC_REUSEPORT=0
+          // shape) instead of failing the whole start
+          rp_shards = false;
+          nlisten = 1;
+          --k;
+          continue;
+        }
+        break;  // later listener: the bound ones still serve
+      }
+    }
+    sockaddr_in addr;
+    memset(&addr, 0, sizeof(addr));
+    addr.sin_family = AF_INET;
+    // listener 0 resolves an ephemeral port; the rest bind the SAME port
+    addr.sin_port = htons((uint16_t)(k == 0 ? port : s->port));
+    addr.sin_addr.s_addr = (ip == nullptr || ip[0] == '\0')
+                               ? htonl(INADDR_ANY)
+                               : inet_addr(ip);
+    if (bind(fd, (sockaddr*)&addr, sizeof(addr)) != 0 ||
+        listen(fd, 1024) != 0) {
+      int e = errno;
+      ::close(fd);
+      if (k == 0) {
+        return -e;  // the primary bind failing fails the start
+      }
+      break;  // partial sharding: the bound listeners still serve
+    }
+    if (k == 0) {
+      socklen_t alen = sizeof(addr);
+      getsockname(fd, (sockaddr*)&addr, &alen);
+      s->port = ntohs(addr.sin_port);
+    }
+    // single listener on a sharded runtime: adopted conns round-robin
+    int conn_shard = rp_shards ? k : (nshards > 1 ? -1 : 0);
+    s->listeners.push_back(
+        Server::Listener{s, conn_shard, fd, INVALID_SOCKET_ID, false});
+    Server::Listener& l = s->listeners.back();
+    int lshard = rp_shards ? k : 0;  // the listen fd's own reactor
+    SocketOptions opts;
+    opts.fd = fd;
+    opts.shard = lshard;
+    opts.edge_fn = OnNewConnections;
+    opts.user = &l;
+    if (Socket::Create(opts, &l.sock) != 0) {
+      ::close(fd);
+      s->listeners.pop_back();
+      if (k == 0) {
+        return -ENOMEM;
+      }
+      break;
+    }
+    if (uring_enabled() &&
+        uring_add_acceptor(l.sock, fd, RingOnAccept, &l, lshard) == 0) {
+      // RingListener mode: multishot ACCEPT completions adopt
+      // connections; the listen Socket exists only for stop/teardown
+      l.ring = true;
+    } else {
+      EventDispatcher::Instance().AddConsumer(l.sock, fd, lshard);
+    }
   }
-  fd_set_reuseaddr(fd);
-  sockaddr_in addr;
-  memset(&addr, 0, sizeof(addr));
-  addr.sin_family = AF_INET;
-  addr.sin_port = htons((uint16_t)port);
-  addr.sin_addr.s_addr = (ip == nullptr || ip[0] == '\0')
-                             ? htonl(INADDR_ANY)
-                             : inet_addr(ip);
-  if (bind(fd, (sockaddr*)&addr, sizeof(addr)) != 0 || listen(fd, 1024) != 0) {
-    int e = errno;
-    ::close(fd);
-    return -e;
+  if (s->listeners.size() == first_listener) {
+    return -EADDRNOTAVAIL;  // no listener came up
   }
-  socklen_t alen = sizeof(addr);
-  getsockname(fd, (sockaddr*)&addr, &alen);
-  s->port = ntohs(addr.sin_port);
-  s->listen_fd = fd;
-  SocketOptions opts;
-  opts.fd = fd;
-  opts.edge_fn = OnNewConnections;
-  opts.user = s;
-  if (Socket::Create(opts, &s->listen_sock) != 0) {
-    ::close(fd);
-    return -ENOMEM;
-  }
-  if (uring_enabled() &&
-      uring_add_acceptor(s->listen_sock, fd, RingOnAccept, s) == 0) {
-    // RingListener mode: multishot ACCEPT completions adopt connections;
-    // the listen Socket exists only for stop/teardown bookkeeping
-    s->ring_acceptor = true;
-    s->running.store(true);
-    return 0;
-  }
-  EventDispatcher::Instance().AddConsumer(s->listen_sock, fd);
   s->running.store(true);
   return 0;
 }
@@ -2466,19 +2555,26 @@ int server_stop(Server* s) {
   if (!s->running.exchange(false)) {
     return 0;
   }
-  if (s->ring_acceptor) {
-    // synchronous: the armed multishot ACCEPT holds a file reference
-    // (the port would stay bound past close) and its completions carry
-    // this Server* — neither may outlive stop
-    uring_remove_acceptor(s->listen_fd);
-    s->ring_acceptor = false;
+  for (Server::Listener& l : s->listeners) {
+    if (l.fd < 0) {
+      continue;  // torn down by an earlier stop (the deque is append-only)
+    }
+    if (l.ring) {
+      // synchronous: the armed multishot ACCEPT holds a file reference
+      // (the port would stay bound past close) and its completions carry
+      // this listener — neither may outlive stop
+      uring_remove_acceptor(l.fd, l.shard >= 0 ? l.shard : 0);
+      l.ring = false;
+    }
+    Socket* ls = Socket::Address(l.sock);
+    if (ls != nullptr) {
+      // listener teardown must be synchronous — the port must be unbound
+      // when stop returns (restart storms re-bind it immediately)
+      ls->SetFailed(TRPC_ESTOP);  // lint:allow-cross-shard (synchronous port release)
+      ls->Dereference();
+    }
+    l.fd = -1;
   }
-  Socket* ls = Socket::Address(s->listen_sock);
-  if (ls != nullptr) {
-    ls->SetFailed(TRPC_ESTOP);
-    ls->Dereference();
-  }
-  s->listen_fd = -1;
   return 0;
 }
 
@@ -2498,11 +2594,11 @@ void server_destroy(Server* s) {
     }
   }
   for (SocketId id : conns) {
-    Socket* cs = Socket::Address(id);
-    if (cs != nullptr) {
-      cs->SetFailed(TRPC_ESTOP);
-      cs->Dereference();
-    }
+    // control-plane teardown from a foreign thread: route each failure
+    // through the owning shard's mailbox (shard.h) — the WaitRecycled
+    // below still observes completion, it just arrives via the shard's
+    // consumer fiber.  shards=1 executes inline (identical to before).
+    shard_post_socket_failed(id, TRPC_ESTOP);
   }
   // Wait for each connection's generation to fully recycle — not merely
   // for Address() to fail (which happens at SetFailed, while processing
@@ -2510,7 +2606,9 @@ void server_destroy(Server* s) {
   for (SocketId id : conns) {
     Socket::WaitRecycled(id);
   }
-  Socket::WaitRecycled(s->listen_sock);
+  for (Server::Listener& l : s->listeners) {
+    Socket::WaitRecycled(l.sock);
+  }
   delete s->redis_store;
   delete s;
 }
@@ -3666,7 +3764,7 @@ Socket* DialConn(Channel* c, int* rc_out) {
   // server side: the TLS engine needs the fd)
   if (tls_st != nullptr || !uring_enabled() ||
       uring_add_recv(sid, fd) != 0) {
-    EventDispatcher::Instance().AddConsumer(sid, fd);
+    EventDispatcher::Instance().AddConsumer(sid, fd, snew->shard);
   }
   if (c->conn_type != 0) {
     // teardown bookkeeping (single-type teardown goes through the
@@ -4043,11 +4141,10 @@ void channel_destroy(Channel* c) {
     socks = c->all_socks;
   }
   for (SocketId sid : socks) {
-    Socket* s = Socket::Address(sid);
-    if (s != nullptr) {
-      s->SetFailed(TRPC_ESTOP);
-      s->Dereference();
-    }
+    // control-plane teardown from a foreign thread: hop to the socket's
+    // owning shard through the mailbox (shard.h; inline at shards=1) —
+    // the WaitRecycled below observes completion either way
+    shard_post_socket_failed(sid, TRPC_ESTOP);
   }
   // wait for full recycle so no fiber still references the pool
   // structures (a checked-out conn's release runs under its socket ref,

@@ -1,0 +1,315 @@
+// shard.cc — see shard.h.  The mailbox is a Treiber push stack (one
+// atomic exchange per post) reversed to FIFO by the consumer; the
+// consumer is a fiber BOUND to the shard's first worker (fiber.h
+// fiber_start_bound), so drains run inside the shard and can touch the
+// shard's sockets without further hops.
+#include "shard.h"
+
+#include <errno.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include <mutex>
+
+#include "fiber.h"
+#include "object_pool.h"
+#include "socket.h"
+
+namespace trpc {
+
+namespace {
+
+std::atomic<int> g_shard_count{-1};    // -1 = unresolved
+std::atomic<bool> g_frozen{false};
+std::atomic<int> g_reuseport{-1};      // -1 = unresolved
+std::atomic<uint64_t> g_rr{0};
+std::atomic<uint64_t> g_hops{0};
+
+int clamp_shards(long v) {
+  if (v < 1) {
+    return 1;
+  }
+  if (v > kMaxShards) {
+    return kMaxShards;
+  }
+  return (int)v;
+}
+
+int resolve_count() {
+  // flag-cached: the ONE env read; the resolved value lives in
+  // g_shard_count for the rest of the process
+  const char* e = getenv("TRPC_SHARDS");
+  int n = e != nullptr ? clamp_shards(strtol(e, nullptr, 10)) : 1;
+  int expected = -1;
+  g_shard_count.compare_exchange_strong(expected, n,
+                                        std::memory_order_acq_rel);
+  return g_shard_count.load(std::memory_order_acquire);
+}
+
+int resolve_reuseport() {
+  // flag-cached: resolved once into g_reuseport
+  const char* e = getenv("TRPC_REUSEPORT");
+  int on = (e == nullptr || e[0] != '0') ? 1 : 0;
+  int expected = -1;
+  g_reuseport.compare_exchange_strong(expected, on,
+                                      std::memory_order_acq_rel);
+  return g_reuseport.load(std::memory_order_acquire);
+}
+
+struct ShardTask {
+  void (*fn)(void*) = nullptr;
+  void* arg = nullptr;
+  ShardTask* next = nullptr;
+};
+
+struct ShardState {
+  std::atomic<ShardTask*> mailbox_head{nullptr};
+  Butex* wake = nullptr;  // created with the consumer
+  std::atomic<bool> consumer_up{false};
+  std::mutex start_mu;
+  ShardCounters counters;
+};
+
+ShardState g_shards[kMaxShards];
+
+void consumer_fiber(void* p) {
+  ShardState* st = (ShardState*)p;
+  while (true) {
+    int32_t v = butex_value(st->wake).load(std::memory_order_acquire);
+    ShardTask* h =
+        st->mailbox_head.exchange(nullptr, std::memory_order_acq_rel);
+    if (h != nullptr) {
+      // reverse the push stack to FIFO
+      ShardTask* fifo = nullptr;
+      while (h != nullptr) {
+        ShardTask* next = h->next;
+        h->next = fifo;
+        fifo = h;
+        h = next;
+      }
+      while (fifo != nullptr) {
+        ShardTask* t = fifo;
+        fifo = t->next;
+        t->fn(t->arg);
+        t->fn = nullptr;
+        t->arg = nullptr;
+        t->next = nullptr;
+        ObjectPool<ShardTask>::Return(t);
+      }
+      st->counters.mailbox_drains.fetch_add(1, std::memory_order_relaxed);
+      continue;  // drain until empty before parking
+    }
+    // park: a producer that pushed after our exchange also bumped the
+    // butex after our snapshot, so the wait returns immediately
+    butex_wait(st->wake, v, -1);
+  }
+}
+
+// Start shard's consumer (idempotent).  False when the fiber runtime is
+// not up or the bound spawn failed — the caller then executes inline.
+bool ensure_consumer(int shard) {
+  ShardState& st = g_shards[shard];
+  if (st.consumer_up.load(std::memory_order_acquire)) {
+    return true;
+  }
+  if (!fiber_runtime_started()) {
+    return false;
+  }
+  std::lock_guard<std::mutex> lk(st.start_mu);
+  if (st.consumer_up.load(std::memory_order_acquire)) {
+    return true;
+  }
+  if (st.wake == nullptr) {
+    st.wake = butex_create();
+  }
+  int w = fiber_worker_for_shard(shard);
+  fiber_t f;
+  if (w < 0 || fiber_start_bound(w, &f, consumer_fiber, &st) != 0) {
+    return false;
+  }
+  st.consumer_up.store(true, std::memory_order_release);
+  return true;
+}
+
+struct FailArg {
+  uint64_t id;
+  int err;
+};
+
+void run_socket_failed(void* p) {
+  FailArg* a = (FailArg*)p;
+  Socket* s = Socket::Address((SocketId)a->id);
+  if (s != nullptr) {
+    s->SetFailed(a->err);
+    s->Dereference();
+  }
+  ObjectPool<FailArg>::Return(a);
+}
+
+}  // namespace
+
+int shard_set_count(int n) {
+  if (g_frozen.load(std::memory_order_acquire)) {
+    return -EBUSY;
+  }
+  g_shard_count.store(clamp_shards(n), std::memory_order_release);
+  return 0;
+}
+
+int shard_count() {
+  int v = g_shard_count.load(std::memory_order_acquire);
+  if (TRPC_UNLIKELY(v < 0)) {
+    v = resolve_count();
+  }
+  return v;
+}
+
+void shard_freeze() {
+  (void)shard_count();  // resolve before locking further sets out
+  g_frozen.store(true, std::memory_order_release);
+}
+
+int shard_set_reuseport(int on) {
+  if (g_frozen.load(std::memory_order_acquire)) {
+    return -EBUSY;
+  }
+  g_reuseport.store(on != 0 ? 1 : 0, std::memory_order_release);
+  return 0;
+}
+
+bool shard_reuseport_enabled() {
+  int v = g_reuseport.load(std::memory_order_acquire);
+  if (TRPC_UNLIKELY(v < 0)) {
+    v = resolve_reuseport();
+  }
+  return v != 0;
+}
+
+int current_shard() {
+  int n = shard_count();
+  if (n <= 1) {
+    return 0;
+  }
+  return fiber_current_shard();
+}
+
+int shard_assign_rr() {
+  int n = shard_count();
+  if (n <= 1) {
+    return 0;
+  }
+  return (int)(g_rr.fetch_add(1, std::memory_order_relaxed) % (uint64_t)n);
+}
+
+int shard_post(int shard, void (*fn)(void*), void* arg) {
+  int n = shard_count();
+  if (shard < 0 || shard >= n) {
+    shard = 0;
+  }
+  if (current_shard() != shard) {
+    g_hops.fetch_add(1, std::memory_order_relaxed);
+  }
+  if (n <= 1 || !ensure_consumer(shard)) {
+    // unsharded runtime (or pre-runtime boot): behavior-identical inline
+    // execution — no mailbox machinery exists at shards=1
+    fn(arg);
+    return 0;
+  }
+  ShardState& st = g_shards[shard];
+  ShardTask* t = ObjectPool<ShardTask>::Get();
+  t->fn = fn;
+  t->arg = arg;
+  // Treiber push: newest-first; the consumer reverses to FIFO
+  ShardTask* head = st.mailbox_head.load(std::memory_order_relaxed);
+  do {
+    t->next = head;
+  } while (!st.mailbox_head.compare_exchange_weak(
+      head, t, std::memory_order_acq_rel, std::memory_order_relaxed));
+  st.counters.mailbox_posts.fetch_add(1, std::memory_order_relaxed);
+  butex_value(st.wake).fetch_add(1, std::memory_order_release);
+  butex_wake_all(st.wake);
+  return 0;
+}
+
+void shard_post_socket_failed(uint64_t socket_id, int err) {
+  int n = shard_count();
+  if (n <= 1) {
+    Socket* s = Socket::Address((SocketId)socket_id);
+    if (s != nullptr) {
+      s->SetFailed(err);  // lint:allow-cross-shard (shards=1: no foreign shard exists)
+      s->Dereference();
+    }
+    return;
+  }
+  int owner = 0;
+  {
+    Socket* s = Socket::Address((SocketId)socket_id);
+    if (s == nullptr) {
+      return;  // already failed/recycled
+    }
+    owner = s->shard;
+    if (current_shard() == owner) {
+      s->SetFailed(err);  // lint:allow-cross-shard (owner-shard caller: direct is the fast path)
+      s->Dereference();
+      return;
+    }
+    s->Dereference();
+  }
+  FailArg* a = ObjectPool<FailArg>::Get();
+  a->id = socket_id;
+  a->err = err;
+  shard_post(owner, run_socket_failed, a);
+}
+
+ShardCounters& shard_counters(int shard) {
+  if (shard < 0 || shard >= kMaxShards) {
+    shard = 0;
+  }
+  return g_shards[shard].counters;
+}
+
+uint64_t cross_shard_hops() {
+  return g_hops.load(std::memory_order_relaxed);
+}
+
+size_t shard_metrics_dump(char* buf, size_t cap) {
+  size_t off = 0;
+  auto put = [&](const char* name, int idx, const char* field,
+                 unsigned long long v) {
+    int nn;
+    if (idx < 0) {
+      nn = snprintf(buf + off, off < cap ? cap - off : 0, "%s %llu\n",
+                    name, v);
+    } else {
+      nn = snprintf(buf + off, off < cap ? cap - off : 0,
+                    "native_shard%d_%s %llu\n", idx, field, v);
+    }
+    if (nn > 0) {
+      off += (size_t)nn;
+      if (off > cap) {
+        off = cap;
+      }
+    }
+  };
+  int n = shard_count();
+  put("native_shard_count", -1, nullptr, (unsigned long long)n);
+  put("native_cross_shard_hops", -1, nullptr,
+      (unsigned long long)cross_shard_hops());
+  for (int k = 0; k < n; ++k) {
+    ShardCounters& c = g_shards[k].counters;
+    auto rd = [](const std::atomic<uint64_t>& a) {
+      return (unsigned long long)a.load(std::memory_order_relaxed);
+    };
+    put(nullptr, k, "accepts", rd(c.accepts));
+    put(nullptr, k, "dispatches", rd(c.dispatches));
+    put(nullptr, k, "ring_cqes", rd(c.ring_cqes));
+    put(nullptr, k, "mailbox_posts", rd(c.mailbox_posts));
+    put(nullptr, k, "mailbox_drains", rd(c.mailbox_drains));
+    put(nullptr, k, "inline_hits", rd(c.inline_hits));
+    put(nullptr, k, "cork_flushes", rd(c.cork_flushes));
+  }
+  return off;
+}
+
+}  // namespace trpc

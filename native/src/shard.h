@@ -1,0 +1,92 @@
+// shard.h — multi-reactor runtime sharding (ROADMAP Open item 1; ≙ the
+// reference running N EventDispatchers + bthread workers per machine,
+// event_dispatcher_epoll.cpp event_dispatcher_num, and "RPC Considered
+// Harmful"'s per-core I/O partitioning argument).
+//
+// Model: TRPC_SHARDS=<n> (or trpc_set_shards before the runtime starts)
+// splits the runtime into n independent reactors.  Each shard owns
+//   * one io_uring engine (uring.cc RingEngine::Shard) or one epoll
+//     dispatcher thread (socket.cc EventDispatcher, shard-pinned epfd),
+//   * a SO_REUSEPORT listener (rpc.cc server_start) accepting on its own
+//     fd, and
+//   * a slice of the fiber workers (fiber.cc: worker w belongs to shard
+//     w % n; stealing is confined to the shard's group).
+// A socket is tagged with its owning shard at Create; its whole
+// parse→dispatch→respond lifecycle stays there, so the PR-3/5
+// run-to-completion and corking fast paths work unchanged per shard.
+//
+// Cross-shard operations are RARE by design (naming/LB updates, foreign
+// SetFailed, teardown, bvar folds) and go through a lock-free MPSC
+// mailbox per shard: producers push with one atomic exchange, a
+// shard-pinned consumer fiber drains FIFO.  native_cross_shard_hops
+// counts them — the echo path must keep it near zero.
+//
+// shards=1 (the default) is wire- and behavior-identical to the
+// pre-shard runtime: no mailbox fibers, no extra listeners, the same
+// fd-hashed epoll mapping, shard_post executes inline.
+#pragma once
+
+#include <atomic>
+#include <cstddef>
+#include <cstdint>
+
+namespace trpc {
+
+constexpr int kMaxShards = 8;
+
+// Boot-time shard count.  Resolution order: trpc_set_shards() before the
+// fiber runtime starts, else the TRPC_SHARDS env var (read once), else 1.
+// Frozen by the first fiber_runtime_init; later set calls return -EBUSY.
+int shard_set_count(int n);
+int shard_count();
+void shard_freeze();  // called by fiber_runtime_init
+
+// SO_REUSEPORT listener sharding gate (TRPC_REUSEPORT, default on).  Off
+// with shards>1: one listener, accepted connections round-robin across
+// shards instead of kernel-hashing to per-shard listeners.
+int shard_set_reuseport(int on);
+bool shard_reuseport_enabled();
+
+// Shard of the calling context: the worker's shard on a fiber worker,
+// -1 on foreign threads (control plane, ring engines, timer thread).
+int current_shard();
+
+// Round-robin shard for a socket created off-worker (client dials from
+// pthreads, single-listener accepts when reuseport is off).
+int shard_assign_rr();
+
+// --- cross-shard mailbox (lock-free MPSC) ----------------------------------
+
+// Run fn(arg) on `shard`'s consumer fiber, FIFO per shard.  With
+// shards=1 (or before the fiber runtime starts) fn runs inline on the
+// caller — behavior-identical to the unsharded runtime.  Posts from a
+// context outside the target shard count into native_cross_shard_hops.
+// Returns 0; never drops a task (the mailbox is unbounded).
+int shard_post(int shard, void (*fn)(void*), void* arg);
+
+// Fail a socket from a foreign shard through its owner's mailbox — the
+// sanctioned cross-shard mutation path (tools/lint.py `crossshard` rule).
+// Same-shard (and shards=1) callers run SetFailed directly.  Async when
+// it hops: best-effort like any remote close — a socket recycled before
+// the task drains is a no-op (stale-id Address).
+void shard_post_socket_failed(uint64_t socket_id, int err);
+
+// --- per-shard agents folded at read time (≙ bvar per-cpu agents) ----------
+
+struct ShardCounters {
+  std::atomic<uint64_t> accepts{0};        // connections adopted
+  std::atomic<uint64_t> dispatches{0};     // input events dispatched
+  std::atomic<uint64_t> ring_cqes{0};      // uring CQEs drained
+  std::atomic<uint64_t> mailbox_posts{0};  // tasks posted to this shard
+  std::atomic<uint64_t> mailbox_drains{0}; // consumer drain rounds
+  std::atomic<uint64_t> inline_hits{0};    // PR-3 run-to-completion hits
+  std::atomic<uint64_t> cork_flushes{0};   // PR-3/5 cork doorbell flushes
+};
+ShardCounters& shard_counters(int shard);
+uint64_t cross_shard_hops();
+
+// "name value\n" lines (native_shard_count, native_cross_shard_hops,
+// per-shard counters), appended to native_metrics_dump.
+size_t shard_metrics_dump(char* buf, size_t cap);
+
+}  // namespace trpc

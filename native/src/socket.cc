@@ -15,6 +15,7 @@
 
 #include "metrics.h"
 #include "sched_perturb.h"
+#include "shard.h"
 #include "tls.h"
 #include "uring.h"
 #include "object_pool.h"
@@ -44,6 +45,15 @@ int Socket::Create(const SocketOptions& opts, SocketId* id_out) {
   }
   s->slot = slot;
   s->fd = opts.fd;
+  // shard affinity: explicit from the caller (listener shard), else the
+  // creating context's shard (client dials on a worker), else rr.  With
+  // shards=1 everything resolves to 0 — the pre-shard behavior.
+  if (opts.shard >= 0 && opts.shard < shard_count()) {
+    s->shard = opts.shard;
+  } else {
+    int cur = current_shard();
+    s->shard = cur >= 0 ? cur : shard_assign_rr();
+  }
   s->edge_fn = opts.edge_fn;
   s->user = opts.user;
   s->on_failed = opts.on_failed;
@@ -176,7 +186,7 @@ void Socket::TryRecycle(uint32_t odd_ver) {
 #endif
   }
   if (fd >= 0) {
-    EventDispatcher::Instance().RemoveConsumer(fd);
+    EventDispatcher::Instance().RemoveConsumer(fd, shard);
     ::close(fd);
     fd = -1;
   }
@@ -220,6 +230,8 @@ void Socket::SetFailed(int err) {
     WriteRequest* req = cork_anchor;
     cork_anchor = nullptr;
     native_metrics().batch_cork_flushes.fetch_add(
+        1, std::memory_order_relaxed);
+    shard_counters(shard).cork_flushes.fetch_add(
         1, std::memory_order_relaxed);
     // bounded inline drain (RunKeepWrite's absorb/release protocol minus
     // the blocking waits — SetFailed must stay prompt): push what the
@@ -281,7 +293,7 @@ void Socket::SetFailed(int err) {
   }
   error_code = err;
   if (ring_feed != nullptr) {
-    uring_cancel(id());  // stop the multishot recv promptly
+    uring_cancel(id(), shard);  // stop the multishot recv promptly
   }
   native_metrics().socket_failures.fetch_add(1, std::memory_order_relaxed);
   if (err == TRPC_EREQUEST) {
@@ -444,9 +456,14 @@ void Socket::StartInputEvent(SocketId id) {
   }
   if (s->nevent.fetch_add(1, std::memory_order_acq_rel) == 0) {
     // first event: spawn the processing fiber (it re-Addresses by id, so a
-    // socket recycled in between is caught by its own version check)
+    // socket recycled in between is caught by its own version check).
+    // Sharded: the fiber lands on the socket's owning shard group — the
+    // whole parse→dispatch→respond chain stays on one reactor.
+    shard_counters(s->shard).dispatches.fetch_add(
+        1, std::memory_order_relaxed);
     fiber_t f;
-    if (fiber_start(&f, ProcessEventFiber, (void*)(uintptr_t)id) != 0) {
+    if (fiber_start_shard(s->shard, &f, ProcessEventFiber,
+                          (void*)(uintptr_t)id) != 0) {
       s->nevent.store(0, std::memory_order_release);
     }
   }
@@ -565,6 +582,8 @@ void Socket::Uncork() {
   cork_anchor = nullptr;
   native_metrics().batch_cork_flushes.fetch_add(1,
                                                 std::memory_order_relaxed);
+  shard_counters(shard).cork_flushes.fetch_add(1,
+                                               std::memory_order_relaxed);
   OwnerFlush(req);
 }
 
@@ -700,7 +719,8 @@ void Socket::RunKeepWrite(WriteRequest* req) {
                       uring_sendzc_forced();
       if (route_ok && uring_egress_ready()) {
         size_t batch_bytes = merged.size();
-        SendTicket* t = uring_sendzc_submit(s->id(), s->fd, &merged);
+        SendTicket* t =
+            uring_sendzc_submit(s->id(), s->fd, &merged, s->shard);
         if (t != nullptr) {
           while (t->state.load(std::memory_order_acquire) == 0) {
             if (s->failed.load(std::memory_order_acquire) &&
@@ -756,9 +776,11 @@ void Socket::RunKeepWrite(WriteRequest* req) {
         // arm EPOLLOUT and wait for writability (or failure)
         int32_t w = butex_value(s->epollout_butex)
                         .load(std::memory_order_acquire);
-        EventDispatcher::Instance().RegisterEpollOut(s->id(), s->fd);
+        EventDispatcher::Instance().RegisterEpollOut(s->id(), s->fd,
+                                                     s->shard);
         butex_wait(s->epollout_butex, w, 1000 * 1000);
-        EventDispatcher::Instance().UnregisterEpollOut(s->id(), s->fd);
+        EventDispatcher::Instance().UnregisterEpollOut(s->id(), s->fd,
+                                                       s->shard);
         continue;
       }
       if (n < 0 && errno == EINTR) {
@@ -819,6 +841,16 @@ void EventDispatcher::Start(int nthreads) {
   if (nthreads <= 0) {
     nthreads = 1;
   }
+  // sharded runtime: one epoll instance per shard minimum, and fds map
+  // by their socket's shard instead of the fd hash — each reactor's
+  // readiness events arrive on its own dispatcher thread
+  int ns = shard_count();
+  if (ns > 1) {
+    sharded_ = true;
+    if (nthreads < ns) {
+      nthreads = ns;
+    }
+  }
   if (nthreads > kMaxEpollThreads) {
     nthreads = kMaxEpollThreads;
   }
@@ -833,41 +865,45 @@ void EventDispatcher::Start(int nthreads) {
 }
 
 // fd -> epoll instance: deterministic so Remove/Register find the same
-// epfd without a lookup table.
-int EventDispatcher::EpfdFor(int fd) const {
+// epfd without a lookup table.  Sharded runtime: the socket's shard IS
+// the instance (callers pass the same shard for add and remove).
+int EventDispatcher::EpfdFor(int fd, int shard) const {
+  if (sharded_ && shard >= 0) {
+    return epfds_[(unsigned)shard % (unsigned)nepfd_];
+  }
   return epfds_[(unsigned)fd % (unsigned)nepfd_];
 }
 
-int EventDispatcher::AddConsumer(SocketId id, int fd) {
+int EventDispatcher::AddConsumer(SocketId id, int fd, int shard) {
   Start(g_event_dispatcher_num.load(std::memory_order_relaxed));
   epoll_event ev;
   memset(&ev, 0, sizeof(ev));
   ev.events = EPOLLIN | EPOLLET;
   ev.data.u64 = id;
-  return epoll_ctl(EpfdFor(fd), EPOLL_CTL_ADD, fd, &ev);
+  return epoll_ctl(EpfdFor(fd, shard), EPOLL_CTL_ADD, fd, &ev);
 }
 
-int EventDispatcher::RemoveConsumer(int fd) {
+int EventDispatcher::RemoveConsumer(int fd, int shard) {
   if (nepfd_ == 0) {
     return -1;
   }
-  return epoll_ctl(EpfdFor(fd), EPOLL_CTL_DEL, fd, nullptr);
+  return epoll_ctl(EpfdFor(fd, shard), EPOLL_CTL_DEL, fd, nullptr);
 }
 
-int EventDispatcher::RegisterEpollOut(SocketId id, int fd) {
+int EventDispatcher::RegisterEpollOut(SocketId id, int fd, int shard) {
   epoll_event ev;
   memset(&ev, 0, sizeof(ev));
   ev.events = EPOLLIN | EPOLLOUT | EPOLLET;
   ev.data.u64 = id;
-  return epoll_ctl(EpfdFor(fd), EPOLL_CTL_MOD, fd, &ev);
+  return epoll_ctl(EpfdFor(fd, shard), EPOLL_CTL_MOD, fd, &ev);
 }
 
-int EventDispatcher::UnregisterEpollOut(SocketId id, int fd) {
+int EventDispatcher::UnregisterEpollOut(SocketId id, int fd, int shard) {
   epoll_event ev;
   memset(&ev, 0, sizeof(ev));
   ev.events = EPOLLIN | EPOLLET;
   ev.data.u64 = id;
-  return epoll_ctl(EpfdFor(fd), EPOLL_CTL_MOD, fd, &ev);
+  return epoll_ctl(EpfdFor(fd, shard), EPOLL_CTL_MOD, fd, &ev);
 }
 
 void EventDispatcher::Loop(int epfd) {

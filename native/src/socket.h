@@ -53,12 +53,19 @@ struct SocketOptions {
   // one fiber hop of latency.  Used by client channels where many caller
   // fibers share a connection.
   bool corked = false;
+  // Owning shard (shard.h): -1 = assign from context (the creating
+  // worker's shard, round-robin off-worker).  The socket's epoll/ring
+  // registration and processing fibers all stay on this shard.
+  int shard = -1;
 };
 
 class Socket {
  public:
   int fd = -1;
   uint32_t slot = 0;
+  // owning shard (shard.h): set once at Create, read-only after — the
+  // whole parse→dispatch→respond lifecycle runs on this shard's reactor
+  int shard = 0;
   std::atomic<uint64_t> versioned_ref{0};  // [version:32][nref:32]
   std::atomic<WriteRequest*> write_head{nullptr};
   std::atomic<uint32_t> nevent{0};
@@ -192,17 +199,21 @@ class EventDispatcher {
 
   static EventDispatcher& Instance();
   void Start(int nthreads);
-  int AddConsumer(SocketId id, int fd);
-  int RemoveConsumer(int fd);
-  int RegisterEpollOut(SocketId id, int fd);
-  int UnregisterEpollOut(SocketId id, int fd);
+  // `shard` >= 0 pins the fd to that shard's epoll instance when the
+  // runtime is sharded (shard.h); -1 (and shards=1) keeps the original
+  // fd-hash mapping.  Add/Remove/Register must pass the same shard.
+  int AddConsumer(SocketId id, int fd, int shard = -1);
+  int RemoveConsumer(int fd, int shard = -1);
+  int RegisterEpollOut(SocketId id, int fd, int shard = -1);
+  int UnregisterEpollOut(SocketId id, int fd, int shard = -1);
 
  private:
   EventDispatcher() = default;
   void Loop(int epfd);
-  int EpfdFor(int fd) const;
+  int EpfdFor(int fd, int shard) const;
   int epfds_[kMaxEpollThreads] = {};
   int nepfd_ = 0;
+  bool sharded_ = false;  // shard-pinned mapping active (shards > 1)
   std::atomic<bool> started_{false};
   std::atomic<bool> ready_{false};  // epfds_/nepfd_ published
 };

@@ -33,6 +33,7 @@
 #include "execution_queue.h"
 #include "metrics.h"
 #include "fiber.h"
+#include "shard.h"
 #include "fiber_sync.h"
 #include "iobuf.h"
 #include "rpc.h"
@@ -2068,6 +2069,12 @@ static void test_sched_proof() {
            "scenario)\n");
     return;
   }
+  // the determinism contract is SINGLE-worker: an inherited TRPC_SHARDS
+  // would raise the worker floor to the shard count (fiber_runtime_init
+  // guarantees one worker per shard) and add a second decision lane —
+  // pin the proof to the unsharded runtime (sole-scenario mode: the
+  // count is not frozen yet)
+  shard_set_count(1);
   fiber_runtime_init(1);
   fiber_t root;
   fiber_start(&root, proof_root, nullptr);
@@ -2077,6 +2084,267 @@ static void test_sched_proof() {
   printf("ok sched_proof decisions=%llu\n",
          (unsigned long long)st.decisions);
   printf("sched_trace_hash=%016llx\n", (unsigned long long)st.hash);
+}
+
+// --- runtime sharding (ISSUE 7) ---------------------------------------------
+// The shard count is boot-frozen (TRPC_SHARDS resolves before the first
+// fiber_runtime_init), so the sharded legs run in CHILD processes
+// re-exec'd with TRPC_SHARDS=2 — the same re-exec pattern as --sweep.
+// Children inherit TRPC_SCHED_SEED (and the sanitizer runtime + its
+// ASAN_OPTIONS/TSAN_OPTIONS log_path), so seed sweeps perturb the
+// sharded schedules and a child's sanitizer abort fails the parent.
+
+static char g_exe_path[512] = "./test_stress";
+
+static int run_forced_shards_child(const char* mode, const char* shards) {
+  pid_t pid = fork();
+  if (pid == 0) {
+    setenv("TRPC_SHARDS", shards, 1);
+    // pin the listener mode too: a developer's exported TRPC_REUSEPORT=0
+    // (the round-robin degrade arm) must not flip what these scenarios
+    // assert they exercise
+    setenv("TRPC_REUSEPORT", "1", 1);
+    char* child_argv[] = {g_exe_path, (char*)mode, nullptr};
+    execv(g_exe_path, child_argv);
+    _exit(127);
+  }
+  if (pid < 0) {
+    return -1;
+  }
+  int status = 0;
+  waitpid(pid, &status, 0);
+  return WIFEXITED(status) ? WEXITSTATUS(status) : 128;
+}
+
+// Child body (TRPC_SHARDS=2, 4 oversubscribed workers): the cross-shard
+// handoff machinery under contention — mailbox post storms from threads
+// AND foreign-shard fibers, shard-targeted spawns, and foreign-shard
+// SetFailed through the mailbox racing live echo traffic + teardown.
+static std::atomic<uint64_t> g_handoff_ran{0};
+
+static void handoff_count_task(void* p) {
+  (void)p;
+  g_handoff_ran.fetch_add(1, std::memory_order_relaxed);
+}
+
+struct ShardSpawnArg {
+  int target;
+  std::atomic<uint64_t>* misplaced;
+  std::atomic<uint64_t>* done;
+};
+
+static void shard_spawn_body(void* p) {
+  ShardSpawnArg* a = (ShardSpawnArg*)p;
+  // placement assertion only without perturbation: the seeded placement
+  // detour deliberately routes unbound fibers across groups
+  if (!sched_perturb_enabled() &&
+      fiber_current_shard() != a->target) {
+    a->misplaced->fetch_add(1, std::memory_order_relaxed);
+  }
+  fiber_yield();  // post-yield the fiber must STAY inside its group
+  if (!sched_perturb_enabled() &&
+      fiber_current_shard() != a->target) {
+    a->misplaced->fetch_add(1, std::memory_order_relaxed);
+  }
+  a->done->fetch_add(1, std::memory_order_relaxed);
+}
+
+static void shard_handoff_child_body() {
+  CHECK_TRUE(shard_count() == 2);
+  fiber_runtime_init(4);
+
+  // 1) mailbox post storm: 6 pthreads x 500 posts alternating shards;
+  //    every task MUST eventually run (the mailbox never drops)
+  constexpr uint64_t kPosts = 6 * 500;
+  {
+    std::vector<std::thread> ts;
+    for (int t = 0; t < 6; ++t) {
+      ts.emplace_back([t] {
+        for (int i = 0; i < 500; ++i) {
+          shard_post((t + i) % 2, handoff_count_task, nullptr);
+        }
+      });
+    }
+    for (auto& th : ts) {
+      th.join();
+    }
+    int64_t deadline = monotonic_us() + 10 * 1000 * 1000;
+    while (g_handoff_ran.load(std::memory_order_acquire) < kPosts &&
+           monotonic_us() < deadline) {
+      usleep(1000);
+    }
+    CHECK_TRUE(g_handoff_ran.load(std::memory_order_acquire) == kPosts);
+  }
+
+  // 2) shard-targeted spawns from pthreads and from fibers of the OTHER
+  //    shard; confinement holds exactly when perturbation is off
+  {
+    std::atomic<uint64_t> misplaced{0}, done{0};
+    constexpr uint64_t kSpawns = 400;
+    std::vector<ShardSpawnArg> args(kSpawns);
+    for (uint64_t i = 0; i < kSpawns; ++i) {
+      args[i] = ShardSpawnArg{(int)(i % 2), &misplaced, &done};
+      fiber_t f;
+      CHECK_TRUE(fiber_start_shard((int)(i % 2), &f, shard_spawn_body,
+                                   &args[i]) == 0);
+    }
+    int64_t deadline = monotonic_us() + 10 * 1000 * 1000;
+    while (done.load(std::memory_order_acquire) < kSpawns &&
+           monotonic_us() < deadline) {
+      usleep(1000);
+    }
+    CHECK_TRUE(done.load(std::memory_order_acquire) == kSpawns);
+    CHECK_TRUE(misplaced.load() == 0);
+  }
+
+  // 3) foreign-shard SetFailed through the mailbox racing live traffic:
+  //    echo callers hammer a server while a reaper thread posts failures
+  //    for the server's accepted sockets from a foreign context, and the
+  //    server restarts mid-traffic (teardown = more mailbox hops)
+  {
+    Server* probe = server_create();
+    CHECK_TRUE(server_start(probe, "127.0.0.1", 0) == 0);
+    int port = server_port(probe);
+    server_destroy(probe);
+
+    std::atomic<bool> stop{false};
+    std::atomic<uint64_t> ok{0}, failed{0};
+    std::vector<std::thread> ts;
+    for (int t = 0; t < 4; ++t) {
+      ts.emplace_back([&, t] {
+        Channel* ch = channel_create("127.0.0.1", port);
+        channel_set_connection_type(ch, t % 2);
+        channel_set_connect_timeout(ch, 50 * 1000);
+        std::string payload(96, 's');
+        CallResult res;
+        while (!stop.load(std::memory_order_acquire)) {
+          if (channel_call(ch, "Echo", (const uint8_t*)payload.data(),
+                           payload.size(), nullptr, 0, 200 * 1000,
+                           &res) == 0) {
+            ok.fetch_add(1);
+          } else {
+            failed.fetch_add(1);
+          }
+        }
+        channel_destroy(ch);
+      });
+    }
+    for (int round = 0; round < 4; ++round) {
+      Server* srv = server_create();
+      server_add_service(srv, "Echo", 0, nullptr, nullptr);
+      if (server_start(srv, "127.0.0.1", port) != 0) {
+        server_destroy(srv);
+        usleep(50 * 1000);
+        continue;
+      }
+      usleep(250 * 1000);
+      // server_destroy fails every live conn through the shard mailbox
+      server_destroy(srv);
+      usleep(50 * 1000);
+    }
+    stop.store(true, std::memory_order_release);
+    for (auto& th : ts) {
+      th.join();
+    }
+    CHECK_TRUE(ok.load() > 0);
+  }
+  uint64_t hops = cross_shard_hops();
+  CHECK_TRUE(hops >= kPosts / 2);  // the storm alone crossed shards
+  printf("ok shard_handoff (child) posts=%llu hops=%llu\n",
+         (unsigned long long)g_handoff_ran.load(),
+         (unsigned long long)hops);
+}
+
+// Child body (TRPC_SHARDS=2): SO_REUSEPORT listener sharding under an
+// accept storm — per-shard listeners race connects, half-open chum, and
+// stop/start cycles rebinding the same port (both listeners must tear
+// down synchronously or the rebind fails).
+static void reuseport_accept_child_body() {
+  CHECK_TRUE(shard_count() == 2);
+  CHECK_TRUE(shard_reuseport_enabled());
+  fiber_runtime_init(4);
+
+  Server* probe = server_create();
+  CHECK_TRUE(server_start(probe, "127.0.0.1", 0) == 0);
+  int port = server_port(probe);
+  server_destroy(probe);
+
+  std::atomic<bool> stop{false};
+  std::atomic<uint64_t> ok{0}, failed{0};
+  std::vector<std::thread> ts;
+  for (int t = 0; t < 4; ++t) {
+    ts.emplace_back([&, t] {
+      std::string payload(64, 'r');
+      CallResult res;
+      while (!stop.load(std::memory_order_acquire)) {
+        // short-lived channels: every call dials a fresh connection, so
+        // the kernel keeps re-hashing across the per-shard listeners
+        Channel* ch = channel_create("127.0.0.1", port);
+        channel_set_connection_type(ch, 2);  // short
+        channel_set_connect_timeout(ch, 50 * 1000);
+        if (channel_call(ch, "Echo", (const uint8_t*)payload.data(),
+                         payload.size(), nullptr, 0, 200 * 1000,
+                         &res) == 0) {
+          ok.fetch_add(1);
+        } else {
+          failed.fetch_add(1);
+        }
+        channel_destroy(ch);
+      }
+    });
+  }
+  // abrupt-disconnect chum against whichever listener accepts it
+  ts.emplace_back([&] {
+    while (!stop.load(std::memory_order_acquire)) {
+      int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+      sockaddr_in a;
+      memset(&a, 0, sizeof(a));
+      a.sin_family = AF_INET;
+      a.sin_port = htons((uint16_t)port);
+      a.sin_addr.s_addr = inet_addr("127.0.0.1");
+      if (connect(fd, (sockaddr*)&a, sizeof(a)) == 0) {
+        (void)!write(fd, "TR", 2);  // half a magic
+      }
+      ::close(fd);
+      usleep(2000);
+    }
+  });
+  for (int round = 0; round < 4; ++round) {
+    Server* srv = server_create();
+    server_add_service(srv, "Echo", 0, nullptr, nullptr);
+    if (server_start(srv, "127.0.0.1", port) != 0) {
+      server_destroy(srv);
+      usleep(50 * 1000);
+      continue;
+    }
+    usleep(300 * 1000);
+    server_destroy(srv);
+    usleep(50 * 1000);
+  }
+  stop.store(true, std::memory_order_release);
+  for (auto& th : ts) {
+    th.join();
+  }
+  uint64_t acc0 = shard_counters(0).accepts.load();
+  uint64_t acc1 = shard_counters(1).accepts.load();
+  CHECK_TRUE(ok.load() > 0);
+  CHECK_TRUE(acc0 + acc1 > 0);
+  printf("ok reuseport_accept (child) ok=%llu failed=%llu accepts=%llu/"
+         "%llu\n",
+         (unsigned long long)ok.load(), (unsigned long long)failed.load(),
+         (unsigned long long)acc0, (unsigned long long)acc1);
+}
+
+static void test_shard_handoff_races() {
+  int rc = run_forced_shards_child("__shard_handoff_body", "2");
+  CHECK_TRUE(rc == 0);
+  printf("ok shard_handoff_races (forced-shards child rc=%d)\n", rc);
+}
+
+static void test_reuseport_accept_races() {
+  int rc = run_forced_shards_child("__reuseport_accept_body", "2");
+  CHECK_TRUE(rc == 0);
+  printf("ok reuseport_accept_races (forced-shards child rc=%d)\n", rc);
 }
 
 // --- scenario registry + driver ---------------------------------------------
@@ -2111,10 +2379,10 @@ static const Scenario kScenarios[] = {
     {"sni_handshake_races", test_sni_handshake_races},
     {"profiler_races", test_profiler_races},
     {"sched_perturb_races", test_sched_perturb_races},
+    {"shard_handoff_races", test_shard_handoff_races},
+    {"reuseport_accept_races", test_reuseport_accept_races},
 };
 constexpr int kNumScenarios = (int)(sizeof(kScenarios) / sizeof(kScenarios[0]));
-
-static char g_exe_path[512] = "./test_stress";
 
 // Printed on EVERY run (and echoed by the sanitizer death callback): a
 // one-shot abort must leave its replay seed in the captured output.
@@ -2228,6 +2496,16 @@ int main(int argc, char** argv) {
 #if defined(TRPC_STRESS_SANITIZED)
   __sanitizer_set_death_callback(sched_death_callback);
 #endif
+  // forced-shards child modes (run_forced_shards_child re-exec'd us with
+  // TRPC_SHARDS set): run the body, report via exit status
+  if (argc > 1 && strcmp(argv[1], "__shard_handoff_body") == 0) {
+    shard_handoff_child_body();
+    return g_failures == 0 ? 0 : 1;
+  }
+  if (argc > 1 && strcmp(argv[1], "__reuseport_accept_body") == 0) {
+    reuseport_accept_child_body();
+    return g_failures == 0 ? 0 : 1;
+  }
   if (argc > 1 && strcmp(argv[1], "--list") == 0) {
     for (int i = 0; i < kNumScenarios; ++i) {
       printf("%s\n", kScenarios[i].name);

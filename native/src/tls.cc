@@ -232,10 +232,19 @@ bool load_ssl() {
     s.dso = dlopen("libssl.so", RTLD_NOW | RTLD_GLOBAL);
   }
   if (s.dso == nullptr) {
+    // OpenSSL 1.1 containers ship only the versioned soname (no -dev
+    // symlink); every symbol below exists in 1.1.1, so the engine runs
+    // unchanged there — LOAD still fails closed on anything older
+    s.dso = dlopen("libssl.so.1.1", RTLD_NOW | RTLD_GLOBAL);
+  }
+  if (s.dso == nullptr) {
     set_tls_error("libssl not found");
     return false;
   }
   s.crypto_dso = dlopen("libcrypto.so.3", RTLD_NOW | RTLD_GLOBAL);
+  if (s.crypto_dso == nullptr) {
+    s.crypto_dso = dlopen("libcrypto.so.1.1", RTLD_NOW | RTLD_GLOBAL);
+  }
   auto sym = [&](const char* name) -> void* {
     void* p = dlsym(s.dso, name);
     if (p == nullptr && s.crypto_dso != nullptr) {

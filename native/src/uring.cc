@@ -27,6 +27,7 @@
 
 #include "metrics.h"
 #include "sched_perturb.h"
+#include "shard.h"
 
 // --- uapi compat -----------------------------------------------------------
 // The engine tracks io_uring uapi newer than some build hosts ship in
@@ -166,10 +167,28 @@ struct Acceptor {
 
 class RingEngine {
  public:
-  static RingEngine* Instance() {
-    static RingEngine* e = new RingEngine();  // leaked on purpose
+  // One engine per shard (shard.h): shard 0 is the pre-shard singleton;
+  // the others come up lazily on first use.  Leaked on purpose.
+  static RingEngine* Shard(int k) {
+    static std::mutex mu;
+    static std::atomic<RingEngine*> engines[kMaxShards];
+    if (k < 0 || k >= shard_count()) {
+      k = 0;
+    }
+    RingEngine* e = engines[k].load(std::memory_order_acquire);
+    if (e != nullptr) {
+      return e;
+    }
+    std::lock_guard<std::mutex> lk(mu);
+    e = engines[k].load(std::memory_order_acquire);
+    if (e == nullptr) {
+      e = new RingEngine(k);
+      engines[k].store(e, std::memory_order_release);
+    }
     return e;
   }
+
+  static RingEngine* Instance() { return Shard(0); }
 
   bool ok() const { return ring_fd_ >= 0; }
 
@@ -205,7 +224,7 @@ class RingEngine {
   }
 
  private:
-  RingEngine() {
+  explicit RingEngine(int shard_idx) : shard_idx_(shard_idx) {
     // flag-cached: the ONE env read for debug logging — every later
     // site consults debug_ (a per-CQE getenv was a hot-path environ
     // scan, flagged by tools/lint.py)
@@ -280,6 +299,14 @@ class RingEngine {
       if (v >= 4096 && v <= (1ll << 30)) {
         zc_slot_size_ = (size_t)v;
       }
+    }
+    if (shard_idx_ != 0) {
+      // the zc landing-zone pool lives on shard 0 only: uring_zc_alloc
+      // callers are shard-blind, and pinning ~32MB per shard would
+      // multiply the footprint for a pool the d2h plane taps rarely.
+      // Shard>0 SEND_ZC still works — just without FIXED_BUF (ZcBufIndex
+      // returns -1 here).
+      zc_slots_ = 0;
     }
     size_t recv_sz = kNumBufs * kBufSize;
     size_t pool_sz = recv_sz + (size_t)zc_slots_ * zc_slot_size_;
@@ -393,11 +420,15 @@ class RingEngine {
         zc_free_.push_back(i);
       }
     }
-    native_metrics().uring_zc_pool_slots.store(zc_slots_,
-                                               std::memory_order_relaxed);
+    if (shard_idx_ == 0) {  // the pool (and its /vars gauge) is shard 0's
+      native_metrics().uring_zc_pool_slots.store(zc_slots_,
+                                                 std::memory_order_relaxed);
+    }
     SelfTestSendZc();
     std::thread t([this] {
-      pthread_setname_np(pthread_self(), "trpc_uring");
+      char name[16];
+      snprintf(name, sizeof(name), "trpc_uring%d", shard_idx_);
+      pthread_setname_np(pthread_self(), name);
       Loop();
     });
     t.detach();
@@ -924,6 +955,8 @@ class RingEngine {
       }
       while (head != tail && drain_budget-- != 0) {
         io_uring_cqe* cqe = &cqes_[head & cq_mask_];
+        shard_counters(shard_idx_).ring_cqes.fetch_add(
+            1, std::memory_order_relaxed);
         uint64_t tag = cqe->user_data & kTagMask;
         if (debug_) fprintf(stderr, "[uring] cqe ud=%llx res=%d flags=%x\n",
                             (unsigned long long)cqe->user_data, cqe->res,
@@ -970,6 +1003,7 @@ class RingEngine {
     }
   }
 
+  int shard_idx_ = 0;  // which shard's reactor this engine serves
   int ring_fd_ = -1;
   int event_fd_ = -1;
   uint64_t wake_buf_ = 0;
@@ -1137,14 +1171,14 @@ ssize_t ring_feed_drain(Socket* s, bool* eof) {
 }
 
 int uring_add_acceptor(SocketId id, int fd, void (*on_accept)(void*, int),
-                       void* user) {
+                       void* user, int shard) {
   (void)id;
   PendingOp op;
   op.kind = 0;
   op.fd = fd;
   op.on_accept = on_accept;
   op.user = user;
-  return RingEngine::Instance()->Add(op);
+  return RingEngine::Shard(shard)->Add(op);
 }
 
 int uring_add_recv(SocketId id, int fd) {
@@ -1152,6 +1186,7 @@ int uring_add_recv(SocketId id, int fd) {
   if (s == nullptr) {
     return -EINVAL;
   }
+  int shard = s->shard;  // the socket's owning reactor holds its recv
   if (s->ring_feed == nullptr) {
     s->ring_feed = new RingFeed();
   }
@@ -1160,21 +1195,21 @@ int uring_add_recv(SocketId id, int fd) {
   op.kind = 1;
   op.id = id;
   op.fd = fd;
-  return RingEngine::Instance()->Add(op);
+  return RingEngine::Shard(shard)->Add(op);
 }
 
-void uring_cancel(SocketId id) {
+void uring_cancel(SocketId id, int shard) {
   PendingOp op;
   op.kind = 2;
   op.id = id;
-  RingEngine::Instance()->Add(op);
+  RingEngine::Shard(shard)->Add(op);
 }
 
-void uring_remove_acceptor(int fd) {
+void uring_remove_acceptor(int fd, int shard) {
   PendingOp op;
   op.kind = 3;
   op.fd = fd;
-  RingEngine* e = RingEngine::Instance();
+  RingEngine* e = RingEngine::Shard(shard);
   if (e->Add(op) == 0) {
     // barrier: when this returns, no accept callback can fire for fd —
     // the Server that owned it may be freed right after
@@ -1245,11 +1280,12 @@ void SendTicket::Drop(SendTicket* t) {
   }
 }
 
-SendTicket* uring_sendzc_submit(SocketId id, int fd, IOBuf* data) {
+SendTicket* uring_sendzc_submit(SocketId id, int fd, IOBuf* data,
+                                int shard) {
   if (data->empty()) {
     return nullptr;
   }
-  RingEngine* e = RingEngine::Instance();
+  RingEngine* e = RingEngine::Shard(shard);
   if (!e->ok()) {
     return nullptr;
   }

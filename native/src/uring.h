@@ -62,21 +62,28 @@ ssize_t ring_feed_drain(Socket* s, bool* eof);
 // Free a RingFeed at socket recycle time (opaque to socket.cc).
 void ring_feed_release(void* feed);
 
-// Register a LISTENING socket: multishot-accept; each new fd is handed
-// to on_accept(user, fd).  Returns 0 or -errno.
+// Register a LISTENING socket: multishot-accept on `shard`'s ring; each
+// new fd is handed to on_accept(user, fd).  Returns 0 or -errno.
+// Sharded runtime (shard.h): every shard owns an independent ring engine
+// (own fd, SQ/CQ, pbuf pool, engine thread); shard 0 is the pre-shard
+// singleton, and shards>0 engines carry no zc landing-zone pool (the
+// d2h pool stays on shard 0 — uring_zc_alloc callers are shard-blind).
 int uring_add_acceptor(SocketId id, int fd, void (*on_accept)(void*, int),
-                       void* user);
+                       void* user, int shard = 0);
 
-// Register a CONNECTION socket for ring receives.  Allocates the
-// socket's RingFeed (freed on socket recycle).  Returns 0 or -errno.
+// Register a CONNECTION socket for ring receives on ITS OWNING SHARD's
+// ring.  Allocates the socket's RingFeed (freed on socket recycle).
+// Returns 0 or -errno.
 int uring_add_recv(SocketId id, int fd);
 
 // Cancel outstanding ops for this user_data owner (socket failed).
-void uring_cancel(SocketId id);
+// `shard` = the socket's owning shard (its ring holds the ops).
+void uring_cancel(SocketId id, int shard = 0);
 
-// Tear down a listener's multishot accept.  Synchronous: on return no
-// accept callback can fire for this fd (safe to free its Server).
-void uring_remove_acceptor(int fd);
+// Tear down a listener's multishot accept on `shard`'s ring.
+// Synchronous: on return no accept callback can fire for this fd (safe
+// to free its Server).
+void uring_remove_acceptor(int fd, int shard = 0);
 
 // --- zero-copy egress rail -------------------------------------------------
 
@@ -116,12 +123,14 @@ struct SendTicket {
   static void Drop(SendTicket* t);
 };
 
-// Submit `*data` for fd as one linked SQE chain.  On success *data is
-// consumed (its block refs stay held until every zerocopy notification
-// CQE lands) and the returned ticket completes when the whole batch is
-// on the wire — wait on it, read result, Drop it.  On nullptr *data is
-// untouched and the caller falls back to writev.
-SendTicket* uring_sendzc_submit(SocketId id, int fd, IOBuf* data);
+// Submit `*data` for fd as one linked SQE chain on `shard`'s ring.  On
+// success *data is consumed (its block refs stay held until every
+// zerocopy notification CQE lands) and the returned ticket completes
+// when the whole batch is on the wire — wait on it, read result, Drop
+// it.  On nullptr *data is untouched and the caller falls back to
+// writev.
+SendTicket* uring_sendzc_submit(SocketId id, int fd, IOBuf* data,
+                                int shard = 0);
 
 // Registered-buffer pool: fixed-size host slots registered with the
 // ring at engine bring-up.  nullptr when the pool is exhausted, the

@@ -25,7 +25,10 @@ def test_repo_is_lint_clean():
 
 
 _RPC_STUB = ("void ServerOnMessages(Socket* s) {\n}\n"
-             "void ChannelOnMessages(Socket* s) {\n}\n")
+             "void ChannelOnMessages(Socket* s) {\n}\n"
+             "int server_stop(Server* s) {\n  return 0;\n}\n"
+             "void server_destroy(Server* s) {\n}\n"
+             "void channel_destroy(Channel* c) {\n}\n")
 
 
 def _mini_repo(tmp_path, *, manifest="", cc="", stress="", rpc=_RPC_STUB,
@@ -110,6 +113,40 @@ def test_unregistered_races_scenario_fails(tmp_path):
     msgs = [v.message for v in run_lint(root) if v.rule == "scenarios"]
     assert any("test_orphan_races" in m and "not" in m for m in msgs), msgs
     assert any("test_missing_fn" in m for m in msgs), msgs
+
+
+def test_cross_shard_setfailed_fails(tmp_path):
+    """ISSUE 7 rule: a control-plane function mutating a socket with a
+    direct SetFailed (instead of the shard mailbox) is flagged; the
+    mailbox route and an annotated synchronous site pass."""
+    root = _mini_repo(tmp_path, rpc=textwrap.dedent("""\
+        void ServerOnMessages(Socket* s) {
+        }
+        void ChannelOnMessages(Socket* s) {
+        }
+        int server_stop(Server* s) {
+          ls->SetFailed(TRPC_ESTOP);
+          return 0;
+        }
+        void server_destroy(Server* s) {
+          shard_post_socket_failed(id, TRPC_ESTOP);
+        }
+        void channel_destroy(Channel* c) {
+          s->SetFailed(TRPC_ESTOP);  // lint:allow-cross-shard (audited)
+        }
+        """))
+    v = [x for x in run_lint(root) if x.rule == "crossshard"]
+    assert len(v) == 1 and v[0].line == 6, v
+    assert "shard_post_socket_failed" in v[0].message
+
+
+def test_cross_shard_region_rename_detected(tmp_path):
+    """Renaming a guarded control-plane function away must fail the
+    gate (a silently-vanished region guards nothing)."""
+    root = _mini_repo(tmp_path, rpc=_RPC_STUB.replace(
+        "channel_destroy", "channel_teardown"))
+    v = [x for x in run_lint(root) if x.rule == "crossshard"]
+    assert len(v) == 1 and "channel_destroy not found" in v[0].message, v
 
 
 def test_hot_path_allocation_fails(tmp_path):

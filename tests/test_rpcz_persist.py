@@ -1,0 +1,182 @@
+"""rpcz span persistence (ISSUE 7 satellite, VERDICT Missing #2):
+sampled spans spill through the Collector into rotated recordio files
+with a time-keyed index and age expiry (≙ the reference persisting spans
+via SpanDB/leveldb, span.cpp:476-494,672), and /rpcz?time= serves them
+back FROM DISK — so spans survive a restart (proven here with a real
+server in a subprocess writing the files and this process reading them
+over a second live server's portal)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from brpc_tpu.utils import flags
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _set(name, value):
+    old = flags.get_flag(name)
+    flags.set_flag(name, value)
+    return old
+
+
+@pytest.fixture()
+def persist_dir(tmp_path):
+    import brpc_tpu.rpc.span  # noqa: F401 — defines the rpcz_* flags
+    d = str(tmp_path / "rpcz")
+    olds = [("enable_rpcz", _set("enable_rpcz", True)),
+            ("rpcz_persist_dir", _set("rpcz_persist_dir", d))]
+    yield d
+    for name, old in olds:
+        flags.set_flag(name, old)
+
+
+def _collected_now() -> int:
+    from brpc_tpu.metrics.collector import global_collector
+    return global_collector().stats()["collected"]
+
+
+def _drain_collector(target: int, deadline_s=10.0):
+    """Wait until the Collector has PROCESSED `target` samples total
+    (``pending == 0`` alone races the in-flight batch)."""
+    from brpc_tpu.metrics.collector import global_collector
+    c = global_collector()
+    end = time.monotonic() + deadline_s
+    while time.monotonic() < end:
+        if c.stats()["collected"] >= target:
+            return
+        time.sleep(0.02)
+    raise AssertionError(
+        f"collector never reached {target}: {c.stats()}")
+
+
+def test_spans_spill_and_read_back(persist_dir):
+    from brpc_tpu.rpc import span
+
+    base = _collected_now()
+    for i in range(20):
+        s = span.start_span("server", f"Svc.m{i}")
+        assert s is not None
+        span.finish_span(s, 0)
+    _drain_collector(base + 20)
+    # the ring is NOT the read path: clear it, then read from disk
+    span.clear()
+    assert span.recent_spans(5) == []
+    got = span.read_persisted(time.time() + 1, limit=100)
+    assert len(got) == 20
+    assert got[0].start_ts >= got[-1].start_ts  # newest first
+    methods = {s.method for s in got}
+    assert "Svc.m0" in methods and "Svc.m19" in methods
+    # time-keyed: asking for a moment before the first span finds nothing
+    assert span.read_persisted(got[-1].start_ts - 10, limit=100) == []
+
+
+def test_rotation_writes_index_and_expiry_prunes(persist_dir):
+    from brpc_tpu.rpc import span
+
+    old_rotate = _set("rpcz_persist_rotate_bytes", 512)  # rotate fast
+    base = _collected_now()
+    try:
+        for i in range(50):
+            s = span.start_span("client", "Rot.m")
+            assert s is not None
+            s.annotate("x" * 64)  # fatten the record past the threshold
+            span.finish_span(s, 0)
+        _drain_collector(base + 50)
+    finally:
+        flags.set_flag("rpcz_persist_rotate_bytes", old_rotate)
+    idx = os.path.join(persist_dir, "index.txt")
+    assert os.path.exists(idx), "rotation never sealed a segment"
+    with open(idx) as f:
+        entries = [line.split() for line in f if line.strip()]
+    assert entries and all(len(e) == 4 for e in entries)
+    # sealed segments + maybe one active segment hold every span
+    got = span.read_persisted(time.time() + 1, limit=1000)
+    assert len(got) == 50
+    # time-keyed pruning actually prunes SEALED segments: asking for a
+    # moment before every span must read nothing (a sealed segment the
+    # index skips is NOT an orphan — regression for the dedup-set bug)
+    assert span.read_persisted(got[-1].start_ts - 10, limit=1000) == []
+    # expiry: with a 0s horizon every sealed segment ages out on read
+    old_exp = _set("rpcz_persist_expiry_s", 0)
+    try:
+        time.sleep(0.05)  # strictly age past the horizon
+        span.read_persisted(time.time() + 1, limit=1)
+        with open(idx) as f:
+            assert f.read().strip() == "", "expiry left sealed entries"
+        for e in entries:
+            assert not os.path.exists(os.path.join(persist_dir, e[0]))
+    finally:
+        flags.set_flag("rpcz_persist_expiry_s", old_exp)
+
+
+_CHILD = r"""
+import os, sys, time
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from brpc_tpu.utils import flags
+from brpc_tpu.rpc import span
+from brpc_tpu.rpc.server import Server
+from brpc_tpu.rpc.channel import Channel
+
+flags.set_flag("enable_rpcz", True)
+flags.set_flag("rpcz_persist_dir", {pdir!r})
+srv = Server()
+srv.add_echo_service()
+
+
+def handled(cntl, body):
+    return b"pong:" + body
+
+
+srv.add_service("Persist", handled)
+port = srv.start("127.0.0.1:0")
+ch = Channel(f"127.0.0.1:{{port}}")
+for i in range(8):
+    assert ch.call("Persist.hit", b"x%d" % i) == b"pong:x%d" % i
+ch.close()
+from brpc_tpu.metrics.collector import global_collector
+deadline = time.monotonic() + 10
+while global_collector().stats()["collected"] < 8 and \
+        time.monotonic() < deadline:
+    time.sleep(0.02)
+# flush the active segment so the next process can read the tail
+span._persister.read(time.time() + 1, 1)
+srv.destroy()
+print("CHILD_OK")
+"""
+
+
+def test_spans_survive_restart_via_portal(persist_dir):
+    """Real-restart proof: process A serves traffic and spills spans;
+    process B (this one) reads them through /rpcz?time= on a live
+    portal — the reference's 'spans outlive the server' property."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    r = subprocess.run(
+        [sys.executable, "-c",
+         _CHILD.format(repo=REPO, pdir=persist_dir)],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
+    assert "CHILD_OK" in r.stdout, r.stdout + r.stderr
+
+    from brpc_tpu.rpc.server import Server
+    srv = Server()
+    port = srv.start("127.0.0.1:0")
+    try:
+        url = (f"http://127.0.0.1:{port}/rpcz?time={time.time() + 1}"
+               f"&max_scan=100")
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            spans = json.loads(resp.read().decode())
+        methods = {s["method"] for s in spans}
+        assert "Persist.hit" in methods, spans
+        # restart-survival is the point: these spans were sampled by a
+        # process that no longer exists
+        assert any(s["kind"] == "server" for s in spans)
+    finally:
+        srv.destroy()

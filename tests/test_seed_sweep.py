@@ -38,6 +38,37 @@ def _build(flavor: str) -> str:
 
 @pytest.mark.slow
 @pytest.mark.parametrize("flavor", ["thread", "address"])
+def test_seed_sweep_sharded_handoffs(flavor):
+    """ISSUE 7 leg: >= 32 seeds over the runtime-sharding scenarios with
+    TRPC_SHARDS=2 forced on the sweep parent — schedule perturbation
+    then exercises the cross-shard mailbox, the SO_REUSEPORT accept
+    path, and the shard-confined stealing under seeded interleavings
+    (the scenario children force shards=2 themselves; the env makes the
+    PARENT gate runtime sharded too)."""
+    if os.environ.get("BRPC_TPU_SKIP_SANITIZERS"):
+        pytest.skip("sanitizer runs disabled by env")
+    exe = _build(flavor)
+    seeds = int(os.environ.get("BRPC_TPU_SEED_SWEEP_SEEDS", "32"))
+    base = int(os.environ.get("BRPC_TPU_SEED_SWEEP_BASE", "1"))
+    env = dict(os.environ)
+    env["TRPC_SHARDS"] = "2"
+    out = subprocess.run(
+        [exe, "--sweep", str(seeds), str(base),
+         "shard_handoff_races", "reuseport_accept_races"],
+        capture_output=True, text=True,
+        timeout=int(os.environ.get("BRPC_TPU_SEED_SWEEP_TIMEOUT", "5400")),
+        env=env)
+    hits = [int(m) for m in re.findall(r"SWEEP HIT seed=(\d+)", out.stdout)]
+    assert out.returncode == 0 and not hits, (
+        f"sharded sweep found schedule-dependent failures (seeds {hits}); "
+        f"replay: TRPC_SHARDS=2 TRPC_SCHED_SEED=<seed> {exe} "
+        f"shard_handoff_races reuseport_accept_races\n"
+        f"{out.stdout[-3000:]}")
+    assert f"sweep done: 0/{seeds}" in out.stdout, out.stdout[-2000:]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("flavor", ["thread", "address"])
 def test_seed_sweep_all_scenarios(flavor):
     """>= 32 seeds x the full scenario gate per sanitizer tree; every hit
     must replay from its seed (the acceptance criterion)."""

@@ -1,0 +1,278 @@
+"""Multi-reactor runtime sharding (ISSUE 7 tentpole, native/src/shard.h).
+
+Reference style (SURVEY §4): real loopback servers, raw sockets for the
+wire proofs, /vars over live HTTP for the counters.  The shard count is
+boot-frozen per process (TRPC_SHARDS resolves at the first fiber runtime
+init), so every forced-shards leg runs in a subprocess — the same
+A/B-by-subprocess shape as the TRPC_INLINE_DISPATCH wire proof.
+
+Hygiene under load (ISSUE 7 satellite): connection/call counts gate on
+the host's available cores, and every subprocess carries an explicit
+generous deadline — a 1-core host running shards=4 is deliberately
+oversubscribed (the structural-proof mode), not fast.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _ncpu() -> int:
+    return len(os.sched_getaffinity(0)) if hasattr(os, "sched_getaffinity") \
+        else (os.cpu_count() or 1)
+
+
+def _run_forced(shards: str, code: str, timeout: float = 180.0,
+                extra_env=None) -> str:
+    env = dict(os.environ)
+    env["TRPC_SHARDS"] = shards
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    if extra_env:
+        env.update(extra_env)
+    pre = ("import sys, os\n"
+           f"sys.path.insert(0, {REPO!r})\n"
+           "from brpc_tpu.rpc.server import Server\n"
+           "from brpc_tpu.rpc.channel import Channel\n")
+    r = subprocess.run([sys.executable, "-c", pre + code],
+                       capture_output=True, text=True, timeout=timeout,
+                       env=env, cwd=REPO)
+    assert r.returncode == 0, (f"shards={shards} child failed:\n"
+                               f"{r.stdout}\n{r.stderr}")
+    return r.stdout
+
+
+# Raw-socket pipelined echo burst -> per-correlation response frame hex.
+# Shared by every wire arm so the bytes are comparable across shard
+# counts (one connection: response order is request order regardless of
+# how many reactors the runtime runs).
+_WIRE_CODE = r"""
+import socket, struct
+srv = Server(); srv.add_echo_service(); srv.start("127.0.0.1:0")
+
+
+def tlv(tag, data):
+    return bytes([tag]) + struct.pack("<I", len(data)) + data
+
+
+s = socket.create_connection(("127.0.0.1", srv.port), timeout=30)
+burst = b""
+for i in range(12):
+    meta = tlv(1, b"Echo.echo") + tlv(2, struct.pack("<Q", 7000 + i))
+    payload = b"wire-proof-%03d" % i
+    burst += b"TRPC" + struct.pack(">II", len(meta), len(payload)) \
+        + meta + payload
+s.sendall(burst)
+buf = b""
+frames = []
+while len(frames) < 12:
+    while True:
+        if len(buf) >= 12:
+            ml, bl = struct.unpack(">II", buf[4:12])
+            if len(buf) >= 12 + ml + bl:
+                break
+        chunk = s.recv(65536)
+        assert chunk, "peer closed early"
+        buf += chunk
+    total = 12 + ml + bl
+    frames.append(buf[:total]); buf = buf[total:]
+s.close()
+for f in frames:
+    print("FRAME", f.hex())
+srv.destroy()
+"""
+
+
+def _wire_frames(shards: str) -> list:
+    out = _run_forced(shards, _WIRE_CODE, timeout=180.0)
+    return [line for line in out.splitlines() if line.startswith("FRAME ")]
+
+
+class TestShardWireAB:
+    def test_shards1_and_sharded_wire_identical(self):
+        """The acceptance A/B: shards=1 must be wire-identical to the
+        pre-shard runtime, and shards=2/4 must put the exact same
+        response bytes on one connection (per-socket shard affinity
+        keeps the PR-3 corked parse->respond path intact per shard)."""
+        base = _wire_frames("1")
+        assert len(base) == 12
+        assert _wire_frames("2") == base
+        if _ncpu() >= 2:
+            assert _wire_frames("4") == base
+        else:
+            # 1-core host: still FORCE the oversubscribed 4-shard leg —
+            # that is the ISSUE 7 structural proof — just once, on the
+            # smaller burst above
+            assert _wire_frames("4") == base
+
+
+_VARS_CODE = r"""
+import json, threading, urllib.request
+srv = Server(); srv.add_echo_service(); srv.start("127.0.0.1:0")
+import os
+ncpu = len(os.sched_getaffinity(0)) \
+    if hasattr(os, "sched_getaffinity") else (os.cpu_count() or 1)
+nconn = 32 if ncpu >= 2 else 16
+errs = []
+
+
+chans = []
+chans_mu = threading.Lock()
+
+
+def worker(i):
+    try:
+        # pooled: each channel dials its OWN connection (the default
+        # "single" type would SocketMap-share one socket across all 32
+        # workers and prove nothing about accept distribution).  Close
+        # happens AFTER the counter snapshot: channel teardown rides the
+        # shard mailbox by design and would show up as hops.
+        ch = Channel(f"127.0.0.1:{srv.port}", connection_type="pooled")
+        with chans_mu:
+            chans.append(ch)
+        for j in range(8):
+            assert ch.call("Echo.echo", b"v%d-%d" % (i, j),
+                           timeout_ms=30000) == b"v%d-%d" % (i, j)
+    except Exception as e:  # noqa: BLE001
+        errs.append(e)
+
+
+ts = [threading.Thread(target=worker, args=(i,)) for i in range(nconn)]
+[t.start() for t in ts]
+[t.join() for t in ts]
+assert not errs, errs
+
+
+def counters():
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/vars", timeout=30) as r:
+        txt = r.read().decode()
+    out = {}
+    for line in txt.splitlines():
+        parts = line.split(" : ")
+        if len(parts) == 2 and (parts[0].startswith("native_shard")
+                                or parts[0] == "native_cross_shard_hops"):
+            out[parts[0]] = int(parts[1])
+    return out
+
+
+c = counters()
+print("COUNTERS", json.dumps(c))
+print("NCONN", nconn)
+for ch in chans:
+    ch.close()
+srv.destroy()
+"""
+
+
+class TestShardedVars:
+    def test_vars_prove_partitioning_at_shards2(self):
+        """/vars acceptance: native_shard_count, per-shard accept +
+        dispatch counters actually spread, and native_cross_shard_hops
+        stays ZERO across the whole echo run (hops are reserved for
+        naming/teardown/aggregation)."""
+        out = _run_forced("2", _VARS_CODE, timeout=240.0)
+        c = json.loads(out.split("COUNTERS ", 1)[1].splitlines()[0])
+        nconn = int(out.split("NCONN ", 1)[1].splitlines()[0])
+        assert c["native_shard_count"] == 2
+        accepts = [c["native_shard0_accepts"], c["native_shard1_accepts"]]
+        # every accepted connection lands on exactly one shard; the /vars
+        # probe connection itself may add one
+        assert nconn <= sum(accepts) <= nconn + 2, c
+        # SO_REUSEPORT hashing across 16+ distinct 4-tuples: both
+        # listeners must see traffic (P[one-sided] ~ 2^-15 worst case)
+        assert all(a > 0 for a in accepts), c
+        assert c["native_shard0_dispatches"] > 0
+        assert c["native_shard1_dispatches"] > 0
+        # the headline invariant: zero hops on the request path
+        assert c["native_cross_shard_hops"] == 0, c
+
+
+_HOPS_CODE = r"""
+from brpc_tpu import fiber
+srv = Server(); srv.add_echo_service(); srv.start("127.0.0.1:0")
+chans = [Channel(f"127.0.0.1:{srv.port}", connection_type="pooled")
+         for _ in range(4)]
+for i, ch in enumerate(chans):
+    for j in range(10):
+        assert ch.call("Echo.echo", b"h%d-%d" % (i, j),
+                       timeout_ms=30000) == b"h%d-%d" % (i, j)
+traffic_hops = fiber.cross_shard_hops()
+# teardown WITH live connections: server_destroy fails each one through
+# its owning shard's mailbox — hops must now appear, and the destroy
+# (which WaitRecycles every socket) must complete: mailbox liveness
+srv.destroy()
+teardown_hops = fiber.cross_shard_hops()
+print("HOPS", traffic_hops, teardown_hops)
+for ch in chans:
+    ch.close()
+"""
+
+
+class TestCrossShardMailbox:
+    def test_hops_zero_under_traffic_then_teardown_uses_mailbox(self):
+        out = _run_forced("2", _HOPS_CODE, timeout=240.0)
+        line = [ln for ln in out.splitlines() if ln.startswith("HOPS ")][0]
+        traffic, teardown = (int(x) for x in line.split()[1:])
+        assert traffic == 0, f"echo path crossed shards: {out}"
+        assert teardown > traffic, \
+            "live-conn teardown never rode the shard mailbox"
+
+
+class TestShards1Default:
+    def test_default_runtime_is_unsharded(self):
+        """Without TRPC_SHARDS the runtime must stay at 1 shard, with
+        the mailbox machinery dormant (inline shard_post, no hops)."""
+        code = r"""
+from brpc_tpu import fiber
+srv = Server(); srv.add_echo_service(); srv.start("127.0.0.1:0")
+ch = Channel(f"127.0.0.1:{srv.port}")
+for i in range(5):
+    assert ch.call("Echo.echo", b"d%d" % i) == b"d%d" % i
+ch.close()
+assert fiber.shards() == 1, fiber.shards()
+srv.destroy()
+assert fiber.cross_shard_hops() == 0, fiber.cross_shard_hops()
+print("DEFAULT_OK")
+"""
+        env = dict(os.environ)
+        env.pop("TRPC_SHARDS", None)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        pre = ("import sys\n"
+               f"sys.path.insert(0, {REPO!r})\n"
+               "from brpc_tpu.rpc.server import Server\n"
+               "from brpc_tpu.rpc.channel import Channel\n")
+        r = subprocess.run([sys.executable, "-c", pre + code],
+                           capture_output=True, text=True, timeout=180,
+                           env=env, cwd=REPO)
+        assert r.returncode == 0 and "DEFAULT_OK" in r.stdout, \
+            r.stdout + r.stderr
+
+    def test_shards_flag_rejects_out_of_range(self):
+        from brpc_tpu.utils import flags
+        import brpc_tpu.rpc.server  # noqa: F401 — defines the flag
+        with pytest.raises(flags.FlagError):
+            flags.set_flag("shards", 0)
+        with pytest.raises(flags.FlagError):
+            flags.set_flag("shards", 99)
+
+
+class TestReuseportOff:
+    def test_single_listener_round_robins_conns(self):
+        """TRPC_REUSEPORT=0 with shards=2: one listener, adopted
+        connections round-robin across shards (both reactors still see
+        work — just without kernel accept hashing)."""
+        out = _run_forced("2", _VARS_CODE, timeout=240.0,
+                          extra_env={"TRPC_REUSEPORT": "0"})
+        c = json.loads(out.split("COUNTERS ", 1)[1].splitlines()[0])
+        assert c["native_shard_count"] == 2
+        # round-robin: the split is near-exact, not merely nonzero
+        a0, a1 = c["native_shard0_accepts"], c["native_shard1_accepts"]
+        assert a0 > 0 and a1 > 0 and abs(a0 - a1) <= 2, c
+        assert c["native_cross_shard_hops"] == 0, c

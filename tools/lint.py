@@ -29,6 +29,13 @@ Rules:
                functions (they must draw from the object pools, the PR-3
                invariant); legitimate seams escape with a
                `lint:allow-alloc(reason)` comment on the line.
+  crossshard   control-plane functions (server/channel stop + teardown —
+               code that runs on foreign threads, never on the socket's
+               owning shard) must not mutate a socket directly with
+               `SetFailed`: cross-shard mutations go through the shard
+               mailbox (`shard_post_socket_failed` / `shard_post`,
+               native/src/shard.h, ISSUE 7).  Audited synchronous sites
+               escape with `lint:allow-cross-shard (reason)` on the line.
 
 The checks are deliberately line-level heuristics, not a C++ parser: the
 escape annotations make intent explicit at the use site, which is the
@@ -63,6 +70,15 @@ _HOT_REGIONS = {
     "native/src/rpc.cc": ["ServerOnMessages", "ChannelOnMessages"],
     "native/src/socket.cc": ["WriteRaw", "ReadToBuf"],
 }
+
+# control-plane regions (foreign-thread callers): direct Socket mutation
+# here crosses shards — must ride the shard mailbox (shard.h).  Grown as
+# new control-plane teardown paths appear.
+_CROSS_SHARD_REGIONS = {
+    "native/src/rpc.cc": ["server_stop", "server_destroy",
+                          "channel_destroy"],
+}
+_SETFAILED_RE = re.compile(r"\bSetFailed\s*\(")
 
 _GETENV_RE = re.compile(r'getenv\(\s*"(TRPC_[A-Z0-9_]+)"')
 _LITERAL_RE = re.compile(r'"(TRPC_[A-Z0-9_]+)"')
@@ -282,6 +298,38 @@ def _check_allocations(root: str, violations: List[Violation]) -> None:
                         f"lint:allow-alloc(reason)"))
 
 
+def _check_cross_shard(root: str, violations: List[Violation]) -> None:
+    for rel, fns in _CROSS_SHARD_REGIONS.items():
+        path = os.path.join(root, rel)
+        if not os.path.exists(path):
+            continue
+        lines = _read_lines(path)
+        for fn in fns:
+            span = _function_body(lines, fn)
+            if span is None:
+                violations.append(Violation(
+                    "crossshard", rel, 0,
+                    f"control-plane function {fn} not found (update "
+                    f"tools/lint.py _CROSS_SHARD_REGIONS after renames)"))
+                continue
+            for i in range(span[0], span[1] + 1):
+                line = lines[i]
+                if "lint:allow-cross-shard" in line:
+                    continue
+                code = line.split("//", 1)[0]
+                if "shard_post_socket_failed" in code:
+                    continue  # the sanctioned mailbox route
+                if _SETFAILED_RE.search(code):
+                    violations.append(Violation(
+                        "crossshard", rel, i + 1,
+                        f"direct SetFailed in control-plane {fn}: a "
+                        f"foreign thread mutating a socket crosses "
+                        f"shards — route through "
+                        f"shard_post_socket_failed (shard.h), or escape "
+                        f"a deliberately-synchronous site with "
+                        f"lint:allow-cross-shard (reason)"))
+
+
 def run_lint(repo_root: str,
              reference_root: Optional[str] = None) -> List[Violation]:
     violations: List[Violation] = []
@@ -289,6 +337,7 @@ def run_lint(repo_root: str,
     _check_citations(repo_root, reference_root, violations)
     _check_scenarios(repo_root, violations)
     _check_allocations(repo_root, violations)
+    _check_cross_shard(repo_root, violations)
     return violations
 
 
