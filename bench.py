@@ -66,7 +66,81 @@ def main() -> int:
                     help="back-to-back client-cork A/B at the echo grid's "
                          "concurrency-256 config (one subprocess per arm: "
                          "TRPC_CLIENT_CORK=0 vs 1, --repeat honored)")
+    ap.add_argument("--codec-ab", action="store_true",
+                    help="payload-codec A/B (ISSUE 8): attachment GB/s "
+                         "sweep at 512KB/1MB/4MB per codec "
+                         "(none/snappy/bf16/int8; one subprocess per arm "
+                         "— TRPC_PAYLOAD_CODEC is resolved per process), "
+                         "per-row min/median/max over --repeat runs, "
+                         "plus the param-server allreduce example's "
+                         "codec legs")
+    ap.add_argument("--codec-skip-allreduce", action="store_true",
+                    help="with --codec-ab: skip the (slow, JAX) "
+                         "allreduce legs and sweep attachments only")
     args = ap.parse_args()
+
+    if args.codec_ab:
+        me = os.path.abspath(__file__)
+        reps = max(1, args.repeat)
+        codecs = ("none", "snappy", "bf16", "int8")
+        table = {}
+        for size in (512 << 10, 1 << 20, 4 << 20):
+            row = {}
+            for codec in codecs:
+                env = dict(os.environ)
+                env["TRPC_PAYLOAD_CODEC"] = codec
+                samples, good, errs = [], None, []
+                for _ in range(reps):
+                    try:
+                        r = subprocess.run(
+                            [sys.executable, me, "--attach-bytes",
+                             str(size)], capture_output=True, text=True,
+                            timeout=180, env=env)
+                        if r.returncode != 0:
+                            # a failed arm must NOT contribute a 0.0
+                            # sample that drags the band down silently
+                            raise RuntimeError(
+                                f"arm rc={r.returncode}: "
+                                f"{r.stderr[-200:]}")
+                        good = json.loads(
+                            r.stdout.strip().splitlines()[-1])
+                        samples.append(float(good["value"]))
+                    except Exception as e:  # noqa: BLE001 — arm -> err
+                        errs.append(str(e))
+                if samples:
+                    samples.sort()
+                    good["gbps_band"] = {
+                        "min": round(samples[0], 3),
+                        "median": round(samples[len(samples) // 2], 3),
+                        "max": round(samples[-1], 3)}
+                    if errs:
+                        good["failed_repeats"] = errs
+                    row[codec] = good
+                else:
+                    row[codec] = {"error": "; ".join(errs) or "no runs"}
+            table[str(size)] = row
+        out = {"metric": "codec_ab", "repeat": reps, "table": table}
+        if not args.codec_skip_allreduce:
+            # allreduce shapes (the 25.56M-param ResNet example) per
+            # codec: algbw + the asserted numeric error of the lossy leg
+            ex = os.path.join(os.path.dirname(me), "examples",
+                              "param_server_allreduce.py")
+            allreduce = {}
+            for codec in ("none", "int8", "bf16"):
+                try:
+                    r = subprocess.run(
+                        [sys.executable, ex, "--codec", codec],
+                        capture_output=True, text=True, timeout=600)
+                    j = json.loads(r.stdout.strip().splitlines()[-1])
+                    allreduce[codec] = {
+                        k: j.get(k) for k in
+                        ("allreduce_algbw_gbps", "allreduce_busbw_gbps",
+                         "codec_max_abs_err", "codec_err_bound")}
+                except Exception as e:  # noqa: BLE001 — leg -> error
+                    allreduce[codec] = {"error": str(e)}
+            out["allreduce"] = allreduce
+        print(json.dumps(out))
+        return 0
 
     if args.client_cork_ab:
         me = os.path.abspath(__file__)
@@ -186,9 +260,14 @@ def main() -> int:
                     "copies on this route)")
         return "sendzc"
 
+    codec_names = {0: "none", 1: "snappy", 2: "bf16", 3: "int8"}
+
     if args.attach_bytes > 0:
-        # single large-attachment run for the A/B harness: GB/s + which
-        # egress rail the bytes took + the rail's own accounting
+        # single large-attachment run for the A/B harness: EFFECTIVE GB/s
+        # (plain payload bytes moved per second — with a codec on, the
+        # wire carries fewer) + which egress rail the bytes took + the
+        # codec rail's own accounting (encoder-side bytes in/out = the
+        # wire saving)
         rc = L.trpc_run_echo_bench(b"127.0.0.1", port, 2, 16, 16,
                                    args.attach_bytes, 2.0, out)
         print(json.dumps({
@@ -197,6 +276,12 @@ def main() -> int:
             "qps": round(out[0], 1) if rc == 0 else 0.0,
             "attach_bytes": args.attach_bytes,
             "egress": egress_label(),
+            "payload_codec": codec_names.get(
+                int(L.trpc_payload_codec()), "?"),
+            "codec_encodes": native_counter("native_codec_encodes"),
+            "codec_decodes": native_counter("native_codec_decodes"),
+            "codec_bytes_in": native_counter("native_codec_bytes_in"),
+            "codec_bytes_out": native_counter("native_codec_bytes_out"),
             "sendzc_submitted": native_counter(
                 "native_uring_sendzc_submitted"),
             "sendzc_copied": native_counter("native_uring_sendzc_copied"),
@@ -313,6 +398,13 @@ def main() -> int:
             }
             for k in range(int(L.trpc_shard_count()))
         },
+        # payload-codec rail (ISSUE 8): bench-of-record runs none; the
+        # --codec-ab harness flips TRPC_PAYLOAD_CODEC per subprocess arm
+        "payload_codec": codec_names.get(int(L.trpc_payload_codec()), "?"),
+        "codec_encodes": native_counter("native_codec_encodes"),
+        "codec_decodes": native_counter("native_codec_decodes"),
+        "codec_bytes_in": native_counter("native_codec_bytes_in"),
+        "codec_bytes_out": native_counter("native_codec_bytes_out"),
         # schedule perturbation MUST be off (0) for bench-of-record: a
         # nonzero seed means the run measured the fuzzing mode, not the
         # runtime (BENCH_NOTES.md "Schedule replay")

@@ -130,6 +130,11 @@ def _declare(L: ctypes.CDLL) -> None:
     L.trpc_respond_compressed.restype = c.c_int
     L.trpc_token_compress.argtypes = [c.c_uint64]
     L.trpc_token_compress.restype = c.c_int
+    # pluggable-Authenticator surface (rpc/auth.py)
+    L.trpc_token_auth.argtypes = [c.c_uint64, c.c_char_p, c.c_size_t]
+    L.trpc_token_auth.restype = c.c_size_t
+    L.trpc_token_peer.argtypes = [c.c_uint64, c.c_char_p, c.c_size_t]
+    L.trpc_token_peer.restype = c.c_size_t
 
     # HTTP on the shared port
     L.trpc_server_set_http_handler.argtypes = [c.c_void_p, c.c_void_p,
@@ -245,6 +250,30 @@ def _declare(L: ctypes.CDLL) -> None:
     L.trpc_snappy_decompress.argtypes = [c.c_char_p, c.c_size_t, c.c_char_p,
                                          c.c_size_t]
     L.trpc_snappy_decompress.restype = c.c_size_t
+
+    # payload-codec rail (native/src/codec.h)
+    L.trpc_set_payload_codec.argtypes = [c.c_int]
+    L.trpc_set_payload_codec.restype = None
+    L.trpc_payload_codec.restype = c.c_int
+    L.trpc_set_codec_min_bytes.argtypes = [c.c_int64]
+    L.trpc_set_codec_min_bytes.restype = None
+    L.trpc_codec_id.argtypes = [c.c_char_p]
+    L.trpc_codec_id.restype = c.c_int
+    L.trpc_codec_name.argtypes = [c.c_int]
+    L.trpc_codec_name.restype = c.c_char_p
+    L.trpc_codec_encode.argtypes = [c.c_int, c.c_char_p, c.c_size_t,
+                                    c.POINTER(c.POINTER(c.c_uint8)),
+                                    c.POINTER(c.c_int)]
+    L.trpc_codec_encode.restype = c.c_int64
+    L.trpc_codec_decode.argtypes = [c.c_int, c.c_char_p, c.c_size_t,
+                                    c.POINTER(c.POINTER(c.c_uint8))]
+    L.trpc_codec_decode.restype = c.c_int64
+    L.trpc_codec_buf_free.argtypes = [c.POINTER(c.c_uint8)]
+    L.trpc_codec_buf_free.restype = None
+    L.trpc_codec_roundtrip_chained.argtypes = [c.c_int, c.c_char_p,
+                                               c.c_size_t, c.c_size_t,
+                                               c.POINTER(c.c_double)]
+    L.trpc_codec_roundtrip_chained.restype = c.c_int
 
     L.trpc_set_usercode_workers.argtypes = [c.c_int]
     L.trpc_set_usercode_workers.restype = None

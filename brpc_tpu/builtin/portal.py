@@ -113,12 +113,14 @@ def _fibers(req: HttpRequest) -> HttpResponse:
     })
 
 
-def _flags_service(req: HttpRequest,
-                   writable: bool = False) -> HttpResponse:
+def _flags_service(req: HttpRequest, writable: bool = False,
+                   require_admin: bool = False) -> HttpResponse:
     """GET /flags — list; GET /flags/<name> — one; ?setvalue=v — hot reload
     (≙ builtin/flags_service.cpp: live GET/SET of gflags; only reloadable
     flags accept a set, reloadable_flags.h).  Writes require
-    ServerOptions.builtin_writable."""
+    ServerOptions.builtin_writable — and, on a server with a pluggable
+    Authenticator, a verified AuthContext carrying the "admin" role
+    (rpc/auth.py): remote flag mutation is an identified action."""
     name = req.path[len("/flags"):].lstrip("/")
     params = req.query_params()
     if name and "setvalue" in params:
@@ -126,6 +128,14 @@ def _flags_service(req: HttpRequest,
             return HttpResponse.text(
                 "flag writes disabled (ServerOptions.builtin_writable)\n",
                 403)
+        if require_admin:
+            ctx = req.auth_context
+            if ctx is None or not getattr(ctx, "has_role",
+                                          lambda _r: False)("admin"):
+                return HttpResponse.text(
+                    "flag writes require an authenticated admin "
+                    "credential (Authorization header verified by the "
+                    "server's Authenticator with role 'admin')\n", 403)
         try:
             flags.set_flag(name, params["setvalue"])
         except Exception as e:
@@ -352,8 +362,11 @@ def install_builtin_services(server, dispatcher: HttpDispatcher) -> None:
     d.register("/metrics", _metrics)
     d.register("/fibers", _fibers)
     writable = bool(getattr(server.options, "builtin_writable", False))
-    d.register("/flags", lambda r: _flags_service(r, writable))
-    d.register("/flags/", lambda r: _flags_service(r, writable),
+    # a pluggable Authenticator upgrades /flags mutation to an
+    # identified action (verified AuthContext with role "admin")
+    need_admin = getattr(server.options, "authenticator", None) is not None
+    d.register("/flags", lambda r: _flags_service(r, writable, need_admin))
+    d.register("/flags/", lambda r: _flags_service(r, writable, need_admin),
                prefix=True)
     d.register("/hotspots", _hotspots)
     d.register("/pprof/profile", _pprof_profile)

@@ -77,6 +77,17 @@ class ClusterChannel:
                     getattr(self.options, "connection_type", "single"))
             return sub
 
+    def refresh_auth(self, cred: bytes) -> None:
+        """Push a rotated credential (rpc/auth.py time-boxed HMAC) into
+        every live member subchannel; new members pick it up from
+        options.auth at creation."""
+        from brpc_tpu._native import lib
+        with self._lock:
+            subs = list(self._subs.values())
+        for s in subs:
+            if getattr(s, "_handle", None):
+                lib().trpc_channel_set_auth(s._handle, cred, len(cred))
+
     def _breaker(self, node: ServerNode) -> CircuitBreaker:
         with self._lock:
             br = self._breakers.get(node)

@@ -168,6 +168,11 @@ class ParallelChannel:
                     or sc.payload != first_sc.payload
                     or sc.attachment != first_sc.attachment):
                 return False  # not a broadcast: nothing to share
+            # the fast path bypasses Channel.call, so time-boxed
+            # credentials must rotate HERE too (no-op without an
+            # authenticator) — a fanout-only workload otherwise starts
+            # failing EAUTH at max_skew_s
+            ch._maybe_refresh_credential()
             subs.append(ch._sub)
         timeout_ms = (cntl.timeout_ms if cntl.timeout_ms is not None
                       else self.timeout_ms)
@@ -633,23 +638,46 @@ class MeshParallelChannel:
     XLA collective riding ICI (reference lowering table, SURVEY §2.9:
     "AllGather/AllReduce fan-out+merge over ICI; merger = XLA reduction
     op").  merger: "add"/"max"/"min" → all_reduce; "concat" → all_gather.
+
+    `codec` ("none"/"int8"/"bf16", parallel/quantize.py) runs the reduce
+    leg lossy-but-bounded: each worker's shard is quantized with the
+    native payload-codec formats (codec.h) and the merge DEQUANTIZES-
+    THEN-REDUCES — the EQuARX-style quantized allreduce (arXiv
+    2506.17615) on this rail.  int8's per-worker bound is
+    max|block|/127; the n-way sum's bound is the per-worker bounds
+    added (quantize.int8_error_bound).
     """
 
-    def __init__(self, mesh, axis: str, merger: str = "add"):
-        from brpc_tpu.parallel import collectives
+    def __init__(self, mesh, axis: str, merger: str = "add",
+                 codec: str = "none"):
+        from brpc_tpu.parallel import collectives, quantize
         self._c = collectives
+        self._q = quantize
         self.mesh = mesh
         self.axis = axis
         if merger not in ("add", "max", "min", "concat"):
             raise ValueError(f"unknown merger {merger!r}")
+        if codec not in ("none", "int8", "bf16"):
+            raise ValueError(f"unknown codec {codec!r}")
+        if codec != "none" and merger != "add":
+            # the documented error bounds are ADDITIVE (per-worker
+            # bounds summed); they say nothing about max/min/concat —
+            # refuse rather than hand out an unbounded lossy merge
+            raise ValueError(
+                f"codec {codec!r} applies to merger='add' only (the "
+                f"quantize.int8_error_bound contract is additive)")
         self.merger = merger
+        self.codec = codec
 
     def channel_count(self) -> int:
         return self.mesh.shape[self.axis]
 
     def call_tensor(self, x):
         """The whole ParallelChannel.call, compiled: scatter is implicit in
-        the sharding, merge is the collective."""
+        the sharding, merge is the collective (dequantize-then-reduce
+        when a codec is set)."""
+        if self.codec != "none":
+            x = self._q.fake_quant(x, self.codec)
         if self.merger == "concat":
             return self._c.all_gather(self.mesh, self.axis, x)
         return self._c.all_reduce(self.mesh, self.axis, x, op=self.merger)

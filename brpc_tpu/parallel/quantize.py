@@ -1,0 +1,89 @@
+"""Quantized collective payloads — the tensor-rail mirror of the native
+payload-codec formats (native/src/codec.h; ISSUE 8 tentpole leg (b)).
+
+EQuARX (arXiv 2506.17615) shows quantized allreduce as a first-class
+XLA optimization; here the MeshParallelChannel reduce leg applies the
+SAME per-block int8 / bf16 formats the RPC rail puts on the wire, as
+pure-jnp fake-quantization: each worker's shard is quantized, the merge
+DEQUANTIZES-THEN-REDUCES (sum of dequantized shards), so the collective
+observes exactly what a wire hop through the codec would have delivered
+— lossy but bounded.
+
+Formats mirror codec.cc:
+  int8: per-block (256 floats) scale = max|block| / 127, round-to-
+        nearest, clamp to [-127, 127]; all-zero/denormal blocks emit
+        scale 0 and decode to exact zeros.
+        Per-element bound of one pass: |err| <= max|block| / 127.
+  bf16: round-to-nearest-even truncation to bfloat16.
+"""
+
+from __future__ import annotations
+
+BLOCK = 256  # floats per int8 scale block (== codec.h kInt8BlockFloats)
+
+
+def fake_quant_int8(x, block: int = BLOCK):
+    """dequantize(quantize(x)) along the LAST axis in `block`-float
+    groups — the tensor a peer would reconstruct after an int8 wire hop.
+    Shape/dtype preserved; elementwise+reshape only, so it composes with
+    sharded arrays (the per-shard values quantize independently of the
+    mesh layout, matching per-worker wire encoding)."""
+    import jax.numpy as jnp
+
+    orig_shape = x.shape
+    n = orig_shape[-1]
+    pad = (-n) % block
+    flat = x.reshape(*orig_shape[:-1], n)
+    if pad:
+        flat = jnp.pad(flat, [(0, 0)] * (len(orig_shape) - 1) + [(0, pad)])
+    blocks = flat.reshape(*orig_shape[:-1], (n + pad) // block, block)
+    maxabs = jnp.max(jnp.abs(blocks), axis=-1, keepdims=True)
+    scale = maxabs / 127.0
+    # scale==0 (all-zero / fully-denormal block): decode exact zeros
+    q = jnp.where(scale > 0.0,
+                  jnp.clip(jnp.round(blocks / jnp.where(scale > 0.0,
+                                                        scale, 1.0)),
+                           -127, 127),
+                  0.0)
+    dq = q * scale
+    out = dq.reshape(*orig_shape[:-1], n + pad)
+    if pad:
+        out = out[..., :n]
+    return out.astype(x.dtype)
+
+
+def fake_quant_bf16(x):
+    """dequantize(quantize(x)) through bfloat16 (round-to-nearest-even),
+    the tensor after a bf16 wire hop."""
+    import jax.numpy as jnp
+
+    return x.astype(jnp.bfloat16).astype(x.dtype)
+
+
+def fake_quant(x, codec: str, block: int = BLOCK):
+    """Apply the named codec's quantize→dequantize pass ("none" = x)."""
+    if codec in ("", "none"):
+        return x
+    if codec == "int8":
+        return fake_quant_int8(x, block)
+    if codec == "bf16":
+        return fake_quant_bf16(x)
+    raise ValueError(f"unknown tensor codec {codec!r} "
+                     f"(none/int8/bf16)")
+
+
+def int8_error_bound(x, block: int = BLOCK) -> float:
+    """Max per-element error of ONE int8 pass over x (max over blocks of
+    max|block|/127), as a python float.  For an n-way dequantize-then-
+    reduce SUM, per-worker bounds add."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    n = x.shape[-1]
+    pad = (-n) % block
+    flat = x
+    if pad:
+        flat = jnp.pad(x, [(0, 0)] * (len(x.shape) - 1) + [(0, pad)])
+    blocks = flat.reshape(*x.shape[:-1], (n + pad) // block, block)
+    return float(np.asarray(
+        jnp.max(jnp.max(jnp.abs(blocks), axis=-1)) / 127.0)) + 1e-30

@@ -9,3 +9,5 @@ from brpc_tpu.rpc.channel import Channel, ChannelOptions  # noqa: F401
 from brpc_tpu.rpc.server import Server, ServerOptions  # noqa: F401
 from brpc_tpu.rpc.stream import (  # noqa: F401
     Stream, StreamClosed, StreamReset, StreamTimeout)
+from brpc_tpu.rpc.auth import (  # noqa: F401
+    AuthContext, AuthError, Authenticator, HmacNonceAuthenticator)

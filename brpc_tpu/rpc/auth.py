@@ -1,0 +1,127 @@
+"""Pluggable authentication — Authenticator + AuthContext
+(≙ reference authenticator.h:30-75: the client's GenerateCredential
+writes an auth string into the first message of each connection; the
+server's VerifyCredential checks it and fills an AuthContext — user,
+group, roles, starter, is_service — that handlers read off the
+Controller).
+
+TPU-build mapping: the credential rides meta tag 13 on EVERY request
+(the native layer attaches it per channel, channel_set_auth), so
+"per-connection" generate happens once per Channel and verify runs per
+request on the usercode side (token_auth/token_peer surface the raw
+credential + peer address per token).  Cheap-verifier impls (HMAC) make
+per-request verify a non-issue; the verified AuthContext lands on
+``cntl.auth_context`` for TRPC handlers and ``request.auth_context``
+for HTTP handlers, and gates the portal's /flags mutation.
+
+The legacy static-token path (ServerOptions.auth bytes, compared
+natively before dispatch) is unchanged; an Authenticator replaces it
+with Python-side verification and a real identity.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac as _hmac
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+
+@dataclass
+class AuthContext:
+    """Verified identity of a request's sender (≙ AuthContext,
+    authenticator.h:30-54)."""
+    user: str = ""
+    group: str = ""
+    roles: Tuple[str, ...] = ()
+    starter: str = ""
+    is_service: bool = False
+    # where the credential came from (ip:port), for audit lines
+    client_addr: str = ""
+
+    def has_role(self, role: str) -> bool:
+        return role in self.roles
+
+
+class AuthError(Exception):
+    """Verification failed — the server answers EAUTH / HTTP 401."""
+
+
+class Authenticator:
+    """Interface (≙ Authenticator, authenticator.h:56-75).  Subclass and
+    pass to ServerOptions.authenticator / ChannelOptions.authenticator."""
+
+    def generate_credential(self) -> bytes:
+        """Client side: the credential attached to requests (meta tag 13).
+        Called once per Channel (the per-connection analog)."""
+        raise NotImplementedError
+
+    def verify_credential(self, auth: bytes,
+                          client_addr: str) -> AuthContext:
+        """Server side: verify and build the identity.  Raise
+        :class:`AuthError` to reject (the caller sees EAUTH)."""
+        raise NotImplementedError
+
+
+class HmacNonceAuthenticator(Authenticator):
+    """HMAC-of-nonce credential: ``hmac1 <user> <nonce> <mac>`` where
+    ``mac = HMAC_SHA256(secret, user + " " + nonce)`` and the nonce
+    carries the client's clock (ns) + 8 random bytes.  Verify recomputes
+    the MAC (constant-time compare) and bounds the clock skew, so a
+    captured credential cannot be replayed outside ``max_skew_s``.
+
+    One shared secret, many identities: the user/group/roles the client
+    CLAIMS are authenticated by the MAC (whoever holds the secret vouches
+    for them) — the reference's GenerateCredential embeds identity the
+    same way.
+    """
+
+    def __init__(self, secret: bytes, user: str = "anon",
+                 group: str = "", roles: Tuple[str, ...] = (),
+                 max_skew_s: float = 600.0):
+        if not secret:
+            raise ValueError("empty HMAC secret")
+        self.secret = secret
+        self.user = user
+        self.group = group
+        self.roles = tuple(roles)
+        self.max_skew_s = max_skew_s
+
+    def _mac(self, user: str, nonce: str, group: str,
+             roles_csv: str) -> str:
+        msg = " ".join((user, nonce, group, roles_csv)).encode()
+        return _hmac.new(self.secret, msg, hashlib.sha256).hexdigest()
+
+    def generate_credential(self) -> bytes:
+        nonce = f"{time.time_ns()}.{os.urandom(8).hex()}"
+        roles_csv = ",".join(self.roles)
+        mac = self._mac(self.user, nonce, self.group, roles_csv)
+        return " ".join(("hmac1", self.user, nonce, self.group or "-",
+                         roles_csv or "-", mac)).encode()
+
+    def verify_credential(self, auth: bytes,
+                          client_addr: str) -> AuthContext:
+        try:
+            parts = auth.decode("utf-8", "strict").split(" ")
+        except UnicodeDecodeError:
+            raise AuthError("malformed credential") from None
+        if len(parts) != 6 or parts[0] != "hmac1":
+            raise AuthError("malformed credential")
+        _, user, nonce, group, roles_csv, mac = parts
+        group = "" if group == "-" else group
+        roles_csv = "" if roles_csv == "-" else roles_csv
+        want = self._mac(user, nonce, group, roles_csv)
+        if not _hmac.compare_digest(mac, want):
+            raise AuthError("bad MAC")
+        try:
+            sent_ns = int(nonce.split(".", 1)[0])
+        except ValueError:
+            raise AuthError("malformed nonce") from None
+        if abs(time.time_ns() - sent_ns) > self.max_skew_s * 1e9:
+            raise AuthError("stale credential (replay window exceeded)")
+        return AuthContext(
+            user=user, group=group,
+            roles=tuple(r for r in roles_csv.split(",") if r),
+            client_addr=client_addr)

@@ -20,6 +20,8 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 from brpc_tpu._native import lib
 from brpc_tpu.metrics import bvar
+from brpc_tpu.rpc import codec as _codec  # noqa: F401 — registers the
+# payload_codec / codec_min_bytes flags (native/src/codec.h rail)
 from brpc_tpu.rpc import errors
 from brpc_tpu.rpc.controller import Controller
 from brpc_tpu.utils import flags
@@ -57,6 +59,11 @@ class ChannelOptions:
     # credential sent in every request meta (≙ ChannelOptions.auth +
     # Authenticator::GenerateCredential); verified natively by the server
     auth: Optional[bytes] = None
+    # pluggable credential source (rpc/auth.py Authenticator): when set
+    # (and `auth` is not), generate_credential() runs once per Channel —
+    # the per-connection analog — and the result rides meta tag 13; the
+    # server's Authenticator verifies it into an AuthContext
+    authenticator: Optional[object] = None
     # "single" (default: one SocketMap-shared connection), "pooled"
     # (exclusive connection per in-flight call, parked between calls),
     # "short" (one call per connection)
@@ -303,6 +310,22 @@ class Channel:
     def __init__(self, address: str,
                  options: Optional[ChannelOptions] = None, **kw):
         self.options = options or ChannelOptions(**kw)
+        self._cred_born = None
+        if (self.options.authenticator is not None
+                and self.options.auth is None):
+            # per-connection generate (≙ GenerateCredential writing the
+            # auth string once per connection): resolved per Channel,
+            # then carried on every request meta by the native layer.
+            # Time-boxed credentials (HmacNonceAuthenticator.max_skew_s)
+            # rotate on a live channel — see _maybe_refresh_credential.
+            # The options object is COPIED first: a caller sharing one
+            # ChannelOptions across Channels must not have channel A's
+            # credential leak into (and stop rotation for) channel B.
+            import dataclasses as _dc
+            self.options = _dc.replace(
+                self.options,
+                auth=self.options.authenticator.generate_credential())
+            self._cred_born = time.monotonic()
         self._cluster = None
         self._device_requested = False
         if "://" in address and not address.startswith("tpu://"):
@@ -340,6 +363,26 @@ class Channel:
             Channel._latency.expose("rpc_client")
         self._fallback_warned = False
 
+    def _maybe_refresh_credential(self) -> None:
+        """Rotate a time-boxed credential before it exits the server's
+        replay window: a long-lived channel must not start failing EAUTH
+        at max_skew_s.  Regenerates at HALF the window and pushes the
+        new credential into the live native channel(s) —
+        trpc_channel_set_auth is rotation-safe (Channel::auth_mu)."""
+        a = self.options.authenticator
+        if a is None or self._cred_born is None:
+            return
+        skew = getattr(a, "max_skew_s", None)
+        if not skew or time.monotonic() - self._cred_born <= skew / 2:
+            return
+        cred = a.generate_credential()
+        self.options.auth = cred  # new (incl. cluster) subchannels
+        self._cred_born = time.monotonic()
+        if self._sub is not None and self._sub._handle:
+            lib().trpc_channel_set_auth(self._sub._handle, cred, len(cred))
+        if self._cluster is not None:
+            self._cluster.refresh_auth(cred)
+
     # -- the client pipeline (≙ Channel::CallMethod, channel.cpp:407) -------
 
     def call(self, method: str, payload: bytes = b"",
@@ -357,6 +400,7 @@ class Channel:
         if timeout_ms is None:
             timeout_ms = (cntl.timeout_ms if cntl.timeout_ms is not None
                           else self.options.timeout_ms)
+        self._maybe_refresh_credential()
         mb = method.encode()
         start = time.monotonic_ns()
         deadline = start + int(timeout_ms * 1e6)
@@ -578,6 +622,7 @@ class Channel:
         from brpc_tpu.rpc import stream as _stream
         cntl = cntl or Controller()
         cntl.reset()
+        self._maybe_refresh_credential()
         timeout_ms = (cntl.timeout_ms if cntl.timeout_ms is not None
                       else self.options.timeout_ms)
         timeout_us = int(timeout_ms * 1000)

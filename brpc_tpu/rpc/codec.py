@@ -1,0 +1,142 @@
+"""Payload-codec rail — the Python face of native/src/codec.h (ISSUE 8;
+≙ the reference compress-handler registry policy/gzip_compress.cpp,
+extended TPU-natively with quantizing tensor codecs per EQuARX,
+arXiv 2506.17615).
+
+Unlike rpc/compress.py (whose codecs run in Python on the usercode side
+and ride meta tag 6), this rail is NATIVE and per-part: channel_call /
+channel_fanout_call encode the request's payload and attachment on the
+way into the socket (fan-out groups encode ONCE and share the encoded
+refcounted blocks), the server decodes on the owning shard's parse
+fiber, and responses mirror the request's codec (meta tags 16/17).
+
+Codec ids are wire contract:
+    0 none      1 snappy (lossless)
+    2 bf16      3 int8 per-256-float-block scale   (lossy, f32 streams)
+
+The `payload_codec` flag (seeded from TRPC_PAYLOAD_CODEC) picks what
+THIS process's clients send; "none" is byte-identical wire to a build
+without the rail.  int8's error bound: |err| <= max|block| / 127.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Tuple
+
+from brpc_tpu._native import lib
+from brpc_tpu.utils import flags
+
+CODEC_NONE = 0
+CODEC_SNAPPY = 1
+CODEC_BF16 = 2
+CODEC_INT8 = 3
+
+_NAMES = {CODEC_NONE: "none", CODEC_SNAPPY: "snappy",
+          CODEC_BF16: "bf16", CODEC_INT8: "int8"}
+_IDS = {v: k for k, v in _NAMES.items()}
+
+# int8 quantization block (floats per scale) — must match codec.h
+INT8_BLOCK_FLOATS = 256
+
+
+def name_of(codec_id: int) -> str:
+    return _NAMES.get(codec_id, f"unknown({codec_id})")
+
+
+def id_of(name: str) -> int:
+    if name in ("", "none", "0"):
+        return CODEC_NONE
+    if name not in _IDS:
+        raise KeyError(f"unknown payload codec {name!r}")
+    return _IDS[name]
+
+
+def _push_payload_codec(value: str) -> bool:
+    try:
+        cid = id_of(value)
+    except KeyError:
+        return False
+    lib().trpc_set_payload_codec(cid)
+    return True
+
+
+def _push_codec_min_bytes(value: int) -> bool:
+    if value < 0:
+        return False
+    lib().trpc_set_codec_min_bytes(int(value))
+    return True
+
+
+flags.define_string(
+    "payload_codec",
+    os.environ.get("TRPC_PAYLOAD_CODEC", "none") or "none",
+    "native payload codec for client-issued requests "
+    "(none/snappy/bf16/int8; native/src/codec.h): encode once per call "
+    "— once per FAN-OUT GROUP — on the way into the socket; the server "
+    "mirrors it on responses.  'none' is byte-identical wire "
+    "(the TRPC_PAYLOAD_CODEC A/B)", validator=_push_payload_codec)
+flags.define_int32(
+    "codec_min_bytes", int(os.environ.get("TRPC_CODEC_MIN_BYTES", "") or 256),
+    "payload/attachment parts smaller than this ride plain (encoding a "
+    "16-byte echo costs more than it saves); reloadable",
+    validator=_push_codec_min_bytes)
+
+
+def active() -> str:
+    """Name of the codec the native layer currently applies to requests."""
+    return name_of(int(lib().trpc_payload_codec()))
+
+
+def encode(data: bytes, codec: str | int) -> Tuple[bytes, int]:
+    """Encode bytes through the native rail (tests/tools surface; the
+    RPC paths encode natively, not through here).  Returns
+    (encoded, applied_id) — applied_id 0 means the codec declined
+    (ineligible part / incompressible) and `data` came back unchanged."""
+    cid = id_of(codec) if isinstance(codec, str) else int(codec)
+    L = lib()
+    p = ctypes.POINTER(ctypes.c_uint8)()
+    applied = ctypes.c_int(0)
+    n = L.trpc_codec_encode(cid, data, len(data), ctypes.byref(p),
+                            ctypes.byref(applied))
+    n = int(n)
+    if n < 0:
+        raise ValueError(f"codec {name_of(cid)} encode failed")
+    if n == 0 or applied.value == 0:
+        return data, 0
+    try:
+        return ctypes.string_at(p, n), int(applied.value)
+    finally:
+        L.trpc_codec_buf_free(p)
+
+
+def decode(data: bytes, codec: str | int) -> bytes:
+    """Inverse of :func:`encode` (codec id 0 = identity)."""
+    cid = id_of(codec) if isinstance(codec, str) else int(codec)
+    if cid == CODEC_NONE:
+        return data
+    L = lib()
+    p = ctypes.POINTER(ctypes.c_uint8)()
+    n = int(L.trpc_codec_decode(cid, data, len(data), ctypes.byref(p)))
+    if n < 0:
+        raise ValueError(f"codec {name_of(cid)} decode failed (corrupt "
+                         f"input)")
+    try:
+        return ctypes.string_at(p, n)
+    finally:
+        L.trpc_codec_buf_free(p)
+
+
+def roundtrip_chained(data: bytes, codec: str | int,
+                      chunk: int) -> Tuple[int, float]:
+    """Property-test hook: encode+decode `data` through a CHAINED native
+    IOBuf built from `chunk`-byte appends (multi-block seams).  Returns
+    (rc, max_f32_err): rc 0 = byte-exact, 1 = lossy, -1 = failure."""
+    cid = id_of(codec) if isinstance(codec, str) else int(codec)
+    err = ctypes.c_double(0.0)
+    rc = int(lib().trpc_codec_roundtrip_chained(
+        cid, data, len(data), chunk, ctypes.byref(err)))
+    return rc, float(err.value)
+# (int8's documented per-element bound — max|block|/127 — lives with the
+# tensor-side mirror, brpc_tpu/parallel/quantize.int8_error_bound.)

@@ -34,6 +34,11 @@ class Controller:
         self.method: str = ""
         self.remote_side: str = ""
         self.log_id: int = 0
+        # verified sender identity (rpc/auth.py AuthContext; ≙
+        # Controller::auth_context(), controller.h), set by the server
+        # dispatcher when ServerOptions.authenticator verified the
+        # request's credential; None otherwise
+        self.auth_context = None
         # tracing (rpcz)
         self.trace_id: int = 0
         self.span_id: int = 0

@@ -28,6 +28,10 @@ class HttpRequest:
     query: str = ""                 # raw query string
     headers: Dict[str, str] = field(default_factory=dict)  # lower-case keys
     body: bytes = b""
+    # verified sender identity (rpc/auth.py AuthContext) when the server
+    # has an Authenticator and the Authorization header verified; None
+    # otherwise.  Gates mutating portal endpoints (/flags?setvalue=).
+    auth_context: object = None
 
     def query_params(self) -> Dict[str, str]:
         return {k: v[-1] for k, v in

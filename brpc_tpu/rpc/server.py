@@ -19,6 +19,7 @@ from typing import Callable, Dict, Optional, Tuple, Union
 
 from brpc_tpu._native import HTTP_FN, lib
 from brpc_tpu.metrics import bvar
+from brpc_tpu.rpc import codec as codec_mod
 from brpc_tpu.rpc import compress as compress_mod
 from brpc_tpu.rpc import dump as dump_mod
 from brpc_tpu.rpc import errors, span
@@ -156,6 +157,16 @@ class ServerOptions:
     # verified natively before dispatch).  Channels send it via
     # ChannelOptions.auth.
     auth: Optional[bytes] = None
+    # Pluggable authentication (≙ ServerOptions.auth as an Authenticator*,
+    # authenticator.h:56-75; rpc/auth.py): verify_credential runs on the
+    # usercode side per request (token_auth/token_peer feed it the raw
+    # tag-13 credential + peer address) and the resulting AuthContext
+    # lands on cntl.auth_context / request.auth_context.  HTTP requests
+    # authenticate through the Authorization header when present; the
+    # portal's /flags mutation additionally requires a verified context
+    # with the "admin" role.  Mutually exclusive with `auth` (the static
+    # native token): set one or the other.
+    authenticator: Optional[object] = None
     # Allow state-mutating builtin endpoints (/flags?setvalue=) on the
     # portal.  Deviation from the reference (which allows flag writes by
     # default): unauthenticated remote flag mutation is too sharp a tool
@@ -438,6 +449,30 @@ class Server:
             cntl.method = method.decode() if method else name
             sp = None
             try:
+                authn = limiter_box.options.authenticator
+                if authn is not None:
+                    # pluggable verify (≙ VerifyCredential before dispatch,
+                    # authenticator.h:66): raw tag-13 credential + peer
+                    # address per token; failure answers EAUTH
+                    abuf = ctypes.create_string_buffer(4096)
+                    alen = int(L.trpc_token_auth(token, abuf, len(abuf)))
+                    if alen > len(abuf):
+                        # token_auth reports the FULL length; re-read a
+                        # large credential (JWT/cert chain) untruncated
+                        abuf = ctypes.create_string_buffer(alen)
+                        alen = int(L.trpc_token_auth(token, abuf,
+                                                     len(abuf)))
+                    raw = abuf.raw[:min(alen, len(abuf))] if alen else b""
+                    plen = int(L.trpc_token_peer(token, abuf, len(abuf)))
+                    peer = abuf.raw[:plen].decode() if plen else ""
+                    try:
+                        cntl.auth_context = authn.verify_credential(
+                            raw, peer)
+                    except Exception as e:
+                        raise errors.RpcError(
+                            errors.EAUTH, f"authentication failed: {e}")
+                    if peer:
+                        cntl.remote_side = peer
                 req = ctypes.string_at(req_p, req_len) if req_len else b""
                 cntl.request_compress_type = max(
                     L.trpc_token_compress(token), 0)
@@ -520,6 +555,7 @@ class Server:
         usercode pool; routed through self.http."""
         dispatcher = self.http
         auth = self.options.auth
+        authenticator = self.options.authenticator
 
         def on_http(token, verb, path, query, hdr_p, hdr_len, body_p,
                     body_len, _user):
@@ -542,6 +578,21 @@ class Server:
                         L.trpc_http_respond(token, 401, None,
                                             b"unauthorized\n", 13)
                         return
+                elif authenticator is not None:
+                    # pluggable path: an Authorization header verifies
+                    # into request.auth_context (mutating portal routes
+                    # require it); a PRESENT-but-bad credential is 401,
+                    # absence just leaves the context None
+                    cred = req.headers.get("authorization", "")
+                    if cred:
+                        try:
+                            req.auth_context = \
+                                authenticator.verify_credential(
+                                    cred.encode(), "")
+                        except Exception:
+                            L.trpc_http_respond(token, 401, None,
+                                                b"unauthorized\n", 13)
+                            return
                 resp = dispatcher.dispatch(req)
                 from brpc_tpu.rpc.http import ProgressiveAttachment
                 if isinstance(resp, ProgressiveAttachment):
@@ -616,6 +667,12 @@ class Server:
             int(flags.get_flag("inline_budget_requests")))
         lib().trpc_set_inline_budget_us(
             int(flags.get_flag("inline_budget_us")))
+        # payload-codec rail (codec.h): push the resolved flag state so a
+        # flags-file/env mix lands in the native atomics before traffic
+        lib().trpc_set_payload_codec(
+            codec_mod.id_of(flags.get_flag("payload_codec")))
+        lib().trpc_set_codec_min_bytes(
+            int(flags.get_flag("codec_min_bytes")))
         if self.options.enable_builtin_services:
             from brpc_tpu.builtin import install_builtin_services
             install_builtin_services(self, self.http)
@@ -632,6 +689,10 @@ class Server:
         from brpc_tpu.metrics import dumper as _dumper
         _dumper.ensure_started()
         self._install_http()
+        if self.options.auth and self.options.authenticator is not None:
+            raise ValueError(
+                "set ServerOptions.auth (static native token) OR "
+                ".authenticator (pluggable), not both")
         if self.options.auth:
             lib().trpc_server_set_auth(self._handle, self.options.auth,
                                        len(self.options.auth))

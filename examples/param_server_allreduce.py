@@ -78,10 +78,10 @@ def resnet50_param_shapes():
     return shapes
 
 
-def run(iters: int = 3, dtype=jnp.float32):
+def run(iters: int = 3, dtype=jnp.float32, codec: str = "none"):
     mesh = make_mesh({"dp": len(jax.devices())})
     n = mesh.shape["dp"]
-    ch = MeshParallelChannel(mesh, "dp", merger="add")
+    ch = MeshParallelChannel(mesh, "dp", merger="add", codec=codec)
 
     shapes = resnet50_param_shapes()
     nparams = sum(int(np.prod(s)) for _, s in shapes)
@@ -95,11 +95,36 @@ def run(iters: int = 3, dtype=jnp.float32):
     stacked = jax.device_put(stacked,
                              NamedSharding(mesh, PartitionSpec("dp")))
 
-    # numeric acceptance: the channel's merge == dense jnp sum
+    # numeric acceptance against the dense jnp sum
     merged = ch.call_tensor(stacked)
     expect = flat * (n * (n + 1) // 2)
-    np.testing.assert_allclose(np.asarray(merged[0]), np.asarray(expect),
-                               rtol=1e-5)
+    codec_err = codec_bound = None
+    if codec == "none":
+        np.testing.assert_allclose(np.asarray(merged[0]),
+                                   np.asarray(expect), rtol=1e-5)
+    else:
+        # lossy-but-BOUNDED leg (ISSUE 8): the dequantize-then-reduce
+        # sum's error is at most the per-worker codec bounds added
+        # (parallel/quantize.py mirrors native/src/codec.h's formats)
+        from brpc_tpu.parallel import quantize
+        rows = np.asarray(jax.device_get(stacked))
+        if codec == "int8":
+            codec_bound = sum(
+                quantize.int8_error_bound(jnp.asarray(rows[i]))
+                for i in range(n))
+        else:  # bf16: 8 explicit mantissa bits -> rel err <= 2^-9+ulp,
+            # bounded per worker by max|shard| * 2^-8 (safe factor)
+            codec_bound = sum(
+                float(np.max(np.abs(rows[i]))) * 2.0 ** -8
+                for i in range(n))
+        codec_err = float(np.max(np.abs(
+            np.asarray(merged[0]) - np.asarray(expect))))
+        assert codec_err <= codec_bound, (
+            f"{codec} allreduce error {codec_err} exceeds the "
+            f"documented bound {codec_bound}")
+        # the leg must actually be lossy (0 error would mean the codec
+        # silently didn't engage)
+        assert codec_err > 0.0, f"{codec} codec did not engage"
 
     # measured rate of the real gradient allreduce (first call above
     # already compiled + warmed the jit cache)
@@ -119,6 +144,9 @@ def run(iters: int = 3, dtype=jnp.float32):
         "devices": n,
         "platform": jax.devices()[0].platform,
         "numeric_check": "ok",
+        "codec": codec,
+        "codec_max_abs_err": codec_err,
+        "codec_err_bound": codec_bound,
         "allreduce_algbw_gbps": round(algbw, 3),
         "allreduce_busbw_gbps": round(busbw, 3),
         # the driver's synthetic ICI probe (small shard: the number that
@@ -131,7 +159,16 @@ def run(iters: int = 3, dtype=jnp.float32):
 
 
 def main():
-    print(json.dumps(run()))
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--codec", default="none",
+                    choices=("none", "int8", "bf16"),
+                    help="run the reduce leg through the quantizing "
+                         "payload codec (lossy, asserted within its "
+                         "documented bound)")
+    args = ap.parse_args()
+    print(json.dumps(run(codec=args.codec)))
 
 
 if __name__ == "__main__":

@@ -8,6 +8,7 @@
 
 #include <vector>
 
+#include "codec.h"
 #include "fiber.h"
 #include "fiber_sync.h"
 #include "h2.h"
@@ -245,6 +246,17 @@ int trpc_respond_compressed(uint64_t token, int32_t error_code,
 
 int trpc_token_compress(uint64_t token) { return token_compress_type(token); }
 
+// Pluggable-Authenticator surface (≙ Authenticator::VerifyCredential,
+// authenticator.h:30-75): the request's raw credential (meta tag 13) and
+// the peer address, read per token on the usercode side.  trpc_token_auth
+// returns the credential's FULL length (copy truncated at cap).
+size_t trpc_token_auth(uint64_t token, char* buf, size_t cap) {
+  return token_auth(token, buf, cap);
+}
+size_t trpc_token_peer(uint64_t token, char* buf, size_t cap) {
+  return token_peer(token, buf, cap);
+}
+
 // --- heap + contention profiler (heap_profiler.h ≙ /pprof/heap,
 // /pprof/growth, sampled lock-wait stacks) ---------------------------------
 
@@ -468,6 +480,69 @@ size_t trpc_socket_dump(char* buf, size_t cap) {
 
 size_t trpc_ids_dump(char* buf, size_t cap) {
   return pending_call_dump(buf, cap);
+}
+
+// --- payload-codec rail (codec.h: identity/snappy/bf16/int8) ----------------
+
+// Reloadable request codec (TRPC_PAYLOAD_CODEC seeds the default; the
+// `payload_codec` flag pushes through here).
+void trpc_set_payload_codec(int id) { set_payload_codec(id); }
+int trpc_payload_codec() { return payload_codec(); }
+void trpc_set_codec_min_bytes(int64_t n) { set_codec_min_bytes(n); }
+int trpc_codec_id(const char* name) { return codec_id_from_name(name); }
+const char* trpc_codec_name(int id) { return codec_name(id); }
+
+// Bytes-level encode/decode for the Python surface (tests, tools): the
+// result is malloc'd; free with trpc_codec_buf_free.  Returns the
+// encoded/decoded length, 0 = declined (encode left the part plain),
+// -1 = error.  `codec_out` (encode only, nullable) receives the codec
+// id actually applied.
+int64_t trpc_codec_encode(int codec, const uint8_t* in, size_t n,
+                          uint8_t** out, int* codec_out) {
+  IOBuf part;
+  if (n > 0) {
+    part.append(in, n);
+  }
+  uint8_t applied = codec_encode((uint8_t)codec, &part);
+  if (codec_out != nullptr) {
+    *codec_out = applied;
+  }
+  if (applied == 0) {
+    return 0;
+  }
+  *out = (uint8_t*)malloc(part.size() > 0 ? part.size() : 1);
+  if (*out == nullptr) {
+    return -1;
+  }
+  part.copy_to(*out, part.size());
+  return (int64_t)part.size();
+}
+
+int64_t trpc_codec_decode(int codec, const uint8_t* in, size_t n,
+                          uint8_t** out) {
+  IOBuf part;
+  if (n > 0) {
+    part.append(in, n);
+  }
+  if (codec_decode((uint8_t)codec, &part) != 0) {
+    return -1;
+  }
+  *out = (uint8_t*)malloc(part.size() > 0 ? part.size() : 1);
+  if (*out == nullptr) {
+    return -1;
+  }
+  part.copy_to(*out, part.size());
+  return (int64_t)part.size();
+}
+
+void trpc_codec_buf_free(uint8_t* p) { free(p); }
+
+// Property-test hook: roundtrip `data` through a CHAINED IOBuf built
+// from `chunk`-byte appends (multi-block, element-straddling seams).
+// 0 = byte-exact, 1 = lossy (max |f32 err| in *max_err), -1 = failure.
+int trpc_codec_roundtrip_chained(int codec, const uint8_t* data, size_t n,
+                                 size_t chunk, double* max_err) {
+  return codec_roundtrip_chained(codec, data, n, chunk, max_err);
 }
 
 // --- snappy codec -----------------------------------------------------------

@@ -1,0 +1,577 @@
+// codec.cc — pluggable payload-codec rail (see codec.h; ≙ the reference
+// compress-handler registry policy/gzip_compress.cpp, extended with
+// quantizing tensor codecs per EQuARX, arXiv 2506.17615).
+//
+// Hot-path discipline (tools/lint.py gates these functions like
+// ServerOnMessages): no raw new/malloc in the encode/decode paths —
+// staging goes through a reusable per-shard scratch pool (fiber stacks
+// are 256KB; a snappy chunk pair alone is ~150KB, so stack staging is
+// out).  The pool seam itself is the one sanctioned allocation.
+#include "codec.h"
+
+#include <math.h>
+#include <string.h>
+
+#include <atomic>
+#include <cstdlib>
+
+#include "metrics.h"
+#include "shard.h"
+#include "snappy.h"
+
+namespace trpc {
+
+namespace {
+
+// --- flags (flag-cached: each env var resolves ONCE into its atomic) -------
+
+std::atomic<int> g_payload_codec{-1};        // -1 = consult env on first use
+std::atomic<int64_t> g_codec_min_bytes{-1};  // -1 = consult env on first use
+
+// --- scratch pool (per-shard reuse; the codec_races surface) ---------------
+
+// One slot holds both staging sides of any codec: snappy's 64KB gather
+// window plus its worst-case compressed image bound the sizes.
+constexpr size_t kSnapChunk = 64 * 1024;
+constexpr size_t kQuantChunk = 32 * 1024;  // quantizer staging granularity
+
+struct CodecScratch {
+  std::atomic<int> busy{0};
+  char* in = nullptr;   // >= snappy_max_compressed_length(kSnapChunk)
+  char* out = nullptr;  // same
+};
+
+constexpr int kScratchSlots = kMaxShards + 2;  // shards + off-worker callers
+CodecScratch g_scratch[kScratchSlots];
+
+size_t scratch_bytes() {
+  return snappy_max_compressed_length(kSnapChunk) + 16;
+}
+
+// Acquire a scratch slot, preferring the calling shard's (parse fibers
+// decode on their owning shard, so steady state is contention-free slot
+// reuse); off-worker callers (channel_call encode runs on the caller's
+// pthread) start past the shard range.  All slots busy => a transient
+// heap pair (rare: more concurrent codec ops than slots).
+CodecScratch* scratch_acquire(CodecScratch* temp) {
+  int shard = current_shard();
+  int start = shard >= 0 ? shard : kMaxShards;
+  for (int i = 0; i < kScratchSlots; ++i) {
+    CodecScratch* s = &g_scratch[(start + i) % kScratchSlots];
+    int expected = 0;
+    if (!s->busy.compare_exchange_strong(expected, 1,
+                                         std::memory_order_acquire)) {
+      continue;
+    }
+    if (s->in == nullptr) {
+      // first acquisition of this slot: the CAS owner allocates; the
+      // buffers live for the process (freed never, like the pools)
+      s->in = (char*)malloc(scratch_bytes());   // lint:allow-alloc(scratch pool seam, once per slot)
+      s->out = (char*)malloc(scratch_bytes());  // lint:allow-alloc(scratch pool seam, once per slot)
+      if (s->in == nullptr || s->out == nullptr) {
+        free(s->in);
+        free(s->out);
+        s->in = s->out = nullptr;
+        s->busy.store(0, std::memory_order_release);
+        break;  // fall through to the temp pair
+      }
+    }
+    return s;
+  }
+  temp->in = (char*)malloc(scratch_bytes());   // lint:allow-alloc(scratch overflow, freed by caller)
+  temp->out = (char*)malloc(scratch_bytes());  // lint:allow-alloc(scratch overflow, freed by caller)
+  if (temp->in == nullptr || temp->out == nullptr) {
+    free(temp->in);
+    free(temp->out);
+    temp->in = temp->out = nullptr;
+    return nullptr;
+  }
+  temp->busy.store(2, std::memory_order_relaxed);  // marks "heap temp"
+  return temp;
+}
+
+void scratch_release(CodecScratch* s) {
+  if (s == nullptr) {
+    return;
+  }
+  if (s->busy.load(std::memory_order_relaxed) == 2) {
+    free(s->in);
+    free(s->out);
+    s->in = s->out = nullptr;
+    return;
+  }
+  s->busy.store(0, std::memory_order_release);
+}
+
+// --- chain reader: bounded gather across BlockRefs (never flattens) --------
+
+struct ChainReader {
+  const IOBuf* buf;
+  size_t ref_i = 0;
+  size_t off = 0;  // within the current ref
+  size_t left;
+
+  explicit ChainReader(const IOBuf* b) : buf(b), left(b->size()) {}
+
+  size_t read(void* dst, size_t want) {
+    char* d = (char*)dst;
+    size_t got = 0;
+    while (got < want && ref_i < buf->block_count()) {
+      const BlockRef& r = buf->ref_at(ref_i);
+      size_t n = r.length - off;
+      if (n > want - got) {
+        n = want - got;
+      }
+      memcpy(d + got, r.block->data + r.offset + off, n);
+      got += n;
+      off += n;
+      if (off == r.length) {
+        ++ref_i;
+        off = 0;
+      }
+    }
+    left -= got;
+    return got;
+  }
+};
+
+// --- bf16 (id 2): f32 -> bf16 round-to-nearest-even --------------------------
+
+inline uint16_t f32_to_bf16(uint32_t x) {
+  if ((x & 0x7fffffffu) > 0x7f800000u) {
+    // NaN: rounding could carry the mantissa away and mint an Inf; pin a
+    // quiet-NaN payload bit instead
+    return (uint16_t)((x >> 16) | 0x0040u);
+  }
+  uint32_t lsb = (x >> 16) & 1u;
+  return (uint16_t)((x + 0x7fffu + lsb) >> 16);
+}
+
+int EncodeBf16Chain(const IOBuf& in, IOBuf* out, CodecScratch* sc) {
+  if (in.size() % 4 != 0) {
+    return -1;
+  }
+  ChainReader rd(&in);
+  while (rd.left > 0) {
+    size_t n = rd.read(sc->in, kQuantChunk);  // multiple of 4: chunk is
+    uint16_t* dst = (uint16_t*)sc->out;
+    for (size_t i = 0; i < n; i += 4) {
+      uint32_t x;
+      memcpy(&x, sc->in + i, 4);
+      dst[i / 4] = f32_to_bf16(x);
+    }
+    out->append(sc->out, n / 2);
+  }
+  return 0;
+}
+
+int DecodeBf16Chain(const IOBuf& in, IOBuf* out, CodecScratch* sc) {
+  if (in.size() % 2 != 0) {
+    return -1;
+  }
+  ChainReader rd(&in);
+  while (rd.left > 0) {
+    size_t n = rd.read(sc->in, kQuantChunk / 2);
+    uint32_t* dst = (uint32_t*)sc->out;
+    for (size_t i = 0; i < n; i += 2) {
+      uint16_t b;
+      memcpy(&b, sc->in + i, 2);
+      dst[i / 2] = (uint32_t)b << 16;
+    }
+    out->append(sc->out, n * 2);
+  }
+  return 0;
+}
+
+// --- int8 (id 3): per-block scale quantizer ---------------------------------
+// Layout: u32 nfloats (LE), then per 256-float block one f32 scale (LE)
+// followed by that block's int8 values.  |err| <= max|block| / 127 (the
+// documented bound; round-to-nearest actually gives scale/2).  All-zero
+// (and denormal-only) blocks emit scale 0 and decode to exact zeros.
+
+constexpr uint32_t kMaxDecodedFloats = 1u << 28;  // 1GB of f32 output cap
+
+int EncodeInt8Chain(const IOBuf& in, IOBuf* out, CodecScratch* sc) {
+  if (in.size() % 4 != 0) {
+    return -1;
+  }
+  uint32_t nfloats = (uint32_t)(in.size() / 4);
+  out->append(&nfloats, 4);
+  ChainReader rd(&in);
+  // stage whole quant blocks: kQuantChunk is a multiple of the 1KB block
+  while (rd.left > 0) {
+    size_t n = rd.read(sc->in, kQuantChunk);
+    size_t emitted = 0;
+    for (size_t b = 0; b < n; b += kInt8BlockFloats * 4) {
+      size_t bn = n - b < kInt8BlockFloats * 4 ? n - b : kInt8BlockFloats * 4;
+      float maxabs = 0.0f;
+      for (size_t i = 0; i < bn; i += 4) {
+        float v;
+        memcpy(&v, sc->in + b + i, 4);
+        float a = fabsf(v);
+        if (a > maxabs) {
+          maxabs = a;  // NaN compares false: never poisons the scale
+        }
+      }
+      if (!(maxabs < 3.0e38f)) {
+        maxabs = 3.0e38f;  // Inf/overflow input: clamp, stay finite
+      }
+      float scale = maxabs / 127.0f;
+      char* dst = sc->out + emitted;
+      memcpy(dst, &scale, 4);
+      if (scale > 0.0f && isfinite(1.0f / scale)) {
+        float inv = 1.0f / scale;
+        for (size_t i = 0; i < bn; i += 4) {
+          float v;
+          memcpy(&v, sc->in + b + i, 4);
+          float r = v * inv;
+          long q = lroundf(r);
+          if (!isfinite(r)) {
+            q = 0;  // NaN rides as 0 (garbage-in, defined-out)
+          } else if (q > 127) {
+            q = 127;
+          } else if (q < -127) {
+            q = -127;
+          }
+          dst[4 + i / 4] = (char)(int8_t)q;
+        }
+      } else {
+        // all-zero or denormal-only block (scale underflowed): exact
+        // zeros on decode, error bounded by the denormal range itself
+        float zero = 0.0f;
+        memcpy(dst, &zero, 4);
+        memset(dst + 4, 0, bn / 4);
+      }
+      emitted += 4 + bn / 4;
+    }
+    out->append(sc->out, emitted);
+  }
+  return 0;
+}
+
+int DecodeInt8Chain(const IOBuf& in, IOBuf* out, CodecScratch* sc) {
+  if (in.size() < 4) {
+    return -1;
+  }
+  ChainReader rd(&in);
+  uint32_t nfloats = 0;
+  rd.read(&nfloats, 4);
+  if (nfloats > kMaxDecodedFloats) {
+    return -1;
+  }
+  uint64_t nblocks =
+      ((uint64_t)nfloats + kInt8BlockFloats - 1) / kInt8BlockFloats;
+  if (in.size() != 4 + nblocks * 4 + nfloats) {
+    return -1;
+  }
+  uint32_t left = nfloats;
+  while (left > 0) {
+    // stage whole blocks, bounded by the OUTPUT side of the scratch pair
+    // (64 blocks -> 64KB of f32s; the staged input is ~16.6KB)
+    size_t blocks_now = kSnapChunk / (kInt8BlockFloats * 4);
+    size_t floats_now = 0;
+    size_t in_now = 0;
+    for (size_t b = 0; b < blocks_now && left > floats_now; ++b) {
+      size_t bf = left - floats_now < kInt8BlockFloats
+                      ? left - floats_now
+                      : kInt8BlockFloats;
+      floats_now += bf;
+      in_now += 4 + bf;
+    }
+    if (rd.read(sc->in, in_now) != in_now) {
+      return -1;
+    }
+    char* src = sc->in;
+    float* dst = (float*)sc->out;
+    size_t emitted = 0;
+    while (emitted < floats_now) {
+      size_t bf = floats_now - emitted < kInt8BlockFloats
+                      ? floats_now - emitted
+                      : kInt8BlockFloats;
+      float scale;
+      memcpy(&scale, src, 4);
+      for (size_t i = 0; i < bf; ++i) {
+        dst[emitted + i] = scale * (float)(int8_t)src[4 + i];
+      }
+      src += 4 + bf;
+      emitted += bf;
+    }
+    out->append(sc->out, floats_now * 4);
+    left -= (uint32_t)floats_now;
+  }
+  return 0;
+}
+
+// --- snappy (id 1): chunked framing over the clean-room block codec --------
+// Layout: repeated [u32 plain_len][u32 comp_len][comp bytes], plain_len
+// <= 64KB per chunk so decode staging is bounded regardless of input.
+
+// -2 = decline: the FIRST chunk didn't shrink, so the part is (almost
+// certainly) incompressible — bail before paying compression over the
+// rest of a large attachment (codec_encode sends it plain; measured on
+// the --codec-ab f32 pattern, a full-part probe cost ~11% throughput).
+int EncodeSnappyChain(const IOBuf& in, IOBuf* out, CodecScratch* sc) {
+  ChainReader rd(&in);
+  bool first = true;
+  while (rd.left > 0) {
+    uint32_t n = (uint32_t)rd.read(sc->in, kSnapChunk);
+    uint32_t cn = (uint32_t)snappy_compress((const uint8_t*)sc->in, n,
+                                            (uint8_t*)sc->out);
+    if (first && cn + 8 >= n) {
+      return -2;
+    }
+    first = false;
+    char hdr[8];
+    memcpy(hdr, &n, 4);
+    memcpy(hdr + 4, &cn, 4);
+    out->append(hdr, 8);
+    out->append(sc->out, cn);
+  }
+  return 0;
+}
+
+int DecodeSnappyChain(const IOBuf& in, IOBuf* out, CodecScratch* sc) {
+  ChainReader rd(&in);
+  const size_t comp_cap = snappy_max_compressed_length(kSnapChunk);
+  while (rd.left > 0) {
+    char hdr[8];
+    if (rd.read(hdr, 8) != 8) {
+      return -1;
+    }
+    uint32_t n, cn;
+    memcpy(&n, hdr, 4);
+    memcpy(&cn, hdr + 4, 4);
+    if (n == 0 || n > kSnapChunk || cn == 0 || cn > comp_cap ||
+        cn > rd.left) {
+      return -1;
+    }
+    if (rd.read(sc->in, cn) != cn) {
+      return -1;
+    }
+    size_t hdr_len = 0;
+    if (snappy_uncompressed_length((const uint8_t*)sc->in, cn, &hdr_len) !=
+        (size_t)n) {
+      return -1;
+    }
+    if (snappy_decompress((const uint8_t*)sc->in, cn, (uint8_t*)sc->out,
+                          kSnapChunk) != (size_t)n) {
+      return -1;
+    }
+    out->append(sc->out, n);
+  }
+  return 0;
+}
+
+// --- dispatch ---------------------------------------------------------------
+
+int encode_impl(uint8_t codec, const IOBuf& in, IOBuf* out,
+                CodecScratch* sc) {
+  switch (codec) {
+    case CODEC_SNAPPY:
+      return EncodeSnappyChain(in, out, sc);
+    case CODEC_BF16:
+      return EncodeBf16Chain(in, out, sc);
+    case CODEC_INT8:
+      return EncodeInt8Chain(in, out, sc);
+    default:
+      return -1;
+  }
+}
+
+int decode_impl(uint8_t codec, const IOBuf& in, IOBuf* out,
+                CodecScratch* sc) {
+  switch (codec) {
+    case CODEC_SNAPPY:
+      return DecodeSnappyChain(in, out, sc);
+    case CODEC_BF16:
+      return DecodeBf16Chain(in, out, sc);
+    case CODEC_INT8:
+      return DecodeInt8Chain(in, out, sc);
+    default:
+      return -1;
+  }
+}
+
+}  // namespace
+
+int codec_id_from_name(const char* name) {
+  if (name == nullptr || name[0] == '\0' || strcmp(name, "none") == 0 ||
+      strcmp(name, "0") == 0) {
+    return CODEC_NONE;
+  }
+  if (strcmp(name, "snappy") == 0 || strcmp(name, "1") == 0) {
+    return CODEC_SNAPPY;
+  }
+  if (strcmp(name, "bf16") == 0 || strcmp(name, "2") == 0) {
+    return CODEC_BF16;
+  }
+  if (strcmp(name, "int8") == 0 || strcmp(name, "3") == 0) {
+    return CODEC_INT8;
+  }
+  return -1;
+}
+
+const char* codec_name(int id) {
+  switch (id) {
+    case CODEC_NONE:
+      return "none";
+    case CODEC_SNAPPY:
+      return "snappy";
+    case CODEC_BF16:
+      return "bf16";
+    case CODEC_INT8:
+      return "int8";
+    default:
+      return "unknown";
+  }
+}
+
+void set_payload_codec(int id) {
+  if (codec_id_from_name(codec_name(id)) < 0) {
+    return;  // unknown id: keep the current value
+  }
+  g_payload_codec.store(id, std::memory_order_release);
+}
+
+int payload_codec() {
+  int v = g_payload_codec.load(std::memory_order_acquire);
+  if (v < 0) {
+    // first use: TRPC_PAYLOAD_CODEC names the request codec (flag-cached:
+    // resolved once into g_payload_codec; `payload_codec` flag reloads)
+    const char* e = getenv("TRPC_PAYLOAD_CODEC");
+    int id = e != nullptr ? codec_id_from_name(e) : CODEC_NONE;
+    v = id >= 0 ? id : CODEC_NONE;
+    g_payload_codec.store(v, std::memory_order_release);
+  }
+  return v;
+}
+
+void set_codec_min_bytes(int64_t n) {
+  g_codec_min_bytes.store(n >= 0 ? n : 0, std::memory_order_release);
+}
+
+int64_t codec_min_bytes() {
+  int64_t v = g_codec_min_bytes.load(std::memory_order_acquire);
+  if (v < 0) {
+    // flag-cached: TRPC_CODEC_MIN_BYTES resolves once into the atomic
+    const char* e = getenv("TRPC_CODEC_MIN_BYTES");
+    v = 256;
+    if (e != nullptr && e[0] != '\0') {
+      char* end = nullptr;
+      long long parsed = strtoll(e, &end, 10);
+      if (end != e && parsed >= 0) {
+        v = (int64_t)parsed;
+      }
+    }
+    g_codec_min_bytes.store(v, std::memory_order_release);
+  }
+  return v;
+}
+
+uint8_t codec_encode(uint8_t codec, IOBuf* part) {
+  if (codec == CODEC_NONE || part->empty() ||
+      (int64_t)part->size() < codec_min_bytes()) {
+    return CODEC_NONE;
+  }
+  if ((codec == CODEC_BF16 || codec == CODEC_INT8) &&
+      part->size() % 4 != 0) {
+    return CODEC_NONE;  // not an f32 stream: this part rides plain
+  }
+  CodecScratch temp;
+  CodecScratch* sc = scratch_acquire(&temp);
+  if (sc == nullptr) {
+    return CODEC_NONE;
+  }
+  IOBuf out;
+  int rc = encode_impl(codec, *part, &out, sc);
+  scratch_release(sc);
+  if (rc != 0 || out.size() >= part->size()) {
+    // incompressible under snappy's chunk framing (or a codec error):
+    // declining keeps the wire no worse than plain
+    return CODEC_NONE;
+  }
+  NativeMetrics& nm = native_metrics();
+  nm.codec_encodes.fetch_add(1, std::memory_order_relaxed);
+  nm.codec_bytes_in.fetch_add(part->size(), std::memory_order_relaxed);
+  nm.codec_bytes_out.fetch_add(out.size(), std::memory_order_relaxed);
+  *part = std::move(out);
+  return codec;
+}
+
+int codec_decode(uint8_t codec, IOBuf* part) {
+  if (codec == CODEC_NONE) {
+    return 0;
+  }
+  CodecScratch temp;
+  CodecScratch* sc = scratch_acquire(&temp);
+  if (sc == nullptr) {
+    return -1;
+  }
+  IOBuf out;
+  int rc = decode_impl(codec, *part, &out, sc);
+  scratch_release(sc);
+  if (rc != 0) {
+    return -1;
+  }
+  native_metrics().codec_decodes.fetch_add(1, std::memory_order_relaxed);
+  *part = std::move(out);
+  return 0;
+}
+
+int codec_roundtrip_chained(int codec, const uint8_t* data, size_t n,
+                            size_t chunk, double* max_err) {
+  if (max_err != nullptr) {
+    *max_err = 0.0;
+  }
+  if (chunk == 0) {
+    chunk = 1;
+  }
+  IOBuf in;
+  for (size_t i = 0; i < n; i += chunk) {
+    in.append(data + i, n - i < chunk ? n - i : chunk);
+  }
+  CodecScratch temp;
+  CodecScratch* sc = scratch_acquire(&temp);
+  if (sc == nullptr) {
+    return -1;
+  }
+  IOBuf enc, dec;
+  int rc = encode_impl((uint8_t)codec, in, &enc, sc);
+  if (rc == -2) {
+    scratch_release(sc);
+    return 0;  // encoder declined: the part rides plain (trivially exact)
+  }
+  if (rc == 0) {
+    rc = decode_impl((uint8_t)codec, enc, &dec, sc);
+  }
+  scratch_release(sc);
+  if (rc != 0) {
+    return -1;
+  }
+  if (dec.size() != n) {
+    return -1;
+  }
+  std::string got = dec.to_string();
+  if (memcmp(got.data(), data, n) == 0) {
+    return 0;  // byte-exact
+  }
+  if (codec != CODEC_BF16 && codec != CODEC_INT8) {
+    return -1;  // a lossless codec diverged: corrupt roundtrip
+  }
+  double worst = 0.0;
+  for (size_t i = 0; i + 4 <= n; i += 4) {
+    float a, b;
+    memcpy(&a, data + i, 4);
+    memcpy(&b, got.data() + i, 4);
+    double d = fabs((double)a - (double)b);
+    if (d > worst) {
+      worst = d;  // NaN diffs compare false: skipped
+    }
+  }
+  if (max_err != nullptr) {
+    *max_err = worst;
+  }
+  return 1;
+}
+
+}  // namespace trpc

@@ -1,0 +1,83 @@
+// codec.h — pluggable payload-codec rail (ISSUE 8 tentpole; ≙ the
+// reference registering compress handlers per CompressType,
+// policy/gzip_compress.cpp registration shape — extended TPU-natively
+// with quantizing tensor codecs the way EQuARX treats quantized
+// allreduce as a first-class XLA optimization, arXiv 2506.17615).
+//
+// Codecs transcode IOBuf CHAINS block-wise — no flattening: the encoder
+// walks BlockRefs with a small element-straddle carry, the output is
+// appended in bounded chunks, and the encoded blocks fan out refcounted
+// (PR 5 serialize-once ⇒ codec-once per N-way group).
+//
+// Wire contract (meta TLV tags 16/17, rpc.h): the id of the codec a
+// frame's payload/attachment is encoded with.  Ids are stable:
+//   0 none       — identity (tag omitted on the wire)
+//   1 snappy     — chunked clean-room snappy (snappy.h), lossless
+//   2 bf16       — f32 → bf16 round-to-nearest-even, 2x, lossy
+//   3 int8       — f32 → int8 with one f32 scale per 256-float block,
+//                  ~3.94x, lossy: |err| <= max|block| / 127
+// Quantizers apply only to parts whose size is a nonzero multiple of 4
+// (an f32 stream); ineligible parts ride plain (their tag stays 0) —
+// negotiation is per-part, per-call, never a connection property.
+//
+// Decode runs on the owning shard's parse fiber (both directions), so
+// the PR-3/7 inline-dispatch fast path and shard confinement hold.
+// Codec disabled is byte-identical on the wire (no tags, no codec pass).
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+#include "iobuf.h"
+
+namespace trpc {
+
+enum PayloadCodecId : uint8_t {
+  CODEC_NONE = 0,
+  CODEC_SNAPPY = 1,
+  CODEC_BF16 = 2,
+  CODEC_INT8 = 3,
+};
+
+// int8 quantization block: floats per scale (wire contract — both ends
+// must agree, like the codec ids).
+constexpr size_t kInt8BlockFloats = 256;
+
+// name <-> id ("none"/"snappy"/"bf16"/"int8"; numeric strings accepted).
+// -1 = unknown name.
+int codec_id_from_name(const char* name);
+const char* codec_name(int id);
+
+// Process-wide default codec for client-issued requests (channel_call /
+// channel_fanout_call).  -0 none.  Seeded once from TRPC_PAYLOAD_CODEC
+// (name or id), reloadable via trpc_set_payload_codec / the
+// `payload_codec` flag.
+void set_payload_codec(int id);
+int payload_codec();
+
+// Parts smaller than this ride plain (encoding a 16-byte echo payload
+// costs more than it saves).  Seeded once from TRPC_CODEC_MIN_BYTES
+// (default 256); reloadable.
+void set_codec_min_bytes(int64_t n);
+int64_t codec_min_bytes();
+
+// Encode *part in place with `codec`.  Returns the codec id actually
+// applied: 0 when the part was left plain (empty, under the min-bytes
+// gate, ineligible for a quantizer, or the codec is unknown).  Counts
+// into native_codec_{encodes,bytes_in,bytes_out} when it encodes.
+uint8_t codec_encode(uint8_t codec, IOBuf* part);
+
+// Decode *part in place (inverse of codec_encode).  0 = ok, -1 = corrupt
+// input (bounds-checked: a malicious stream cannot read/write out of
+// range).  Counts into native_codec_decodes only — the bytes counters
+// are encoder-side (metrics.h), so out/in reads as the wire saving.
+int codec_decode(uint8_t codec, IOBuf* part);
+
+// Test hook (capi): append `data` to an IOBuf in `chunk`-byte pieces
+// (forcing a multi-block chain), encode, decode, compare.  Returns 0
+// when the roundtrip is byte-exact, 1 when lossy (max |f32 error| in
+// *max_err), -1 on codec failure.
+int codec_roundtrip_chained(int codec, const uint8_t* data, size_t n,
+                            size_t chunk, double* max_err);
+
+}  // namespace trpc

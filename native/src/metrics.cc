@@ -66,6 +66,10 @@ size_t native_metrics_dump(char* buf, size_t cap) {
   put("native_fanout_subcalls", relu(m.fanout_subcalls));
   put("native_fanout_shared_serializations",
       relu(m.fanout_shared_serializations));
+  put("native_codec_encodes", relu(m.codec_encodes));
+  put("native_codec_decodes", relu(m.codec_decodes));
+  put("native_codec_bytes_in", relu(m.codec_bytes_in));
+  put("native_codec_bytes_out", relu(m.codec_bytes_out));
   put("native_stream_rsts_sent", relu(m.stream_rsts_sent));
   put("native_stream_rsts_received", relu(m.stream_rsts_received));
   put("native_stream_device_local_rail", relu(m.stream_device_local_rail));

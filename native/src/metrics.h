@@ -83,6 +83,15 @@ struct NativeMetrics {
   std::atomic<uint64_t> fanout_subcalls{0};
   std::atomic<uint64_t> fanout_shared_serializations{0};
 
+  // payload-codec rail (codec.cc): encodes/decodes = parts transcoded
+  // (a fan-out group encodes ONCE — compare against fanout_subcalls for
+  // the codec-once proof); bytes_in/bytes_out are ENCODER-side (plain
+  // in, encoded out): out/in is the wire saving
+  std::atomic<uint64_t> codec_encodes{0};
+  std::atomic<uint64_t> codec_decodes{0};
+  std::atomic<uint64_t> codec_bytes_in{0};
+  std::atomic<uint64_t> codec_bytes_out{0};
+
   // stream RST frames (stream.cc): abortive close carrying an error code
   std::atomic<uint64_t> stream_rsts_sent{0};
   std::atomic<uint64_t> stream_rsts_received{0};

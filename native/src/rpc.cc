@@ -25,6 +25,7 @@
 #include <unordered_map>
 #include <vector>
 
+#include "codec.h"
 #include "h2.h"
 #include "http.h"
 #include "metrics.h"
@@ -129,6 +130,12 @@ void EncodeMeta(const RpcMeta& m, MetaWriter* w) {
   if (m.plane_uid != 0) {
     w->tlv_u64(15, m.plane_uid);
   }
+  if (m.payload_codec != 0) {
+    w->tlv_u8(16, m.payload_codec);
+  }
+  if (m.attach_codec != 0) {
+    w->tlv_u8(17, m.attach_codec);
+  }
 }
 
 bool DecodeMeta(const char* p, size_t n, RpcMeta* m) {
@@ -158,6 +165,8 @@ bool DecodeMeta(const char* p, size_t n, RpcMeta* m) {
       case 13: m->auth.assign(v, len); break;
       case 14: if (len == 8) memcpy(&m->device_caps, v, 8); break;
       case 15: if (len == 8) memcpy(&m->plane_uid, v, 8); break;
+      case 16: if (len == 1) m->payload_codec = (uint8_t)v[0]; break;
+      case 17: if (len == 1) m->attach_codec = (uint8_t)v[0]; break;
       default: break;  // forward compatibility: skip unknown tags
     }
     i += len;
@@ -319,6 +328,12 @@ struct CallCtx {
   HandlerCb cb = nullptr;
   void* user = nullptr;
   uint8_t compress_type = 0;
+  // payload-codec rail (codec.h): the codec the request's parts arrived
+  // encoded with (already decoded at parse); respond() mirrors it
+  uint8_t payload_codec = 0;
+  // raw request credential (meta tag 13) for the pluggable Authenticator
+  // surface (token_auth); empty when the client sent none
+  std::string auth;
   // HTTP requests share the CallCtx/usercode-pool path; method carries the
   // verb, payload the body, and these the rest of the request line
   bool is_http = false;
@@ -926,7 +941,8 @@ uint64_t ServerDeviceCaps() {
 void SendResponse(SocketId sock_id, uint64_t correlation_id,
                   int32_t error_code, const char* error_text, IOBuf&& payload,
                   IOBuf&& attachment, uint64_t stream_id = 0,
-                  uint64_t stream_window = 0, uint8_t compress_type = 0) {
+                  uint64_t stream_window = 0, uint8_t compress_type = 0,
+                  uint8_t codec = 0) {
   Socket* s = Socket::Address(sock_id);
   if (s == nullptr) {
     return;
@@ -934,6 +950,15 @@ void SendResponse(SocketId sock_id, uint64_t correlation_id,
   RpcMeta meta;
   meta.correlation_id = correlation_id;
   meta.error_code = error_code;
+  if (codec != 0 && error_code == 0 && compress_type == 0) {
+    // mirror the request's payload codec (codec.h): each part encodes
+    // independently — an ineligible part rides plain with its tag 0.
+    // compress (tag 6) and codec are orthogonal rails: a response the
+    // usercode layer already compressed must NOT be quantized on top
+    // (a lossy pass over compressed bytes would corrupt them)
+    meta.payload_codec = codec_encode(codec, &payload);
+    meta.attach_codec = codec_encode(codec, &attachment);
+  }
   if (s->advertise_device_caps.load(std::memory_order_acquire)) {
     meta.device_caps = ServerDeviceCaps();
     meta.plane_uid = tpu_plane_uid();
@@ -978,6 +1003,7 @@ struct EchoFiberArg {
   SocketId sock;
   uint64_t corr;
   uint8_t compress;
+  uint8_t codec;  // request's payload codec, mirrored on the response
   IOBuf payload;
   IOBuf attachment;
 };
@@ -985,7 +1011,7 @@ struct EchoFiberArg {
 void EchoFiber(void* p) {
   EchoFiberArg* a = (EchoFiberArg*)p;
   SendResponse(a->sock, a->corr, 0, nullptr, std::move(a->payload),
-               std::move(a->attachment), 0, 0, a->compress);
+               std::move(a->attachment), 0, 0, a->compress, a->codec);
   a->payload.clear();
   a->attachment.clear();
   ObjectPool<EchoFiberArg>::Return(a);
@@ -997,6 +1023,7 @@ void EchoFiber(void* p) {
 struct HbmEchoArg {
   SocketId sock;
   uint64_t corr;
+  uint8_t codec = 0;  // request's payload codec, mirrored on the response
   IOBuf payload;
   IOBuf attachment;
 };
@@ -1023,7 +1050,7 @@ void HbmEchoFiber(void* p) {
     }
   }
   SendResponse(a->sock, a->corr, err, etext, std::move(a->payload),
-               std::move(resp_attach));
+               std::move(resp_attach), 0, 0, 0, a->codec);
   a->payload.clear();
   a->attachment.clear();
   ObjectPool<HbmEchoArg>::Return(a);
@@ -1882,6 +1909,25 @@ void ServerOnMessages(Socket* s) {
         s->peer_plane_uid.store(meta.plane_uid, std::memory_order_release);
       }
     }
+    // Payload-codec rail (codec.h): decode ON THIS PARSE FIBER — the
+    // socket's owning shard — so downstream dispatch (inline echo,
+    // HbmEcho DMA, usercode) sees plain bytes and shard confinement
+    // holds.  Frames are delimited, so a corrupt codec stream fails THIS
+    // call, not the connection.  req_codec is mirrored on the response.
+    uint8_t req_codec = meta.payload_codec != 0 ? meta.payload_codec
+                                                : meta.attach_codec;
+    if (req_codec != 0) {
+      if ((meta.payload_codec != 0 &&
+           codec_decode(meta.payload_codec, &payload) != 0) ||
+          (meta.attach_codec != 0 &&
+           codec_decode(meta.attach_codec, &attachment) != 0)) {
+        native_metrics().parse_errors.fetch_add(1,
+                                                std::memory_order_relaxed);
+        SendResponse(s->id(), meta.correlation_id, TRPC_EREQUEST,
+                     "undecodable payload codec", IOBuf(), IOBuf());
+        continue;
+      }
+    }
     srv->nrequests.fetch_add(1, std::memory_order_relaxed);
     ServiceHandler* sh = srv->services.find(meta.method);
     if (sh == nullptr) {
@@ -1919,6 +1965,8 @@ void ServerOnMessages(Socket* s) {
             rmeta.device_caps = ServerDeviceCaps();
             rmeta.plane_uid = tpu_plane_uid();
           }
+          // re-encode with the request's codec, still on the parse fiber
+          rmeta.payload_codec = codec_encode(req_codec, &payload);
           PackFrame(&batched_out, rmeta, std::move(payload), IOBuf());
           continue;
         }
@@ -1928,6 +1976,7 @@ void ServerOnMessages(Socket* s) {
       HbmEchoArg* a = ObjectPool<HbmEchoArg>::Get();
       a->sock = s->id();
       a->corr = meta.correlation_id;
+      a->codec = req_codec;
       a->payload = std::move(payload);
       a->attachment = std::move(attachment);
       fiber_t f;
@@ -1961,6 +2010,14 @@ void ServerOnMessages(Socket* s) {
           rmeta.device_caps = ServerDeviceCaps();
           rmeta.plane_uid = tpu_plane_uid();
         }
+        // mirror the request's payload codec: encode runs here on the
+        // parse fiber (the run-to-completion fast path, shard-confined).
+        // Skipped for compressed echoes — the payload is the client's
+        // compressed bytes, and quantizing those would corrupt them
+        if (rmeta.compress_type == 0) {
+          rmeta.payload_codec = codec_encode(req_codec, &payload);
+          rmeta.attach_codec = codec_encode(req_codec, &attachment);
+        }
         PackFrame(&batched_out, rmeta, std::move(payload),
                   std::move(attachment));
       } else {
@@ -1973,6 +2030,7 @@ void ServerOnMessages(Socket* s) {
         a->sock = s->id();
         a->corr = meta.correlation_id;
         a->compress = meta.compress_type;
+        a->codec = req_codec;
         a->payload = std::move(payload);
         a->attachment = std::move(attachment);
         fiber_t f;
@@ -1999,6 +2057,11 @@ void ServerOnMessages(Socket* s) {
       ctx->is_thrift = false;
       ctx->is_user_proto = false;
       ctx->compress_type = meta.compress_type;
+      ctx->payload_codec = req_codec;  // respond() mirrors it
+      // the raw credential rides to the usercode layer: the pluggable
+      // Authenticator (token_auth) verifies per request and builds the
+      // AuthContext there — native exact-match auth above is unchanged
+      ctx->auth = std::move(meta.auth);
       ctx->req_stream_id = meta.stream_id;
       ctx->req_stream_window = meta.feedback_bytes;
       ctx->accepted_stream = 0;
@@ -2644,7 +2707,8 @@ int respond(uint64_t token, int32_t error_code, const char* error_text,
   }
   SendResponse(ctx->sock, ctx->correlation_id, error_code, error_text,
                std::move(payload), std::move(attachment), accepted,
-               accepted != 0 ? stream_window(accepted) : 0, compress_type);
+               accepted != 0 ? stream_window(accepted) : 0, compress_type,
+               ctx->payload_codec);
   if (ctx->cancel_registered) {
     // ordering matters: unregister BEFORE the version bump, so a racing
     // canceller that still finds the token under g_cancel_mu is flagging
@@ -2655,6 +2719,7 @@ int respond(uint64_t token, int32_t error_code, const char* error_text,
   ctx->version.fetch_add(1, std::memory_order_release);  // invalidate token
   ctx->payload.clear();
   ctx->attachment.clear();
+  ctx->auth.clear();
   ResourcePool<CallCtx>::Return(slot);
   return 0;
 }
@@ -3077,6 +3142,49 @@ int token_compress_type(uint64_t token) {
   return ctx->compress_type;
 }
 
+size_t token_auth(uint64_t token, char* buf, size_t cap) {
+  uint32_t slot = (uint32_t)token;
+  uint32_t ver = (uint32_t)(token >> 32);
+  CallCtx* ctx = ResourcePool<CallCtx>::Address(slot);
+  if (ctx == nullptr ||
+      ctx->version.load(std::memory_order_acquire) != ver) {
+    return 0;
+  }
+  size_t n = ctx->auth.size() < cap ? ctx->auth.size() : cap;
+  if (n > 0) {
+    memcpy(buf, ctx->auth.data(), n);
+  }
+  return ctx->auth.size();
+}
+
+size_t token_peer(uint64_t token, char* buf, size_t cap) {
+  uint32_t slot = (uint32_t)token;
+  uint32_t ver = (uint32_t)(token >> 32);
+  CallCtx* ctx = ResourcePool<CallCtx>::Address(slot);
+  if (ctx == nullptr ||
+      ctx->version.load(std::memory_order_acquire) != ver) {
+    return 0;
+  }
+  Socket* s = Socket::Address(ctx->sock);
+  if (s == nullptr) {
+    return 0;
+  }
+  sockaddr_in peer;
+  socklen_t plen = sizeof(peer);
+  size_t out = 0;
+  if (getpeername(s->fd, (sockaddr*)&peer, &plen) == 0 &&
+      peer.sin_family == AF_INET) {
+    char ip[64];
+    inet_ntop(AF_INET, &peer.sin_addr, ip, sizeof(ip));
+    int n = snprintf(buf, cap, "%s:%d", ip, (int)ntohs(peer.sin_port));
+    if (n > 0) {
+      out = (size_t)n < cap ? (size_t)n : cap;
+    }
+  }
+  s->Dereference();
+  return out;
+}
+
 // The request's stream handle (0 if the client attached no stream).
 uint64_t token_stream_id(uint64_t token) {
   uint32_t slot = (uint32_t)token;
@@ -3370,7 +3478,12 @@ class Channel {
   std::string ip;
   int port = 0;
   int64_t connect_timeout_us = 500 * 1000;
-  std::string auth;  // credential riding every request meta (tag 13)
+  // credential riding every request meta (tag 13).  auth_mu makes
+  // channel_set_auth safe DURING traffic — the pluggable Authenticator
+  // rotates time-boxed credentials on a live channel (rpc/auth.py).
+  // mutable: SocketMapKeyOf reads through const Channel*.
+  mutable std::mutex auth_mu;
+  std::string auth;
   int conn_type = 0;  // 0 single (SocketMap-shared), 1 pooled, 2 short
   int protocol = 0;   // 0 TRPC, 1 HTTP/1.1 (client side)
   std::string host_header;  // HTTP Host: value (defaults to ip:port)
@@ -3555,6 +3668,21 @@ void ChannelOnMessages(Socket* s) {
     }
     pc->error_code = meta.error_code;
     pc->error_text = std::move(meta.error_text);
+    // payload-codec rail: decode on THIS parse fiber (the socket's owning
+    // shard), after the stale-response drop above — a response nobody
+    // waits for never pays the decode
+    if (meta.payload_codec != 0 || meta.attach_codec != 0) {
+      if ((meta.payload_codec != 0 &&
+           codec_decode(meta.payload_codec, &payload) != 0) ||
+          (meta.attach_codec != 0 &&
+           codec_decode(meta.attach_codec, &attachment) != 0)) {
+        nm.parse_errors.fetch_add(1, std::memory_order_relaxed);
+        pc->error_code = TRPC_ERESPONSE;
+        pc->error_text = "undecodable response codec";
+        payload.clear();
+        attachment.clear();
+      }
+    }
     pc->response = std::move(payload);
     pc->attachment = std::move(attachment);
     pc->stream_id = meta.stream_id;
@@ -3794,6 +3922,7 @@ std::string SocketMapKeyOf(const Channel* c) {
   k += ':';
   k += std::to_string(c->port);
   k += '|';
+  std::lock_guard lk(c->auth_mu);  // vs live credential rotation
   k += c->auth;
   return k;
 }
@@ -3813,7 +3942,13 @@ Socket* AcquireSingle(Channel* c, int* rc_out) {
     }
   }
   std::lock_guard lk(c->conn_mu);
-  std::string key = SocketMapKeyOf(c);
+  // Once attached, the channel's map identity is FROZEN at its
+  // first-attach key: credential ROTATION (channel_set_auth on a live
+  // channel) must not re-key redials — that would strand the refcount
+  // taken under the old key and register reconnects under a new entry
+  // with no ref (the per-request meta carries the rotated credential
+  // either way; the key only partitions connection sharing).
+  std::string key = c->map_attached ? c->map_key : SocketMapKeyOf(c);
   {
     // another channel (or a previous call) may have a live entry
     std::lock_guard mlk(g_socket_map_mu);
@@ -4013,6 +4148,7 @@ void channel_set_connect_timeout(Channel* c, int64_t us) {
 }
 
 void channel_set_auth(Channel* c, const uint8_t* secret, size_t len) {
+  std::lock_guard lk(c->auth_mu);
   c->auth.assign((const char*)secret, len);
 }
 
@@ -4189,7 +4325,10 @@ int channel_call(Channel* c, const char* method, const uint8_t* req,
   meta.method = method;
   meta.correlation_id = corr;
   meta.compress_type = compress;
-  meta.auth = c->auth;
+  {
+    std::lock_guard lk(c->auth_mu);  // vs live credential rotation
+    meta.auth = c->auth;
+  }
   if (c->device_plane) {
     meta.device_caps = 1;  // probe: answered by every response (tag 14)
     meta.plane_uid = tpu_plane_uid();  // tag 15: same-client detection
@@ -4204,6 +4343,16 @@ int channel_call(Channel* c, const char* method, const uint8_t* req,
   }
   if (attach != nullptr && attach_len > 0) {
     attachment.append(attach, attach_len);
+  }
+  // payload-codec rail (codec.h): encode per the reloadable
+  // TRPC_PAYLOAD_CODEC / payload_codec flag; the applied ids ride the
+  // meta (tags 16/17) and the server mirrors them on the response.
+  // Skipped when the caller already compressed (compress tag 6): the
+  // two rails are orthogonal and double-encoding helps neither.
+  uint8_t want_codec = compress == 0 ? (uint8_t)payload_codec() : 0;
+  if (want_codec != 0) {
+    meta.payload_codec = codec_encode(want_codec, &payload);
+    meta.attach_codec = codec_encode(want_codec, &attachment);
   }
   PackFrame(&frame, meta, std::move(payload), std::move(attachment));
   // Request corking (the client half of the PR-3 doorbell): hold the
@@ -4317,6 +4466,16 @@ int channel_fanout_call(Channel** chans, int n, const char* method,
     shared_attach.append(attach, attach_len);
   }
   nm.fanout_shared_serializations.fetch_add(1, std::memory_order_relaxed);
+  // Codec-once semantics (codec.h, ISSUE 8): the shared serialization is
+  // encoded ONCE here and the ENCODED refcounted blocks fan out to all N
+  // sub-frames — native_codec_encodes grows by the encoded part count
+  // (not by N) per group, the counter proof of 1 encode per fan-out.
+  uint8_t group_payload_codec = 0, group_attach_codec = 0;
+  uint8_t want_codec = (uint8_t)payload_codec();
+  if (want_codec != 0) {
+    group_payload_codec = codec_encode(want_codec, &shared_payload);
+    group_attach_codec = codec_encode(want_codec, &shared_attach);
+  }
 
   struct Sub {
     Socket* s = nullptr;
@@ -4379,11 +4538,16 @@ int channel_fanout_call(Channel** chans, int n, const char* method,
     RpcMeta meta;
     meta.method = method;
     meta.correlation_id = sb.corr;
-    meta.auth = chans[i]->auth;
+    {
+      std::lock_guard lk(chans[i]->auth_mu);  // vs credential rotation
+      meta.auth = chans[i]->auth;
+    }
     if (chans[i]->device_plane) {
       meta.device_caps = 1;
       meta.plane_uid = tpu_plane_uid();
     }
+    meta.payload_codec = group_payload_codec;  // the ONE shared encode
+    meta.attach_codec = group_attach_codec;
     IOBuf payload = shared_payload;  // BlockRef share, not a serialization
     IOBuf attachment = shared_attach;
     PackFrame(&sb.frame, meta, std::move(payload), std::move(attachment));
@@ -4766,7 +4930,21 @@ int run_echo_bench(const char* ip, int port, int nconn, int concurrency,
   }
   sh.channels = chans.data();
   sh.payload.assign((size_t)payload_size, 'x');
-  sh.attach.assign((size_t)attach_size, 'a');
+  // Deterministic f32 pattern in [-1, 1) for the attachment: the codec
+  // A/B (--codec-ab) measures tensor-shaped payloads — an all-'a' fill
+  // would make snappy look infinitely good and the quantizers
+  // meaningless.  Identical across runs/arms, so wire A/Bs stay exact.
+  sh.attach.resize((size_t)attach_size);
+  uint32_t lcg = 0x243f6a88u;
+  size_t fi = 0;
+  for (; fi + 4 <= (size_t)attach_size; fi += 4) {
+    lcg = lcg * 1664525u + 1013904223u;
+    float v = ((float)(lcg >> 8) / (float)(1u << 24)) * 2.0f - 1.0f;
+    memcpy(&sh.attach[fi], &v, 4);
+  }
+  for (; fi < (size_t)attach_size; ++fi) {
+    sh.attach[fi] = 'a';
+  }
 
   int64_t t0 = monotonic_ns();
   std::vector<fiber_t> fids(concurrency);

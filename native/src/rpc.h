@@ -22,6 +22,10 @@
 //  13 auth (bytes — connection credential, ≙ Authenticator,
 //     authenticator.h: the client's generate_credential output, verified
 //     server-side before dispatch)
+//  16 payload_codec (u8)               17 attach_codec (u8)
+//     — payload-codec rail (codec.h): the codec each body part is
+//     encoded with; absent = plain.  Responses mirror the request's
+//     codec; decode runs on the receiving parse fiber.
 #pragma once
 
 #include <cstdint>
@@ -51,6 +55,12 @@ struct RpcMeta {
   // up (device count in bits 8+), bit1 = server answered the probe (so
   // an explicit "no plane" is distinguishable from an old server).
   uint64_t device_caps = 0;
+  // tags 16/17 — payload-codec rail (codec.h): how payload / attachment
+  // are encoded on the wire.  Negotiated per call: the client picks (the
+  // TRPC_PAYLOAD_CODEC / payload_codec flag), the server mirrors it on
+  // the response.  0 = plain (tag omitted — codec off is byte-identical).
+  uint8_t payload_codec = 0;
+  uint8_t attach_codec = 0;
   // tag 15 — the sender's tpu_plane_uid, carried alongside the caps
   // probe/answer.  Equal uids on both ends = same process's PJRT client:
   // stream device frames may pass buffer handles and copy dev→dev with
@@ -195,6 +205,17 @@ int http_respond2(uint64_t token, int status, const char* headers_blob,
                   const char* trailers_blob);
 // Compress type of a pending request's meta (what the client used).
 int token_compress_type(uint64_t token);
+
+// Credential bytes (meta tag 13) of a pending usercode request — the
+// pluggable-Authenticator surface (≙ Authenticator::VerifyCredential
+// receiving auth_str, authenticator.h:30-75): Python verifies per
+// request and builds the AuthContext.  Copies min(len, cap) bytes into
+// buf; returns the credential's FULL length (0 = none/stale token).
+size_t token_auth(uint64_t token, char* buf, size_t cap);
+// Peer address ("ip:port") of a pending request's connection — the
+// client_addr argument of VerifyCredential.  Returns bytes written
+// (0 = stale token / address unavailable).
+size_t token_peer(uint64_t token, char* buf, size_t cap);
 
 // --- client ---------------------------------------------------------------
 

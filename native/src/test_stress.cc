@@ -29,6 +29,7 @@
 #include <thread>
 #include <vector>
 
+#include "codec.h"
 #include "common.h"
 #include "execution_queue.h"
 #include "metrics.h"
@@ -2341,6 +2342,166 @@ static void test_shard_handoff_races() {
   printf("ok shard_handoff_races (forced-shards child rc=%d)\n", rc);
 }
 
+// Payload-codec rail concurrency (ISSUE 8, codec.h): the surfaces that
+// interleave — (a) ENCODED refcounted blocks shared across a fan-out
+// group racing the group's harvest and a dead member's teardown, (b)
+// parse-fiber DECODE racing the connection being slammed shut mid-drain
+// (raw pipeliners burst encoded frames, including a corrupt codec body,
+// then close after reading a little), (c) per-shard codec scratch slots
+// reused concurrently from more contexts than slots (unary callers +
+// fan-out + server parse fibers all transcode at once), and (d) the
+// reloadable payload_codec flag flipping through every codec id under
+// live traffic.
+static void test_codec_races() {
+  set_codec_min_bytes(0);
+  set_payload_codec(CODEC_SNAPPY);
+  Server* srv = server_create();
+  server_add_service(srv, "Echo", 0, nullptr, nullptr);
+  CHECK_TRUE(server_start(srv, "127.0.0.1", 0) == 0);
+  int port = server_port(srv);
+
+  // f32 pattern: eligible for the quantizers, compressible for snappy
+  std::string f32_payload(16 * 1024, '\0');
+  for (size_t i = 0; i + 4 <= f32_payload.size(); i += 4) {
+    float v = (float)((i / 4) % 613) * 0.25f - 64.0f;
+    memcpy(&f32_payload[i], &v, 4);
+  }
+
+  std::atomic<bool> stop{false};
+  std::atomic<uint64_t> ok{0}, failed{0}, fan_rounds{0};
+  std::vector<std::thread> ts;
+
+  // (d) flag flipper: every codec id cycles under traffic (reloadable)
+  ts.emplace_back([&] {
+    int i = 0;
+    while (!stop.load(std::memory_order_acquire)) {
+      set_payload_codec(i & 3);  // none/snappy/bf16/int8
+      ++i;
+      usleep(600);
+    }
+  });
+
+  // (c) unary callers on single + pooled connections: encode on the
+  // caller thread, decode on the parse fibers — scratch slots churn
+  for (int t = 0; t < 3; ++t) {
+    ts.emplace_back([&, t] {
+      Channel* ch = channel_create("127.0.0.1", port);
+      channel_set_connection_type(ch, t % 2);
+      channel_set_connect_timeout(ch, 100 * 1000);
+      CallResult res;
+      while (!stop.load(std::memory_order_acquire)) {
+        int rc = channel_call(ch, "Echo",
+                              (const uint8_t*)f32_payload.data(),
+                              f32_payload.size(), nullptr, 0, 300 * 1000,
+                              &res);
+        if (rc == 0) {
+          ok.fetch_add(1);
+        } else {
+          failed.fetch_add(1);
+        }
+      }
+      channel_destroy(ch);
+    });
+  }
+
+  // (a) fan-out groups: 3 live members + 1 to a refused port — the ONE
+  // shared encode's blocks must survive the dead member's failure path
+  // and the harvest completing out of order
+  ts.emplace_back([&] {
+    int dead_port = port == 1 ? 2 : 1;  // nothing listens there
+    while (!stop.load(std::memory_order_acquire)) {
+      Channel* chans[4];
+      for (int i = 0; i < 3; ++i) {
+        chans[i] = channel_create("127.0.0.1", port);
+        channel_set_connection_type(chans[i], i == 2 ? 2 : 0);  // a short
+        channel_set_connect_timeout(chans[i], 50 * 1000);
+      }
+      chans[3] = channel_create("127.0.0.1", dead_port);
+      channel_set_connect_timeout(chans[3], 30 * 1000);
+      CallResult r[4];
+      CallResult* outs[4] = {&r[0], &r[1], &r[2], &r[3]};
+      for (int round = 0; round < 8 &&
+                          !stop.load(std::memory_order_acquire);
+           ++round) {
+        channel_fanout_call(chans, 4, "Echo",
+                            (const uint8_t*)f32_payload.data(),
+                            f32_payload.size(), nullptr, 0, 300 * 1000,
+                            outs);
+        fan_rounds.fetch_add(1);
+      }
+      for (Channel* c : chans) {
+        channel_destroy(c);
+      }
+    }
+  });
+
+  // (b) raw encoded bursts + a corrupt codec body, then slam the door:
+  // the parse fiber's decode (and its error respond) races teardown
+  ts.emplace_back([&] {
+    std::string burst;
+    for (int i = 0; i < 12; ++i) {
+      RpcMeta m;
+      m.method = "Echo";
+      m.correlation_id = 0x20000u + (uint32_t)i;  // responses ignored
+      IOBuf payload, frame;
+      payload.append(f32_payload.data(), 4096);
+      m.payload_codec = codec_encode(CODEC_SNAPPY, &payload);
+      PackFrame(&frame, m, std::move(payload), IOBuf());
+      burst += frame.to_string();
+    }
+    {
+      // corrupt: tag says snappy, body is garbage — must error-respond
+      RpcMeta m;
+      m.method = "Echo";
+      m.correlation_id = 0x2ffffu;
+      m.payload_codec = CODEC_SNAPPY;
+      IOBuf payload, frame;
+      std::string junk("\xff\xff\xff\xff not a snappy chunk");
+      payload.append(junk.data(), junk.size());
+      PackFrame(&frame, m, std::move(payload), IOBuf());
+      burst += frame.to_string();
+    }
+    while (!stop.load(std::memory_order_acquire)) {
+      int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+      sockaddr_in addr;
+      memset(&addr, 0, sizeof(addr));
+      addr.sin_family = AF_INET;
+      addr.sin_port = htons((uint16_t)port);
+      addr.sin_addr.s_addr = inet_addr("127.0.0.1");
+      if (::connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+        ::close(fd);
+        usleep(1000);
+        continue;
+      }
+      (void)!::write(fd, burst.data(), burst.size());
+      char sink[512];
+      (void)!::read(fd, sink, sizeof(sink));  // then slam the door
+      ::close(fd);
+    }
+  });
+
+  usleep(3200 * 1000);
+  stop.store(true, std::memory_order_release);
+  for (auto& t : ts) {
+    t.join();
+  }
+  server_destroy(srv);
+  set_payload_codec(CODEC_NONE);  // restore for later scenarios
+  set_codec_min_bytes(256);
+  NativeMetrics& nm = native_metrics();
+  uint64_t enc = nm.codec_encodes.load();
+  uint64_t dec = nm.codec_decodes.load();
+  CHECK_TRUE(ok.load() > 0);
+  CHECK_TRUE(fan_rounds.load() > 0);
+  CHECK_TRUE(enc > 0);  // the rail actually transcoded under the races
+  CHECK_TRUE(dec > 0);
+  printf("ok codec_races ok=%llu failed=%llu fan_rounds=%llu "
+         "encodes=%llu decodes=%llu\n",
+         (unsigned long long)ok.load(), (unsigned long long)failed.load(),
+         (unsigned long long)fan_rounds.load(), (unsigned long long)enc,
+         (unsigned long long)dec);
+}
+
 static void test_reuseport_accept_races() {
   int rc = run_forced_shards_child("__reuseport_accept_body", "2");
   CHECK_TRUE(rc == 0);
@@ -2379,6 +2540,7 @@ static const Scenario kScenarios[] = {
     {"sni_handshake_races", test_sni_handshake_races},
     {"profiler_races", test_profiler_races},
     {"sched_perturb_races", test_sched_perturb_races},
+    {"codec_races", test_codec_races},
     {"shard_handoff_races", test_shard_handoff_races},
     {"reuseport_accept_races", test_reuseport_accept_races},
 };

@@ -1,0 +1,186 @@
+"""Pluggable Authenticator + AuthContext (ISSUE 8 satellite, VERDICT
+Missing #1; ≙ authenticator.h:30-75).  Reference test style: a real
+loopback server, real channels, the portal exercised over live HTTP —
+both the accept and reject paths, from both sides of the credential."""
+
+import urllib.error
+import urllib.request
+
+import pytest
+
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.auth import (AuthContext, AuthError,
+                               HmacNonceAuthenticator)
+from brpc_tpu.rpc.channel import Channel, ChannelOptions
+from brpc_tpu.rpc.server import Server, ServerOptions
+
+SECRET = b"test-hmac-secret"
+
+
+@pytest.fixture()
+def auth_server():
+    seen = {}
+
+    def whoami(cntl, payload):
+        ctx = cntl.auth_context
+        seen["ctx"] = ctx
+        return (f"{ctx.user}|{ctx.group}|{','.join(ctx.roles)}"
+                f"|{ctx.client_addr}").encode()
+
+    srv = Server(ServerOptions(
+        authenticator=HmacNonceAuthenticator(SECRET, user="server"),
+        builtin_writable=True))
+    srv.add_service("Who.ami", whoami)
+    srv.start("127.0.0.1:0")
+    yield srv, seen
+    srv.destroy()
+
+
+class TestHmacNonceUnit:
+    def test_roundtrip_carries_identity(self):
+        a = HmacNonceAuthenticator(SECRET, user="alice", group="ml",
+                                   roles=("admin", "reader"))
+        cred = a.generate_credential()
+        ctx = a.verify_credential(cred, "10.0.0.7:123")
+        assert ctx.user == "alice"
+        assert ctx.group == "ml"
+        assert ctx.roles == ("admin", "reader")
+        assert ctx.has_role("admin") and not ctx.has_role("writer")
+        assert ctx.client_addr == "10.0.0.7:123"
+
+    def test_wrong_secret_and_tamper_rejected(self):
+        a = HmacNonceAuthenticator(SECRET, user="alice")
+        b = HmacNonceAuthenticator(b"other-secret", user="alice")
+        cred = a.generate_credential()
+        with pytest.raises(AuthError):
+            b.verify_credential(cred, "")
+        # claiming a different user under the same MAC must fail
+        parts = cred.split(b" ")
+        parts[1] = b"mallory"
+        with pytest.raises(AuthError):
+            a.verify_credential(b" ".join(parts), "")
+        with pytest.raises(AuthError):
+            a.verify_credential(b"garbage", "")
+
+    def test_replay_window(self):
+        a = HmacNonceAuthenticator(SECRET, user="alice", max_skew_s=0.0)
+        cred = a.generate_credential()
+        with pytest.raises(AuthError):
+            a.verify_credential(cred, "")  # 0s window: always stale
+
+
+class TestTrpcBothSides:
+    def test_good_credential_surfaces_auth_context(self, auth_server):
+        srv, seen = auth_server
+        ch = Channel(f"127.0.0.1:{srv.port}", options=ChannelOptions(
+            authenticator=HmacNonceAuthenticator(
+                SECRET, user="alice", group="ml", roles=("admin",))))
+        out = ch.call("Who.ami", b"")
+        user, group, roles, addr = out.decode().split("|")
+        assert user == "alice" and group == "ml" and roles == "admin"
+        assert addr.startswith("127.0.0.1:")  # token_peer fed client_addr
+        assert isinstance(seen["ctx"], AuthContext)
+        ch.close()
+
+    def test_bad_credential_gets_eauth(self, auth_server):
+        srv, _ = auth_server
+        ch = Channel(f"127.0.0.1:{srv.port}", options=ChannelOptions(
+            authenticator=HmacNonceAuthenticator(
+                b"wrong-secret", user="eve"), max_retry=0))
+        with pytest.raises(errors.RpcError) as ei:
+            ch.call("Who.ami", b"")
+        assert ei.value.code == errors.EAUTH
+        ch.close()
+
+    def test_missing_credential_gets_eauth(self, auth_server):
+        srv, _ = auth_server
+        ch = Channel(f"127.0.0.1:{srv.port}", max_retry=0)
+        with pytest.raises(errors.RpcError) as ei:
+            ch.call("Who.ami", b"")
+        assert ei.value.code == errors.EAUTH
+        ch.close()
+
+
+class TestCredentialRotation:
+    def test_long_lived_channel_outlives_replay_window(self):
+        """A channel older than max_skew_s must keep working: the client
+        rotates its time-boxed credential at half the window and pushes
+        it into the live native channel (rotation-safe under traffic)."""
+        import time as _t
+        srv = Server(ServerOptions(authenticator=HmacNonceAuthenticator(
+            SECRET, user="srv", max_skew_s=1.0)))
+        srv.add_service("Who.ami", lambda cntl, p:
+                        cntl.auth_context.user.encode())
+        srv.start("127.0.0.1:0")
+        ch = Channel(f"127.0.0.1:{srv.port}", options=ChannelOptions(
+            authenticator=HmacNonceAuthenticator(
+                SECRET, user="alice", max_skew_s=1.0), max_retry=0))
+        assert ch.call("Who.ami", b"") == b"alice"
+        first_cred = ch.options.auth
+        _t.sleep(1.2)  # past the 1s replay window
+        assert ch.call("Who.ami", b"") == b"alice"  # rotated, not EAUTH
+        assert ch.options.auth != first_cred
+        # the negative control: a STATIC stale credential is rejected
+        ch2 = Channel(f"127.0.0.1:{srv.port}", options=ChannelOptions(
+            auth=first_cred, max_retry=0))
+        with pytest.raises(errors.RpcError) as ei:
+            ch2.call("Who.ami", b"")
+        assert ei.value.code == errors.EAUTH
+        ch.close()
+        ch2.close()
+        srv.destroy()
+
+
+class TestSharedOptions:
+    def test_shared_channel_options_not_mutated(self):
+        """Two Channels sharing one ChannelOptions each generate their
+        OWN credential (the options object is copied before injection) —
+        channel B must not inherit A's frozen nonce, and the caller's
+        object stays untouched."""
+        opts = ChannelOptions(
+            authenticator=HmacNonceAuthenticator(SECRET, user="a"))
+        a = Channel("127.0.0.1:1", options=opts)
+        b = Channel("127.0.0.1:1", options=opts)
+        assert opts.auth is None            # caller's object untouched
+        assert a.options.auth and b.options.auth
+        assert a.options.auth != b.options.auth  # distinct nonces
+        assert a._cred_born is not None and b._cred_born is not None
+        a.close()
+        b.close()
+
+
+class TestPortalFlagsGating:
+    def _set_flag(self, port, header=None):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/flags/inline_budget_requests"
+            f"?setvalue=512")
+        if header:
+            req.add_header("Authorization", header)
+        return urllib.request.urlopen(req, timeout=10)
+
+    def test_mutation_requires_verified_admin(self, auth_server):
+        srv, _ = auth_server
+        # no credential: listing works, mutation is 403
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/flags", timeout=10) as r:
+            assert r.status == 200
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self._set_flag(srv.port)
+        assert ei.value.code == 403
+        # verified but NOT admin: still 403
+        user_cred = HmacNonceAuthenticator(
+            SECRET, user="bob").generate_credential().decode()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self._set_flag(srv.port, user_cred)
+        assert ei.value.code == 403
+        # a forged credential on the header is an outright 401
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self._set_flag(srv.port, "hmac1 root x - - deadbeef")
+        assert ei.value.code == 401
+        # verified admin: the mutation lands
+        admin_cred = HmacNonceAuthenticator(
+            SECRET, user="ops", roles=("admin",)) \
+            .generate_credential().decode()
+        with self._set_flag(srv.port, admin_cred) as r:
+            assert r.status == 200
+            assert b"set to" in r.read()

@@ -162,3 +162,46 @@ def test_hot_path_allocation_fails(tmp_path):
     v = [x for x in run_lint(root) if x.rule == "allocations"]
     lines = sorted(x.line for x in v)
     assert lines == [2, 3], v
+
+
+def test_codec_hot_path_allocation_fails(tmp_path):
+    """ISSUE 8: the codec rail's encode/decode run on parse fibers and
+    sit inside the no-raw-alloc gate — a staging buffer heap-allocated
+    per operation (instead of drawn from the scratch pool) must be
+    flagged; the pool seam's lint:allow-alloc escape must not."""
+    root = _mini_repo(tmp_path)
+    (tmp_path / "native" / "src" / "codec.cc").write_text(
+        textwrap.dedent("""\
+            uint8_t codec_encode(uint8_t codec, IOBuf* part) {
+              char* staging = (char*)malloc(65536);  // per-op: flagged
+              return 0;
+            }
+            int codec_decode(uint8_t codec, IOBuf* part) {
+              return 0;
+            }
+            CodecScratch* scratch_acquire(CodecScratch* temp) {
+              s->in = (char*)malloc(n);  // lint:allow-alloc(pool seam)
+              return temp;
+            }
+            int EncodeSnappyChain(const IOBuf& in, IOBuf* out) {
+              return 0;
+            }
+            int DecodeSnappyChain(const IOBuf& in, IOBuf* out) {
+              return 0;
+            }
+            int EncodeBf16Chain(const IOBuf& in, IOBuf* out) {
+              return 0;
+            }
+            int DecodeBf16Chain(const IOBuf& in, IOBuf* out) {
+              return 0;
+            }
+            int EncodeInt8Chain(const IOBuf& in, IOBuf* out) {
+              return 0;
+            }
+            int DecodeInt8Chain(const IOBuf& in, IOBuf* out) {
+              return 0;
+            }
+            """))
+    v = [x for x in run_lint(root) if x.rule == "allocations"]
+    assert len(v) == 1 and v[0].line == 2, v
+    assert v[0].path == os.path.join("native", "src", "codec.cc")
