@@ -66,6 +66,12 @@ def main() -> int:
                     help="back-to-back client-cork A/B at the echo grid's "
                          "concurrency-256 config (one subprocess per arm: "
                          "TRPC_CLIENT_CORK=0 vs 1, --repeat honored)")
+    ap.add_argument("--telemetry-ab", action="store_true",
+                    help="telemetry-overhead A/B (ISSUE 9): full echo "
+                         "grid with TRPC_TELEMETRY=0 vs 1 (one subprocess "
+                         "per arm — histogram writes + per-request clock "
+                         "reads on vs off), --repeat honored; the bands "
+                         "must overlap within the ±20% single-core noise")
     ap.add_argument("--codec-ab", action="store_true",
                     help="payload-codec A/B (ISSUE 8): attachment GB/s "
                          "sweep at 512KB/1MB/4MB per codec "
@@ -140,6 +146,26 @@ def main() -> int:
                     allreduce[codec] = {"error": str(e)}
             out["allreduce"] = allreduce
         print(json.dumps(out))
+        return 0
+
+    if args.telemetry_ab:
+        me = os.path.abspath(__file__)
+        table = {}
+        for arm, extra in (("off", {"TRPC_TELEMETRY": "0"}),
+                           ("on", {"TRPC_TELEMETRY": "1"})):
+            env = dict(os.environ)
+            env.update(extra)
+            cmd = [sys.executable, me, "--no-scaling",
+                   "--repeat", str(max(1, args.repeat))]
+            if args.brief:
+                cmd.append("--brief")
+            try:
+                r = subprocess.run(cmd, capture_output=True, text=True,
+                                   timeout=900, env=env)
+                table[arm] = json.loads(r.stdout.strip().splitlines()[-1])
+            except Exception as e:  # noqa: BLE001 — arm recorded null
+                table[arm] = {"error": str(e)}
+        print(json.dumps({"metric": "telemetry_ab", "table": table}))
         return 0
 
     if args.client_cork_ab:
@@ -374,6 +400,21 @@ def main() -> int:
             "native_inline_dispatch_fallbacks"),
         "cork_responses_per_flush": native_counter(
             "native_batch_cork_responses_per_flush"),
+        # hot-path telemetry (ISSUE 9): SERVER-side percentiles from the
+        # native histograms beside the client-measured numbers above —
+        # inline_echo is what the server saw for the same requests
+        # (client_unary is issue->completion including the wait)
+        "telemetry": "on" if bool(L.trpc_telemetry_active()) else "off",
+        "server_p50_us": native_counter(
+            "native_latency_inline_echo_p50_us"),
+        "server_p99_us": native_counter(
+            "native_latency_inline_echo_p99_us"),
+        "server_p999_us": native_counter(
+            "native_latency_inline_echo_p999_us"),
+        "server_hist_count": native_counter(
+            "native_latency_inline_echo_count"),
+        "client_hist_p99_us": native_counter(
+            "native_latency_client_unary_p99_us"),
         "client_cork": "on" if bool(L.trpc_client_cork_active()) else "off",
         "client_cork_windows": native_counter("native_client_cork_windows"),
         "client_inline_completes": native_counter(

@@ -425,6 +425,42 @@ def _declare(L: ctypes.CDLL) -> None:
     # native metrics seam + profiler (metrics.h, profiler.h)
     L.trpc_native_metrics_dump.argtypes = [c.c_char_p, c.c_size_t]
     L.trpc_native_metrics_dump.restype = c.c_size_t
+    # hot-path telemetry plane (metrics.h, ISSUE 9): per-shard latency
+    # histograms, native rpcz span rings, cross-hop trace context
+    L.trpc_set_telemetry.argtypes = [c.c_int]
+    L.trpc_set_telemetry.restype = None
+    L.trpc_telemetry_active.argtypes = []
+    L.trpc_telemetry_active.restype = c.c_int
+    L.trpc_telemetry_percentile_us.argtypes = [c.c_int, c.c_double]
+    L.trpc_telemetry_percentile_us.restype = c.c_int64
+    L.trpc_telemetry_count.argtypes = [c.c_int]
+    L.trpc_telemetry_count.restype = c.c_uint64
+    L.trpc_telemetry_inflight.argtypes = [c.c_int]
+    L.trpc_telemetry_inflight.restype = c.c_int64
+    L.trpc_telemetry_family_name.argtypes = [c.c_int]
+    L.trpc_telemetry_family_name.restype = c.c_char_p
+    L.trpc_telemetry_families.argtypes = []
+    L.trpc_telemetry_families.restype = c.c_int
+    L.trpc_telemetry_prom_dump.argtypes = [c.c_char_p, c.c_size_t]
+    L.trpc_telemetry_prom_dump.restype = c.c_size_t
+    L.trpc_set_rpcz.argtypes = [c.c_int]
+    L.trpc_set_rpcz.restype = None
+    L.trpc_rpcz_active.argtypes = []
+    L.trpc_rpcz_active.restype = c.c_int
+    L.trpc_set_rpcz_budget.argtypes = [c.c_int64]
+    L.trpc_set_rpcz_budget.restype = None
+    L.trpc_rpcz_drain.argtypes = [c.c_char_p, c.c_size_t]
+    L.trpc_rpcz_drain.restype = c.c_size_t
+    L.trpc_trace_set_current.argtypes = [c.c_uint64, c.c_uint64, c.c_int]
+    L.trpc_trace_set_current.restype = None
+    L.trpc_trace_current.argtypes = [c.POINTER(c.c_uint64),
+                                     c.POINTER(c.c_uint64)]
+    L.trpc_trace_current.restype = c.c_int
+    L.trpc_trace_annotate.argtypes = [c.c_char_p]
+    L.trpc_trace_annotate.restype = None
+    L.trpc_token_trace.argtypes = [c.c_uint64, c.POINTER(c.c_uint64),
+                                   c.POINTER(c.c_uint64)]
+    L.trpc_token_trace.restype = c.c_int
     # schedule perturbation / replay (native/src/sched_perturb.h)
     L.trpc_sched_set_seed.argtypes = [c.c_uint64]
     L.trpc_sched_set_seed.restype = None

@@ -37,8 +37,9 @@ _SERVICES = [
     ("/connections", "live server connections"),
     ("/metrics", "Prometheus text exposition"),
     ("/fibers", "fiber runtime counters (≙ /bthreads)"),
-    ("/rpcz", "sampled RPC spans (?trace_id=, ?max_scan=, ?time= reads "
-              "persisted spans back from disk)"),
+    ("/rpcz", "sampled RPC spans incl. native fast-path captures "
+              "(?trace_id=, ?max_scan=, ?time= reads persisted spans "
+              "back from disk, ?view=tree renders the trace tree)"),
     ("/hotspots", "collapsed-stack CPU samples (?seconds=, ?view=flame)"),
     ("/pprof/profile", "native SIGPROF profile (?seconds=, ?hz=)"),
     ("/pprof/heap", "sampled live heap (?interval=; first hit enables; "
@@ -96,8 +97,12 @@ def _vars(req: HttpRequest) -> HttpResponse:
 
 
 def _metrics(req: HttpRequest) -> HttpResponse:
+    # bvar gauges + the native histogram exposition (real cumulative
+    # _bucket{le=...} series per method family — metrics.h telemetry)
+    from brpc_tpu.metrics.native import native_prometheus_text
+    text = bvar.dump_prometheus() + native_prometheus_text()
     return HttpResponse(200, {"Content-Type": "text/plain; version=0.0.4"},
-                        bvar.dump_prometheus().encode())
+                        text.encode())
 
 
 def _fibers(req: HttpRequest) -> HttpResponse:
@@ -376,11 +381,17 @@ def install_builtin_services(server, dispatcher: HttpDispatcher) -> None:
     d.register("/pprof/contention", _pprof_contention)
 
     def _status(req: HttpRequest) -> HttpResponse:
+        # `methods` = Python-dispatched handlers (LatencyRecorder);
+        # `native_methods` = the families that never leave the native
+        # core (inline echo, redis cache, client unary, ...) read from
+        # the per-shard histograms — the fast path's latency story
+        from brpc_tpu.metrics.native import native_family_stats
         return HttpResponse.json({
             "version": VERSION,
             "uptime_s": round(time.time() - _START_TIME, 1),
             "requests": server.request_count(),
             "methods": server.method_stats(),
+            "native_methods": native_family_stats(),
         })
 
     def _connections(req: HttpRequest) -> HttpResponse:
@@ -527,12 +538,50 @@ def install_builtin_services(server, dispatcher: HttpDispatcher) -> None:
                 return HttpResponse.text(
                     "span persistence is off (set the rpcz_persist_dir "
                     "flag)\n", 400)
+            _span.drain_native()  # fast-path spans spill before the read
             spans = _span.read_persisted(at_ts, max_scan)
             if tid is not None:
                 spans = [s for s in spans if s.trace_id == tid]
+            if params.get("view") == "tree":
+                return _rpcz_tree_html(spans)
             return HttpResponse.json([s.describe() for s in spans])
         spans = _span.recent_spans(max_scan, tid)
+        if params.get("view") == "tree":
+            return _rpcz_tree_html(spans)
         return HttpResponse.json([s.describe() for s in spans])
+
+    def _rpcz_tree_html(spans) -> HttpResponse:
+        """Trace tree: children indented under their parent_span_id
+        (≙ rpcz_service.cpp's per-trace drill-down view)."""
+        import html as _html
+        by_parent = {}
+        ids = {s.span_id for s in spans}
+        for s in sorted(spans, key=lambda s: s.start_ts):
+            # roots: no parent, or the parent's span lives in another
+            # process (the cross-hop case — its subtree still renders)
+            key = s.parent_span_id if s.parent_span_id in ids else 0
+            by_parent.setdefault(key, []).append(s)
+        lines = []
+
+        def walk(parent_id: int, depth: int) -> None:
+            for s in by_parent.get(parent_id, []):
+                d = s.describe()
+                annot = "; ".join(d["annotations"])
+                lines.append(
+                    "&nbsp;" * (4 * depth) +
+                    _html.escape(
+                        f"[{d['kind']}] {d['method']} span={d['span_id']} "
+                        f"parent={d['parent_span_id']} "
+                        f"{d['latency_us']}us err={d['error_code']}"
+                        + (f"  // {annot}" if annot else "")))
+                if s.span_id != parent_id:  # guard a self-parented span
+                    walk(s.span_id, depth + 1)
+
+        walk(0, 0)
+        body = ("<html><head><title>rpcz trace tree</title></head><body>"
+                "<tt>" + "<br>".join(lines or ["(no spans)"]) +
+                "</tt></body></html>")
+        return HttpResponse.html(body)
 
     d.register("/status", _status)
     d.register("/connections", _connections)

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import ctypes
 import threading
+import time
 from typing import Dict
 
 from brpc_tpu._native import lib
@@ -34,14 +35,84 @@ def read_native_metrics() -> Dict[str, int]:
     return out
 
 
+def native_prometheus_text() -> str:
+    """The native histogram exposition (real cumulative ``_bucket{le=}``
+    series per method family + ``_sum``/``_count``) — appended to the
+    portal's /metrics output beside the bvar gauges."""
+    buf = ctypes.create_string_buffer(1 << 18)
+    n = lib().trpc_telemetry_prom_dump(buf, len(buf))
+    return buf.raw[:n].decode()
+
+
+def native_families() -> tuple:
+    """Method-family names of native/src/metrics.h TelemetryFamily, in
+    id order — derived from the C++ table through capi so a family added
+    natively surfaces here without a Python edit."""
+    L = lib()
+    return tuple(L.trpc_telemetry_family_name(f).decode()
+                 for f in range(int(L.trpc_telemetry_families())))
+
+
+# lazy per-family rate window for /status qps: (monotonic_ts, count)
+# samples appended at READ time — /status is scraped at human frequency,
+# so the window self-assembles from consecutive scrapes; a single scrape
+# falls back to count/uptime-since-install
+_rate_lock = threading.Lock()
+_rate_hist: Dict[int, list] = {}
+_rate_t0 = None
+
+
+def native_family_stats() -> Dict[str, dict]:
+    """Per-family qps / percentiles / inflight from the native histograms
+    — the /status block for the methods Python never sees (the
+    inline-dispatched fast path finally has a latency story)."""
+    global _rate_t0
+    L = lib()
+    now = time.monotonic()
+    out: Dict[str, dict] = {}
+    with _rate_lock:
+        if _rate_t0 is None:
+            _rate_t0 = now
+        for f, name in enumerate(native_families()):
+            count = int(L.trpc_telemetry_count(f))
+            hist = _rate_hist.setdefault(f, [])
+            hist.append((now, count))
+            # keep ~60s of scrape samples
+            while len(hist) > 2 and now - hist[0][0] > 60.0:
+                hist.pop(0)
+            t_old, c_old = hist[0]
+            if now - t_old >= 0.5 and count >= c_old:
+                qps = (count - c_old) / (now - t_old)
+            else:
+                # first scrape: average since the plane started observing
+                qps = count / max(now - _rate_t0, 1e-9) \
+                    if now > _rate_t0 else 0.0
+            out[name] = {
+                "qps": round(qps, 1),
+                "count": count,
+                "latency_50_us": int(L.trpc_telemetry_percentile_us(f, 0.5)),
+                "latency_99_us": int(
+                    L.trpc_telemetry_percentile_us(f, 0.99)),
+                "latency_999_us": int(
+                    L.trpc_telemetry_percentile_us(f, 0.999)),
+                "inflight": int(L.trpc_telemetry_inflight(f)),
+            }
+    return out
+
+
 def install_native_metrics() -> None:
     """Expose every native counter as a PassiveStatus bvar (idempotent).
     Called from Server.start(); safe to call standalone."""
-    global _installed
+    global _installed, _rate_t0
     with _install_lock:
         if _installed:
             return
         _installed = True
+        # anchor the /status qps fallback window at server start: the
+        # FIRST scrape after load then reports count/elapsed instead of 0
+        with _rate_lock:
+            if _rate_t0 is None:
+                _rate_t0 = time.monotonic()
         for name in read_native_metrics():
             # each var re-reads the full dump: reads happen at human
             # frequency (portal/dump), writes stay single-atomic

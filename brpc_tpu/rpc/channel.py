@@ -420,50 +420,77 @@ class Channel:
             payload = compress_mod.compress(payload, compress_type)
 
         from brpc_tpu.rpc import span as span_mod
-        sp = span_mod.start_span("client", method)
+        # cross-hop inheritance: a client span created INSIDE a server
+        # handler parents at the current server span, continuing the
+        # caller's trace (≙ Channel::CallMethod inheriting tls_parent,
+        # channel.cpp:467-485)
+        parent = span_mod.current()
+        sp = span_mod.start_span(
+            "client", method,
+            trace_id=parent.trace_id if parent is not None else 0,
+            parent_span_id=parent.span_id if parent is not None else 0)
+        saved_trace = None
+        if sp is not None:
+            # push this span into the thread's native TraceCtx so the
+            # wire (TLV tags 7/8) carries it — the server parents its
+            # span here.  python_owned=1 stops the native layer from
+            # capturing a duplicate client-unary span for this call.
+            # Saved/restored so a handler's LATER downstream calls still
+            # parent at the server span.  (Backup-request hedge attempts
+            # run on their own threads and skip propagation.)
+            L = lib()
+            _t, _s = ctypes.c_uint64(0), ctypes.c_uint64(0)
+            owned = L.trpc_trace_current(ctypes.byref(_t),
+                                         ctypes.byref(_s))
+            saved_trace = (_t.value, _s.value, owned)
+            L.trpc_trace_set_current(sp.trace_id, sp.span_id, 1)
 
         # arm the cancellation window (≙ Controller::call_id being valid
         # from IssueRPC on): start_cancel from another thread claims the
         # published id; between attempts the flag stops the retry loop
         cntl._call_id_buf = ctypes.c_uint64(0)
 
-        attempt = 0
-        while True:
-            if cntl._cancel_requested:
-                cntl.set_failed(errors.ECANCELED,
-                                "canceled before the attempt")
-                break
-            remaining_us = (deadline - time.monotonic_ns()) // 1000
-            if remaining_us <= 0:
-                cntl.set_failed(errors.ERPCTIMEDOUT)
-                break
-            code, text, data, att = self._call_attempt(
-                mb, payload, attachment, remaining_us, backup_ms, cntl,
-                compress_type)
-            cntl.error_code, cntl.error_text = code, text
-            if code == 0:
-                cntl.response_attachment = att
-                cntl.latency_us = (time.monotonic_ns() - start) // 1000
-                Channel._latency.record(cntl.latency_us)
+        try:
+            attempt = 0
+            while True:
+                if cntl._cancel_requested:
+                    cntl.set_failed(errors.ECANCELED,
+                                    "canceled before the attempt")
+                    break
+                remaining_us = (deadline - time.monotonic_ns()) // 1000
+                if remaining_us <= 0:
+                    cntl.set_failed(errors.ERPCTIMEDOUT)
+                    break
+                code, text, data, att = self._call_attempt(
+                    mb, payload, attachment, remaining_us, backup_ms, cntl,
+                    compress_type)
+                cntl.error_code, cntl.error_text = code, text
+                if code == 0:
+                    cntl.response_attachment = att
+                    cntl.latency_us = (time.monotonic_ns() - start) // 1000
+                    Channel._latency.record(cntl.latency_us)
+                    if sp is not None:
+                        sp.remote_side = cntl.remote_side
+                        span_mod.finish_span(sp, 0)
+                    self._check_transport_settled()
+                    return data
+                if attempt >= max_retry or not policy.do_retry(cntl):
+                    break
+                attempt += 1
+                cntl.retried_count = attempt
                 if sp is not None:
-                    sp.remote_side = cntl.remote_side
-                    span_mod.finish_span(sp, 0)
-                self._check_transport_settled()
-                return data
-            if attempt >= max_retry or not policy.do_retry(cntl):
-                break
-            attempt += 1
-            cntl.retried_count = attempt
+                    sp.annotate(f"retry #{attempt} after E{code}")
+                backoff = policy.backoff_us(attempt)
+                if backoff > 0:
+                    time.sleep(backoff / 1e6)
+            cntl.latency_us = (time.monotonic_ns() - start) // 1000
             if sp is not None:
-                sp.annotate(f"retry #{attempt} after E{code}")
-            backoff = policy.backoff_us(attempt)
-            if backoff > 0:
-                time.sleep(backoff / 1e6)
-        cntl.latency_us = (time.monotonic_ns() - start) // 1000
-        if sp is not None:
-            sp.remote_side = cntl.remote_side
-            span_mod.finish_span(sp, cntl.error_code)
-        raise errors.RpcError(cntl.error_code, cntl.error_text)
+                sp.remote_side = cntl.remote_side
+                span_mod.finish_span(sp, cntl.error_code)
+            raise errors.RpcError(cntl.error_code, cntl.error_text)
+        finally:
+            if saved_trace is not None:
+                lib().trpc_trace_set_current(*saved_trace)
 
     @property
     def transport_state(self) -> str:

@@ -39,7 +39,10 @@ class Controller:
         # dispatcher when ServerOptions.authenticator verified the
         # request's credential; None otherwise
         self.auth_context = None
-        # tracing (rpcz)
+        # tracing (rpcz): server side, the INBOUND trace/span ids from
+        # meta tags 7/8 (≙ Controller::trace_id feeding span parentage)
+        # — populated by the server dispatcher via trpc_token_trace;
+        # 0/0 when the caller sent no trace context
         self.trace_id: int = 0
         self.span_id: int = 0
         # populated after a call
@@ -109,6 +112,20 @@ class Controller:
         timeout_us = -1 if timeout_s is None else int(timeout_s * 1e6)
         return lib().trpc_call_wait_canceled(
             self._stream_token, timeout_us) == 1
+
+    def trace_annotate(self, text: str) -> None:
+        """TRACEPRINTF (≙ traceprintf.h): free text into the current rpcz
+        span.  With a sampled Python span current (the normal handler
+        case) the annotation lands there; otherwise it rides the native
+        twin — the next native-captured span on this thread (e.g. the
+        client-unary span of a downstream call made right after) carries
+        it.  No-op when rpcz is off or the request wasn't sampled."""
+        from brpc_tpu.rpc import span as _span
+        if _span.current() is not None:
+            _span.annotate(text)
+        else:
+            from brpc_tpu._native import lib
+            lib().trpc_trace_annotate(text.encode("utf-8", "replace"))
 
     def failed(self) -> bool:
         return self.error_code != 0

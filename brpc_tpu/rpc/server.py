@@ -136,6 +136,22 @@ flags.define_int32("inline_budget_us", 500,
                    "back to the spawned path (reloadable)",
                    validator=_push_inline_budget_us)
 
+
+def _push_telemetry(value) -> bool:
+    lib().trpc_set_telemetry(1 if value else 0)
+    return True
+
+
+flags.define_bool("telemetry",
+                  os.environ.get("TRPC_TELEMETRY") != "0",
+                  "native hot-path telemetry plane (metrics.h): per-shard "
+                  "latency histograms + inflight gauges for the method "
+                  "families that never leave the native core, and the "
+                  "rpcz span rings; off = no histogram writes, no span "
+                  "capture, no extra clock reads — the TRPC_TELEMETRY=0 "
+                  "A/B baseline (reloadable)",
+                  validator=_push_telemetry)
+
 _HANDLER_CB = ctypes.CFUNCTYPE(
     None, ctypes.c_uint64, ctypes.c_char_p,
     ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,
@@ -447,6 +463,16 @@ class Server:
             cntl = Controller()
             cntl._stream_token = token
             cntl.method = method.decode() if method else name
+            # cross-hop trace ingress: surface the INBOUND trace/span ids
+            # (meta tags 7/8) on the Controller — the server span created
+            # below parents at the caller's span, and the native TraceCtx
+            # (already stamped by the usercode pool) carries the hop into
+            # any downstream channel_call this handler makes
+            tid = ctypes.c_uint64(0)
+            sid = ctypes.c_uint64(0)
+            if L.trpc_token_trace(token, ctypes.byref(tid),
+                                  ctypes.byref(sid)) == 0:
+                cntl.trace_id, cntl.span_id = tid.value, sid.value
             sp = None
             try:
                 authn = limiter_box.options.authenticator
@@ -497,9 +523,17 @@ class Server:
                         return  # finally below still releases the limiter
                 cntl.request_attachment = (
                     ctypes.string_at(att_p, att_len) if att_len else b"")
-                sp = span.start_span("server", cntl.method)
+                # server span inherits the inbound trace: parent_span_id
+                # = the caller's span (≙ Span::CreateServerSpan with
+                # received ids) — /rpcz?trace_id= assembles the tree
+                sp = span.start_span("server", cntl.method,
+                                     trace_id=cntl.trace_id,
+                                     parent_span_id=cntl.span_id)
                 span.set_current(sp)
                 if sp is not None:
+                    # re-point the native hop at the sampled server span:
+                    # downstream calls now parent HERE, not at the caller
+                    L.trpc_trace_set_current(sp.trace_id, sp.span_id, 0)
                     # queue-inclusive arm stamp from the parse loop's
                     # coarse clock (one native clock read per drain):
                     # rpcz shows how long the request waited for a
@@ -673,6 +707,14 @@ class Server:
             codec_mod.id_of(flags.get_flag("payload_codec")))
         lib().trpc_set_codec_min_bytes(
             int(flags.get_flag("codec_min_bytes")))
+        # hot-path telemetry plane (metrics.h): histograms + native rpcz
+        # rings follow the resolved flags before the first request
+        lib().trpc_set_telemetry(
+            1 if flags.get_flag("telemetry") else 0)
+        lib().trpc_set_rpcz(
+            1 if flags.get_flag("enable_rpcz") else 0)
+        lib().trpc_set_rpcz_budget(
+            int(flags.get_flag("rpcz_max_samples_per_second")))
         if self.options.enable_builtin_services:
             from brpc_tpu.builtin import install_builtin_services
             install_builtin_services(self, self.http)

@@ -33,9 +33,29 @@ from typing import List, Optional
 from brpc_tpu.utils import flags
 from brpc_tpu.utils import recordio
 
-flags.define_bool("enable_rpcz", False, "collect rpcz spans")
+def _push_rpcz(value) -> bool:
+    """Flag validator doubling as the native push: the C++ span rings
+    (native/src/metrics.h rpcz_*) capture fast-path spans only while the
+    native half of the switch is on."""
+    from brpc_tpu._native import lib
+    lib().trpc_set_rpcz(1 if value else 0)
+    return True
+
+
+def _push_rpcz_budget(value) -> bool:
+    if value < 0:
+        return False
+    from brpc_tpu._native import lib
+    lib().trpc_set_rpcz_budget(int(value))
+    return True
+
+
+flags.define_bool("enable_rpcz", False, "collect rpcz spans",
+                  validator=_push_rpcz)
 flags.define_int32("rpcz_max_samples_per_second", 16384,
-                   "span sampling budget (≙ COLLECTOR_SAMPLING_BASE)")
+                   "span sampling budget (≙ COLLECTOR_SAMPLING_BASE); "
+                   "shared by the Python spans and the native span rings",
+                   validator=_push_rpcz_budget)
 flags.define_int32("rpcz_keep_spans", 10000, "ring size of kept spans")
 flags.define_string("rpcz_persist_dir", "",
                     "directory for rpcz span spill files (recordio, "
@@ -321,6 +341,90 @@ def persisting() -> bool:
     return bool(flags.get_flag("rpcz_persist_dir"))
 
 
+# --- native fast-path spans (native/src/metrics.h span rings) ---------------
+# Inline-dispatched requests never enter Python, so their sampled spans
+# live in per-shard native rings; drain_native() pulls them into the SAME
+# store/persistence the Python spans use — /rpcz shows one merged view,
+# and the recordio spill rides the shared Collector unchanged.
+
+# presentation (kind, method label) per native family id (metrics.h
+# TelemetryFamily); a family added natively falls back to its capi name
+# with kind "server" instead of a blind "native" label
+_NATIVE_FAMILY_VIEW = {
+    0: ("server", "Echo (native inline)"),
+    1: ("server", "HbmEcho (native)"),
+    2: ("server", "redis_cache (native)"),
+    3: ("server", "usercode (native)"),
+    4: ("client", "client (native unary)"),
+    5: ("client", "fanout (native group)"),
+}
+
+
+def _family_view(fam: int):
+    view = _NATIVE_FAMILY_VIEW.get(fam)
+    if view is not None:
+        return view
+    try:
+        from brpc_tpu._native import lib
+        return ("server",
+                lib().trpc_telemetry_family_name(fam).decode() +
+                " (native)")
+    except Exception:
+        return ("server", "native")
+
+
+_drain_lock = threading.Lock()
+
+
+def drain_native() -> int:
+    """Move captured native spans into the span store (returns how many).
+    Called on every /rpcz read and recent_spans() — reads happen at human
+    frequency; the native side is lock-free for its writers."""
+    if not enabled():
+        return 0
+    try:
+        import ctypes
+        from brpc_tpu._native import lib
+    except Exception:
+        return 0  # native core unavailable (exotic import contexts)
+    moved = 0
+    # rebase CLOCK_MONOTONIC capture stamps onto the wall clock once per
+    # drain (both sides read the same kernel clocks on Linux)
+    offset = time.time() - time.monotonic()
+    with _drain_lock:
+        buf = ctypes.create_string_buffer(1 << 20)
+        while True:
+            n = lib().trpc_rpcz_drain(buf, len(buf))
+            if n == 0:
+                break
+            for line in buf.raw[:n].decode("utf-8", "replace").splitlines():
+                parts = line.split("\t")
+                if len(parts) < 8:
+                    continue
+                try:
+                    fam = int(parts[3])
+                    kind, method = _family_view(fam)
+                    s = Span(
+                        trace_id=int(parts[0]), span_id=int(parts[1]),
+                        parent_span_id=int(parts[2]),
+                        kind=kind, method=method,
+                        start_ts=int(parts[6]) / 1e9 + offset,
+                        latency_us=int(parts[7]),
+                        error_code=int(parts[4]),
+                        annotations=[a for a in parts[8].split("|") if a])
+                except (ValueError, IndexError):
+                    continue
+                s.remote_side = f"shard{parts[5]}"
+                _store.add(s)
+                if persisting():
+                    from brpc_tpu.metrics.collector import global_collector
+                    global_collector().submit(_SpanSample(s))
+                moved += 1
+            if n < len(buf) - 256:
+                break  # the rings are drained (not a buffer-full stop)
+    return moved
+
+
 def read_persisted(at_ts: Optional[float] = None,
                    limit: int = 100) -> List[Span]:
     """Disk read-back for /rpcz?time= (spans survive restarts)."""
@@ -376,6 +480,7 @@ def annotate(text: str) -> None:
 
 
 def recent_spans(n: int = 100, trace_id: Optional[int] = None) -> List[Span]:
+    drain_native()  # fast-path spans surface beside the Python ones
     return _store.recent(n, trace_id)
 
 

@@ -34,7 +34,24 @@ class PressResult:
                 f"qps={self.qps:.0f} "
                 f"p50={self.percentile(.5):.0f}us "
                 f"p90={self.percentile(.9):.0f}us "
-                f"p99={self.percentile(.99):.0f}us")
+                f"p99={self.percentile(.99):.0f}us "
+                f"p999={self.percentile(.999):.0f}us")
+
+    def to_json_line(self) -> str:
+        """One machine-readable JSON line (the overload-control harness
+        of ROADMAP item 4 diff-checks these across pressure levels)."""
+        import json
+        return json.dumps({
+            "metric": "rpc_press",
+            "calls": self.calls,
+            "errors": self.errors,
+            "wall_s": round(self.wall_s, 3),
+            "qps": round(self.qps, 1),
+            "p50_us": self.percentile(.5),
+            "p90_us": self.percentile(.9),
+            "p99_us": self.percentile(.99),
+            "p999_us": self.percentile(.999),
+        })
 
 
 def press(server: str, method: str, payload: bytes, qps: float = 0.0,
@@ -155,12 +172,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="wire protocol (HTTP/1.1 via 'GET /path' methods)")
     ap.add_argument("-t", "--time", type=float, default=5.0,
                     help="duration seconds")
+    ap.add_argument("--json", action="store_true",
+                    help="print ONE JSON summary line (qps + "
+                         "p50/p90/p99/p999) instead of the text summary")
     args = ap.parse_args(argv)
     payload = (open(args.file, "rb").read() if args.file
                else args.data.encode())
     res = press(args.server, args.method, payload, args.qps,
                 args.concurrency, args.time, protocol=args.protocol)
-    print(res.summary())
+    print(res.to_json_line() if args.json else res.summary())
     return 1 if res.errors and not res.calls - res.errors else 0
 
 

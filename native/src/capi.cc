@@ -737,6 +737,75 @@ size_t trpc_native_metrics_dump(char* buf, size_t cap) {
   return native_metrics_dump(buf, cap);
 }
 
+// --- hot-path telemetry plane (metrics.h, ISSUE 9) --------------------------
+
+// Reloadable master switch (TRPC_TELEMETRY seeds the default; the
+// `telemetry` flag pushes through here) — off is the bench A/B baseline.
+void trpc_set_telemetry(int on) { set_telemetry(on); }
+int trpc_telemetry_active() { return telemetry_enabled() ? 1 : 0; }
+
+// Folded per-family histogram reads (percentile by log-bucket walk).
+int64_t trpc_telemetry_percentile_us(int family, double q) {
+  return telemetry_percentile_us(family, q);
+}
+uint64_t trpc_telemetry_count(int family) { return telemetry_count(family); }
+int64_t trpc_telemetry_inflight(int family) {
+  return telemetry_inflight(family);
+}
+const char* trpc_telemetry_family_name(int family) {
+  return telemetry_family_name(family);
+}
+// Number of method families — the Python layer derives its family list
+// from name(0..n-1) so a family added in metrics.h shows up in /status
+// and the span labels without touching Python.
+int trpc_telemetry_families() { return TF_FAMILIES; }
+
+// Prometheus exposition: real cumulative _bucket{le=...} series per
+// family + _sum/_count (the portal appends this to /metrics).
+size_t trpc_telemetry_prom_dump(char* buf, size_t cap) {
+  return telemetry_prom_dump(buf, cap);
+}
+
+// Native rpcz: span capture for inline-dispatched / native-client calls.
+// The Python enable_rpcz flag drives the switch; the budget mirrors
+// rpcz_max_samples_per_second (collector-style rate limit).
+void trpc_set_rpcz(int on) { rpcz_set_enabled(on); }
+int trpc_rpcz_active() { return rpcz_native_enabled() ? 1 : 0; }
+void trpc_set_rpcz_budget(int64_t per_second) {
+  rpcz_set_budget(per_second);
+}
+// Drain captured spans as tab-separated lines (consumed; they surface
+// exactly once, through the Python Collector into span.py's store).
+size_t trpc_rpcz_drain(char* buf, size_t cap) { return rpcz_drain(buf, cap); }
+
+// Cross-hop trace context of the calling thread (fiber-local parent):
+// trace_set_current(0,0,0) clears; python_owned=1 marks "the Python
+// layer created this hop's client span" so native skips its duplicate.
+void trpc_trace_set_current(uint64_t trace_id, uint64_t span_id,
+                            int python_owned) {
+  trace_set_current(trace_id, span_id, python_owned);
+}
+int trpc_trace_current(uint64_t* trace_id, uint64_t* span_id) {
+  TraceCtx tc = trace_current();
+  if (trace_id != nullptr) {
+    *trace_id = tc.trace_id;
+  }
+  if (span_id != nullptr) {
+    *span_id = tc.span_id;
+  }
+  return tc.python_owned ? 1 : 0;
+}
+// TRACEPRINTF twin: annotation rides the next native span captured on
+// this thread (no-op while rpcz is off).
+void trpc_trace_annotate(const char* text) { trace_annotate(text); }
+
+// Inbound trace/span ids (meta tags 7/8) of a pending usercode request —
+// the Controller.trace_id surface.  Returns 0, -1 for stale tokens.
+int trpc_token_trace(uint64_t token, uint64_t* trace_id,
+                     uint64_t* span_id) {
+  return token_trace(token, trace_id, span_id);
+}
+
 // --- schedule perturbation / replay (sched_perturb.h) -----------------------
 
 // Seed the schedule-fuzzing mode (0 disables; the `sched_seed`

@@ -1,7 +1,12 @@
 #include "metrics.h"
 
 #include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
 
+#include <mutex>
+
+#include "common.h"
 #include "sched_perturb.h"
 #include "shard.h"
 #include "tpu.h"
@@ -11,6 +16,430 @@ namespace trpc {
 NativeMetrics& native_metrics() {
   static NativeMetrics* m = new NativeMetrics();  // leaked on purpose
   return *m;
+}
+
+// ---------------------------------------------------------------------------
+// Hot-path telemetry plane (see metrics.h).  Storage is per shard
+// (≙ bvar per-cpu agents, folded only at read time): a parse fiber only
+// ever touches its own shard's cache lines, so the write side is one
+// relaxed fetch_add per bucket/sum — no locks, no allocation (the lint
+// no-raw-alloc gate covers telemetry_record/rpcz_capture).
+
+namespace {
+
+// metrics_manifest families: tools/lint.py expands the %s in exported
+// "native_..._%s_..." name literals against THIS list, so every concrete
+// series name lands in tools/metrics_manifest.txt.  Order = TelemetryFamily.
+static const char* kTelemetryFamilyNames[TF_FAMILIES] = {
+    "inline_echo", "hbm_echo", "redis_cache", "usercode",
+    "client_unary", "fanout_group"};
+
+struct LatHist {
+  std::atomic<uint64_t> buckets[kHistFiniteBuckets + 1];  // +1 = +Inf
+  std::atomic<uint64_t> sum_us{0};
+  std::atomic<int64_t> inflight{0};
+};
+
+// [shard][family] — shard agents fold at read time; kMaxShards is tiny
+// (8) so the whole plane is ~11KB of atomics.
+LatHist g_hist[kMaxShards][TF_FAMILIES];
+
+// -1 = resolve TRPC_TELEMETRY on first use (flag-cached; the reloadable
+// `telemetry` flag overrides through set_telemetry)
+std::atomic<int> g_telemetry{-1};
+
+int telemetry_resolve() {
+  const char* e = getenv("TRPC_TELEMETRY");
+  int on = (e == nullptr || e[0] != '0') ? 1 : 0;
+  int expected = -1;
+  g_telemetry.compare_exchange_strong(expected, on,
+                                      std::memory_order_acq_rel);
+  return g_telemetry.load(std::memory_order_acquire);
+}
+
+inline int bucket_of(int64_t lat_us) {
+  if (lat_us <= 1) {
+    return 0;
+  }
+  // bucket k holds (2^(k-1), 2^k]: k = ceil(log2(lat))
+  int k = 64 - __builtin_clzll((uint64_t)(lat_us - 1));
+  return k < kHistFiniteBuckets ? k : kHistFiniteBuckets;  // +Inf overflow
+}
+
+inline int clamp_family(int family) {
+  return (family >= 0 && family < TF_FAMILIES) ? family : 0;
+}
+
+inline int clamp_shard(int shard) {
+  // off-worker callers (current_shard() == -1) fold into shard 0's agent
+  return (shard >= 0 && shard < kMaxShards) ? shard : 0;
+}
+
+// fold one family's buckets across shard agents into out[] / *sum
+uint64_t fold_family(int family, uint64_t out[kHistFiniteBuckets + 1],
+                     uint64_t* sum) {
+  uint64_t total = 0, s = 0;
+  memset(out, 0, sizeof(uint64_t) * (kHistFiniteBuckets + 1));
+  int nshards = shard_count();
+  for (int k = 0; k < nshards && k < kMaxShards; ++k) {
+    const LatHist& h = g_hist[k][family];
+    for (int i = 0; i <= kHistFiniteBuckets; ++i) {
+      uint64_t v = h.buckets[i].load(std::memory_order_relaxed);
+      out[i] += v;
+      total += v;
+    }
+    s += h.sum_us.load(std::memory_order_relaxed);
+  }
+  if (sum != nullptr) {
+    *sum = s;
+  }
+  return total;
+}
+
+}  // namespace
+
+void set_telemetry(int on) {
+  g_telemetry.store(on != 0 ? 1 : 0, std::memory_order_release);
+}
+
+bool telemetry_enabled() {
+  int v = g_telemetry.load(std::memory_order_acquire);
+  if (TRPC_UNLIKELY(v < 0)) {
+    v = telemetry_resolve();
+  }
+  return v != 0;
+}
+
+const char* telemetry_family_name(int family) {
+  return kTelemetryFamilyNames[clamp_family(family)];
+}
+
+void telemetry_record(int family, int shard, int64_t lat_us) {
+  if (lat_us < 0) {
+    lat_us = 0;  // coarse-clock arm stamps can sit slightly in the future
+  }
+  LatHist& h = g_hist[clamp_shard(shard)][clamp_family(family)];
+  h.buckets[bucket_of(lat_us)].fetch_add(1, std::memory_order_relaxed);
+  h.sum_us.fetch_add((uint64_t)lat_us, std::memory_order_relaxed);
+}
+
+void telemetry_inflight_add(int family, int shard, int64_t d) {
+  g_hist[clamp_shard(shard)][clamp_family(family)].inflight.fetch_add(
+      d, std::memory_order_relaxed);
+}
+
+int64_t telemetry_percentile_us(int family, double q) {
+  family = clamp_family(family);
+  uint64_t buckets[kHistFiniteBuckets + 1];
+  uint64_t total = fold_family(family, buckets, nullptr);
+  if (total == 0) {
+    return 0;
+  }
+  if (q < 0.0) {
+    q = 0.0;
+  }
+  if (q > 1.0) {
+    q = 1.0;
+  }
+  // rank is 1-based so q=1.0 lands in the last populated bucket
+  uint64_t rank = (uint64_t)(q * (double)total);
+  if (rank == 0) {
+    rank = 1;
+  }
+  uint64_t cum = 0;
+  for (int i = 0; i <= kHistFiniteBuckets; ++i) {
+    uint64_t n = buckets[i];
+    if (cum + n < rank) {
+      cum += n;
+      continue;
+    }
+    int64_t lo = i == 0 ? 0 : (int64_t)1 << (i - 1);
+    // +Inf bucket reports its lower bound ×2: an honest "beyond the
+    // histogram" marker rather than a fabricated interpolation
+    int64_t hi = i < kHistFiniteBuckets ? (int64_t)1 << i : lo * 2;
+    double frac = n > 0 ? (double)(rank - cum) / (double)n : 1.0;
+    return lo + (int64_t)((double)(hi - lo) * frac);
+  }
+  return (int64_t)1 << kHistFiniteBuckets;
+}
+
+uint64_t telemetry_count(int family) {
+  uint64_t buckets[kHistFiniteBuckets + 1];
+  return fold_family(clamp_family(family), buckets, nullptr);
+}
+
+uint64_t telemetry_sum_us(int family) {
+  uint64_t buckets[kHistFiniteBuckets + 1];
+  uint64_t sum = 0;
+  fold_family(clamp_family(family), buckets, &sum);
+  return sum;
+}
+
+int64_t telemetry_inflight(int family) {
+  family = clamp_family(family);
+  int64_t v = 0;
+  int nshards = shard_count();
+  for (int k = 0; k < nshards && k < kMaxShards; ++k) {
+    v += g_hist[k][family].inflight.load(std::memory_order_relaxed);
+  }
+  return v;
+}
+
+size_t telemetry_prom_dump(char* buf, size_t cap) {
+  size_t off = 0;
+  auto emit = [&](const char* fmt, auto... args) {
+    int n = snprintf(buf + off, off < cap ? cap - off : 0, fmt, args...);
+    if (n > 0) {
+      off += (size_t)n;
+      if (off > cap) {
+        off = cap;
+      }
+    }
+  };
+  emit("# TYPE native_latency_us histogram\n");
+  for (int f = 0; f < TF_FAMILIES; ++f) {
+    uint64_t buckets[kHistFiniteBuckets + 1];
+    uint64_t sum = 0;
+    uint64_t total = fold_family(f, buckets, &sum);
+    uint64_t cum = 0;
+    for (int i = 0; i < kHistFiniteBuckets; ++i) {
+      cum += buckets[i];
+      emit("native_latency_us_bucket{family=\"%s\",le=\"%llu\"} %llu\n",
+           kTelemetryFamilyNames[f], (unsigned long long)(1ULL << i),
+           (unsigned long long)cum);
+    }
+    // the +Inf cumulative IS the count by construction (both derive from
+    // one bucket fold), so a scrape can never see them disagree
+    emit("native_latency_us_bucket{family=\"%s\",le=\"+Inf\"} %llu\n",
+         kTelemetryFamilyNames[f], (unsigned long long)total);
+    emit("native_latency_us_sum{family=\"%s\"} %llu\n",
+         kTelemetryFamilyNames[f], (unsigned long long)sum);
+    emit("native_latency_us_count{family=\"%s\"} %llu\n",
+         kTelemetryFamilyNames[f], (unsigned long long)total);
+  }
+  emit("# TYPE native_inflight gauge\n");
+  for (int f = 0; f < TF_FAMILIES; ++f) {
+    emit("native_inflight{family=\"%s\"} %lld\n", kTelemetryFamilyNames[f],
+         (long long)telemetry_inflight(f));
+  }
+  return off;
+}
+
+// --- native rpcz span rings ------------------------------------------------
+
+namespace {
+
+constexpr int kSpanRingSlots = 256;  // per shard; drained at read time
+
+struct SpanSlot {
+  // seqlock: odd = writer inside; readers retry/skip on instability
+  std::atomic<uint32_t> seq{0};
+  NativeSpan span;
+};
+
+struct SpanRing {
+  std::atomic<uint64_t> head{0};  // next slot index to claim (mod slots)
+  uint64_t tail = 0;              // consumed watermark (under drain_mu)
+  std::mutex drain_mu;
+  SpanSlot slots[kSpanRingSlots];
+};
+
+SpanRing g_rings[kMaxShards];
+
+// -1 = resolve TRPC_RPCZ on first use (flag-cached; the Python
+// enable_rpcz validator overrides through rpcz_set_enabled)
+std::atomic<int> g_rpcz{-1};
+std::atomic<int64_t> g_rpcz_budget{16384};  // ≙ COLLECTOR_SAMPLING_BASE
+// token bucket refilled per ~second (monotonic_ns >> 30 ≈ 1.07s epochs;
+// collector-style rate limit, exactness is not the point)
+std::atomic<int64_t> g_rpcz_epoch{-1};
+std::atomic<int64_t> g_rpcz_left{0};
+
+int rpcz_resolve() {
+  // flag-cached: the ONE env read; the resolved value lives in g_rpcz
+  const char* e = getenv("TRPC_RPCZ");
+  int on = (e != nullptr && e[0] != '\0' && e[0] != '0') ? 1 : 0;
+  int expected = -1;
+  g_rpcz.compare_exchange_strong(expected, on, std::memory_order_acq_rel);
+  return g_rpcz.load(std::memory_order_acquire);
+}
+
+// per-thread pending annotation buffer (trace_annotate) — attached to
+// the next native span captured on this thread
+thread_local char t_annot[sizeof(NativeSpan::annotations)];
+thread_local size_t t_annot_len = 0;
+thread_local TraceCtx t_trace;
+
+}  // namespace
+
+void rpcz_set_enabled(int on) {
+  g_rpcz.store(on != 0 ? 1 : 0, std::memory_order_release);
+}
+
+bool rpcz_native_enabled() {
+  int v = g_rpcz.load(std::memory_order_acquire);
+  if (TRPC_UNLIKELY(v < 0)) {
+    v = rpcz_resolve();
+  }
+  return v != 0;
+}
+
+void rpcz_set_budget(int64_t per_second) {
+  g_rpcz_budget.store(per_second > 0 ? per_second : 0,
+                      std::memory_order_release);
+}
+
+bool rpcz_try_sample() {
+  if (!rpcz_native_enabled() || !telemetry_enabled()) {
+    return false;
+  }
+  int64_t epoch = monotonic_ns() >> 30;
+  int64_t seen = g_rpcz_epoch.load(std::memory_order_acquire);
+  if (seen != epoch &&
+      g_rpcz_epoch.compare_exchange_strong(seen, epoch,
+                                           std::memory_order_acq_rel)) {
+    // refill winner: losers draw from whatever remains of the old epoch
+    // for one race window — collector semantics, not an exact meter
+    g_rpcz_left.store(g_rpcz_budget.load(std::memory_order_relaxed),
+                      std::memory_order_release);
+  }
+  return g_rpcz_left.fetch_sub(1, std::memory_order_acq_rel) > 0;
+}
+
+uint64_t rpcz_next_id() {
+  // SplitMix64 over a per-boot random base: ids look random (they are
+  // browsed/correlated by humans) yet cost one relaxed fetch_add
+  static std::atomic<uint64_t> ctr{
+      (uint64_t)monotonic_ns() * 0x9e3779b97f4a7c15ULL + 0x1234567ULL};
+  uint64_t z = ctr.fetch_add(0x9e3779b97f4a7c15ULL,
+                             std::memory_order_relaxed);
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+  z ^= z >> 31;
+  return z != 0 ? z : 1;  // 0 means "no id" on the wire
+}
+
+void rpcz_capture(const NativeSpan& s) {
+  int shard = clamp_shard(s.shard);
+  SpanRing& ring = g_rings[shard];
+  uint64_t idx = ring.head.fetch_add(1, std::memory_order_acq_rel);
+  SpanSlot& slot = ring.slots[idx % kSpanRingSlots];
+  // CLAIM the slot (even -> odd CAS) before writing: captures come from
+  // arbitrary threads, and the ring can lap a stalled writer — a second
+  // writer blindly bumping seq would flip it back to even mid-write and
+  // let a drain emit torn data as "stable".  A failed claim means the
+  // prior tenant is still inside the slot: this sample is DROPPED
+  // (counted), never co-written.
+  uint32_t seq = slot.seq.load(std::memory_order_acquire);
+  if ((seq & 1u) != 0 ||
+      !slot.seq.compare_exchange_strong(seq, seq + 1,
+                                        std::memory_order_acq_rel)) {
+    shard_counters(shard).rpcz_drops.fetch_add(1,
+                                               std::memory_order_relaxed);
+    native_metrics().rpcz_spans_dropped.fetch_add(
+        1, std::memory_order_relaxed);
+    return;
+  }
+  slot.span = s;
+  slot.seq.fetch_add(1, std::memory_order_release);  // even: stable
+  shard_counters(shard).rpcz_samples.fetch_add(1,
+                                               std::memory_order_relaxed);
+  native_metrics().rpcz_spans_sampled.fetch_add(1,
+                                                std::memory_order_relaxed);
+}
+
+size_t rpcz_drain(char* buf, size_t cap) {
+  size_t off = 0;
+  NativeMetrics& nm = native_metrics();
+  for (int k = 0; k < kMaxShards; ++k) {
+    SpanRing& ring = g_rings[k];
+    std::lock_guard<std::mutex> lk(ring.drain_mu);
+    uint64_t head = ring.head.load(std::memory_order_acquire);
+    uint64_t from = ring.tail;
+    if (head - from > (uint64_t)kSpanRingSlots) {
+      // ring lapped the drain: the overwritten spans are gone
+      uint64_t lost = head - from - kSpanRingSlots;
+      shard_counters(k).rpcz_drops.fetch_add(lost,
+                                             std::memory_order_relaxed);
+      nm.rpcz_spans_dropped.fetch_add(lost, std::memory_order_relaxed);
+      from = head - kSpanRingSlots;
+    }
+    for (uint64_t i = from; i < head; ++i) {
+      SpanSlot& slot = ring.slots[i % kSpanRingSlots];
+      uint32_t s0 = slot.seq.load(std::memory_order_acquire);
+      NativeSpan sp = slot.span;
+      uint32_t s1 = slot.seq.load(std::memory_order_acquire);
+      if ((s0 & 1u) != 0 || s0 != s1) {
+        // a writer is mid-slot (the ring lapped us during the walk):
+        // the torn span is counted, not emitted half-written
+        shard_counters(k).rpcz_drops.fetch_add(1,
+                                               std::memory_order_relaxed);
+        nm.rpcz_spans_dropped.fetch_add(1, std::memory_order_relaxed);
+        continue;
+      }
+      sp.annotations[sizeof(sp.annotations) - 1] = '\0';
+      int n = snprintf(
+          buf + off, off < cap ? cap - off : 0,
+          "%llu\t%llu\t%llu\t%d\t%d\t%d\t%lld\t%lld\t%s\n",
+          (unsigned long long)sp.trace_id, (unsigned long long)sp.span_id,
+          (unsigned long long)sp.parent_span_id, (int)sp.family,
+          (int)sp.error_code, (int)sp.shard,
+          (long long)sp.start_mono_ns, (long long)sp.latency_us,
+          sp.annotations);
+      if (n > 0 && off + (size_t)n <= cap) {
+        off += (size_t)n;
+      } else {
+        // out of buffer: stop consuming so the rest surfaces next drain
+        ring.tail = i;
+        return off;
+      }
+    }
+    ring.tail = head;
+  }
+  return off;
+}
+
+// --- cross-hop trace context ----------------------------------------------
+
+TraceCtx trace_current() { return t_trace; }
+
+void trace_set_current(uint64_t trace_id, uint64_t span_id,
+                       int python_owned) {
+  t_trace.trace_id = trace_id;
+  t_trace.span_id = span_id;
+  t_trace.python_owned = python_owned != 0;
+  if (trace_id == 0 && span_id == 0) {
+    t_annot_len = 0;  // context cleared: orphaned annotations go with it
+  }
+}
+
+void trace_annotate(const char* text) {
+  if (!rpcz_native_enabled() || text == nullptr) {
+    return;  // unsampled TRACEPRINTF is free (≙ traceprintf.h)
+  }
+  size_t n = strlen(text);
+  size_t room = sizeof(t_annot) - 1;
+  if (t_annot_len > 0 && t_annot_len < room) {
+    t_annot[t_annot_len++] = '|';
+  }
+  for (size_t i = 0; i < n && t_annot_len < room; ++i) {
+    char c = text[i];
+    // the drain line format is tab/newline-delimited
+    t_annot[t_annot_len++] = (c == '\t' || c == '\n') ? ' ' : c;
+  }
+  t_annot[t_annot_len] = '\0';
+}
+
+size_t trace_take_annotations(char* buf, size_t cap) {
+  if (cap == 0) {
+    t_annot_len = 0;
+    return 0;
+  }
+  size_t n = t_annot_len < cap - 1 ? t_annot_len : cap - 1;
+  memcpy(buf, t_annot, n);
+  buf[n] = '\0';
+  t_annot_len = 0;
+  return n;
 }
 
 size_t native_metrics_dump(char* buf, size_t cap) {
@@ -91,6 +520,37 @@ size_t native_metrics_dump(char* buf, size_t cap) {
   put("native_uring_sendzc_fallbacks", relu(m.uring_sendzc_fallbacks));
   put("native_uring_zc_pool_slots", rel(m.uring_zc_pool_slots));
   put("native_uring_zc_pool_in_use", rel(m.uring_zc_pool_in_use));
+  put("native_rpcz_spans_sampled", relu(m.rpcz_spans_sampled));
+  put("native_rpcz_spans_dropped", relu(m.rpcz_spans_dropped));
+  // hot-path telemetry plane: per-family latency percentiles (derived
+  // from the per-shard log-bucket histograms at read time), counts and
+  // inflight gauges — what /status, /vars and the periodic bvar dump see
+  // for the methods that never leave the native core
+  for (int f = 0; f < TF_FAMILIES; ++f) {
+    const char* fam = telemetry_family_name(f);
+    auto putf = [&](const char* fmt, long long v) {
+      int n = snprintf(buf + off, off < cap ? cap - off : 0, fmt, fam, v);
+      if (n > 0) {
+        off += (size_t)n;
+        if (off > cap) {
+          off = cap;
+        }
+      }
+    };
+    putf("native_latency_%s_p50_us %lld\n",
+         (long long)telemetry_percentile_us(f, 0.50));
+    putf("native_latency_%s_p90_us %lld\n",
+         (long long)telemetry_percentile_us(f, 0.90));
+    putf("native_latency_%s_p99_us %lld\n",
+         (long long)telemetry_percentile_us(f, 0.99));
+    putf("native_latency_%s_p999_us %lld\n",
+         (long long)telemetry_percentile_us(f, 0.999));
+    putf("native_latency_%s_count %lld\n",
+         (long long)telemetry_count(f));
+    putf("native_latency_%s_sum_us %lld\n",
+         (long long)telemetry_sum_us(f));
+    putf("native_inflight_%s %lld\n", (long long)telemetry_inflight(f));
+  }
   put("native_sched_perturb_yields", relu(m.sched_perturb_yields));
   put("native_sched_perturb_steal_shuffles",
       relu(m.sched_perturb_steal_shuffles));

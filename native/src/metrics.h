@@ -136,6 +136,13 @@ struct NativeMetrics {
   std::atomic<int64_t> uring_zc_pool_slots{0};
   std::atomic<int64_t> uring_zc_pool_in_use{0};
 
+  // native rpcz span capture (metrics.cc rings): sampled = spans that
+  // landed in a shard ring; dropped = spans lost to ring laps or torn
+  // drain reads.  A sustained dropped climb means the Python drain is
+  // not keeping up with the sampling budget.
+  std::atomic<uint64_t> rpcz_spans_sampled{0};
+  std::atomic<uint64_t> rpcz_spans_dropped{0};
+
   // schedule perturbation (sched_perturb.cc, TRPC_SCHED_SEED): yields =
   // injected pauses/spins/budget truncations at instrumented seams;
   // steal_shuffles = seeded steal-victim + placement-detour draws;
@@ -151,5 +158,111 @@ NativeMetrics& native_metrics();
 // Write "name value\n" lines (plus the device-plane counters from tpu.h)
 // into buf; returns bytes written (truncated at cap).
 size_t native_metrics_dump(char* buf, size_t cap);
+
+// ---------------------------------------------------------------------------
+// Hot-path telemetry plane (ISSUE 9; ≙ the reference's per-method
+// LatencyRecorder feeding /status, latency_recorder.h:32-75, and the
+// bvar::Collector-throttled rpcz spans, span.h:47 + collector.h:41).
+// The PR-3/5/7 fast paths execute run-to-completion on parse fibers and
+// never touch the Python LatencyRecorder — these per-shard structures
+// make exactly that traffic observable: lock-free relaxed-atomic writes
+// on the owning shard, percentiles/fold at read time only.
+
+// Native method families with their own latency histogram + inflight
+// gauge (≙ per-method MethodStatus for the methods Python never sees).
+enum TelemetryFamily {
+  TF_INLINE_ECHO = 0,   // native echo (inline + spawned-fallback arms)
+  TF_HBM_ECHO = 1,      // device-plane echo (tpu.h round trips)
+  TF_REDIS_CACHE = 2,   // native redis-cache commands
+  TF_USERCODE = 3,      // Python TRPC handlers (queue-inclusive)
+  TF_CLIENT_UNARY = 4,  // channel_call, issue -> completion
+  TF_FANOUT_GROUP = 5,  // channel_fanout_call whole-group latency
+  TF_FAMILIES = 6,
+};
+
+// Log-bucket bounds: bucket i holds latencies in (2^(i-1), 2^i] µs for
+// i in 0..kHistFiniteBuckets-1 (bucket 0 = [0,1]µs), one +Inf overflow.
+constexpr int kHistFiniteBuckets = 26;  // le 1µs .. le 2^25µs (~33.5s)
+
+// Reloadable master switch (TRPC_TELEMETRY env seeds the default; the
+// `telemetry` flag pushes through capi).  Off = no histogram writes, no
+// span capture, no extra clock reads — the bench A/B baseline.
+void set_telemetry(int on);
+bool telemetry_enabled();
+
+const char* telemetry_family_name(int family);
+// One histogram write: relaxed atomic adds on the shard's agent (negative
+// shard / off-worker callers fold into shard 0's agent).
+void telemetry_record(int family, int shard, int64_t lat_us);
+void telemetry_inflight_add(int family, int shard, int64_t d);
+// Read side (folds every shard agent): percentile by log-bucket walk with
+// linear interpolation inside the bucket, total count, µs sum, inflight.
+int64_t telemetry_percentile_us(int family, double q);
+uint64_t telemetry_count(int family);
+uint64_t telemetry_sum_us(int family);
+int64_t telemetry_inflight(int family);
+// Prometheus text exposition: real cumulative `_bucket{le=...}` series
+// per family plus `_sum` / `_count` (appended to /metrics by the portal).
+size_t telemetry_prom_dump(char* buf, size_t cap);
+
+// --- native rpcz: sampled span capture for fast-path requests --------------
+
+// Native half of the rpcz switch (TRPC_RPCZ env seeds the default; the
+// Python `enable_rpcz` flag validator pushes through capi) plus the
+// collector-style per-second sampling budget shared by all shards.
+void rpcz_set_enabled(int on);
+bool rpcz_native_enabled();
+void rpcz_set_budget(int64_t per_second);
+// One budget token (false = disabled or over budget this second).
+bool rpcz_try_sample();
+// Fresh nonzero span/trace id (SplitMix64 over a per-boot random base).
+uint64_t rpcz_next_id();
+
+struct NativeSpan {
+  uint64_t trace_id = 0;
+  uint64_t span_id = 0;
+  uint64_t parent_span_id = 0;
+  int32_t family = 0;      // TelemetryFamily
+  int32_t error_code = 0;
+  int32_t shard = 0;
+  int64_t start_mono_ns = 0;  // CLOCK_MONOTONIC (Python rebases to wall)
+  int64_t latency_us = 0;
+  char annotations[96] = {};  // '|'-separated free text (≙ TRACEPRINTF)
+};
+
+// Publish a finished span into the capturing shard's ring (seqlock
+// slots: writers never block, a drain racing a write skips that slot).
+void rpcz_capture(const NativeSpan& s);
+// Drain every shard's ring into tab-separated lines
+//   trace span parent family error shard start_mono_ns latency_us annot\n
+// consuming the spans (they surface once, through the Python Collector).
+size_t rpcz_drain(char* buf, size_t cap);
+
+// --- cross-hop trace context (fiber-local parent) --------------------------
+// One context per executing thread: parse fibers run requests to
+// completion without yielding and usercode handlers own their pthread
+// for the handler's duration, so a thread_local carries the inbound
+// trace across the dispatch exactly like the reference's tls_parent
+// (span.h:115).  channel_call/channel_fanout_call read it into TLV tags
+// 7/8; UsercodePool stamps/clears it around every Python handler.
+
+struct TraceCtx {
+  uint64_t trace_id = 0;
+  uint64_t span_id = 0;   // the CURRENT span: downstream hops parent here
+  // set by the Python layer when IT created the client span for the next
+  // call — native must then not capture a duplicate client-unary span
+  bool python_owned = false;
+};
+
+TraceCtx trace_current();
+void trace_set_current(uint64_t trace_id, uint64_t span_id,
+                       int python_owned);
+// TRACEPRINTF twin: append free text to the calling thread's pending
+// annotation buffer; the next native span captured on this thread
+// carries it (no-op when rpcz is off — unsampled annotate is free).
+void trace_annotate(const char* text);
+// Move the pending annotations out (into a NativeSpan::annotations-sized
+// buffer); returns bytes written and clears the thread's buffer.
+size_t trace_take_annotations(char* buf, size_t cap);
 
 }  // namespace trpc

@@ -368,6 +368,16 @@ struct CallCtx {
   // loop — the rpcz/LatencyRecorder arm stamp, read back via
   // token_arm_ns; queue-inclusive without per-request clock syscalls
   int64_t arm_ns = 0;
+  // inbound trace/span ids (meta tags 7/8) — surfaced on the Controller
+  // via token_trace and stamped into the usercode thread's TraceCtx so
+  // downstream channel_call inherits the hop (metrics.h trace plane)
+  uint64_t trace_id = 0;
+  uint64_t span_id = 0;
+  // telemetry (metrics.h): owning shard for the per-shard histogram
+  // agents; telemetry_family < 0 = this request is not histogrammed
+  // (HTTP/redis-python/thrift ride their own Python-side recorders)
+  int shard = 0;
+  int telemetry_family = -1;
   uint32_t slot = 0;
   std::atomic<uint32_t> version{1};
   // cancellation (≙ server side of Controller::StartCancel +
@@ -637,6 +647,13 @@ class UsercodePool {
                                                std::memory_order_relaxed);
         }
       }
+      // fiber-local-parent ingress (metrics.h trace plane): the handler
+      // owns this pthread for the callback's duration, so the inbound
+      // trace/span ids ride a thread_local — downstream channel_call /
+      // channel_fanout_call made FROM the handler inherit them into TLV
+      // tags 7/8 (the Python dispatcher re-points the ctx at its sampled
+      // server span; this native stamp is the no-Python-span fallback)
+      trace_set_current(ctx->trace_id, ctx->span_id, 0);
       if (ctx->is_redis || ctx->is_thrift || ctx->is_user_proto) {
         ctx->rcb(ctx->token(), (const uint8_t*)ctx->payload.data(),
                  ctx->payload.size(), ctx->user);
@@ -653,6 +670,7 @@ class UsercodePool {
                 (const uint8_t*)ctx->attachment.data(),
                 ctx->attachment.size(), ctx->user);
       }
+      trace_set_current(0, 0, 0);  // the worker is nobody's hop now
       nm.usercode_running.fetch_sub(1, std::memory_order_relaxed);
       lk.lock();
     }
@@ -796,10 +814,15 @@ struct ConnState {
   // the fiber drains them in parse order — otherwise a budget-tripped
   // "SET k" racing a next-drain inline "GET k" could read the store
   // before the SET ran (replies would still sequence, masking it).
-  // Plain data (seq + argv); a dead connection's queue dies with the
-  // ConnState, nothing to release.
+  // Plain data (seq + arm stamp + argv); a dead connection's queue dies
+  // with the ConnState, nothing to release.
   bool cache_fiber_active = false;
-  std::deque<std::pair<uint64_t, std::vector<std::string>>> cache_q;
+  struct CacheCmd {
+    uint64_t seq;
+    int64_t arm_ns;  // telemetry: queued-behind-the-fiber wait counts
+    std::vector<std::string> argv;
+  };
+  std::deque<CacheCmd> cache_q;
 
   ~ConnState() {
     // Python-redis commands still awaiting their key's turn when the
@@ -1004,6 +1027,10 @@ struct EchoFiberArg {
   uint64_t corr;
   uint8_t compress;
   uint8_t codec;  // request's payload codec, mirrored on the response
+  // telemetry (metrics.h): parse-loop arm stamp + owning shard so the
+  // spawned-fallback arm lands in the SAME histogram family as inline
+  int64_t arm_ns = 0;
+  int shard = 0;
   IOBuf payload;
   IOBuf attachment;
 };
@@ -1012,6 +1039,10 @@ void EchoFiber(void* p) {
   EchoFiberArg* a = (EchoFiberArg*)p;
   SendResponse(a->sock, a->corr, 0, nullptr, std::move(a->payload),
                std::move(a->attachment), 0, 0, a->compress, a->codec);
+  if (a->arm_ns > 0) {
+    telemetry_record(TF_INLINE_ECHO, a->shard,
+                     (monotonic_ns() - a->arm_ns) / 1000);
+  }
   a->payload.clear();
   a->attachment.clear();
   ObjectPool<EchoFiberArg>::Return(a);
@@ -1024,6 +1055,8 @@ struct HbmEchoArg {
   SocketId sock;
   uint64_t corr;
   uint8_t codec = 0;  // request's payload codec, mirrored on the response
+  int64_t arm_ns = 0;  // telemetry arm stamp (coarse, from the parse loop)
+  int shard = 0;
   IOBuf payload;
   IOBuf attachment;
 };
@@ -1051,6 +1084,11 @@ void HbmEchoFiber(void* p) {
   }
   SendResponse(a->sock, a->corr, err, etext, std::move(a->payload),
                std::move(resp_attach), 0, 0, 0, a->codec);
+  if (a->arm_ns > 0) {
+    telemetry_record(TF_HBM_ECHO, a->shard,
+                     (monotonic_ns() - a->arm_ns) / 1000);
+    telemetry_inflight_add(TF_HBM_ECHO, a->shard, -1);
+  }
   a->payload.clear();
   a->attachment.clear();
   ObjectPool<HbmEchoArg>::Return(a);
@@ -1140,6 +1178,8 @@ void RedisCacheExec(RedisStore* st, const std::vector<std::string>& argv,
 struct RedisCacheFiberArg {
   SocketId sock;
   uint64_t seq;
+  int64_t arm_ns = 0;  // telemetry arm stamp (coarse, from the parse loop)
+  int shard = 0;
   RedisStore* store;
   std::vector<std::string> argv;
 };
@@ -1151,6 +1191,10 @@ void RedisCacheFiber(void* p) {
     IOBuf reply;
     RedisCacheExec(a->store, a->argv, &reply);
     ReleaseSequenced(s, a->seq, std::move(reply), false);
+    if (a->arm_ns > 0) {
+      telemetry_record(TF_REDIS_CACHE, a->shard,
+                       (monotonic_ns() - a->arm_ns) / 1000);
+    }
     // drain the cache commands that queued behind this one (see
     // ConnState.cache_q): they execute here IN PARSE ORDER, and the
     // parse loop keeps appending while cache_fiber_active — the
@@ -1161,6 +1205,7 @@ void RedisCacheFiber(void* p) {
     if (cs != nullptr) {
       while (true) {
         uint64_t seq;
+        int64_t arm;
         std::vector<std::string> argv;
         {
           std::lock_guard lk(cs->mu);
@@ -1168,13 +1213,18 @@ void RedisCacheFiber(void* p) {
             cs->cache_fiber_active = false;
             break;
           }
-          seq = cs->cache_q.front().first;
-          argv = std::move(cs->cache_q.front().second);
+          seq = cs->cache_q.front().seq;
+          arm = cs->cache_q.front().arm_ns;
+          argv = std::move(cs->cache_q.front().argv);
           cs->cache_q.pop_front();
         }
         IOBuf r;
         RedisCacheExec(a->store, argv, &r);
         ReleaseSequenced(s, seq, std::move(r), false);
+        if (arm > 0) {
+          telemetry_record(TF_REDIS_CACHE, a->shard,
+                           (monotonic_ns() - arm) / 1000);
+        }
       }
     }
     s->Dereference();
@@ -1238,6 +1288,9 @@ void DispatchHttp(Socket* s, Server* srv, HttpRequest&& req) {
   ctx->accepted_stream = 0;
   ctx->pipe_seq = seq;
   ctx->arm_ns = coarse_now_ns();
+  ctx->trace_id = 0;  // pooled slot: a prior TRPC use must not leak ids
+  ctx->span_id = 0;
+  ctx->telemetry_family = -1;
   ctx->hcb = srv->http_cb;
   ctx->user = srv->http_user;
   UsercodePool::Instance().Submit(ctx);
@@ -1318,6 +1371,9 @@ void DispatchH2(Socket* s, Server* srv, H2Request&& req) {
   ctx->req_stream_window = 0;
   ctx->accepted_stream = 0;
   ctx->arm_ns = coarse_now_ns();
+  ctx->trace_id = 0;  // pooled slot: a prior TRPC use must not leak ids
+  ctx->span_id = 0;
+  ctx->telemetry_family = -1;
   ctx->hcb = srv->http_cb;
   ctx->user = srv->http_user;
   UsercodePool::Instance().Submit(ctx);
@@ -1370,7 +1426,14 @@ void ServerOnMessages(Socket* s) {
   // leaves as one flush when the scope closes — K pipelined requests cost
   // one wakeup + one egress submission instead of K.
   bool fast = inline_dispatch_enabled();
-  InlineBudget budget(fast, CoarseClockRefresh());
+  // drain_ns doubles as the telemetry arm stamp for every request of
+  // this drain: inline latencies are measured end-of-request minus drain
+  // start, so the Kth pipelined request's number includes its in-drain
+  // queueing — the queue-inclusive signal the adaptive limiter
+  // (ROADMAP item 4) needs, at one clock read per completion
+  int64_t drain_ns = CoarseClockRefresh();
+  InlineBudget budget(fast, drain_ns);
+  bool telem = telemetry_enabled();
   CorkScope cork_scope(s, fast);
   // connections that completed the h2 preface stay h2 for life (is_h2
   // gates the registry mutex off the non-h2 hot path)
@@ -1519,7 +1582,8 @@ void ServerOnMessages(Socket* s) {
             std::lock_guard lk(cs->mu);
             rseq = cs->next_dispatch++;
             if (cs->cache_fiber_active) {
-              cs->cache_q.emplace_back(rseq, std::move(argv));
+              cs->cache_q.push_back(ConnState::CacheCmd{
+                  rseq, telem ? drain_ns : 0, std::move(argv)});
               queued = true;
             }
           }
@@ -1536,12 +1600,18 @@ void ServerOnMessages(Socket* s) {
             IOBuf reply;
             RedisCacheExec(srv->redis_store, argv, &reply);
             ReleaseSequenced(s, rseq, std::move(reply), false);
+            if (telem) {
+              telemetry_record(TF_REDIS_CACHE, s->shard,
+                               (monotonic_ns() - drain_ns) / 1000);
+            }
           } else {
             nm.inline_dispatch_fallbacks.fetch_add(1,
                                                    std::memory_order_relaxed);
             RedisCacheFiberArg* fa = ObjectPool<RedisCacheFiberArg>::Get();
             fa->sock = s->id();
             fa->seq = rseq;
+            fa->arm_ns = telem ? drain_ns : 0;
+            fa->shard = s->shard;
             fa->store = srv->redis_store;
             fa->argv = std::move(argv);
             {
@@ -1600,6 +1670,9 @@ void ServerOnMessages(Socket* s) {
           rctx->pipe_seq = cs->next_dispatch++;
         }
         rctx->arm_ns = coarse_now_ns();
+        rctx->trace_id = 0;  // pooled slot: no stale trace ids
+        rctx->span_id = 0;
+        rctx->telemetry_family = -1;
         rctx->rcb = srv->redis_cb;
         rctx->user = srv->redis_user;
         // per-KEY execution ordering (see ConnState.redis_key_q): run
@@ -1700,6 +1773,9 @@ void ServerOnMessages(Socket* s) {
           tctx->pipe_seq = tcs->next_dispatch++;
         }
         tctx->arm_ns = coarse_now_ns();
+        tctx->trace_id = 0;  // pooled slot: no stale trace ids
+        tctx->span_id = 0;
+        tctx->telemetry_family = -1;
         tctx->rcb = srv->thrift_cb;
         tctx->user = srv->thrift_user;
         UsercodePool::Instance().Submit(tctx);
@@ -1806,6 +1882,9 @@ void ServerOnMessages(Socket* s) {
             uctx->pipe_seq = ucs->next_dispatch++;
           }
           uctx->arm_ns = coarse_now_ns();
+          uctx->trace_id = 0;  // pooled slot: no stale trace ids
+          uctx->span_id = 0;
+          uctx->telemetry_family = -1;
           uctx->rcb = (RedisHandlerCb)up.handler;
           uctx->user = up.user;
           UsercodePool::Instance().Submit(uctx);
@@ -1968,6 +2047,24 @@ void ServerOnMessages(Socket* s) {
           // re-encode with the request's codec, still on the parse fiber
           rmeta.payload_codec = codec_encode(req_codec, &payload);
           PackFrame(&batched_out, rmeta, std::move(payload), IOBuf());
+          if (telem) {
+            int64_t lat_us = (monotonic_ns() - drain_ns) / 1000;
+            telemetry_record(TF_HBM_ECHO, s->shard, lat_us);
+            if (rpcz_try_sample()) {
+              NativeSpan sp;
+              sp.trace_id = meta.trace_id != 0 ? meta.trace_id
+                                               : rpcz_next_id();
+              sp.span_id = rpcz_next_id();
+              sp.parent_span_id = meta.span_id;
+              sp.family = TF_HBM_ECHO;
+              sp.shard = s->shard;
+              sp.start_mono_ns = drain_ns;
+              sp.latency_us = lat_us;
+              trace_take_annotations(sp.annotations,
+                                     sizeof(sp.annotations));
+              rpcz_capture(sp);
+            }
+          }
           continue;
         }
         native_metrics().inline_dispatch_fallbacks.fetch_add(
@@ -1977,10 +2074,20 @@ void ServerOnMessages(Socket* s) {
       a->sock = s->id();
       a->corr = meta.correlation_id;
       a->codec = req_codec;
+      a->arm_ns = telem ? drain_ns : 0;
+      a->shard = s->shard;
       a->payload = std::move(payload);
       a->attachment = std::move(attachment);
+      if (telem) {
+        // gauge spans the DMA waits on the spawned fiber — the inflight
+        // depth the gradient limiter will read against latency
+        telemetry_inflight_add(TF_HBM_ECHO, s->shard, 1);
+      }
       fiber_t f;
       if (fiber_start(&f, HbmEchoFiber, a) != 0) {
+        if (a->arm_ns > 0) {
+          telemetry_inflight_add(TF_HBM_ECHO, a->shard, -1);
+        }
         a->payload.clear();
         a->attachment.clear();
         ObjectPool<HbmEchoArg>::Return(a);
@@ -2020,6 +2127,27 @@ void ServerOnMessages(Socket* s) {
         }
         PackFrame(&batched_out, rmeta, std::move(payload),
                   std::move(attachment));
+        if (telem) {
+          // the histogram write /status and the overload gradient read:
+          // one clock syscall + two relaxed adds on this shard's agent
+          int64_t lat_us = (monotonic_ns() - drain_ns) / 1000;
+          telemetry_record(TF_INLINE_ECHO, s->shard, lat_us);
+          if (rpcz_try_sample()) {
+            // fast-path span: /rpcz finally sees inline-dispatched
+            // requests; inbound tags 7/8 parent it into the caller's tree
+            NativeSpan sp;
+            sp.trace_id = meta.trace_id != 0 ? meta.trace_id
+                                             : rpcz_next_id();
+            sp.span_id = rpcz_next_id();
+            sp.parent_span_id = meta.span_id;
+            sp.family = TF_INLINE_ECHO;
+            sp.shard = s->shard;
+            sp.start_mono_ns = drain_ns;
+            sp.latency_us = lat_us;
+            trace_take_annotations(sp.annotations, sizeof(sp.annotations));
+            rpcz_capture(sp);
+          }
+        }
       } else {
         // spawned path (budget tripped, or the fast path is flagged off
         // for the A/B): one fiber + one response write per request —
@@ -2031,6 +2159,8 @@ void ServerOnMessages(Socket* s) {
         a->corr = meta.correlation_id;
         a->compress = meta.compress_type;
         a->codec = req_codec;
+        a->arm_ns = telem ? drain_ns : 0;
+        a->shard = s->shard;
         a->payload = std::move(payload);
         a->attachment = std::move(attachment);
         fiber_t f;
@@ -2070,6 +2200,16 @@ void ServerOnMessages(Socket* s) {
       ctx->payload = payload.to_string();
       ctx->attachment = attachment.to_string();
       ctx->arm_ns = coarse_now_ns();
+      // cross-hop trace ingress: the inbound ids surface on the
+      // Controller (token_trace) and UsercodePool stamps them into the
+      // handler thread's TraceCtx so downstream calls inherit the hop
+      ctx->trace_id = meta.trace_id;
+      ctx->span_id = meta.span_id;
+      ctx->shard = s->shard;
+      ctx->telemetry_family = telem ? TF_USERCODE : -1;
+      if (telem) {
+        telemetry_inflight_add(TF_USERCODE, s->shard, 1);
+      }
       ctx->cb = h.cb;
       ctx->user = h.user;
       // cancellation surface: the call is findable by (sock, corr) until
@@ -2647,6 +2787,19 @@ void server_destroy(Server* s) {
     tls_ctx_destroy(s->tls_ctx);
     s->tls_ctx = nullptr;
   }
+  // Listener fibers FIRST: an accept in flight during stop can still be
+  // adopting a fresh connection into s->conns, so snapshotting conns
+  // before the accept paths are provably finished would miss it — that
+  // connection's parse fiber would then read the freed Server through
+  // socket->user (the one-shot heap-use-after-free telemetry_races
+  // reproduced; conns inserts happen on the accept path only).  The
+  // epoll accept loop runs on the listener socket's processing fiber,
+  // which holds a listener ref — WaitRecycled == no accept loop is
+  // running anymore; ring acceptors were already removed synchronously
+  // by server_stop.
+  for (Server::Listener& l : s->listeners) {
+    Socket::WaitRecycled(l.sock);
+  }
   // fail live connections and wait for their fibers to drain (they hold
   // Server* through socket->user)
   std::vector<SocketId> conns;
@@ -2668,9 +2821,6 @@ void server_destroy(Server* s) {
   // fibers still hold refs and read Server* through socket->user).
   for (SocketId id : conns) {
     Socket::WaitRecycled(id);
-  }
-  for (Server::Listener& l : s->listeners) {
-    Socket::WaitRecycled(l.sock);
   }
   delete s->redis_store;
   delete s;
@@ -2709,6 +2859,15 @@ int respond(uint64_t token, int32_t error_code, const char* error_text,
                std::move(payload), std::move(attachment), accepted,
                accepted != 0 ? stream_window(accepted) : 0, compress_type,
                ctx->payload_codec);
+  if (ctx->telemetry_family >= 0) {
+    // queue-INCLUSIVE usercode latency: parse-loop arm stamp -> response
+    // handed to the socket (the number /status could never show before —
+    // inline fast paths have their own families in the same histograms)
+    telemetry_record(ctx->telemetry_family, ctx->shard,
+                     (monotonic_ns() - ctx->arm_ns) / 1000);
+    telemetry_inflight_add(ctx->telemetry_family, ctx->shard, -1);
+    ctx->telemetry_family = -1;
+  }
   if (ctx->cancel_registered) {
     // ordering matters: unregister BEFORE the version bump, so a racing
     // canceller that still finds the token under g_cancel_mu is flagging
@@ -4229,6 +4388,22 @@ int64_t token_arm_ns(uint64_t token) {
   return ctx->arm_ns;
 }
 
+int token_trace(uint64_t token, uint64_t* trace_id, uint64_t* span_id) {
+  CallCtx* ctx = ResourcePool<CallCtx>::Address((uint32_t)token);
+  if (ctx == nullptr ||
+      ctx->version.load(std::memory_order_acquire) !=
+          (uint32_t)(token >> 32)) {
+    return -1;
+  }
+  if (trace_id != nullptr) {
+    *trace_id = ctx->trace_id;
+  }
+  if (span_id != nullptr) {
+    *span_id = ctx->span_id;
+  }
+  return 0;
+}
+
 void channel_set_connection_type(Channel* c, int t) {
   c->conn_type = t;
 }
@@ -4309,6 +4484,30 @@ int channel_call(Channel* c, const char* method, const uint8_t* req,
   }
   ClientConn* conn = (ClientConn*)s->user;
   SocketId sid = s->id();
+  // Telemetry + cross-hop trace context (metrics.h): snapshot the
+  // thread's TraceCtx ONCE here — the completion wait below can migrate
+  // this fiber across workers, so nothing later may re-read the TLS.
+  bool telem = telemetry_enabled();
+  int64_t t0 = telem ? monotonic_ns() : 0;
+  TraceCtx tc = trace_current();
+  NativeSpan nsp;
+  bool capture = false;
+  if (telem && !tc.python_owned && rpcz_try_sample()) {
+    // native client-unary span (suppressed when the Python layer already
+    // created this call's client span — python_owned): pre-generate the
+    // span id so the wire carries it and the server parents HERE
+    capture = true;
+    nsp.trace_id = tc.trace_id != 0 ? tc.trace_id : rpcz_next_id();
+    nsp.span_id = rpcz_next_id();
+    nsp.parent_span_id = tc.span_id;
+    nsp.family = TF_CLIENT_UNARY;
+    nsp.shard = s->shard;
+    nsp.start_mono_ns = t0;
+    trace_take_annotations(nsp.annotations, sizeof(nsp.annotations));
+  }
+  if (telem) {
+    telemetry_inflight_add(TF_CLIENT_UNARY, s->shard, 1);
+  }
   PendingCall* pc = nullptr;
   uint32_t slot = ResourcePool<PendingCall>::Get(&pc);
   uint64_t corr = ArmPendingCall(pc, slot, sid);
@@ -4325,6 +4524,13 @@ int channel_call(Channel* c, const char* method, const uint8_t* req,
   meta.method = method;
   meta.correlation_id = corr;
   meta.compress_type = compress;
+  // cross-hop propagation (tags 7/8): with a captured native span the
+  // downstream server parents at THIS call's span; otherwise the
+  // inherited context (a Python span via trace_set_current, or the
+  // inbound ids stamped by UsercodePool) passes through unchanged —
+  // zero ids mean no tags, byte-identical to the pre-telemetry wire
+  meta.trace_id = capture ? nsp.trace_id : tc.trace_id;
+  meta.span_id = capture ? nsp.span_id : tc.span_id;
   {
     std::lock_guard lk(c->auth_mu);  // vs live credential rotation
     meta.auth = c->auth;
@@ -4427,6 +4633,19 @@ int channel_call(Channel* c, const char* method, const uint8_t* req,
                           std::memory_order_release);
   conn->SweepUnlink(pc);
   ReleasePendingCall(pc, slot);
+  if (telem) {
+    // client-observed latency: issue -> completion, wait included (what
+    // the caller experienced; the server-side histograms break down
+    // where the time went)
+    int64_t lat_us = (monotonic_ns() - t0) / 1000;
+    telemetry_record(TF_CLIENT_UNARY, s->shard, lat_us);
+    telemetry_inflight_add(TF_CLIENT_UNARY, s->shard, -1);
+    if (capture) {
+      nsp.error_code = result;
+      nsp.latency_us = lat_us;
+      rpcz_capture(nsp);
+    }
+  }
   if (conn->short_lived && !(stream != 0 && result == 0)) {
     // one call per connection — unless a stream now rides it (then the
     // socket lives until the stream closes / channel_destroy)
@@ -4455,6 +4674,31 @@ int channel_fanout_call(Channel** chans, int n, const char* method,
   NativeMetrics& nm = native_metrics();
   nm.fanout_calls.fetch_add(1, std::memory_order_relaxed);
   nm.fanout_subcalls.fetch_add((uint64_t)n, std::memory_order_relaxed);
+  // telemetry: ONE group-latency sample + ONE span per fan-out (the
+  // per-sub spans belong to the Python layer); trace ids snapshot once —
+  // the harvest waits can migrate this fiber across workers
+  bool telem = telemetry_enabled();
+  int64_t t0 = telem ? monotonic_ns() : 0;
+  TraceCtx tc = trace_current();
+  int tshard = current_shard();
+  if (tshard < 0) {
+    tshard = 0;
+  }
+  NativeSpan gsp;
+  bool capture = false;
+  if (telem && !tc.python_owned && rpcz_try_sample()) {
+    capture = true;
+    gsp.trace_id = tc.trace_id != 0 ? tc.trace_id : rpcz_next_id();
+    gsp.span_id = rpcz_next_id();
+    gsp.parent_span_id = tc.span_id;
+    gsp.family = TF_FANOUT_GROUP;
+    gsp.shard = tshard;
+    gsp.start_mono_ns = t0;
+    trace_take_annotations(gsp.annotations, sizeof(gsp.annotations));
+  }
+  if (telem) {
+    telemetry_inflight_add(TF_FANOUT_GROUP, tshard, 1);
+  }
   // serialize ONCE: every sub-frame below appends these buffers by
   // BlockRef (IOBuf copy = block refcount bump, zero byte copies); the
   // socket write path holds its own refs until the bytes are on the wire
@@ -4538,6 +4782,10 @@ int channel_fanout_call(Channel** chans, int n, const char* method,
     RpcMeta meta;
     meta.method = method;
     meta.correlation_id = sb.corr;
+    // every member carries the SAME trace tags: the group is one hop,
+    // so each downstream server span parents at the group span
+    meta.trace_id = capture ? gsp.trace_id : tc.trace_id;
+    meta.span_id = capture ? gsp.span_id : tc.span_id;
     {
       std::lock_guard lk(chans[i]->auth_mu);  // vs credential rotation
       meta.auth = chans[i]->auth;
@@ -4634,6 +4882,16 @@ int channel_fanout_call(Channel** chans, int n, const char* method,
       ReleasePooled(chans[i], sb.s);
     }
     sb.s->Dereference();
+  }
+  if (telem) {
+    int64_t lat_us = (monotonic_ns() - t0) / 1000;
+    telemetry_record(TF_FANOUT_GROUP, tshard, lat_us);
+    telemetry_inflight_add(TF_FANOUT_GROUP, tshard, -1);
+    if (capture) {
+      gsp.error_code = failures;
+      gsp.latency_us = lat_us;
+      rpcz_capture(gsp);
+    }
   }
   return failures;
 }

@@ -278,6 +278,13 @@ int64_t coarse_now_ns();
 // the wire; 0 for a stale token.  Queue-inclusive latency = now - arm.
 int64_t token_arm_ns(uint64_t token);
 
+// Inbound trace/span ids (meta tags 7/8) of a pending usercode request —
+// the cross-hop trace surface (≙ Controller::trace_id feeding rpcz span
+// parentage): the Python dispatcher parents its server span here and
+// downstream channel_call inherits the context into its own tags.
+// Returns 0, or -1 for a stale token (*trace_id/*span_id then untouched).
+int token_trace(uint64_t token, uint64_t* trace_id, uint64_t* span_id);
+
 // Native redis cache: GET/SET/DEL/EXISTS/PING execute against an
 // in-memory native store — inline on the parse fiber when the fast path
 // grants it, on a spawned fiber otherwise; commands outside the table

@@ -308,6 +308,8 @@ size_t shard_metrics_dump(char* buf, size_t cap) {
     put(nullptr, k, "mailbox_drains", rd(c.mailbox_drains));
     put(nullptr, k, "inline_hits", rd(c.inline_hits));
     put(nullptr, k, "cork_flushes", rd(c.cork_flushes));
+    put(nullptr, k, "rpcz_samples", rd(c.rpcz_samples));
+    put(nullptr, k, "rpcz_drops", rd(c.rpcz_drops));
   }
   return off;
 }

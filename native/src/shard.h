@@ -81,6 +81,11 @@ struct ShardCounters {
   std::atomic<uint64_t> mailbox_drains{0}; // consumer drain rounds
   std::atomic<uint64_t> inline_hits{0};    // PR-3 run-to-completion hits
   std::atomic<uint64_t> cork_flushes{0};   // PR-3/5 cork doorbell flushes
+  // native rpcz (metrics.h span rings): spans captured into / lost from
+  // THIS shard's ring — per-shard proof the fast-path sampling runs on
+  // the owning reactor
+  std::atomic<uint64_t> rpcz_samples{0};
+  std::atomic<uint64_t> rpcz_drops{0};
 };
 ShardCounters& shard_counters(int shard);
 uint64_t cross_shard_hops();

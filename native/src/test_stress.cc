@@ -2508,6 +2508,218 @@ static void test_reuseport_accept_races() {
   printf("ok reuseport_accept_races (forced-shards child rc=%d)\n", rc);
 }
 
+// Child body (TRPC_SHARDS=2): the ISSUE-9 telemetry plane ITSELF under
+// races — (a) the reloadable telemetry/rpcz flags + sampling budget
+// flipping under live traffic, (b) histogram writes on both shards'
+// parse fibers racing (d)'s percentile folds and Prometheus dumps, (c)
+// span-ring capture (incl. fan-out group spans and a dead member's
+// failure path) racing the drain consuming the same slots, (e) raw
+// bursts carrying trace tags 7/8 slammed shut mid-drain (trace
+// propagation vs socket teardown), and (f) server restart rounds
+// tearing both shards' listeners down under all of it.
+static void telemetry_child_body() {
+  CHECK_TRUE(shard_count() == 2);
+  fiber_runtime_init(4);
+  set_telemetry(1);
+  rpcz_set_enabled(1);
+  rpcz_set_budget(1 << 20);
+
+  Server* probe = server_create();
+  CHECK_TRUE(server_start(probe, "127.0.0.1", 0) == 0);
+  int port = server_port(probe);
+  server_destroy(probe);
+
+  std::atomic<bool> stop{false};
+  std::atomic<uint64_t> ok{0}, failed{0}, drained{0}, fan_rounds{0};
+  std::vector<std::thread> ts;
+
+  // (a) flag flipper: every combination cycles under traffic, restored
+  // to fully-on before the final asserts
+  ts.emplace_back([&] {
+    int i = 0;
+    while (!stop.load(std::memory_order_acquire)) {
+      set_telemetry(i & 1);
+      rpcz_set_enabled((i >> 1) & 1);
+      rpcz_set_budget((i & 7) == 0 ? 0 : (1 << 18));
+      ++i;
+      usleep(900);
+    }
+    set_telemetry(1);
+    rpcz_set_enabled(1);
+    rpcz_set_budget(1 << 20);
+  });
+
+  // (b) unary callers WITH a trace context: tags 7/8 ride every request
+  // (server-side capture parents there), annotations race the capture
+  for (int t = 0; t < 3; ++t) {
+    ts.emplace_back([&, t] {
+      Channel* ch = channel_create("127.0.0.1", port);
+      channel_set_connection_type(ch, t % 2);
+      channel_set_connect_timeout(ch, 100 * 1000);
+      std::string payload(256, 'q');
+      CallResult res;
+      uint64_t i = 0;
+      while (!stop.load(std::memory_order_acquire)) {
+        trace_set_current(0x1000u + (uint64_t)t, 0x2000u + (++i), 0);
+        if ((i & 7u) == 0) {
+          trace_annotate("press annotation");
+        }
+        if (channel_call(ch, "Echo", (const uint8_t*)payload.data(),
+                         payload.size(), nullptr, 0, 300 * 1000,
+                         &res) == 0) {
+          ok.fetch_add(1);
+        } else {
+          failed.fetch_add(1);
+        }
+      }
+      trace_set_current(0, 0, 0);
+      channel_destroy(ch);
+    });
+  }
+
+  // (c) fan-out groups with a dead member: the ONE group span + group
+  // histogram sample race sub-call failures and the harvest
+  ts.emplace_back([&] {
+    int dead_port = port == 1 ? 2 : 1;  // nothing listens there
+    std::string payload(512, 'f');
+    while (!stop.load(std::memory_order_acquire)) {
+      Channel* chans[3];
+      for (int i = 0; i < 2; ++i) {
+        chans[i] = channel_create("127.0.0.1", port);
+        channel_set_connect_timeout(chans[i], 50 * 1000);
+      }
+      chans[2] = channel_create("127.0.0.1", dead_port);
+      channel_set_connect_timeout(chans[2], 30 * 1000);
+      CallResult r[3];
+      CallResult* outs[3] = {&r[0], &r[1], &r[2]};
+      for (int round = 0;
+           round < 6 && !stop.load(std::memory_order_acquire); ++round) {
+        channel_fanout_call(chans, 3, "Echo",
+                            (const uint8_t*)payload.data(),
+                            payload.size(), nullptr, 0, 300 * 1000, outs);
+        fan_rounds.fetch_add(1);
+      }
+      for (Channel* c : chans) {
+        channel_destroy(c);
+      }
+    }
+  });
+
+  // (d) reader: ring drains consume slots the writers are claiming,
+  // percentile folds + Prometheus/metrics dumps walk the histograms
+  // while both shards write them
+  ts.emplace_back([&] {
+    std::vector<char> buf(256 * 1024);
+    while (!stop.load(std::memory_order_acquire)) {
+      size_t n = rpcz_drain(buf.data(), buf.size());
+      for (size_t i = 0; i < n; ++i) {
+        if (buf[i] == '\n') {
+          drained.fetch_add(1);
+        }
+      }
+      telemetry_prom_dump(buf.data(), buf.size());
+      native_metrics_dump(buf.data(), buf.size());
+      for (int f = 0; f < TF_FAMILIES; ++f) {
+        (void)telemetry_percentile_us(f, 0.99);
+        (void)telemetry_inflight(f);
+      }
+      usleep(1500);
+    }
+  });
+
+  // (e) raw encoded bursts carrying trace tags, then slam the door: the
+  // server-side capture (parented at the burst's span ids) races the
+  // connection dying mid-drain
+  ts.emplace_back([&] {
+    std::string burst;
+    for (int i = 0; i < 10; ++i) {
+      RpcMeta m;
+      m.method = "Echo";
+      m.correlation_id = 0x30000u + (uint32_t)i;  // responses ignored
+      m.trace_id = 0xabcd0000u + (uint32_t)i;
+      m.span_id = 0xef000000u + (uint32_t)i;
+      IOBuf payload, frame;
+      payload.append("telemetry burst payload", 23);
+      PackFrame(&frame, m, std::move(payload), IOBuf());
+      burst += frame.to_string();
+    }
+    while (!stop.load(std::memory_order_acquire)) {
+      int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+      sockaddr_in addr;
+      memset(&addr, 0, sizeof(addr));
+      addr.sin_family = AF_INET;
+      addr.sin_port = htons((uint16_t)port);
+      addr.sin_addr.s_addr = inet_addr("127.0.0.1");
+      if (::connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+        ::close(fd);
+        usleep(1000);
+        continue;
+      }
+      (void)!::write(fd, burst.data(), burst.size());
+      char sink[256];
+      (void)!::read(fd, sink, sizeof(sink));  // then slam the door
+      ::close(fd);
+    }
+  });
+
+  // (f) restart rounds: both shards' listeners + every live connection
+  // tear down while histograms/rings are being written for them
+  for (int round = 0; round < 4; ++round) {
+    Server* srv = server_create();
+    server_add_service(srv, "Echo", 0, nullptr, nullptr);
+    if (server_start(srv, "127.0.0.1", port) != 0) {
+      server_destroy(srv);
+      usleep(50 * 1000);
+      continue;
+    }
+    usleep(700 * 1000);
+    server_destroy(srv);
+    usleep(50 * 1000);
+  }
+  stop.store(true, std::memory_order_release);
+  for (auto& th : ts) {
+    th.join();
+  }
+  // flipper restored full-on; drain the tail so the counts below are
+  // settled (spans captured after the reader stopped)
+  {
+    std::vector<char> buf(256 * 1024);
+    size_t n;
+    while ((n = rpcz_drain(buf.data(), buf.size())) > 0) {
+      for (size_t i = 0; i < n; ++i) {
+        if (buf[i] == '\n') {
+          drained.fetch_add(1);
+        }
+      }
+    }
+  }
+  NativeMetrics& nm = native_metrics();
+  CHECK_TRUE(ok.load() > 0);
+  CHECK_TRUE(fan_rounds.load() > 0);
+  CHECK_TRUE(telemetry_count(TF_INLINE_ECHO) > 0);
+  CHECK_TRUE(telemetry_count(TF_CLIENT_UNARY) > 0);
+  CHECK_TRUE(telemetry_count(TF_FANOUT_GROUP) > 0);
+  CHECK_TRUE(nm.rpcz_spans_sampled.load() > 0);
+  CHECK_TRUE(drained.load() > 0);
+  // gauges balance once traffic stops (no leaked inflight increments)
+  CHECK_TRUE(telemetry_inflight(TF_CLIENT_UNARY) == 0);
+  CHECK_TRUE(telemetry_inflight(TF_FANOUT_GROUP) == 0);
+  printf("ok telemetry (child) ok=%llu failed=%llu fan_rounds=%llu "
+         "hist=%llu spans=%llu drained=%llu drops=%llu\n",
+         (unsigned long long)ok.load(), (unsigned long long)failed.load(),
+         (unsigned long long)fan_rounds.load(),
+         (unsigned long long)telemetry_count(TF_INLINE_ECHO),
+         (unsigned long long)nm.rpcz_spans_sampled.load(),
+         (unsigned long long)drained.load(),
+         (unsigned long long)nm.rpcz_spans_dropped.load());
+}
+
+static void test_telemetry_races() {
+  int rc = run_forced_shards_child("__telemetry_body", "2");
+  CHECK_TRUE(rc == 0);
+  printf("ok telemetry_races (forced-shards child rc=%d)\n", rc);
+}
+
 // --- scenario registry + driver ---------------------------------------------
 // The default (no-args) run IS the sanitized gate: tools/lint.py
 // enforces that every test_*_races function above appears in this table,
@@ -2543,6 +2755,7 @@ static const Scenario kScenarios[] = {
     {"codec_races", test_codec_races},
     {"shard_handoff_races", test_shard_handoff_races},
     {"reuseport_accept_races", test_reuseport_accept_races},
+    {"telemetry_races", test_telemetry_races},
 };
 constexpr int kNumScenarios = (int)(sizeof(kScenarios) / sizeof(kScenarios[0]));
 
@@ -2666,6 +2879,10 @@ int main(int argc, char** argv) {
   }
   if (argc > 1 && strcmp(argv[1], "__reuseport_accept_body") == 0) {
     reuseport_accept_child_body();
+    return g_failures == 0 ? 0 : 1;
+  }
+  if (argc > 1 && strcmp(argv[1], "__telemetry_body") == 0) {
+    telemetry_child_body();
     return g_failures == 0 ? 0 : 1;
   }
   if (argc > 1 && strcmp(argv[1], "--list") == 0) {

@@ -21,6 +21,10 @@ def _wait_for(pred, timeout_s=10.0):
 def test_dump_file_observes_two_rotations(tmp_path):
     path = str(tmp_path / "vars.dump")
     counter = bvar.Adder("bvar_dump_test_counter")
+    # the native telemetry plane rides the same dump (ISSUE 9): the
+    # histogram percentiles + inflight gauges are PassiveStatus bvars
+    from brpc_tpu.metrics.native import install_native_metrics
+    install_native_metrics()
     try:
         counter.add(1)
         flags.set_flag("bvar_dump_interval_s", 0.1)
@@ -39,6 +43,13 @@ def test_dump_file_observes_two_rotations(tmp_path):
             "second rotation never happened"
         second = open(path).read()
         assert "bvar_dump_test_counter : 42" in second, second[:400]
+        # native histogram percentiles + inflight gauges dump too —
+        # offline operators get the fast path's latency story
+        for key in ("native_latency_inline_echo_p99_us",
+                    "native_latency_usercode_p50_us",
+                    "native_inflight_usercode",
+                    "native_inflight_client_unary"):
+            assert f"{key} : " in second, f"{key} missing from the dump"
         # no leftover tmp files (os.replace consumed them)
         leftovers = [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
         assert not leftovers, leftovers

@@ -164,6 +164,85 @@ def test_hot_path_allocation_fails(tmp_path):
     assert lines == [2, 3], v
 
 
+# stub bodies for the hot-path functions the allocations rule expects in
+# metrics.cc — a synthetic metrics.cc must carry them or the mini repo
+# trips the (unrelated) no-raw-alloc region check
+_METRICS_HOT_STUBS = textwrap.dedent("""\
+    void telemetry_record(int family, int shard, long lat) {
+    }
+    void telemetry_inflight_add(int family, int shard, long d) {
+    }
+    bool rpcz_try_sample() {
+      return false;
+    }
+    void rpcz_capture(const NativeSpan& s) {
+    }
+    void trace_annotate(const char* text) {
+    }
+    void trace_set_current(uint64_t t, uint64_t s, int o) {
+    }
+    """)
+
+
+def _metrics_cc(body: str) -> str:
+    return _METRICS_HOT_STUBS + textwrap.dedent(body)
+
+
+def test_metrics_manifest_unregistered_and_stale_fail(tmp_path):
+    """ISSUE 9 rule: a native_* name exported by metrics.cc but missing
+    from tools/metrics_manifest.txt fails, a manifest entry nothing
+    exports fails (both-ways staleness = rename detection), and %s name
+    literals expand against the kTelemetryFamilyNames table."""
+    root = _mini_repo(tmp_path)
+    (tmp_path / "tools" / "metrics_manifest.txt").write_text(
+        "native_widget_total  widgets ever made\n"
+        "native_latency_alpha_p50_us  alpha p50\n"
+        "native_ghost_gauge  nothing exports this\n")
+    (tmp_path / "native" / "src" / "metrics.cc").write_text(_metrics_cc("""\
+        static const char* kTelemetryFamilyNames[2] = {"alpha", "beta"};
+        size_t dump(char* buf, size_t cap) {
+          put("native_widget_total", 1);
+          put("native_unregistered_total", 2);
+          putf("native_latency_%s_p50_us", 3);
+          return 0;
+        }
+        """))
+    v = [x for x in run_lint(root) if x.rule == "metrics"]
+    msgs = [x.message for x in v]
+    assert any("native_unregistered_total is exported" in m
+               for m in msgs), msgs
+    # the %s literal expanded against the family table: beta's expansion
+    # is missing from the manifest
+    assert any("native_latency_beta_p50_us is exported" in m
+               for m in msgs), msgs
+    assert any("stale metrics manifest entry native_ghost_gauge" in m
+               for m in msgs), msgs
+    assert len(v) == 3, v
+    # registering the missing names (and dropping the ghost) goes clean
+    (tmp_path / "tools" / "metrics_manifest.txt").write_text(
+        "native_widget_total  widgets ever made\n"
+        "native_latency_alpha_p50_us  alpha p50\n"
+        "native_latency_beta_p50_us  beta p50\n"
+        "native_unregistered_total  now registered\n")
+    assert [x for x in run_lint(root) if x.rule == "metrics"] == []
+
+
+def test_metrics_manifest_requires_description(tmp_path):
+    """A manifest entry without a one-line description guards nothing —
+    the rule demands the operator-facing meaning beside the name."""
+    root = _mini_repo(tmp_path)
+    (tmp_path / "tools" / "metrics_manifest.txt").write_text(
+        "native_widget_total\n")
+    (tmp_path / "native" / "src" / "metrics.cc").write_text(_metrics_cc("""\
+        size_t dump(char* buf, size_t cap) {
+          put("native_widget_total", 1);
+          return 0;
+        }
+        """))
+    v = [x for x in run_lint(root) if x.rule == "metrics"]
+    assert len(v) == 1 and "no description" in v[0].message, v
+
+
 def test_codec_hot_path_allocation_fails(tmp_path):
     """ISSUE 8: the codec rail's encode/decode run on parse fibers and
     sit inside the no-raw-alloc gate — a staging buffer heap-allocated

@@ -48,6 +48,82 @@ def test_vars_exports_native_counters():
     srv.destroy()
 
 
+def _parse_prometheus(text):
+    """Strict scrape parse: every non-comment line must be
+    `name{labels} value` or `name value` — returns
+    {(name, labels_str): float}."""
+    import re
+    line_re = re.compile(
+        r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(\S+)$")
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = line_re.match(line)
+        assert m is not None, f"malformed exposition line: {line!r}"
+        try:
+            val = float(m.group(3))
+        except ValueError:
+            raise AssertionError(f"non-numeric sample: {line!r}")
+        out[(m.group(1), m.group(2) or "")] = val
+    return out
+
+
+def test_histogram_prometheus_exposition():
+    """ISSUE 9: /metrics exports the native latency histograms as REAL
+    cumulative `_bucket{le=...}` series — monotone across le ordering,
+    `+Inf` == `_count`, `_sum` consistent — and the whole page survives
+    a strict scrape-parse round trip against a live server."""
+    srv = Server()
+    srv.add_echo_service()
+    port = srv.start("127.0.0.1:0")
+    ch = Channel(f"127.0.0.1:{port}", ChannelOptions(max_retry=0))
+    for _ in range(200):
+        ch.call("Echo", b"prometheus-probe" * 4)
+    text = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+    series = _parse_prometheus(text)  # strict parse of EVERY line
+    assert ("# TYPE native_latency_us histogram") in text
+
+    import re
+    for family in ("inline_echo", "client_unary"):
+        buckets = []  # (le_float, value) in page order
+        for (name, labels), val in series.items():
+            if name != "native_latency_us_bucket" or \
+                    f'family="{family}"' not in labels:
+                continue
+            le = re.search(r'le="([^"]+)"', labels).group(1)
+            buckets.append((float("inf") if le == "+Inf" else float(le),
+                            val))
+        assert buckets, f"no buckets for {family}"
+        buckets.sort(key=lambda b: b[0])
+        # cumulative monotonicity across le= ordering
+        for (le_a, va), (le_b, vb) in zip(buckets, buckets[1:]):
+            assert va <= vb, (family, le_a, va, le_b, vb)
+        count = series[("native_latency_us_count", f'{{family="{family}"}}')]
+        total = series[("native_latency_us_sum", f'{{family="{family}"}}')]
+        assert count >= 200, (family, count)
+        # +Inf == _count (both derive from one fold by construction)
+        assert buckets[-1][0] == float("inf")
+        assert buckets[-1][1] == count, (family, buckets[-1], count)
+        # _sum consistency: bounded by count x the largest finite le a
+        # sample could have landed under (loose but directionally real)
+        finite = [b for b in buckets if b[0] != float("inf")]
+        assert 0 <= total <= count * finite[-1][0] * 2, (family, total)
+        # inflight gauge exported beside the histogram
+        assert ("native_inflight", f'{{family="{family}"}}') in series
+
+    # round trip: a second scrape parses too and counts never go down
+    text2 = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+    series2 = _parse_prometheus(text2)
+    for key in series:
+        if key[0] == "native_latency_us_count":
+            assert series2[key] >= series[key]
+    ch.close()
+    srv.destroy()
+
+
 def test_pprof_profile_sees_native_frames():
     """Under echo load, the SIGPROF profile must attribute samples to
     named frames of the native core (the hot path lives there)."""

@@ -97,6 +97,33 @@ def test_seed_sweep_codec_races(flavor):
 
 @pytest.mark.slow
 @pytest.mark.parametrize("flavor", ["thread", "address"])
+def test_seed_sweep_telemetry_races(flavor):
+    """ISSUE 9 leg: >= 32 seeds over the telemetry scenario with
+    TRPC_SHARDS=2 forced — seeded interleavings drive histogram writes
+    and span-ring capture/drain racing flag flips, trace propagation,
+    socket teardown and both reactors' parse fibers."""
+    if os.environ.get("BRPC_TPU_SKIP_SANITIZERS"):
+        pytest.skip("sanitizer runs disabled by env")
+    exe = _build(flavor)
+    seeds = int(os.environ.get("BRPC_TPU_SEED_SWEEP_SEEDS", "32"))
+    base = int(os.environ.get("BRPC_TPU_SEED_SWEEP_BASE", "1"))
+    env = dict(os.environ)
+    env["TRPC_SHARDS"] = "2"
+    out = subprocess.run(
+        [exe, "--sweep", str(seeds), str(base), "telemetry_races"],
+        capture_output=True, text=True,
+        timeout=int(os.environ.get("BRPC_TPU_SEED_SWEEP_TIMEOUT", "5400")),
+        env=env)
+    hits = [int(m) for m in re.findall(r"SWEEP HIT seed=(\d+)", out.stdout)]
+    assert out.returncode == 0 and not hits, (
+        f"telemetry sweep found schedule-dependent failures (seeds "
+        f"{hits}); replay: TRPC_SHARDS=2 TRPC_SCHED_SEED=<seed> {exe} "
+        f"telemetry_races\n{out.stdout[-3000:]}")
+    assert f"sweep done: 0/{seeds}" in out.stdout, out.stdout[-2000:]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("flavor", ["thread", "address"])
 def test_seed_sweep_all_scenarios(flavor):
     """>= 32 seeds x the full scenario gate per sanitizer tree; every hit
     must replay from its seed (the acceptance criterion)."""

@@ -36,6 +36,16 @@ Rules:
                mailbox (`shard_post_socket_failed` / `shard_post`,
                native/src/shard.h, ISSUE 7).  Audited synchronous sites
                escape with `lint:allow-cross-shard (reason)` on the line.
+  metrics      every native_* metric name exported by
+               native/src/metrics.cc must appear in
+               tools/metrics_manifest.txt with a one-line description,
+               and every manifest entry must still be exported — the
+               both-ways staleness check makes a rename fail on BOTH
+               sides (old entry stale + new name unregistered).  Name
+               literals containing %s are expanded against the
+               kTelemetryFamilyNames table parsed from metrics.cc
+               (dynamic per-shard native_shard<N>_* names live in
+               shard.cc and are out of scope by design).
 
 The checks are deliberately line-level heuristics, not a C++ parser: the
 escape annotations make intent explicit at the use site, which is the
@@ -77,6 +87,11 @@ _HOT_REGIONS = {
                             "EncodeSnappyChain", "DecodeSnappyChain",
                             "EncodeBf16Chain", "DecodeBf16Chain",
                             "EncodeInt8Chain", "DecodeInt8Chain"],
+    # ISSUE 9: histogram writes + span capture run on the parse fibers
+    # (and inside channel_call) — they must never heap-allocate
+    "native/src/metrics.cc": ["telemetry_record", "telemetry_inflight_add",
+                              "rpcz_try_sample", "rpcz_capture",
+                              "trace_annotate", "trace_set_current"],
 }
 
 # control-plane regions (foreign-thread callers): direct Socket mutation
@@ -338,6 +353,81 @@ def _check_cross_shard(root: str, violations: List[Violation]) -> None:
                         f"lint:allow-cross-shard (reason)"))
 
 
+_METRIC_NAME_RE = re.compile(r'"(native_[a-z0-9_%]+)')
+_FAMILY_TABLE_RE = re.compile(
+    r"kTelemetryFamilyNames\s*\[[^\]]*\]\s*=\s*\{([^}]*)\}")
+
+
+def _check_metrics_manifest(root: str,
+                            violations: List[Violation]) -> None:
+    """ISSUE 9 rule: metrics.cc's exported native_* names <-> the
+    tools/metrics_manifest.txt registry, staleness both ways."""
+    src_rel = os.path.join("native", "src", "metrics.cc")
+    src_path = os.path.join(root, src_rel)
+    if not os.path.exists(src_path):
+        return
+    man_rel = os.path.join("tools", "metrics_manifest.txt")
+    man_path = os.path.join(root, man_rel)
+    manifest: Dict[str, int] = {}
+    if not os.path.exists(man_path):
+        violations.append(Violation(
+            "metrics", man_rel, 0,
+            "metrics manifest missing (every native_* name exported by "
+            "metrics.cc must be registered here with a description)"))
+    else:
+        for i, line in enumerate(_read_lines(man_path), 1):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            name, _, desc = line.partition(" ")
+            if not re.fullmatch(r"native_[a-z0-9_]+", name):
+                violations.append(Violation(
+                    "metrics", man_rel, i,
+                    f"malformed metrics manifest entry {name!r}"))
+                continue
+            if not desc.strip():
+                violations.append(Violation(
+                    "metrics", man_rel, i,
+                    f"metrics manifest entry {name} has no description "
+                    f"(one line saying what the series means)"))
+            manifest[name] = i
+
+    lines = _read_lines(src_path)
+    text = "\n".join(lines)
+    fam_m = _FAMILY_TABLE_RE.search(text)
+    families = re.findall(r'"([a-z0-9_]+)"', fam_m.group(1)) if fam_m else []
+
+    exported: Dict[str, int] = {}  # concrete name -> first exporting line
+    for i, line in enumerate(lines, 1):
+        for raw in _METRIC_NAME_RE.findall(line):
+            if "%s" in raw:
+                if not families:
+                    violations.append(Violation(
+                        "metrics", src_rel, i,
+                        f"{raw} uses %s but no kTelemetryFamilyNames "
+                        f"table was found to expand it against"))
+                    continue
+                for f in families:
+                    exported.setdefault(raw.replace("%s", f), i)
+            elif "%" not in raw:
+                exported.setdefault(raw, i)
+            # other % directives (%d/%llu) format VALUES, and the name
+            # regex already stopped at the preceding space
+
+    for name in sorted(exported):
+        if name not in manifest:
+            violations.append(Violation(
+                "metrics", src_rel, exported[name],
+                f"{name} is exported by metrics.cc but not registered "
+                f"in tools/metrics_manifest.txt (add it with a one-line "
+                f"description)"))
+    for name in sorted(set(manifest) - set(exported)):
+        violations.append(Violation(
+            "metrics", man_rel, manifest[name],
+            f"stale metrics manifest entry {name}: metrics.cc no longer "
+            f"exports it (renamed series must update the manifest)"))
+
+
 def run_lint(repo_root: str,
              reference_root: Optional[str] = None) -> List[Violation]:
     violations: List[Violation] = []
@@ -346,6 +436,7 @@ def run_lint(repo_root: str,
     _check_scenarios(repo_root, violations)
     _check_allocations(repo_root, violations)
     _check_cross_shard(repo_root, violations)
+    _check_metrics_manifest(repo_root, violations)
     return violations
 
 
