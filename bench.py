@@ -19,6 +19,19 @@ import subprocess
 import sys
 
 
+def _analyzer_version() -> str:
+    """Short content hash of the static-analyzer tree (tools/lint.py
+    analyzer_version) — '?' if the tools are unimportable, never a
+    bench failure."""
+    try:
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        from lint import analyzer_version
+        return analyzer_version()
+    except Exception:  # noqa: BLE001 — bench must not die on tooling
+        return "?"
+
+
 def _scaling_table(cores_avail: int) -> dict:
     """The 1/2/4/8-core table (≙ docs/cn/benchmark.md methodology: same
     binary, pinned to N cores).  Each point is a subprocess because CPU
@@ -312,6 +325,7 @@ def main() -> int:
                 "native_uring_sendzc_submitted"),
             "sendzc_copied": native_counter("native_uring_sendzc_copied"),
             "sendzc_fixed": native_counter("native_uring_sendzc_fixed"),
+            "analyzer": _analyzer_version(),
         }))
         return 0 if rc == 0 else 1
 
@@ -450,6 +464,9 @@ def main() -> int:
         # nonzero seed means the run measured the fuzzing mode, not the
         # runtime (BENCH_NOTES.md "Schedule replay")
         "sched_seed": int(L.trpc_sched_seed()),
+        # ISSUE 10: content hash of tools/lint.py + tools/analyze/* +
+        # the manifests — BENCH_NOTES rows name the analyzed tree
+        "analyzer": _analyzer_version(),
     }
     if reps > 1:
         result["rows"] = row_stats

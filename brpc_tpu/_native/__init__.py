@@ -91,9 +91,11 @@ def _declare(L: ctypes.CDLL) -> None:
 
     L.trpc_butex_create.restype = c.c_void_p
     L.trpc_butex_destroy.argtypes = [c.c_void_p]
+    L.trpc_butex_destroy.restype = None
     L.trpc_butex_load.argtypes = [c.c_void_p]
     L.trpc_butex_load.restype = c.c_int32
     L.trpc_butex_store.argtypes = [c.c_void_p, c.c_int32]
+    L.trpc_butex_store.restype = None
     L.trpc_butex_add.argtypes = [c.c_void_p, c.c_int32]
     L.trpc_butex_add.restype = c.c_int32
     L.trpc_butex_wait.argtypes = [c.c_void_p, c.c_int32, c.c_int64]
@@ -295,6 +297,7 @@ def _declare(L: ctypes.CDLL) -> None:
     L.trpc_channel_create.argtypes = [c.c_char_p, c.c_int]
     L.trpc_channel_create.restype = c.c_void_p
     L.trpc_channel_destroy.argtypes = [c.c_void_p]
+    L.trpc_channel_destroy.restype = None
     L.trpc_channel_set_connect_timeout.argtypes = [c.c_void_p, c.c_int64]
     L.trpc_channel_set_connect_timeout.restype = None
     L.trpc_channel_call.argtypes = [c.c_void_p, c.c_char_p, c.c_char_p,
@@ -318,6 +321,7 @@ def _declare(L: ctypes.CDLL) -> None:
                                          c.POINTER(c.POINTER(c.c_uint8))]
     L.trpc_result_attachment.restype = c.c_size_t
     L.trpc_result_destroy.argtypes = [c.c_void_p]
+    L.trpc_result_destroy.restype = None
 
     # streaming RPC
     L.trpc_channel_call_stream.argtypes = [c.c_void_p, c.c_char_p, c.c_char_p,
@@ -398,29 +402,43 @@ def _declare(L: ctypes.CDLL) -> None:
     # fiber sync primitives (fiber_sync.h)
     L.trpc_mutex_create.restype = c.c_void_p
     L.trpc_mutex_destroy.argtypes = [c.c_void_p]
+    L.trpc_mutex_destroy.restype = None
     L.trpc_mutex_lock.argtypes = [c.c_void_p]
+    L.trpc_mutex_lock.restype = None
     L.trpc_mutex_trylock.argtypes = [c.c_void_p]
     L.trpc_mutex_trylock.restype = c.c_int
     L.trpc_mutex_unlock.argtypes = [c.c_void_p]
+    L.trpc_mutex_unlock.restype = None
     L.trpc_cond_create.restype = c.c_void_p
     L.trpc_cond_destroy.argtypes = [c.c_void_p]
+    L.trpc_cond_destroy.restype = None
     L.trpc_cond_wait.argtypes = [c.c_void_p, c.c_void_p, c.c_int64]
     L.trpc_cond_wait.restype = c.c_int
     L.trpc_cond_notify_one.argtypes = [c.c_void_p]
+    L.trpc_cond_notify_one.restype = None
     L.trpc_cond_notify_all.argtypes = [c.c_void_p]
+    L.trpc_cond_notify_all.restype = None
     L.trpc_countdown_create.argtypes = [c.c_int]
     L.trpc_countdown_create.restype = c.c_void_p
     L.trpc_countdown_destroy.argtypes = [c.c_void_p]
+    L.trpc_countdown_destroy.restype = None
     L.trpc_countdown_signal.argtypes = [c.c_void_p, c.c_int]
+    L.trpc_countdown_signal.restype = None
     L.trpc_countdown_add.argtypes = [c.c_void_p, c.c_int]
+    L.trpc_countdown_add.restype = None
     L.trpc_countdown_wait.argtypes = [c.c_void_p, c.c_int64]
     L.trpc_countdown_wait.restype = c.c_int
     L.trpc_rwlock_create.restype = c.c_void_p
     L.trpc_rwlock_destroy.argtypes = [c.c_void_p]
+    L.trpc_rwlock_destroy.restype = None
     L.trpc_rwlock_rdlock.argtypes = [c.c_void_p]
+    L.trpc_rwlock_rdlock.restype = None
     L.trpc_rwlock_rdunlock.argtypes = [c.c_void_p]
+    L.trpc_rwlock_rdunlock.restype = None
     L.trpc_rwlock_wrlock.argtypes = [c.c_void_p]
+    L.trpc_rwlock_wrlock.restype = None
     L.trpc_rwlock_wrunlock.argtypes = [c.c_void_p]
+    L.trpc_rwlock_wrunlock.restype = None
 
     # native metrics seam + profiler (metrics.h, profiler.h)
     L.trpc_native_metrics_dump.argtypes = [c.c_char_p, c.c_size_t]

@@ -1,6 +1,10 @@
 #!/usr/bin/env bash
-# Build the instrumented stress binary: build_sanitized.sh <thread|address>
-# -> native/build-{tsan|asan}/test_stress, from the LIVE sources.
+# Build the instrumented stress binary:
+#   build_sanitized.sh <thread|address|undefined>
+# -> native/build-{tsan|asan|ubsan}/test_stress, from the LIVE sources.
+# The undefined flavor (ISSUE 10) runs with -fno-sanitize-recover=all:
+# any UB (shift/overflow in crc32c/codec block math, misaligned loads,
+# ...) aborts the scenario instead of silently wrapping.
 #
 # build_sanitized.sh <flavor> --sweep N [base-seed] additionally runs the
 # seed sweep on the freshly built tree: N full gate runs, each under a
@@ -14,11 +18,13 @@
 # sanitizer toolchain/runtime here" (callers skip, not fail).
 set -euo pipefail
 cd "$(dirname "$0")"
-flavor="${1:?usage: build_sanitized.sh <thread|address> [--sweep N [base]]}"
+flavor="${1:?usage: build_sanitized.sh <thread|address|undefined> \
+[--sweep N [base]]}"
 case "$flavor" in
-  thread)  dir=build-tsan ;;
-  address) dir=build-asan ;;
-  *) echo "flavor must be thread or address" >&2; exit 2 ;;
+  thread)    dir=build-tsan ;;
+  address)   dir=build-asan ;;
+  undefined) dir=build-ubsan ;;
+  *) echo "flavor must be thread, address or undefined" >&2; exit 2 ;;
 esac
 
 run_sweep_if_asked() {
@@ -36,7 +42,7 @@ if command -v cmake >/dev/null 2>&1 && command -v ninja >/dev/null 2>&1; then
   fi
   # ALWAYS run ninja: incremental, and a stale binary would test old code
   if ! out=$(ninja -C "$dir" test_stress 2>&1); then
-    if grep -qE "cannot find -l(t|a)san|lib(t|a)san.*No such file" \
+    if grep -qE "cannot find -l(t|a|ub)san|lib(t|a|ub)san.*No such file" \
         <<<"$out"; then
       exit 3
     fi
@@ -80,6 +86,11 @@ fi
 SRCS="$(grep -v '^#' sources.lst | tr '\n' ' ') src/test_stress.cc"
 FLAGS="-std=c++17 -fsanitize=$flavor -fno-omit-frame-pointer -O1 -g \
   -fPIC -pthread"
+if [[ "$flavor" == "undefined" ]]; then
+  # UB aborts the run (exit != 0) instead of printing-and-continuing —
+  # the gate contract: fix the UB, never suppress it
+  FLAGS+=" -fno-sanitize-recover=all"
+fi
 PJRT_INC="$(bash pjrt_include.sh)"  # shared probe: see pjrt_include.sh
 PJRT_FLAGS=""
 if [[ -n "${PJRT_INC}" ]]; then
@@ -87,7 +98,8 @@ if [[ -n "${PJRT_INC}" ]]; then
 fi
 # shellcheck disable=SC2086
 if ! out=$(${CXX} ${FLAGS} ${PJRT_FLAGS} ${SRCS} -o "$exe" -ldl 2>&1); then
-  if grep -qE "cannot find -l(t|a)san|lib(t|a)san.*No such file" <<<"$out"
+  if grep -qE "cannot find -l(t|a|ub)san|lib(t|a|ub)san.*No such file" \
+      <<<"$out"
   then
     exit 3
   fi

@@ -161,12 +161,16 @@ struct RemainedCb {
 
 struct TaskGroup {
   WorkStealingQueue<fiber_t> rq{4096};
+  // lint:allow-blocking-bounded (O(1) deque push/pop, never held across
+  // a park — this queue handoff IS the scheduler's own spine)
   std::mutex remote_mu;
   std::deque<fiber_t> remote_rq;
   // bound fibers: owner-only queue, invisible to steal_task (FORK
   // "bound task queues" — work pinned to a worker, e.g. per-core state).
   // nbound lets the dispatch hot path skip the lock entirely when no
   // bound work exists (the common case for the whole RPC path)
+  // lint:allow-blocking-bounded (O(1) deque ops, owner + spawner only,
+  // no parks under it; nbound skips the lock when no bound work exists)
   std::mutex bound_mu;
   std::deque<fiber_t> bound_rq;
   std::atomic<uint32_t> nbound{0};
@@ -601,6 +605,10 @@ class ListLock {
 // Lives on the waiting pthread's stack; fiber waiters and the per-Butex
 // sentinel never construct one.
 struct PthreadSync {
+  // lint:allow-blocking-bounded (waiter side is a pthread by definition
+  // — fiber waiters never construct one; the waker side, which parse
+  // fibers CAN reach through butex_wake, only locks to flip `signaled`
+  // and notify: O(1), no parks under it)
   std::mutex wmu;              // guards signaled
   std::condition_variable cv;
   bool signaled = false;
@@ -695,8 +703,11 @@ int butex_wait_pthread(Butex* b, int32_t expected, int64_t timeout_us) {
   {
     std::unique_lock<std::mutex> lk(ps.wmu);
     if (timeout_us < 0) {
+      // lint:allow-blocking (butex_wait_pthread runs only on non-worker
+      // pthreads — the fiber path parks on the butex, never here)
       ps.cv.wait(lk, [&] { return ps.signaled; });
     } else {
+      // lint:allow-blocking (pthread-caller branch, as above)
       timed_out = !ps.cv.wait_for(lk, std::chrono::microseconds(timeout_us),
                                   [&] { return ps.signaled; });
     }
@@ -713,6 +724,7 @@ int butex_wait_pthread(Butex* b, int32_t expected, int64_t timeout_us) {
     // a waker unlinked us between the timeout and the lock: it is about
     // to signal; wait it out so its notify hits a live frame
     std::unique_lock<std::mutex> lk(ps.wmu);
+    // lint:allow-blocking (pthread-caller branch, as above)
     ps.cv.wait(lk, [&] { return ps.signaled; });
   }
   return 0;

@@ -287,6 +287,8 @@ struct StreamState {
 class H2Conn {
  public:
   std::atomic<int> refs{1};  // registry's reference
+  // lint:allow-blocking-bounded (frame-state mutation only; writes
+  // leave the lock before Socket::Write; contention-profiled)
   ProfiledMutex mu;  // hot: every frame; contention-profiled
   Hpack hpack;
   std::unordered_map<uint32_t, StreamState> streams;
@@ -313,6 +315,8 @@ class H2Conn {
 
 namespace {
 
+// lint:allow-blocking-bounded (O(1) registry map lookup/insert per
+// connection event, no parks under it)
 std::mutex g_conns_mu;
 std::unordered_map<SocketId, H2Conn*> g_conns;
 
@@ -1174,6 +1178,9 @@ struct H2ClientStream {
 
 struct H2ClientConn {
   SocketId sock = INVALID_SOCKET_ID;
+  // lint:allow-blocking-bounded (stream-table mutation only; the
+  // HEADERS write ordering uses header_mu so this is never held
+  // across Socket::Write; contention-profiled)
   ProfiledMutex mu;  // hot: every frame/call; contention-profiled
   // serializes stream-id allocation with the HEADERS write (RFC 9113
   // §5.1.1 increasing-id order) WITHOUT holding mu across Socket::Write:

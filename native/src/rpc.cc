@@ -87,54 +87,57 @@ struct MetaWriter {
 };
 
 void EncodeMeta(const RpcMeta& m, MetaWriter* w) {
+  // tags come from the kMetaTag* registry (rpc.h <-> tools/
+  // wire_tags_manifest.txt, `wiretags` analyzer rule): no bare numerics
   if (!m.method.empty()) {
-    w->tlv(1, m.method.data(), (uint32_t)m.method.size());
+    w->tlv(kMetaTagMethod, m.method.data(), (uint32_t)m.method.size());
   }
-  w->tlv_u64(2, m.correlation_id);
+  w->tlv_u64(kMetaTagCorrelationId, m.correlation_id);
   if (m.error_code != 0) {
-    w->tlv_u32(3, (uint32_t)m.error_code);
+    w->tlv_u32(kMetaTagErrorCode, (uint32_t)m.error_code);
   }
   if (!m.error_text.empty()) {
-    w->tlv(4, m.error_text.data(), (uint32_t)m.error_text.size());
+    w->tlv(kMetaTagErrorText, m.error_text.data(),
+           (uint32_t)m.error_text.size());
   }
   if (m.attachment_size != 0) {
-    w->tlv_u32(5, m.attachment_size);
+    w->tlv_u32(kMetaTagAttachmentSize, m.attachment_size);
   }
   if (m.compress_type != 0) {
-    w->tlv_u8(6, m.compress_type);
+    w->tlv_u8(kMetaTagCompressType, m.compress_type);
   }
   if (m.trace_id != 0) {
-    w->tlv_u64(7, m.trace_id);
+    w->tlv_u64(kMetaTagTraceId, m.trace_id);
   }
   if (m.span_id != 0) {
-    w->tlv_u64(8, m.span_id);
+    w->tlv_u64(kMetaTagSpanId, m.span_id);
   }
   if (m.flags != 0) {
-    w->tlv_u8(9, m.flags);
+    w->tlv_u8(kMetaTagFlags, m.flags);
   }
   if (m.stream_id != 0) {
-    w->tlv_u64(10, m.stream_id);
+    w->tlv_u64(kMetaTagStreamId, m.stream_id);
   }
   if (m.stream_frame_type != 0) {
-    w->tlv_u8(11, m.stream_frame_type);
+    w->tlv_u8(kMetaTagStreamFrameType, m.stream_frame_type);
   }
   if (m.feedback_bytes != 0) {
-    w->tlv_u64(12, m.feedback_bytes);
+    w->tlv_u64(kMetaTagFeedbackBytes, m.feedback_bytes);
   }
   if (!m.auth.empty()) {
-    w->tlv(13, m.auth.data(), (uint32_t)m.auth.size());
+    w->tlv(kMetaTagAuth, m.auth.data(), (uint32_t)m.auth.size());
   }
   if (m.device_caps != 0) {
-    w->tlv_u64(14, m.device_caps);
+    w->tlv_u64(kMetaTagDeviceCaps, m.device_caps);
   }
   if (m.plane_uid != 0) {
-    w->tlv_u64(15, m.plane_uid);
+    w->tlv_u64(kMetaTagPlaneUid, m.plane_uid);
   }
   if (m.payload_codec != 0) {
-    w->tlv_u8(16, m.payload_codec);
+    w->tlv_u8(kMetaTagPayloadCodec, m.payload_codec);
   }
   if (m.attach_codec != 0) {
-    w->tlv_u8(17, m.attach_codec);
+    w->tlv_u8(kMetaTagAttachCodec, m.attach_codec);
   }
 }
 
@@ -150,23 +153,51 @@ bool DecodeMeta(const char* p, size_t n, RpcMeta* m) {
     }
     const char* v = p + i;
     switch (tag) {
-      case 1: m->method.assign(v, len); break;
-      case 2: if (len == 8) memcpy(&m->correlation_id, v, 8); break;
-      case 3: if (len == 4) memcpy(&m->error_code, v, 4); break;
-      case 4: m->error_text.assign(v, len); break;
-      case 5: if (len == 4) memcpy(&m->attachment_size, v, 4); break;
-      case 6: if (len == 1) m->compress_type = (uint8_t)v[0]; break;
-      case 7: if (len == 8) memcpy(&m->trace_id, v, 8); break;
-      case 8: if (len == 8) memcpy(&m->span_id, v, 8); break;
-      case 9: if (len == 1) m->flags = (uint8_t)v[0]; break;
-      case 10: if (len == 8) memcpy(&m->stream_id, v, 8); break;
-      case 11: if (len == 1) m->stream_frame_type = (uint8_t)v[0]; break;
-      case 12: if (len == 8) memcpy(&m->feedback_bytes, v, 8); break;
-      case 13: m->auth.assign(v, len); break;
-      case 14: if (len == 8) memcpy(&m->device_caps, v, 8); break;
-      case 15: if (len == 8) memcpy(&m->plane_uid, v, 8); break;
-      case 16: if (len == 1) m->payload_codec = (uint8_t)v[0]; break;
-      case 17: if (len == 1) m->attach_codec = (uint8_t)v[0]; break;
+      case kMetaTagMethod: m->method.assign(v, len); break;
+      case kMetaTagCorrelationId:
+        if (len == 8) memcpy(&m->correlation_id, v, 8);
+        break;
+      case kMetaTagErrorCode:
+        if (len == 4) memcpy(&m->error_code, v, 4);
+        break;
+      case kMetaTagErrorText: m->error_text.assign(v, len); break;
+      case kMetaTagAttachmentSize:
+        if (len == 4) memcpy(&m->attachment_size, v, 4);
+        break;
+      case kMetaTagCompressType:
+        if (len == 1) m->compress_type = (uint8_t)v[0];
+        break;
+      case kMetaTagTraceId:
+        if (len == 8) memcpy(&m->trace_id, v, 8);
+        break;
+      case kMetaTagSpanId:
+        if (len == 8) memcpy(&m->span_id, v, 8);
+        break;
+      case kMetaTagFlags:
+        if (len == 1) m->flags = (uint8_t)v[0];
+        break;
+      case kMetaTagStreamId:
+        if (len == 8) memcpy(&m->stream_id, v, 8);
+        break;
+      case kMetaTagStreamFrameType:
+        if (len == 1) m->stream_frame_type = (uint8_t)v[0];
+        break;
+      case kMetaTagFeedbackBytes:
+        if (len == 8) memcpy(&m->feedback_bytes, v, 8);
+        break;
+      case kMetaTagAuth: m->auth.assign(v, len); break;
+      case kMetaTagDeviceCaps:
+        if (len == 8) memcpy(&m->device_caps, v, 8);
+        break;
+      case kMetaTagPlaneUid:
+        if (len == 8) memcpy(&m->plane_uid, v, 8);
+        break;
+      case kMetaTagPayloadCodec:
+        if (len == 1) m->payload_codec = (uint8_t)v[0];
+        break;
+      case kMetaTagAttachCodec:
+        if (len == 1) m->attach_codec = (uint8_t)v[0];
+        break;
       default: break;  // forward compatibility: skip unknown tags
     }
     i += len;
@@ -489,6 +520,9 @@ struct InlineBudget {
 // unregister: a canceller that finds the token sets the flag BEFORE the
 // version can bump (respond unregisters first, bumps after), so the flag
 // can never land on a recycled slot's next occupant.
+// lint:allow-blocking-bounded (O(1) hash-map insert/erase per call,
+// no parks under it; the registry must be reachable from pthread
+// cancel callers, so it cannot be a FiberMutex)
 ProfiledMutex g_cancel_mu;
 std::unordered_map<SocketId, std::unordered_map<uint64_t, uint64_t>>
     g_inflight_calls;
@@ -700,6 +734,8 @@ struct ServiceHandler {
 // mutex guards ~one hash op per command; parse fibers of different
 // connections contend only under multi-connection redis load.
 struct RedisStore {
+  // lint:allow-blocking-bounded (~one hash op per command under the
+  // lock — see the contention note above; no parks under it)
   std::mutex mu;
   std::unordered_map<std::string, std::string> kv;
 };
@@ -777,6 +813,9 @@ void PaAbort(uint64_t pa_token);         // idem — dead conn, wake writers
 
 struct ConnState {
   HttpParseState http;  // chunked-body resume state
+  // lint:allow-blocking-bounded (per-connection sequencer: O(1) seq
+  // bookkeeping + cork-chain splice under the lock, writes happen
+  // after release; contention-profiled, no parks under it)
   ProfiledMutex mu;  // hot: per-request pipeline sequencing
   uint64_t next_dispatch = 0;  // seq assigned to the next parsed request
   uint64_t next_release = 0;   // seq whose response may be written next

@@ -35,6 +35,33 @@
 
 namespace trpc {
 
+// Meta TLV wire tags — the ONE assignment point on the C++ side.  The
+// registry of record is tools/wire_tags_manifest.txt (tag, name,
+// description); the `wiretags` analyzer rule (tools/analyze/wiretags.py)
+// checks these constants, the manifest, and the Python mirror
+// (brpc_tpu/rpc/wire_tags.py) against each other BOTH ways, and rejects
+// bare numeric tag literals at the rpc.cc framing seams — so the next
+// codec/trace PR cannot collide a tag by grepping comments.
+enum : uint8_t {
+  kMetaTagMethod = 1,
+  kMetaTagCorrelationId = 2,
+  kMetaTagErrorCode = 3,
+  kMetaTagErrorText = 4,
+  kMetaTagAttachmentSize = 5,
+  kMetaTagCompressType = 6,
+  kMetaTagTraceId = 7,
+  kMetaTagSpanId = 8,
+  kMetaTagFlags = 9,
+  kMetaTagStreamId = 10,
+  kMetaTagStreamFrameType = 11,
+  kMetaTagFeedbackBytes = 12,
+  kMetaTagAuth = 13,
+  kMetaTagDeviceCaps = 14,
+  kMetaTagPlaneUid = 15,
+  kMetaTagPayloadCodec = 16,
+  kMetaTagAttachCodec = 17,
+};
+
 struct RpcMeta {
   std::string method;
   uint64_t correlation_id = 0;

@@ -65,6 +65,8 @@ struct alignas(64) Lane {
 
 Lane g_worker_lanes[kWorkerLanes];
 std::atomic<uint64_t> g_seed{0};
+// lint:allow-blocking-bounded (seed/mode resolution: once per process
+// boot and per reseed — fuzzing control plane, not a traffic path)
 std::mutex g_seed_mu;
 
 // foreign threads (engine/timer/API callers): private lanes, seeded from

@@ -828,7 +828,11 @@ std::atomic<int> g_event_dispatcher_num{1};
 
 void EventDispatcher::Start(int nthreads) {
   bool expected = false;
-  if (!started_.compare_exchange_strong(expected, true)) {
+  // boot-time start latch, not a hot path: explicit seq_cst keeps the
+  // pre-ISSUE-10 semantics (the winner's ready_ release-store below is
+  // what actually publishes the epoll instances to spinning losers)
+  if (!started_.compare_exchange_strong(expected, true,
+                                        std::memory_order_seq_cst)) {
     // another thread is initializing: wait until the epoll instances are
     // visible — callers use EpfdFor immediately after Start returns
     while (!ready_.load(std::memory_order_acquire)) {

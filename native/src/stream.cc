@@ -70,6 +70,9 @@ struct Stream {
   uint32_t slot = 0;
   std::atomic<uint32_t> version{1};
 
+  // lint:allow-blocking-bounded (stream-state bookkeeping + IOBuf
+  // splice under the lock; reader parks happen on the butex AFTER
+  // release; contention-profiled)
   ProfiledMutex mu;  // hot: every frame/read/write; contention-profiled
   SocketId sock = INVALID_SOCKET_ID;
   uint64_t remote_id = 0;

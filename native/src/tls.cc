@@ -222,6 +222,8 @@ bool load_ssl() {
   if (s.up) {
     return true;
   }
+  // lint:allow-blocking-bounded (first call dlopens libssl under the
+  // lock — boot-time; every later call is a flag check and returns)
   static std::mutex mu;
   std::lock_guard<std::mutex> lk(mu);
   if (s.up) {
@@ -305,6 +307,8 @@ struct TlsState {
   SSL* conn = nullptr;
   BIO* rbio = nullptr;  // network -> SSL
   BIO* wbio = nullptr;  // SSL -> network
+  // lint:allow-blocking-bounded (per-connection SSL serialization:
+  // CPU-bound OpenSSL record work under the lock, no parks/syscalls)
   std::mutex mu;        // SSL objects are not thread-safe
   bool handshaken = false;
   // plaintext writes that arrived before the handshake finished; flushed

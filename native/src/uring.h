@@ -50,6 +50,8 @@ bool uring_enabled();  // enabled AND available
 
 // Staging between the ring thread and Socket::ReadToBuf.
 struct RingFeed {
+  // lint:allow-blocking-bounded (O(1) IOBuf block splice between the
+  // ring thread and the parse fiber, no parks under it)
   std::mutex mu;
   IOBuf staged;
   bool eof = false;

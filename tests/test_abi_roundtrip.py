@@ -1,0 +1,77 @@
+"""C-ABI round trip (ISSUE 10 satellite): the `abi` analyzer rule proves
+the ctypes surface matches capi.cc STATICALLY; this test proves it
+DYNAMICALLY — every zero-argument `trpc_*` getter is called through the
+verified bindings against the live library.  A drifted restype (the
+silent-corruption class the gate exists for) shows up here as a wrong
+Python type or a garbage value, not as a crash three layers later.
+
+Getter = zero parameters, non-void return, not a handle allocator
+(c_void_p returns create objects the test would leak).  The set is
+derived from capi.cc by the analyzer's own parser, so a new export is
+exercised automatically — and the test fails if the derivation goes
+empty (the sweep must never silently become a no-op).
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+sys.path.insert(0, REPO)
+
+from analyze import abi  # noqa: E402
+from lint import run_lint  # noqa: E402
+
+
+def test_abi_rule_clean():
+    """The static gate itself, pinned as its own test: both-ways
+    coverage over the real capi.cc/_native pair."""
+    assert run_lint(REPO, rules=["abi"]) == []
+
+
+def test_zero_arg_getters_roundtrip():
+    from brpc_tpu._native import lib
+
+    L = lib()
+    L.trpc_init(2)
+    exports = abi.parse_capi(REPO)
+    assert exports, "capi.cc parse came back empty"
+    getters = {name: ex for name, ex in exports.items()
+               if not ex["params"] and ex["ret"] not in (abi.NONE,)}
+    # the surface this was written against had ~30; shrinking hard
+    # means the parser (or capi.cc) broke, not that getters went away
+    assert len(getters) >= 20, sorted(getters)
+
+    decls = abi.load_declarations(REPO)
+    assert decls is not None
+    for name, ex in sorted(getters.items()):
+        fn = decls.get(name)
+        assert fn is not None, f"{name} missing from _declare"
+        py_ret = abi._py_class(fn.restype) if fn.restype != "UNSET" \
+            else abi.I32
+        if py_ret == abi.PTR:
+            continue  # handle allocators (trpc_*_create) are not getters
+        val = getattr(L, name)()
+        assert isinstance(val, int), (name, val)
+        # width sanity: an i32 getter must fit 32 bits (a truncated-u64
+        # binding typically yields a sign-garbled value here)
+        if ex["ret"] == abi.I32:
+            assert -(1 << 31) <= val < (1 << 32), (name, val)
+
+
+def test_string_getters_roundtrip():
+    """Zero-arg c_char_p getters return bytes-or-None, never an int
+    (an undeclared restype would give a truncated pointer int)."""
+    from brpc_tpu._native import lib
+
+    L = lib()
+    exports = abi.parse_capi(REPO)
+    decls = abi.load_declarations(REPO)
+    for name, ex in sorted(exports.items()):
+        if ex["params"] or ex["ret"] != abi.PTR:
+            continue
+        fn = decls.get(name)
+        if fn is None or fn.restype != __import__("ctypes").c_char_p:
+            continue  # only const-char* getters; handles are allocators
+        val = getattr(L, name)()
+        assert val is None or isinstance(val, bytes), (name, val)

@@ -32,10 +32,14 @@ _RPC_STUB = ("void ServerOnMessages(Socket* s) {\n}\n"
 
 
 def _mini_repo(tmp_path, *, manifest="", cc="", stress="", rpc=_RPC_STUB,
-               pyfile=""):
+               pyfile="", wire_manifest="", wire_py=""):
     tmp_path.mkdir(parents=True, exist_ok=True)
     (tmp_path / "tools").mkdir()
     (tmp_path / "tools" / "flags_manifest.txt").write_text(manifest)
+    # wiretags rule (ISSUE 10): an rpc.cc implies the tag registry +
+    # Python mirror exist (empty = no tags assigned yet = clean)
+    (tmp_path / "tools" / "wire_tags_manifest.txt").write_text(
+        wire_manifest)
     src = tmp_path / "native" / "src"
     src.mkdir(parents=True)
     (src / "engine.cc").write_text(cc)
@@ -44,6 +48,8 @@ def _mini_repo(tmp_path, *, manifest="", cc="", stress="", rpc=_RPC_STUB,
     pkg = tmp_path / "brpc_tpu"
     pkg.mkdir()
     (pkg / "mod.py").write_text(pyfile)
+    (pkg / "rpc").mkdir()
+    (pkg / "rpc" / "wire_tags.py").write_text(wire_py)
     return str(tmp_path)
 
 
@@ -284,3 +290,377 @@ def test_codec_hot_path_allocation_fails(tmp_path):
     v = [x for x in run_lint(root) if x.rule == "allocations"]
     assert len(v) == 1 and v[0].line == 2, v
     assert v[0].path == os.path.join("native", "src", "codec.cc")
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 10: concurrency-contract analyzer rules (tools/analyze/).  Same
+# contract as above: the live tree is clean, and each rule demonstrably
+# fires on a fabricated violation naming the site.
+
+
+def test_lockorder_cycle_fails(tmp_path):
+    """Two functions taking the same two mutexes in opposite orders is
+    the textbook deadlock; the rule must report the cycle with witness
+    sites, and an escape on one edge's acquisition must clear it."""
+    cc = textwrap.dedent("""\
+        struct Engine {
+          std::mutex alpha_mu;
+          std::mutex beta_mu;
+        };
+        void TakeForward(Engine* e) {
+          std::lock_guard<std::mutex> a(e->alpha_mu);
+          std::lock_guard<std::mutex> b(e->beta_mu);
+        }
+        void TakeBackward(Engine* e) {
+          std::lock_guard<std::mutex> b(e->beta_mu);
+          std::lock_guard<std::mutex> a(e->alpha_mu);
+        }
+        """)
+    root = _mini_repo(tmp_path, cc=cc)
+    v = [x for x in run_lint(root, rules=["lockorder"])]
+    assert len(v) == 1 and "cycle" in v[0].message, v
+    assert "alpha_mu" in v[0].message and "beta_mu" in v[0].message
+    # escaping one direction's inner acquisition breaks the cycle
+    (tmp_path / "native" / "src" / "engine.cc").write_text(cc.replace(
+        "  std::lock_guard<std::mutex> a(e->alpha_mu);\n}",
+        "  // lint:allow-lock-order (trylock-only in production)\n"
+        "  std::lock_guard<std::mutex> a(e->alpha_mu);\n}"))
+    assert run_lint(root, rules=["lockorder"]) == []
+
+
+def test_lockorder_cycle_through_call_graph_fails(tmp_path):
+    """The cycle that never shows inside one function: A->B nested in
+    one place, B->A only via a call made under B."""
+    root = _mini_repo(tmp_path, cc=textwrap.dedent("""\
+        struct Engine {
+          std::mutex alpha_mu;
+          std::mutex beta_mu;
+        };
+        void TakesAlpha(Engine* e) {
+          std::lock_guard<std::mutex> a(e->alpha_mu);
+        }
+        void Forward(Engine* e) {
+          std::lock_guard<std::mutex> a(e->alpha_mu);
+          std::lock_guard<std::mutex> b(e->beta_mu);
+        }
+        void Backward(Engine* e) {
+          std::lock_guard<std::mutex> b(e->beta_mu);
+          TakesAlpha(e);
+        }
+        """))
+    v = [x for x in run_lint(root, rules=["lockorder"])]
+    assert len(v) == 1 and "cycle" in v[0].message, v
+    assert "Backward calls TakesAlpha" in v[0].message, v
+
+
+def test_lockorder_self_edge_fails(tmp_path):
+    """Locking a second instance of the same-named mutex while one is
+    held is an instance-ordering hazard (or a straight self-deadlock)."""
+    root = _mini_repo(tmp_path, cc=textwrap.dedent("""\
+        struct Node {
+          std::mutex node_mu;
+        };
+        void Link(Node* a, Node* b) {
+          std::lock_guard<std::mutex> la(a->node_mu);
+          std::lock_guard<std::mutex> lb(b->node_mu);
+        }
+        """))
+    v = [x for x in run_lint(root, rules=["lockorder"])]
+    assert len(v) == 1 and "self lock-order edge" in v[0].message, v
+
+
+def test_fiberblock_reachable_sleep_and_mutex_fail(tmp_path):
+    """An OS sleep two calls below ServerOnMessages and an unannotated
+    std::mutex on the same path must both fire with a witness chain;
+    the declaration-level bounded escape clears the mutex, the site
+    escape clears the sleep."""
+    cc = textwrap.dedent("""\
+        struct Throttle {
+          std::mutex gate_mu;
+        };
+        void SlowHelper(Throttle* t) {
+          std::lock_guard<std::mutex> lk(t->gate_mu);
+          usleep(1000);
+        }
+        """)
+    rpc = _RPC_STUB.replace(
+        "void ServerOnMessages(Socket* s) {\n}",
+        "void ServerOnMessages(Socket* s) {\n  SlowHelper(s->t);\n}")
+    root = _mini_repo(tmp_path, cc=cc, rpc=rpc)
+    v = [x for x in run_lint(root, rules=["fiberblock"])]
+    msgs = [x.message for x in v]
+    assert any("OS sleep" in m and "SlowHelper <- ServerOnMessages" in m
+               for m in msgs), msgs
+    assert any("OS mutex gate_mu" in m for m in msgs), msgs
+    assert len(v) == 2, v
+    (tmp_path / "native" / "src" / "engine.cc").write_text(textwrap.dedent(
+        """\
+        struct Throttle {
+          // lint:allow-blocking-bounded (O(1) token check, no parks)
+          std::mutex gate_mu;
+        };
+        void SlowHelper(Throttle* t) {
+          std::lock_guard<std::mutex> lk(t->gate_mu);
+          usleep(1000);  // lint:allow-blocking (test-only throttle)
+        }
+        """))
+    assert run_lint(root, rules=["fiberblock"]) == []
+
+
+def test_fiberblock_fiber_mutex_allowed(tmp_path):
+    """FiberMutex parks the FIBER, not the reactor thread — acquiring
+    one on the hot path is the sanctioned pattern and must not fire."""
+    root = _mini_repo(tmp_path, cc=textwrap.dedent("""\
+        struct S {
+          FiberMutex fm;
+        };
+        void FiberSafe(S* s) {
+          std::lock_guard<FiberMutex> lk(s->fm);
+        }
+        """), rpc=_RPC_STUB.replace(
+        "void ServerOnMessages(Socket* s) {\n}",
+        "void ServerOnMessages(Socket* s) {\n  FiberSafe(s->x);\n}"))
+    assert run_lint(root, rules=["fiberblock"]) == []
+
+
+def test_atomics_default_order_fails(tmp_path):
+    """A defaulted-order load and an ++ on a declared atomic in a gated
+    file must fire; explicit orders and the escape must not."""
+    root = _mini_repo(tmp_path)
+    (tmp_path / "native" / "src" / "shard.cc").write_text(textwrap.dedent(
+        """\
+        std::atomic<uint64_t> g_hops{0};
+        uint64_t peek() {
+          return g_hops.load();
+        }
+        void bump() {
+          g_hops++;
+        }
+        uint64_t peek_ok() {
+          return g_hops.load(std::memory_order_relaxed);
+        }
+        void bump_ok() {
+          g_hops.fetch_add(1, std::memory_order_relaxed);
+        }
+        int escaped() {
+          return g_hops.load();  // lint:allow-default-order (cold path)
+        }
+        """))
+    v = [x for x in run_lint(root, rules=["atomics"])]
+    assert len(v) == 2, v
+    assert any(".load() without an explicit" in x.message for x in v), v
+    assert any("shorthand on std::atomic g_hops" in x.message
+               for x in v), v
+
+
+def test_abi_arity_and_width_mismatch_detected(tmp_path):
+    """The acceptance-criteria fixture: an injected arity mismatch (and
+    a width mismatch, a missing binding, and a stale binding) in a
+    fabricated capi.cc/_native pair must all be detected."""
+    root = _mini_repo(tmp_path)
+    (tmp_path / "native" / "src" / "capi.cc").write_text(textwrap.dedent(
+        """\
+        extern "C" {
+        int trpc_add(int a, int b) { return a + b; }
+        uint64_t trpc_token(int which) { return 0; }
+        void trpc_unbound() {}
+        }
+        """))
+    nat = tmp_path / "brpc_tpu" / "_native"
+    nat.mkdir(parents=True)
+    (nat / "__init__.py").write_text(textwrap.dedent("""\
+        import ctypes
+
+
+        def _declare(L):
+            c = ctypes
+            L.trpc_add.argtypes = [c.c_int]          # arity: C takes 2
+            L.trpc_add.restype = c.c_int
+            L.trpc_token.argtypes = [c.c_int]
+            L.trpc_token.restype = c.c_int           # width: u64 -> i32
+            L.trpc_gone.argtypes = []                # stale: no export
+            L.trpc_gone.restype = c.c_int
+        """))
+    msgs = [x.message for x in run_lint(root, rules=["abi"])]
+    assert any("trpc_add arity mismatch" in m and "takes 2" in m
+               for m in msgs), msgs
+    assert any("trpc_token restype width mismatch" in m
+               for m in msgs), msgs
+    assert any("trpc_unbound" in m and "no ctypes declaration" in m
+               for m in msgs), msgs
+    assert any("stale ctypes binding trpc_gone" in m for m in msgs), msgs
+    assert len(msgs) == 4, msgs
+
+
+def test_abi_loop_driven_declarations_seen(tmp_path):
+    """_declare is EXECUTED against a recorder, so getattr/f-string
+    declaration loops count as declarations (a regex would miss them)."""
+    root = _mini_repo(tmp_path)
+    (tmp_path / "native" / "src" / "capi.cc").write_text(textwrap.dedent(
+        """\
+        extern "C" {
+        int trpc_part_a(void* h) { return 0; }
+        int trpc_part_b(void* h) { return 0; }
+        }
+        """))
+    nat = tmp_path / "brpc_tpu" / "_native"
+    nat.mkdir(parents=True)
+    (nat / "__init__.py").write_text(textwrap.dedent("""\
+        import ctypes
+
+
+        def _declare(L):
+            c = ctypes
+            for part in ("a", "b"):
+                fn = getattr(L, f"trpc_part_{part}")
+                fn.argtypes = [c.c_void_p]
+                fn.restype = c.c_int
+        """))
+    assert run_lint(root, rules=["abi"]) == []
+
+
+def test_wiretags_bare_literal_and_drift_fail(tmp_path):
+    """A bare numeric tag at a tlv() seam, a constant the manifest does
+    not know, a manifest entry with no constant, and a Python-mirror
+    drift must all fire."""
+    root = _mini_repo(
+        tmp_path,
+        wire_manifest="1 method request method\n"
+                      "2 correlation_id pending-call id\n"
+                      "3 ghost_tag nothing defines this\n",
+        wire_py="METHOD = 1\nCORRELATION_ID = 7\n",
+        rpc=_RPC_STUB + textwrap.dedent("""\
+            void EncodeMeta(const RpcMeta& m, MetaWriter* w) {
+              w->tlv_u64(kMetaTagCorrelationId, m.correlation_id);
+              w->tlv_u8(9, m.flags);
+            }
+            """))
+    (tmp_path / "native" / "src" / "rpc.h").write_text(textwrap.dedent("""\
+        enum : uint8_t {
+          kMetaTagMethod = 1,
+          kMetaTagCorrelationId = 2,
+          kMetaTagRogue = 99,
+        };
+        """))
+    msgs = [x.message for x in run_lint(root, rules=["wiretags"])]
+    assert any("bare numeric TLV tag 9" in m for m in msgs), msgs
+    assert any("kMetaTagRogue" in m and "not registered" in m
+               for m in msgs), msgs
+    assert any("ghost_tag" in m and "no kMetaTag" in m for m in msgs), msgs
+    assert any("CORRELATION_ID = 7 disagrees" in m for m in msgs), msgs
+    # ghost_tag also has no Python-mirror constant
+    assert len(msgs) == 5, msgs
+
+
+def test_wiretags_tag_collision_fails(tmp_path):
+    """Two names on one tag number is a wire collision — exactly what
+    the registry exists to prevent."""
+    root = _mini_repo(tmp_path,
+                      wire_manifest="16 payload_codec codec id\n"
+                                    "16 shiny_new_tag oops\n",
+                      wire_py="PAYLOAD_CODEC = 16\n")
+    (tmp_path / "native" / "src" / "rpc.h").write_text(
+        "enum : uint8_t { kMetaTagPayloadCodec = 16 };\n")
+    msgs = [x.message for x in run_lint(root, rules=["wiretags"])]
+    assert any("tag 16 assigned to both" in m for m in msgs), msgs
+
+
+def test_rule_selection_and_json(tmp_path):
+    """--rule subsets run only the named rules; unknown names raise."""
+    root = _mini_repo(tmp_path, pyfile=
+                      'import os\nV = os.environ.get("TRPC_ROGUE")\n')
+    # flags violation exists, but an atomics-only run must not see it
+    assert [x.rule for x in run_lint(root, rules=["atomics"])] == []
+    assert any(x.rule == "flags" for x in run_lint(root, rules=["flags"]))
+    import pytest
+    with pytest.raises(ValueError):
+        run_lint(root, rules=["no_such_rule"])
+
+
+def test_analyzer_wall_clock_budget():
+    """Tier-1 runs the whole analyzer on every pytest invocation: all
+    rules over the REAL tree must stay well under ~10s (the line-level
+    rules were ~1s; the multi-pass model must not regress the gate)."""
+    import time
+    from lint import analyzer_version
+    t0 = time.monotonic()
+    run_lint(REPO, os.environ.get("TRPC_REFERENCE_ROOT",
+                                  "/root/reference"))
+    elapsed = time.monotonic() - t0
+    assert elapsed < 10.0, f"analyzer took {elapsed:.1f}s (budget 10s)"
+    # the version hash is stable within a tree and 12 hex chars
+    v1, v2 = analyzer_version(REPO), analyzer_version(REPO)
+    assert v1 == v2 and len(v1) == 12 and int(v1, 16) >= 0
+
+
+def test_atomics_same_statement_masking_detected(tmp_path):
+    """A defaulted-order op must fire even when ANOTHER op in the same
+    statement passes a memory_order — the check looks at the matched
+    call's own argument list, not the statement text."""
+    root = _mini_repo(tmp_path)
+    (tmp_path / "native" / "src" / "shard.cc").write_text(textwrap.dedent(
+        """\
+        std::atomic<uint64_t> g_a{0};
+        std::atomic<uint64_t> g_b{0};
+        uint64_t both() {
+          return g_a.load() + g_b.load(std::memory_order_relaxed);
+        }
+        """))
+    v = [x for x in run_lint(root, rules=["atomics"])]
+    assert len(v) == 1 and ".load() without an explicit" in v[0].message, v
+
+
+def test_fiberblock_group_escape_fails_closed(tmp_path):
+    """One audited `mu` must not bless an unaudited same-named `mu` in
+    the same file: the declaration escape covers the identity only when
+    EVERY same-file declaration of the name carries it."""
+    cc = textwrap.dedent("""\
+        struct Audited {
+          // lint:allow-blocking-bounded (O(1), audited)
+          std::mutex mu;
+        };
+        struct Unaudited {
+          std::mutex mu;
+        };
+        void Touch(Audited* a, Unaudited* u) {
+          std::lock_guard<std::mutex> lk(u->mu);
+        }
+        """)
+    rpc = _RPC_STUB.replace(
+        "void ServerOnMessages(Socket* s) {\n}",
+        "void ServerOnMessages(Socket* s) {\n  Touch(s->a, s->u);\n}")
+    root = _mini_repo(tmp_path, cc=cc, rpc=rpc)
+    v = [x for x in run_lint(root, rules=["fiberblock"])]
+    assert len(v) == 1 and "OS mutex mu" in v[0].message, v
+    # annotating the second declaration completes the audit: clean
+    (tmp_path / "native" / "src" / "engine.cc").write_text(cc.replace(
+        "struct Unaudited {\n  std::mutex mu;",
+        "struct Unaudited {\n"
+        "  // lint:allow-blocking-bounded (O(1), audited too)\n"
+        "  std::mutex mu;"))
+    assert run_lint(root, rules=["fiberblock"]) == []
+
+
+def test_model_sees_constructor_with_init_list(tmp_path):
+    """A constructor with a member-initializer list must register under
+    the CLASS name (not a phantom named after the last initializer), so
+    blocking calls in its body stay visible to the graph rules."""
+    cc = textwrap.dedent("""\
+        struct Engine {
+          int a_;
+          int b_;
+          explicit Engine(int a) : a_(a), b_(a + 1) {
+            usleep(1000);
+          }
+        };
+        Engine* MakeEngine() {
+          return new Engine(1);
+        }
+        """)
+    rpc = _RPC_STUB.replace(
+        "void ServerOnMessages(Socket* s) {\n}",
+        "void ServerOnMessages(Socket* s) {\n  MakeEngine();\n}")
+    root = _mini_repo(tmp_path, cc=cc, rpc=rpc)
+    msgs = [x.message for x in run_lint(root, rules=["fiberblock"])]
+    assert any("OS sleep" in m and "Engine <- MakeEngine" in m
+               for m in msgs), msgs

@@ -23,6 +23,10 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+_FLAVOR_DIRS = {"thread": "build-tsan", "address": "build-asan",
+                "undefined": "build-ubsan"}
+
+
 def _build(flavor: str) -> str:
     r = subprocess.run(
         ["bash", os.path.join(REPO, "native", "build_sanitized.sh"),
@@ -31,9 +35,8 @@ def _build(flavor: str) -> str:
         pytest.skip(f"no {flavor} sanitizer toolchain/runtime: "
                     f"{(r.stdout + r.stderr)[-200:]}")
     assert r.returncode == 0, r.stdout + r.stderr
-    return os.path.join(
-        REPO, "native", "build-" + ("tsan" if flavor == "thread"
-                                    else "asan"), "test_stress")
+    return os.path.join(REPO, "native", _FLAVOR_DIRS[flavor],
+                        "test_stress")
 
 
 @pytest.mark.slow
@@ -119,6 +122,42 @@ def test_seed_sweep_telemetry_races(flavor):
         f"telemetry sweep found schedule-dependent failures (seeds "
         f"{hits}); replay: TRPC_SHARDS=2 TRPC_SCHED_SEED=<seed> {exe} "
         f"telemetry_races\n{out.stdout[-3000:]}")
+    assert f"sweep done: 0/{seeds}" in out.stdout, out.stdout[-2000:]
+
+
+@pytest.mark.slow
+def test_ubsan_gate():
+    """ISSUE 10 UBSan rail: the FULL kScenarios gate table under
+    -fsanitize=undefined -fno-sanitize-recover=all (any UB aborts the
+    scenario — shift/overflow in crc32c/codec block math, misaligned
+    loads, ...), run from the repo root so the TLS scenario finds its
+    certs, then a small seeded sweep (UB is schedule-independent in the
+    common case, so a handful of seeds buys the interleaving coverage
+    without the full 32-seed budget: BRPC_TPU_UBSAN_SWEEP_SEEDS).  UB
+    found here is FIXED, never suppressed (no suppression file exists
+    by design)."""
+    if os.environ.get("BRPC_TPU_SKIP_SANITIZERS"):
+        pytest.skip("sanitizer runs disabled by env")
+    exe = _build("undefined")
+    out = subprocess.run(
+        [exe], capture_output=True, text=True, cwd=REPO,
+        timeout=int(os.environ.get("BRPC_TPU_SEED_SWEEP_TIMEOUT", "5400")))
+    assert out.returncode == 0 and "ALL STRESS TESTS PASSED" in out.stdout, (
+        f"UBSan gate failed (rc={out.returncode}) — fix the UB, do not "
+        f"suppress it\n{out.stdout[-3000:]}\n{out.stderr[-3000:]}")
+    seeds = int(os.environ.get("BRPC_TPU_UBSAN_SWEEP_SEEDS", "8"))
+    base = int(os.environ.get("BRPC_TPU_SEED_SWEEP_BASE", "1"))
+    env = dict(os.environ)
+    env["TRPC_SHARDS"] = "2"
+    out = subprocess.run(
+        [exe, "--sweep", str(seeds), str(base)],
+        capture_output=True, text=True, cwd=REPO, env=env,
+        timeout=int(os.environ.get("BRPC_TPU_SEED_SWEEP_TIMEOUT", "5400")))
+    hits = [int(m) for m in re.findall(r"SWEEP HIT seed=(\d+)", out.stdout)]
+    assert out.returncode == 0 and not hits, (
+        f"UBSan seed sweep found failures (seeds {hits}); replay: "
+        f"TRPC_SHARDS=2 TRPC_SCHED_SEED=<seed> {exe}\n"
+        f"{out.stdout[-3000:]}")
     assert f"sweep done: 0/{seeds}" in out.stdout, out.stdout[-2000:]
 
 

@@ -1,0 +1,45 @@
+"""tools/analyze — the concurrency-contract analyzer (ISSUE 10).
+
+Multi-pass static rules over a shared C++ source model (analyze.model):
+
+    lockorder   held-while-acquiring graph over every mutex, cycles fail
+    fiberblock  no OS-blocking calls reachable from parse-fiber roots
+    atomics     explicit std::memory_order on every gated hot-path op
+    abi         capi.cc trpc_* exports <-> ctypes declarations, both ways
+    wiretags    meta TLV tags from the one registry, no bare numerics
+
+Entry point: run_rules(root, names) -> List[Violation].  tools/lint.py
+folds these into its rule registry (python tools/lint.py --rule ...);
+tools/ANALYZE.md documents each contract and its escape hatch.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from . import abi, atomics, fiberblock, lockorder, wiretags
+from .model import Model, Violation, build_model
+
+# rule name -> check(model, violations)
+ANALYZER_RULES = {
+    "lockorder": lockorder.check,
+    "fiberblock": fiberblock.check,
+    "atomics": atomics.check,
+    "abi": abi.check,
+    "wiretags": wiretags.check,
+}
+
+
+def run_rules(root: str, names: Optional[List[str]] = None,
+              model: Optional[Model] = None) -> List[Violation]:
+    picked = list(ANALYZER_RULES) if names is None else list(names)
+    unknown = [n for n in picked if n not in ANALYZER_RULES]
+    if unknown:
+        raise ValueError(f"unknown analyzer rule(s): {unknown} "
+                         f"(have: {sorted(ANALYZER_RULES)})")
+    if model is None:
+        model = build_model(root)
+    violations: List[Violation] = []
+    for name in picked:
+        ANALYZER_RULES[name](model, violations)
+    return violations

@@ -1,0 +1,338 @@
+"""Rule `abi` (ISSUE 10 contract 4): the `trpc_*` C exports in
+native/src/capi.cc and the ctypes declarations in
+brpc_tpu/_native/__init__.py must agree BOTH ways.
+
+Today a drifted binding is a silent-corruption class: ctypes guesses
+int-sized arguments for undeclared functions, truncates 64-bit handles
+on LP64, and reads garbage RAX for void returns — none of it crashes at
+the call site.  The gate:
+
+  * every `trpc_*` function DEFINED in capi.cc has a Python declaration
+    (missing binding) and vice versa (stale binding — the export was
+    renamed/removed but the ctypes decl survived);
+  * declared argtypes match the C parameter list in arity and WIDTH
+    CLASS (I32 / I64 / F64 / PTR — the classes whose confusion corrupts:
+    an int binding for a size_t parameter truncates at 4GB, a c_int
+    restype for a uint64_t handle drops the top half);
+  * every binding with C parameters declares argtypes, and every binding
+    whose C return is not plain `int` declares restype (ctypes' implicit
+    c_int default is only correct for int).
+
+The Python side is NOT parsed by regex: the module's `_declare(L)` is
+executed against a recording stub, so loops/getattr-driven declarations
+(`for f in (...): getattr(L, f"trpc_h2_result_{f}")`) are seen exactly
+as ctypes sees them.
+
+Escapes: `lint:allow-abi (reason)` on the capi.cc definition line, or a
+`# lint:allow-abi trpc_name (reason)` line in the Python file.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import importlib.util
+import itertools
+import os
+import re
+from typing import Dict, List, Optional
+
+from .model import Violation, blank_comments
+
+CAPI_REL = os.path.join("native", "src", "capi.cc")
+PY_REL = os.path.join("brpc_tpu", "_native", "__init__.py")
+
+_ESCAPE = "lint:allow-abi"
+
+# width classes
+I32, I64, F64, PTR, NONE, UNKNOWN = "i32", "i64", "f64", "ptr", "void", "?"
+
+_C_I32 = {"int", "int32_t", "uint32_t", "unsigned", "unsigned int",
+          "bool", "uint8_t", "int8_t", "uint16_t", "int16_t", "char"}
+_C_I64 = {"int64_t", "uint64_t", "size_t", "ssize_t", "long",
+          "unsigned long", "long long", "unsigned long long",
+          "uintptr_t", "intptr_t"}
+
+
+def _c_class(decl: str, fnptr_typedefs: set) -> str:
+    d = decl.strip()
+    d = re.sub(r"\bconst\b", "", d).strip()
+    if not d or d == "void":
+        return NONE
+    if "*" in d or "[" in d or "(" in d:
+        return PTR
+    # strip the trailing parameter name
+    m = re.match(r"([A-Za-z_][\w:\s]*?)\s+[A-Za-z_]\w*$", d)
+    base = (m.group(1) if m else d).strip()
+    if base in fnptr_typedefs:
+        return PTR
+    if base in _C_I32:
+        return I32
+    if base in _C_I64:
+        return I64
+    if base == "double" or base == "float":
+        return F64
+    return UNKNOWN
+
+
+def parse_capi(root: str) -> Dict[str, dict]:
+    """{name: {ret, params: [class...], line, escaped}} from capi.cc."""
+    path = os.path.join(root, CAPI_REL)
+    out: Dict[str, dict] = {}
+    if not os.path.exists(path):
+        return out
+    with open(path, encoding="utf-8", errors="replace") as f:
+        text = f.read()
+    blanked = blank_comments(text)
+    lines = text.splitlines()
+    fnptr_typedefs = set(re.findall(
+        r"typedef\s+[\w\s\*]+\(\s*\*\s*(\w+)\s*\)", blanked))
+    # definitions: ret trpc_name(params) {  — params may span lines and
+    # contain function-pointer declarators, so the parameter list is
+    # scanned with balanced parens, not a regex
+    for m in re.finditer(r"\b(trpc_\w+)\s*\(", blanked):
+        name = m.group(1)
+        # balanced-paren scan for the closing ')'
+        depth = 0
+        i = m.end() - 1
+        while i < len(blanked):
+            if blanked[i] == "(":
+                depth += 1
+            elif blanked[i] == ")":
+                depth -= 1
+                if depth == 0:
+                    break
+            i += 1
+        if i >= len(blanked):
+            continue
+        # a DEFINITION is followed by '{' (declarations/typedefs/calls
+        # are followed by ';', ',', ')', operators, ...)
+        j = i + 1
+        while j < len(blanked) and blanked[j] in " \t\n":
+            j += 1
+        if j >= len(blanked) or blanked[j] != "{":
+            continue
+        # return declaration: scan back to the previous ; } { or newline
+        # boundary of the previous statement
+        k = m.start() - 1
+        while k >= 0 and blanked[k] not in ";}{":
+            k -= 1
+        ret_decl = blanked[k + 1:m.start()].strip()
+        if not ret_decl:
+            continue  # a call like `trpc_foo(...) {` cannot occur; skip
+        params = blanked[m.end():i]
+        line1 = blanked.count("\n", 0, m.start()) + 1
+        escaped = any(_ESCAPE in lines[x]
+                      for x in range(max(0, line1 - 2),
+                                     min(line1 + 1, len(lines))))
+        if ret_decl.split()[-1] == "void" and "*" not in ret_decl:
+            ret = NONE
+        else:
+            ret = _c_class(ret_decl + " x", fnptr_typedefs)  # fake a name
+        plist = []
+        params = params.strip()
+        if params and params != "void":
+            # split top-level commas only (fn-ptr params nest parens)
+            depth = 0
+            cur = ""
+            parts = []
+            for ch in params:
+                if ch == "(":
+                    depth += 1
+                elif ch == ")":
+                    depth -= 1
+                if ch == "," and depth == 0:
+                    parts.append(cur)
+                    cur = ""
+                else:
+                    cur += ch
+            parts.append(cur)
+            for p in parts:
+                if "(" in p or "*" in p or "[" in p:
+                    plist.append(PTR)
+                else:
+                    plist.append(_c_class(p, fnptr_typedefs))
+        out[name] = {"ret": ret, "params": plist, "line": line1,
+                     "escaped": escaped}
+    return out
+
+
+class _RecFn:
+    def __init__(self, name: str):
+        self.name = name
+        self.argtypes: Optional[list] = None
+        self.restype = "UNSET"
+
+
+class _Recorder:
+    def __init__(self):
+        self.fns: Dict[str, _RecFn] = {}
+
+    def __getattr__(self, name: str):
+        if name.startswith("__"):
+            raise AttributeError(name)
+        fns = object.__getattribute__(self, "fns")
+        if name not in fns:
+            fns[name] = _RecFn(name)
+        return fns[name]
+
+
+_probe_counter = itertools.count()
+
+
+def load_declarations(root: str) -> Optional[Dict[str, _RecFn]]:
+    """Import the ctypes loader module from the target repo and run its
+    _declare against a recorder.  Returns None when the module or its
+    _declare is missing (reported by check())."""
+    path = os.path.join(root, PY_REL)
+    if not os.path.exists(path):
+        return None
+    modname = f"_abi_probe_{next(_probe_counter)}"
+    spec = importlib.util.spec_from_file_location(modname, path)
+    if spec is None or spec.loader is None:
+        return None
+    mod = importlib.util.module_from_spec(spec)
+    try:
+        spec.loader.exec_module(mod)
+    except Exception:
+        return None
+    declare = getattr(mod, "_declare", None)
+    if declare is None:
+        return None
+    rec = _Recorder()
+    declare(rec)
+    return rec.fns
+
+
+def _py_class(obj) -> str:
+    if obj is None:
+        return NONE
+    if obj is ctypes.c_double or obj is ctypes.c_float:
+        return F64
+    if obj in (ctypes.c_int, ctypes.c_int32, ctypes.c_uint32,
+               ctypes.c_uint, ctypes.c_bool, ctypes.c_uint8,
+               ctypes.c_int8, ctypes.c_uint16, ctypes.c_int16):
+        return I32
+    if obj in (ctypes.c_int64, ctypes.c_uint64, ctypes.c_size_t,
+               ctypes.c_ssize_t, ctypes.c_long, ctypes.c_ulong,
+               ctypes.c_longlong, ctypes.c_ulonglong):
+        # c_long is 64-bit on LP64, which is what the runtime targets
+        return I64
+    try:
+        if obj in (ctypes.c_char_p, ctypes.c_void_p, ctypes.c_wchar_p):
+            return PTR
+        if isinstance(obj, type) and issubclass(
+                obj, (ctypes._Pointer, ctypes._CFuncPtr, ctypes.Array,
+                      ctypes.Structure)):
+            return PTR
+    except TypeError:
+        pass
+    return UNKNOWN
+
+
+def _py_escapes(root: str) -> Dict[str, bool]:
+    path = os.path.join(root, PY_REL)
+    out: Dict[str, bool] = {}
+    if not os.path.exists(path):
+        return out
+    with open(path, encoding="utf-8", errors="replace") as f:
+        for ln in f:
+            if _ESCAPE in ln:
+                for name in re.findall(r"trpc_\w+", ln):
+                    out[name] = True
+    return out
+
+
+def _py_line_of(root: str, name: str) -> int:
+    path = os.path.join(root, PY_REL)
+    try:
+        with open(path, encoding="utf-8", errors="replace") as f:
+            for i, ln in enumerate(f, 1):
+                if name in ln:
+                    return i
+    except OSError:
+        pass
+    return 0
+
+
+def check_root(root: str, violations: List[Violation]) -> None:
+    exports = parse_capi(root)
+    if not exports:
+        return  # no capi.cc in this tree: rule out of scope
+    decls = load_declarations(root)
+    if decls is None:
+        violations.append(Violation(
+            "abi", PY_REL, 0,
+            "ctypes loader (or its _declare) missing/unimportable — the "
+            "C-ABI gate cannot verify the binding surface"))
+        return
+    py_escaped = _py_escapes(root)
+
+    for name, ex in sorted(exports.items()):
+        if ex["escaped"] or py_escaped.get(name):
+            continue
+        fn = decls.get(name)
+        if fn is None:
+            violations.append(Violation(
+                "abi", CAPI_REL, ex["line"],
+                f"{name} is exported by capi.cc but has no ctypes "
+                f"declaration in {PY_REL} — an undeclared call lets "
+                f"ctypes guess int-sized args (silent corruption); "
+                f"declare argtypes/restype or escape with {_ESCAPE}"))
+            continue
+        # arity + width
+        if fn.argtypes is None:
+            if ex["params"]:
+                violations.append(Violation(
+                    "abi", PY_REL, _py_line_of(root, name),
+                    f"{name} takes {len(ex['params'])} parameter(s) in "
+                    f"capi.cc but declares no argtypes — ctypes will "
+                    f"guess widths at every call"))
+        else:
+            if len(fn.argtypes) != len(ex["params"]):
+                violations.append(Violation(
+                    "abi", PY_REL, _py_line_of(root, name),
+                    f"{name} arity mismatch: capi.cc takes "
+                    f"{len(ex['params'])} parameter(s), argtypes "
+                    f"declares {len(fn.argtypes)}"))
+            else:
+                for i, (c_cls, py_t) in enumerate(
+                        zip(ex["params"], fn.argtypes)):
+                    p_cls = _py_class(py_t)
+                    if c_cls == UNKNOWN or p_cls == UNKNOWN:
+                        continue
+                    if c_cls != p_cls:
+                        violations.append(Violation(
+                            "abi", PY_REL, _py_line_of(root, name),
+                            f"{name} argument {i} width mismatch: "
+                            f"capi.cc says {c_cls}, argtypes says "
+                            f"{p_cls} ({getattr(py_t, '__name__', py_t)})"))
+        # restype
+        if fn.restype == "UNSET":
+            if ex["ret"] not in (I32,):
+                violations.append(Violation(
+                    "abi", PY_REL, _py_line_of(root, name),
+                    f"{name} returns {ex['ret']} in capi.cc but declares "
+                    f"no restype — ctypes' implicit c_int default "
+                    f"{'reads garbage for void' if ex['ret'] == NONE else 'truncates the value'}; "
+                    f"declare restype "
+                    f"({'None' if ex['ret'] == NONE else 'the matching c type'})"))
+        else:
+            r_cls = _py_class(fn.restype)
+            if r_cls != UNKNOWN and ex["ret"] != UNKNOWN \
+                    and r_cls != ex["ret"]:
+                violations.append(Violation(
+                    "abi", PY_REL, _py_line_of(root, name),
+                    f"{name} restype width mismatch: capi.cc returns "
+                    f"{ex['ret']}, restype declares {r_cls}"))
+
+    for name, fn in sorted(decls.items()):
+        if name.startswith("trpc_") and name not in exports \
+                and not py_escaped.get(name):
+            violations.append(Violation(
+                "abi", PY_REL, _py_line_of(root, name),
+                f"stale ctypes binding {name}: capi.cc no longer exports "
+                f"it (renamed exports must update {PY_REL})"))
+
+
+def check(model, violations: List[Violation]) -> None:
+    check_root(model.root, violations)

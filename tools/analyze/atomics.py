@@ -1,0 +1,114 @@
+"""Rule `atomics` (ISSUE 10 contract 3): every atomic operation in the
+gated hot-path files states an explicit std::memory_order.
+
+A defaulted seq_cst on the fast path is a silent full fence per
+operation (the PR-9 histograms write on every request; the PR-7 mailbox
+CAS loop runs per cross-shard post), and a defaulted order also hides
+the author's intent — explicit order is the per-site annotation the
+reviewer checks against the pairing site.  Two checks per gated file:
+
+  * method-form ops (.load/.store/.fetch_*/.exchange/.compare_exchange_*)
+    must pass a memory_order argument (statement-spanning: multi-line
+    calls are joined to the terminating ';');
+  * `++` / `--` / `+=` / `-=` / `|=` / `&=` shorthand on a variable
+    DECLARED std::atomic in the same file is flagged — the shorthand is
+    always seq_cst and cannot state an order; spell it fetch_add/fetch_or
+    with the intended order.
+
+Gated files: the ISSUE-10 set (metrics, shard, socket, uring — the
+relaxed-histogram, mailbox, wait-free-write and ring seams) — grown by
+editing GATED_FILES as new hot-path translation units appear.
+
+Escape: `lint:allow-default-order (reason)` on the line (or the line
+above) — for deliberate seq_cst sites (e.g. the PR-3 cork park/Uncork
+Dekker handshake, which NEEDS the StoreLoad fence).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+from .model import Model, Violation
+
+GATED_FILES = (
+    "native/src/metrics.h", "native/src/metrics.cc",
+    "native/src/shard.h", "native/src/shard.cc",
+    "native/src/socket.h", "native/src/socket.cc",
+    "native/src/uring.h", "native/src/uring.cc",
+)
+
+_OP_RE = re.compile(
+    r"\.(load|store|fetch_add|fetch_sub|fetch_or|fetch_and|fetch_xor|"
+    r"exchange|compare_exchange_weak|compare_exchange_strong)\s*\(")
+_INC_RE = re.compile(
+    r"(?:\+\+|--)\s*([A-Za-z_]\w*)|([A-Za-z_]\w*)(?:\[[^\]]*\])?\s*"
+    r"(?:\+\+|--|\+=|-=|\|=|&=|\^=)")
+
+_ESCAPE = "lint:allow-default-order"
+
+
+def _call_args(stmt: str) -> str:
+    """The argument text of the FIRST call in stmt (which starts at the
+    matched `.op(`): the balanced-paren span after the first '('.
+    Returns what was scanned even on an unterminated span (joining is
+    capped), which errs toward accepting — the op is then re-checked by
+    a human, not spuriously flagged."""
+    start = stmt.find("(")
+    if start < 0:
+        return ""
+    depth = 0
+    for i in range(start, len(stmt)):
+        if stmt[i] == "(":
+            depth += 1
+        elif stmt[i] == ")":
+            depth -= 1
+            if depth == 0:
+                return stmt[start + 1:i]
+    return stmt[start + 1:]
+
+
+def check(model: Model, violations: List[Violation]) -> None:
+    for rel in GATED_FILES:
+        sf = model.files.get(rel)
+        if sf is None:
+            continue
+        atoms = model.atomics.get(rel, set())
+        lines = sf.blanked_lines
+        for i, ln in enumerate(lines):
+            orig = sf.lines[i]
+            escaped = _ESCAPE in orig or (i > 0 and _ESCAPE in sf.lines[i - 1])
+            for m in _OP_RE.finditer(ln):
+                # the order must appear in THIS call's own argument
+                # list: join continuation lines, then walk the balanced
+                # parens from the matched '(' — a memory_order on a
+                # neighboring op in the same statement must not mask a
+                # defaulted one (`a.load() + b.load(relaxed)`)
+                stmt = ln[m.start():]
+                j = i
+                while ";" not in stmt and j + 1 < len(lines) and j - i < 6:
+                    j += 1
+                    stmt += " " + lines[j]
+                if "memory_order" in _call_args(stmt):
+                    continue
+                if escaped:
+                    continue
+                violations.append(Violation(
+                    "atomics", rel, i + 1,
+                    f".{m.group(1)}() without an explicit "
+                    f"std::memory_order in a gated hot-path file: state "
+                    f"the order (relaxed/acquire/release/acq_rel/seq_cst "
+                    f"— the default seq_cst is a full fence AND hides "
+                    f"intent), or escape with {_ESCAPE} (reason)"))
+            if not atoms or escaped:
+                continue
+            for m in _INC_RE.finditer(ln):
+                name = m.group(1) or m.group(2)
+                if name in atoms:
+                    violations.append(Violation(
+                        "atomics", rel, i + 1,
+                        f"increment/compound-assign shorthand on "
+                        f"std::atomic {name} is an implicit seq_cst RMW: "
+                        f"spell it fetch_add/fetch_sub/fetch_or with an "
+                        f"explicit order, or escape with {_ESCAPE} "
+                        f"(reason)"))

@@ -1,0 +1,158 @@
+"""Rule `fiberblock` (ISSUE 10 contract 2): no OS-blocking calls
+reachable from the parse-fiber hot-path roots.
+
+A parse fiber runs on a shard's reactor worker; anything that parks the
+OS THREAD (not the fiber) stalls every fiber of that shard — the
+whole-reactor head-of-line blocking the PR-3/5 fast paths exist to
+avoid.  This rule extends the line-level no-raw-alloc gate to
+reachability: from the roots (ServerOnMessages / ChannelOnMessages and
+the inline-dispatch seams they run), walk the call graph and flag
+
+  * acquisitions of OS mutexes (std::mutex / ProfiledMutex — FiberMutex
+    parks the FIBER and is allowed),
+  * sleeps (sleep/usleep/nanosleep/std::this_thread::sleep_*),
+  * OS condvar waits and bare blocking syscalls (epoll_wait/poll/select,
+    fsync/fdatasync).
+
+The call graph uses the precision-filtered resolution (unique names,
+std-method denylist, model.resolved_calls) so a `.push()` on a vector
+doesn't drag unrelated code into the reachable set.
+
+Escapes, matching how the tree actually earns its exceptions:
+
+  * `lint:allow-blocking-bounded (reason)` on an OS-mutex DECLARATION
+    line marks every acquisition of that mutex as audited-bounded (held
+    for O(1) pointer work, never across a park/syscall) — the
+    object-pool free lists and the per-socket sequencer are this class;
+  * `lint:allow-blocking (reason)` on a call SITE escapes that site
+    alone (for sleeps/waits with a real justification).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Set
+
+from .model import (GUARD_RE, LOCK_CALL_RE, Model, Violation, lock_field)
+
+ROOTS = [
+    # server + client parse fibers (the PR-3/5 run-to-completion paths)
+    "ServerOnMessages", "ChannelOnMessages",
+    # inline-dispatch seams spawned ON the parse fiber
+    "EchoFiber", "HbmEchoFiber", "RedisCacheFiber",
+    # telemetry record sites run inside the above (gated separately for
+    # allocations; reachability keeps them honest about blocking too)
+    "telemetry_record", "rpcz_capture",
+]
+
+_SLEEP_RE = re.compile(
+    r"\b(?:usleep|nanosleep|sleep)\s*\(|std::this_thread::sleep_")
+_SYSCALL_RE = re.compile(r"\b(?:epoll_wait|poll|select|fsync|fdatasync)\s*\(")
+_CONDVAR_WAIT_RE = re.compile(
+    r"\b([A-Za-z_][\w.\->]*?)\s*(?:\.|->)\s*wait(?:_for|_until)?\s*\(")
+
+_SITE_ESCAPE = "lint:allow-blocking"
+_DECL_ESCAPE = "lint:allow-blocking-bounded"
+
+
+def _decl_escaped(model: Model, rel: str, line0: int) -> bool:
+    """The bounded-audit escape counts only on the declaration line or
+    in the CONTIGUOUS comment block immediately above it — a fixed
+    lookback window would let one mutex's escape silently bless an
+    unaudited mutex declared a couple of lines below the same comment."""
+    sf = model.files.get(rel)
+    if sf is None:
+        return False
+    if _DECL_ESCAPE in sf.lines[line0]:
+        return True
+    i = line0 - 1
+    while i >= 0 and sf.lines[i].strip().startswith("//"):
+        if _DECL_ESCAPE in sf.lines[i]:
+            return True
+        i -= 1
+    return False
+
+
+def _bounded_os_mutexes(model: Model) -> Set[str]:
+    """Identities ("path::name") whose bounded audit covers EVERY
+    same-named OS-mutex declaration in that file.  Name-based identity
+    cannot tell two same-file `mu` members apart, so the escape is
+    fail-closed: one unannotated declaration in the group withholds the
+    blessing from all of them — adding an unaudited `std::mutex mu;` to
+    a file whose other `mu` is audited re-fails the sites until the new
+    declaration is audited too."""
+    groups: Dict[str, List[bool]] = {}
+    for name, decls in model.mutexes.items():
+        for d in decls:
+            if d.kind != "os":
+                continue
+            groups.setdefault(f"{d.path}::{name}", []).append(
+                _decl_escaped(model, d.path, d.line - 1))
+    return {ident for ident, escs in groups.items() if all(escs)}
+
+
+def check(model: Model, violations: List[Violation]) -> None:
+    parent = model.reachable_from(ROOTS)
+    if not parent:
+        return
+    bounded = _bounded_os_mutexes(model)
+
+    for name in sorted(parent):
+        for d in model.functions.get(name, ()):
+            sf = model.files[d.path]
+            body = sf.blanked_lines[d.body_start:d.end + 1]
+            orig = sf.lines[d.body_start:d.end + 1]
+            witness = model.witness_path(parent, name)
+            for i, ln in enumerate(body):
+                line1 = d.body_start + i + 1
+                # site escape: the line itself or up to 2 comment lines
+                # above (escape reasons often wrap)
+                if any(_SITE_ESCAPE in orig[j]
+                       for j in range(max(0, i - 2), i + 1)):
+                    continue
+
+                m = _SLEEP_RE.search(ln)
+                if m:
+                    violations.append(Violation(
+                        "fiberblock", d.path, line1,
+                        f"OS sleep reachable from parse-fiber roots "
+                        f"({witness}): use fiber_usleep / a timer, or "
+                        f"escape with {_SITE_ESCAPE} (reason)"))
+                    continue
+                m = _SYSCALL_RE.search(ln)
+                if m:
+                    violations.append(Violation(
+                        "fiberblock", d.path, line1,
+                        f"blocking syscall reachable from parse-fiber "
+                        f"roots ({witness}): move it off the reactor or "
+                        f"escape with {_SITE_ESCAPE} (reason)"))
+                    continue
+                for g in list(GUARD_RE.finditer(ln)) + \
+                        list(LOCK_CALL_RE.finditer(ln)):
+                    res = model.resolve_mutex(lock_field(g.group(1)),
+                                              d.path)
+                    if res is None or res[1] != "os":
+                        continue
+                    if res[0] in bounded:
+                        continue
+                    violations.append(Violation(
+                        "fiberblock", d.path, line1,
+                        f"OS mutex {res[0].split('::')[-1]} acquired on a "
+                        f"path reachable from parse-fiber roots "
+                        f"({witness}): a contended std::mutex parks the "
+                        f"whole reactor thread — use FiberMutex, or audit "
+                        f"the critical section as bounded and mark the "
+                        f"DECLARATION with {_DECL_ESCAPE} (reason), or "
+                        f"escape this site with {_SITE_ESCAPE} (reason)"))
+                for w in _CONDVAR_WAIT_RE.finditer(ln):
+                    # FiberCond / butex waits park the fiber: allowed.
+                    # Flag only receivers declared std::condition_variable
+                    # (model.os_condvars — built with the declarations,
+                    # so no per-rule cache to go stale)
+                    if lock_field(w.group(1)) in model.os_condvars:
+                        violations.append(Violation(
+                            "fiberblock", d.path, line1,
+                            f"OS condition-variable wait reachable from "
+                            f"parse-fiber roots ({witness}): park the "
+                            f"fiber (butex / FiberCond) instead, or "
+                            f"escape with {_SITE_ESCAPE} (reason)"))

@@ -1,0 +1,245 @@
+"""Rule `lockorder` (ISSUE 10 contract 1): build the held-while-acquiring
+edge set over every mutex acquisition in native/src/ and fail on cycles.
+
+A deadlock needs a cycle in the lock-order graph: thread 1 holds A and
+wants B while thread 2 holds B and wants A.  The analyzer extracts every
+acquisition site (lock_guard / unique_lock / scoped_lock guards and
+explicit .lock() calls), tracks which locks are lexically held at each
+point (guards release at their scope's closing brace, .lock() at the
+matching .unlock() or end of function), and adds the edge A -> B for
+every acquisition of B under A — both directly and through the call
+graph (holding A while calling a function that may acquire B).
+
+Lock identity is the declared variable name, classified against the
+declaration table (std::mutex / ProfiledMutex / FiberMutex all
+participate: FiberMutex can deadlock fibers just as std::mutex deadlocks
+threads).  Names declared more than once in DIFFERENT files (the generic
+`mu` / `mu_` members) are file-qualified; two same-named instances in one
+file share an identity, which is the conservative direction — an
+instance-ordering hazard (locking b->mu under a->mu) shows up as a self
+edge.
+
+Self edges are reported only when taken DIRECTLY (a nested acquisition
+of the same identity inside one function): cross-call self edges are
+dominated by re-entrant helpers that the caller locks FOR, and the
+direct case is the one that encodes a real two-instance ordering
+decision (document it: address-ordered, or single-instance by
+construction).
+
+Escapes: `lint:allow-lock-order (reason)` on the acquisition line (or
+the line above) removes that SITE's outgoing/incoming edges; the reason
+should name the ordering argument (e.g. "address-ordered", "trylock
+only", "never taken concurrently with X").
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, NamedTuple, Optional, Set, Tuple
+
+from .model import (GUARD_RE, LOCK_CALL_RE, UNLOCK_CALL_RE,
+                    _STD_METHOD_DENY, FuncDef, Model, Violation,
+                    lock_field)
+
+_ESCAPE = "lint:allow-lock-order"
+
+
+class Acq(NamedTuple):
+    lock: str          # canonical lock identity
+    line0: int         # 0-based line of the acquisition
+    release0: int      # 0-based line after which the lock is free
+    escaped: bool
+
+
+class FnLocks(NamedTuple):
+    acqs: List[Acq]
+    # (callee name, set of lock identities held at the call, call line)
+    calls_held: List[Tuple[str, frozenset, int]]
+
+
+def _canon_at(model: Model, name: str, rel: str) -> Optional[str]:
+    res = model.resolve_mutex(name, rel)
+    return res[0] if res else None
+
+
+def _scope_end(depths: List[int], start: int, decl_depth: int) -> int:
+    """Last 0-based line (relative index) at which a guard declared at
+    line `start` with brace depth `decl_depth` is still held."""
+    for i in range(start + 1, len(depths)):
+        if depths[i] < decl_depth:
+            return i
+    return len(depths) - 1
+
+
+def _analyze_function(model: Model, d: FuncDef) -> FnLocks:
+    sf = model.files[d.path]
+    body = sf.blanked_lines[d.body_start:d.end + 1]
+    orig = sf.lines[d.body_start:d.end + 1]
+    # depth AFTER processing each line (guards declared on line i live
+    # while depth stays >= depth at declaration)
+    depths: List[int] = []
+    depth = 0
+    entry: List[int] = []
+    for ln in body:
+        entry.append(depth)
+        depth += ln.count("{") - ln.count("}")
+        depths.append(depth)
+
+    acqs: List[Acq] = []
+    for i, ln in enumerate(body):
+        escaped = _ESCAPE in orig[i] or (i > 0 and _ESCAPE in orig[i - 1])
+        for m in GUARD_RE.finditer(ln):
+            lock = _canon_at(model, lock_field(m.group(1)), d.path)
+            if lock is None:
+                continue
+            acqs.append(Acq(lock, i, _scope_end(depths, i, depths[i]),
+                            escaped))
+        for m in LOCK_CALL_RE.finditer(ln):
+            lock = _canon_at(model, lock_field(m.group(1)), d.path)
+            if lock is None:
+                continue
+            rel_end = len(body) - 1
+            field = lock_field(m.group(1))
+            for j in range(i + 1, len(body)):
+                um = UNLOCK_CALL_RE.search(body[j])
+                if um and lock_field(um.group(1)) == field:
+                    rel_end = j
+                    break
+            acqs.append(Acq(lock, i, rel_end, escaped))
+
+    calls_held: List[Tuple[str, frozenset, int]] = []
+    for i, ln in enumerate(body):
+        held = frozenset(a.lock for a in acqs
+                         if a.line0 < i <= a.release0 and not a.escaped)
+        if not held:
+            continue
+        for m in re.finditer(r"\b([A-Za-z_]\w*)\s*\(", ln):
+            name = m.group(1)
+            if (name != d.name and name not in _STD_METHOD_DENY
+                    and len(model.functions.get(name, ())) == 1):
+                calls_held.append((name, held, i))
+    return FnLocks(acqs, calls_held)
+
+
+def check(model: Model, violations: List[Violation]) -> None:
+    per_fn: Dict[Tuple[str, int], FnLocks] = {}
+    fn_locks_summary: Dict[str, Set[str]] = {}  # fn name -> locks it
+    # may acquire (directly, unescaped); propagated transitively below
+    defs_of: Dict[str, List[FuncDef]] = model.functions
+
+    for name, defs in defs_of.items():
+        for d in defs:
+            fl = _analyze_function(model, d)
+            per_fn[(d.path, d.start)] = fl
+            s = fn_locks_summary.setdefault(name, set())
+            s.update(a.lock for a in fl.acqs if not a.escaped)
+
+    # transitive closure: a function "may acquire" what its callees may
+    changed = True
+    guard = 0
+    while changed and guard < 50:
+        changed = False
+        guard += 1
+        for name, defs in defs_of.items():
+            s = fn_locks_summary[name]
+            before = len(s)
+            for d in defs:
+                for callee in model.resolved_calls(d):
+                    s |= fn_locks_summary.get(callee, set())
+            if len(s) != before:
+                changed = True
+
+    # edge set: lock A -> lock B ("B acquired while A held"), with one
+    # witness site per edge
+    edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+
+    def add_edge(a: str, b: str, path: str, line1: int, how: str) -> None:
+        if (a, b) not in edges:
+            edges[(a, b)] = (path, line1, how)
+
+    for name, defs in defs_of.items():
+        for d in defs:
+            fl = per_fn[(d.path, d.start)]
+            # direct nesting
+            for held in fl.acqs:
+                if held.escaped:
+                    continue
+                for inner in fl.acqs:
+                    if inner is held or inner.escaped:
+                        continue
+                    if held.line0 < inner.line0 <= held.release0:
+                        if inner.lock == held.lock:
+                            violations.append(Violation(
+                                "lockorder", d.path,
+                                d.body_start + inner.line0 + 1,
+                                f"self lock-order edge in {d.name}: "
+                                f"{inner.lock} acquired while an instance "
+                                f"of the same lock is held — order the "
+                                f"instances (e.g. by address) and escape "
+                                f"with {_ESCAPE} (reason), or restructure"))
+                            continue
+                        add_edge(held.lock, inner.lock, d.path,
+                                 d.body_start + inner.line0 + 1,
+                                 f"nested in {d.name}")
+            # through calls
+            for callee, held_set, line0 in fl.calls_held:
+                for b in fn_locks_summary.get(callee, set()):
+                    for a in held_set:
+                        if a != b:
+                            add_edge(a, b, d.path, d.body_start + line0 + 1,
+                                     f"{d.name} calls {callee} holding {a}")
+
+    # cycle detection (iterative DFS over the edge graph)
+    graph: Dict[str, List[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, []).append(b)
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color: Dict[str, int] = {}
+    stack_path: List[str] = []
+    cycles: List[List[str]] = []
+
+    def dfs(start: str) -> None:
+        stack: List[Tuple[str, int]] = [(start, 0)]
+        color[start] = GRAY
+        stack_path.append(start)
+        while stack:
+            node, idx = stack[-1]
+            nbrs = graph.get(node, [])
+            if idx < len(nbrs):
+                stack[-1] = (node, idx + 1)
+                nxt = nbrs[idx]
+                c = color.get(nxt, WHITE)
+                if c == GRAY:
+                    at = stack_path.index(nxt)
+                    cyc = stack_path[at:] + [nxt]
+                    if len(cycles) < 8:
+                        cycles.append(cyc)
+                elif c == WHITE:
+                    color[nxt] = GRAY
+                    stack_path.append(nxt)
+                    stack.append((nxt, 0))
+            else:
+                stack.pop()
+                stack_path.pop()
+                color[node] = BLACK
+
+    for node in sorted(graph):
+        if color.get(node, WHITE) == WHITE:
+            dfs(node)
+
+    reported: Set[frozenset] = set()
+    for cyc in cycles:
+        key = frozenset(cyc)
+        if key in reported:
+            continue
+        reported.add(key)
+        detail = []
+        for a, b in zip(cyc, cyc[1:]):
+            path, line1, how = edges[(a, b)]
+            detail.append(f"{a} -> {b} [{path}:{line1}: {how}]")
+        path0, line0, _ = edges[(cyc[0], cyc[1])]
+        violations.append(Violation(
+            "lockorder", path0, line0,
+            "lock-order cycle (deadlock risk): " + "; ".join(detail) +
+            f" — fix the acquisition order or escape one edge's site "
+            f"with {_ESCAPE} (reason)"))

@@ -1,0 +1,485 @@
+"""Lightweight C++ source model for the concurrency-contract analyzer
+(ISSUE 10).  No libclang — the container has no egress — so this is a
+comment/string-aware, brace-tracking heuristic parser, deliberately in
+the spirit of tools/lint.py's line-level checks: precise enough to build
+function spans, a name-resolved call graph, and lock/atomic site tables
+over native/src/, with `lint:allow-*` escape hatches carrying the intent
+where the heuristics over-approximate.
+
+What the model extracts per translation unit:
+
+  * function definitions — name (and Class::name when qualified), the
+    0-based [start, end] line span of the body, found by matching a
+    definition header (identifier + params + `{`, not a control keyword)
+    and walking braces;
+  * a call graph — identifiers followed by `(` inside a body, resolved
+    against the set of defined function names (over-approximate on
+    purpose: same-name methods merge, which is the conservative
+    direction for reachability rules);
+  * mutex declarations — (file, name, kind) for std::mutex /
+    ProfiledMutex (OS mutexes) and FiberMutex (fiber-aware), so lock
+    sites can classify what they acquire;
+  * lock acquisitions — lock_guard/unique_lock/scoped_lock guards with
+    their active scope (decl line .. closing brace) plus explicit
+    .lock()/.unlock() pairs;
+  * atomic declarations — names of std::atomic<...> variables, so the
+    atomics rule can flag `++`/`+=` shorthand (defaulted seq_cst).
+
+Comments and string/char literals are blanked (not removed: columns and
+line numbers stay stable) before structural parsing; the ORIGINAL lines
+are kept for escape-annotation lookups, since the escapes live in
+comments by design.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, NamedTuple, Optional, Set, Tuple
+
+
+class Violation(NamedTuple):
+    rule: str
+    path: str   # repo-relative
+    line: int   # 1-based; 0 = whole file
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+# ---------------------------------------------------------------------------
+# comment/string blanking
+
+
+def blank_comments(text: str) -> str:
+    """Replace comment bodies and string/char literal contents with
+    spaces, preserving length and newlines so line/column math on the
+    result maps 1:1 onto the source."""
+    out = list(text)
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
+        if c == "/" and i + 1 < n and text[i + 1] == "/":
+            j = text.find("\n", i)
+            j = n if j < 0 else j
+            for k in range(i, j):
+                out[k] = " "
+            i = j
+        elif c == "/" and i + 1 < n and text[i + 1] == "*":
+            j = text.find("*/", i + 2)
+            j = n - 2 if j < 0 else j
+            for k in range(i, j + 2):
+                if out[k] != "\n":
+                    out[k] = " "
+            i = j + 2
+        elif c == '"' or c == "'":
+            q = c
+            j = i + 1
+            while j < n and text[j] != q:
+                if text[j] == "\\":
+                    j += 1
+                j += 1
+            for k in range(i + 1, min(j, n)):
+                if out[k] != "\n":
+                    out[k] = " "
+            i = j + 1
+        else:
+            i += 1
+    return "".join(out)
+
+
+# ---------------------------------------------------------------------------
+# function extraction
+
+_KEYWORDS = {
+    "if", "for", "while", "switch", "catch", "return", "sizeof", "new",
+    "delete", "throw", "do", "else", "case", "default", "alignof",
+    "static_assert", "decltype", "defined", "alignas", "noexcept",
+}
+
+# definition header: ...name(args) [const|noexcept|override]* {
+_DEF_TAIL_RE = re.compile(
+    r"(?:(\w+)\s*::\s*)?([A-Za-z_]\w*)\s*$")
+
+_CALL_RE = re.compile(r"\b([A-Za-z_]\w*)\s*(?:<[\w\s:,<>*&]*>)?\s*\(")
+
+# method names shared with std containers/sync types: a `.name(` call on
+# an unknown receiver must never resolve to one of OUR same-named
+# functions (precision guard for the graph rules)
+_STD_METHOD_DENY = {
+    "lock", "unlock", "try_lock", "wait", "wait_for", "wait_until",
+    "notify_one", "notify_all", "push", "pop", "push_back",
+    "emplace_back", "emplace", "append", "size", "clear", "reset",
+    "get", "release", "swap", "count", "find", "begin", "end", "insert",
+    "erase", "data", "empty", "front", "back", "load", "store",
+    "exchange", "fetch_add", "fetch_sub", "str", "c_str", "substr",
+    "resize", "reserve", "assign", "at", "run", "Run", "join", "detach",
+    "open", "close", "read", "write", "abort", "exit", "signal",
+    # generic callback-member names: `task.fn(arg)` must not resolve to
+    # some unrelated local helper that happens to be named `fn`
+    "fn", "cb", "done", "func", "callback",
+}
+
+
+class FuncDef(NamedTuple):
+    name: str          # unqualified
+    qualified: str     # Class::name or name
+    path: str          # repo-relative
+    start: int         # 0-based first line of the header
+    body_start: int    # 0-based line of the opening brace
+    end: int           # 0-based line of the closing brace
+
+
+class MutexDecl(NamedTuple):
+    name: str
+    path: str
+    line: int          # 1-based
+    kind: str          # "os" (std::mutex / ProfiledMutex) | "fiber"
+
+
+_MUTEX_DECL_RE = re.compile(
+    r"\b(std::mutex|ProfiledMutex|FiberMutex)\b(?:\s*&)?\s+"
+    r"([A-Za-z_]\w*)\s*[;={(]")
+_ATOMIC_DECL_RE = re.compile(
+    r"\bstd::atomic(?:<[^;>]*>|_bool|_int|_flag)?\s+([A-Za-z_]\w*)\s*[;={]")
+_CONDVAR_DECL_RE = re.compile(
+    r"\bstd::condition_variable(?:_any)?\s+([A-Za-z_]\w*)\s*[;={]")
+
+# lock-acquisition shapes, shared by the lockorder and fiberblock rules
+# (one definition: the two rules must classify a site identically, and
+# the `path::name` identities the escapes key on must never drift)
+GUARD_RE = re.compile(
+    r"\b(?:std::)?(?:lock_guard|unique_lock|scoped_lock)\s*(?:<[^>]*>)?\s+"
+    r"\w+\s*[({]\s*([A-Za-z_][\w.\->]*?)\s*[,)}]")
+LOCK_CALL_RE = re.compile(
+    r"\b([A-Za-z_][\w.\->]*?)\s*(?:\.|->)\s*lock\s*\(\s*\)")
+UNLOCK_CALL_RE = re.compile(
+    r"\b([A-Za-z_][\w.\->]*?)\s*(?:\.|->)\s*unlock\s*\(\s*\)")
+
+
+def lock_field(expr: str) -> str:
+    """Last identifier of an access path: `victim->remote_mu` ->
+    `remote_mu`, `mu()` -> `mu`, `ps.wmu` -> `wmu`."""
+    parts = re.split(r"\.|->", expr)
+    return parts[-1].strip().rstrip("()")
+
+
+def _skip_init_list_back(blob: str, k: int) -> Optional[int]:
+    """If the paren group opening at k belongs to a constructor's member
+    initializer (`Ctor(params) : a_(x), b_(y) {` brace-walk-back matches
+    b_'s parens), return the position of the REAL parameter list's ')';
+    None when k is not inside an initializer list.  Without this, the
+    constructor registers as a phantom function named after the last
+    initializer's member and its body is invisible to the graph rules."""
+    j = k - 1
+    while j >= 0 and (blob[j].isalnum() or blob[j] == "_"):
+        j -= 1  # the initializer's member name
+    while j >= 0 and blob[j] in " \t\n":
+        j -= 1
+    # walk back over preceding `, name(args)` initializer groups
+    while j >= 0 and blob[j] == ",":
+        j -= 1
+        while j >= 0 and blob[j] in " \t\n":
+            j -= 1
+        if j < 0 or blob[j] != ")":
+            return None
+        depth = 0
+        while j >= 0:
+            if blob[j] == ")":
+                depth += 1
+            elif blob[j] == "(":
+                depth -= 1
+                if depth == 0:
+                    break
+            j -= 1
+        j -= 1
+        while j >= 0 and (blob[j].isalnum() or blob[j] == "_"):
+            j -= 1
+        while j >= 0 and blob[j] in " \t\n":
+            j -= 1
+    if j >= 0 and blob[j] == ":" and (j == 0 or blob[j - 1] != ":"):
+        j -= 1
+        while j >= 0 and blob[j] in " \t\n":
+            j -= 1
+        if j >= 0 and blob[j] == ")":
+            return j
+    return None
+
+
+def _find_matching_brace(blanked: str, open_pos: int) -> int:
+    depth = 0
+    for i in range(open_pos, len(blanked)):
+        c = blanked[i]
+        if c == "{":
+            depth += 1
+        elif c == "}":
+            depth -= 1
+            if depth == 0:
+                return i
+    return len(blanked) - 1
+
+
+class SourceFile:
+    def __init__(self, root: str, rel: str):
+        self.rel = rel
+        with open(os.path.join(root, rel), encoding="utf-8",
+                  errors="replace") as f:
+            self.text = f.read()
+        self.lines = self.text.splitlines()
+        self.blanked = blank_comments(self.text)
+        self.blanked_lines = self.blanked.splitlines()
+        # offset of each line start in the blob
+        self.line_off: List[int] = [0]
+        for ln in self.blanked_lines:
+            self.line_off.append(self.line_off[-1] + len(ln) + 1)
+
+    def line_of(self, pos: int) -> int:
+        """0-based line index containing blob offset pos."""
+        lo, hi = 0, len(self.line_off) - 1
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if self.line_off[mid + 1] <= pos:
+                lo = mid + 1
+            else:
+                hi = mid
+        return lo
+
+
+def extract_functions(sf: SourceFile) -> List[FuncDef]:
+    """All function/method definitions in the file (free functions,
+    out-of-line methods, and methods defined inline in class bodies)."""
+    out: List[FuncDef] = []
+    blob = sf.blanked
+    i = 0
+    n = len(blob)
+    while i < n:
+        op = blob.find("{", i)
+        if op < 0:
+            break
+        # walk back over [const|noexcept|override|final|-> type] to ')'
+        j = op - 1
+        while j >= 0 and blob[j] in " \t\n":
+            j -= 1
+        tail_end = j + 1
+        # tolerate trailing qualifiers between ')' and '{'
+        m_qual = re.search(r"\)\s*(?:const|noexcept|override|final|mutable"
+                           r"|\s)*$", blob[max(0, op - 200):op])
+        if not m_qual:
+            i = op + 1
+            continue
+        close_paren = max(0, op - 200) + m_qual.start()
+        # find the matching '(' for that ')'
+        depth = 0
+        k = close_paren
+        while k >= 0:
+            if blob[k] == ")":
+                depth += 1
+            elif blob[k] == "(":
+                depth -= 1
+                if depth == 0:
+                    break
+            k -= 1
+        if k < 0:
+            i = op + 1
+            continue
+        in_init_list = False
+        real_close = _skip_init_list_back(blob, k)
+        if real_close is not None:
+            # constructor with a member-initializer list: rematch at the
+            # actual parameter list
+            in_init_list = True
+            close_paren = real_close
+            depth = 0
+            k = close_paren
+            while k >= 0:
+                if blob[k] == ")":
+                    depth += 1
+                elif blob[k] == "(":
+                    depth -= 1
+                    if depth == 0:
+                        break
+                k -= 1
+            if k < 0:
+                i = op + 1
+                continue
+        head = blob[max(0, k - 160):k]
+        m = _DEF_TAIL_RE.search(head)
+        if not m:
+            i = op + 1
+            continue
+        name = m.group(2)
+        if name in _KEYWORDS or name.startswith("TRPC_"):
+            i = op + 1
+            continue
+        # reject initializer/assignment shapes: between the CLOSING paren
+        # and '{' a definition carries only qualifiers — a ';' or '='
+        # there means this brace opens something else.  (The check must
+        # not cover the parameter list itself: default arguments
+        # `int x = 3` are legal in definitions — FiberCond::wait et al.
+        # A detected member-initializer list sits in that span by
+        # construction and may contain '=' inside initializer
+        # expressions, so it is exempt.)
+        if not in_init_list and (";" in blob[close_paren:op]
+                                 or "=" in blob[close_paren:op]):
+            i = op + 1
+            continue
+        qualified = (m.group(1) + "::" + name) if m.group(1) else name
+        cp = _find_matching_brace(blob, op)
+        start_line = sf.line_of(k)
+        out.append(FuncDef(name=name, qualified=qualified, path=sf.rel,
+                           start=start_line, body_start=sf.line_of(op),
+                           end=sf.line_of(cp)))
+        # inline class methods: do NOT skip the whole body — nested
+        # definitions (methods inside struct bodies) are found because we
+        # keep scanning from just past this opening brace
+        i = op + 1
+    return out
+
+
+class Model:
+    """Parsed view of every .cc/.h under native/src (minus excluded test
+    drivers), shared by the analyzer rules."""
+
+    def __init__(self, root: str,
+                 exclude: Tuple[str, ...] = ("test_core.cc",
+                                             "test_stress.cc",
+                                             "pjrt_fake.cc")):
+        self.root = root
+        self.files: Dict[str, SourceFile] = {}
+        src = os.path.join(root, "native", "src")
+        if os.path.isdir(src):
+            for name in sorted(os.listdir(src)):
+                if not name.endswith((".cc", ".h")):
+                    continue
+                if name in exclude:
+                    continue
+                rel = os.path.join("native", "src", name)
+                self.files[rel] = SourceFile(root, rel)
+
+        # function table: unqualified name -> defs
+        self.functions: Dict[str, List[FuncDef]] = {}
+        self.defs_by_file: Dict[str, List[FuncDef]] = {}
+        for rel, sf in self.files.items():
+            defs = extract_functions(sf)
+            self.defs_by_file[rel] = defs
+            for d in defs:
+                self.functions.setdefault(d.name, []).append(d)
+
+        # mutex + atomic + OS-condvar declarations
+        self.mutexes: Dict[str, List[MutexDecl]] = {}
+        self.atomics: Dict[str, Set[str]] = {}  # file -> names
+        self.os_condvars: Set[str] = set()      # std::condition_variable
+        for rel, sf in self.files.items():
+            names: Set[str] = set()
+            for idx, ln in enumerate(sf.blanked_lines, 1):
+                for m in _MUTEX_DECL_RE.finditer(ln):
+                    kind = "fiber" if m.group(1) == "FiberMutex" else "os"
+                    self.mutexes.setdefault(m.group(2), []).append(
+                        MutexDecl(m.group(2), rel, idx, kind))
+                for m in _ATOMIC_DECL_RE.finditer(ln):
+                    names.add(m.group(1))
+                for m in _CONDVAR_DECL_RE.finditer(ln):
+                    self.os_condvars.add(m.group(1))
+            self.atomics[rel] = names
+
+        self._calls_cache: Dict[Tuple[str, int], Set[str]] = {}
+        self._resolved_cache: Dict[Tuple[str, int], Set[str]] = {}
+
+    # -- call graph ---------------------------------------------------------
+
+    def calls_in(self, d: FuncDef) -> Set[str]:
+        """Names of defined functions called inside d's body (an
+        over-approximation: any identifier followed by '(' that matches
+        a definition anywhere in the scanned tree)."""
+        key = (d.path, d.start)
+        cached = self._calls_cache.get(key)
+        if cached is not None:
+            return cached
+        sf = self.files[d.path]
+        body = "\n".join(sf.blanked_lines[d.body_start:d.end + 1])
+        out: Set[str] = set()
+        for m in _CALL_RE.finditer(body):
+            name = m.group(1)
+            if name == d.name or name in _KEYWORDS:
+                continue
+            if name in self.functions:
+                out.add(name)
+        self._calls_cache[key] = out
+        return out
+
+    def resolved_calls(self, d: FuncDef) -> Set[str]:
+        """Precision-filtered call set for graph rules: a callee counts
+        only when its name resolves to exactly ONE definition in the
+        scanned tree and is not a std-container/mutex method name (the
+        `x.lock()` / `q.push()` forms would otherwise alias unrelated
+        same-named functions and manufacture edges out of nothing)."""
+        key = (d.path, d.start)
+        cached = self._resolved_cache.get(key)
+        if cached is not None:
+            return cached
+        out = {name for name in self.calls_in(d)
+               if name not in _STD_METHOD_DENY
+               and len(self.functions.get(name, ())) == 1}
+        self._resolved_cache[key] = out
+        return out
+
+    def reachable_from(self, roots: List[str]) -> Dict[str, Optional[str]]:
+        """BFS over the precision-filtered call graph from root function
+        NAMES.  Returns {function name: parent name} (parent None for
+        roots) — the parent chain is the witness path for findings.
+        Uses resolved_calls (unique-name + denylist) so ambiguous method
+        names don't drag unrelated subsystems into the reachable set."""
+        parent: Dict[str, Optional[str]] = {}
+        queue: List[str] = []
+        for r in roots:
+            if r in self.functions and r not in parent:
+                parent[r] = None
+                queue.append(r)
+        while queue:
+            cur = queue.pop()
+            for d in self.functions.get(cur, ()):
+                for callee in self.resolved_calls(d):
+                    if callee not in parent:
+                        parent[callee] = cur
+                        queue.append(callee)
+        return parent
+
+    def witness_path(self, parent: Dict[str, Optional[str]],
+                     name: str) -> str:
+        chain = [name]
+        seen = {name}
+        while parent.get(chain[-1]) is not None:
+            nxt = parent[chain[-1]]
+            if nxt in seen:
+                break
+            chain.append(nxt)
+            seen.add(nxt)
+        return " <- ".join(chain)
+
+    # -- lock identity --------------------------------------------------------
+
+    def resolve_mutex(self, field: str,
+                      rel: str) -> Optional[Tuple[str, str]]:
+        """(identity "path::name", kind "os"|"fiber") for a lock FIELD
+        name used in file rel.  Names unique to one file resolve there;
+        a name declared in several files resolves to the USE site's file
+        when that file declares one (generic `mu`/`mu_` members), else
+        None (cannot tell whose member this is).  Both graph rules key
+        their escapes on this identity — one definition, no drift."""
+        decls = self.mutexes.get(field)
+        if not decls:
+            return None
+        files = {d.path for d in decls}
+        if len(files) == 1:
+            path = next(iter(files))
+        elif rel in files:
+            path = rel
+        else:
+            return None
+        kinds = {d.kind for d in decls if d.path == path}
+        kind = "os" if "os" in kinds else "fiber"
+        return (f"{path}::{field}", kind)
+
+def build_model(root: str) -> Model:
+    return Model(root)

@@ -1,0 +1,182 @@
+"""Rule `wiretags` (ISSUE 10 contract 5): TRPC meta TLV tags come from
+ONE registry — tools/wire_tags_manifest.txt — mirrored by named
+constants on both sides of the wire, with no bare numeric tag literals
+at the framing seams.
+
+Tags 6/7/8/13/16/17… were assigned PR by PR as comments in rpc.cc's
+EncodeMeta/DecodeMeta; the next codec/trace PR picking "18" by grepping
+comments is one collision away from corrupting frames.  The registry:
+
+  * manifest line: `<tag> <name> <description>` (name lower_snake);
+  * C++: `kMetaTag<CamelCase(name)> = <tag>` constants (native/src/rpc.h)
+    must match the manifest BOTH ways (a constant the manifest doesn't
+    know / a manifest entry no constant defines both fail — rename
+    detection, like the flags/metrics manifests);
+  * Python: brpc_tpu/rpc/wire_tags.py `<NAME_UPPER> = <tag>` mirror,
+    both ways again;
+  * rpc.cc framing seams: `tlv(`/`tlv_u8(`/`tlv_u32(`/`tlv_u64(` calls
+    must not pass a bare integer literal as the tag, and `case <int>:`
+    inside DecodeMeta must use the constants.
+
+Escape: `lint:allow-wire-tag (reason)` on the line — for deliberately
+raw bytes (e.g. a fuzz fixture building an INVALID tag on purpose).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Tuple
+
+from .model import Model, Violation
+
+MANIFEST_REL = os.path.join("tools", "wire_tags_manifest.txt")
+HEADER_REL = os.path.join("native", "src", "rpc.h")
+RPCCC_REL = os.path.join("native", "src", "rpc.cc")
+PY_REL = os.path.join("brpc_tpu", "rpc", "wire_tags.py")
+
+_ESCAPE = "lint:allow-wire-tag"
+
+_CONST_RE = re.compile(r"\bkMetaTag(\w+)\s*=\s*(\d+)")
+_PY_CONST_RE = re.compile(r"^([A-Z][A-Z0-9_]*)\s*=\s*(\d+)", re.M)
+_TLV_CALL_RE = re.compile(r"\btlv(?:_u8|_u32|_u64)?\s*\(\s*(\d+)\s*,")
+_CASE_RE = re.compile(r"\bcase\s+(\d+)\s*:")
+
+
+def camel(name: str) -> str:
+    return "".join(p.capitalize() for p in name.split("_"))
+
+
+def _load_manifest(root: str, violations: List[Violation]
+                   ) -> Dict[str, int]:
+    path = os.path.join(root, MANIFEST_REL)
+    out: Dict[str, int] = {}
+    by_tag: Dict[int, str] = {}
+    if not os.path.exists(path):
+        violations.append(Violation(
+            "wiretags", MANIFEST_REL, 0,
+            "wire-tag manifest missing (every meta TLV tag must be "
+            "registered here: `<tag> <name> <description>`)"))
+        return out
+    with open(path, encoding="utf-8") as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split(None, 2)
+            if len(parts) < 3 or not parts[0].isdigit() \
+                    or not re.fullmatch(r"[a-z][a-z0-9_]*", parts[1]):
+                violations.append(Violation(
+                    "wiretags", MANIFEST_REL, i,
+                    f"malformed wire-tag manifest entry {line!r} "
+                    f"(want `<tag> <lower_snake_name> <description>`)"))
+                continue
+            tag, name = int(parts[0]), parts[1]
+            if name in out:
+                violations.append(Violation(
+                    "wiretags", MANIFEST_REL, i,
+                    f"duplicate wire-tag name {name}"))
+                continue
+            if tag in by_tag:
+                violations.append(Violation(
+                    "wiretags", MANIFEST_REL, i,
+                    f"tag {tag} assigned to both {by_tag[tag]} and "
+                    f"{name} — a wire collision"))
+                continue
+            out[name] = tag
+            by_tag[tag] = name
+    return out
+
+
+def check(model: Model, violations: List[Violation]) -> None:
+    root = model.root
+    if not os.path.exists(os.path.join(root, RPCCC_REL)):
+        return  # no framing code in this tree: rule out of scope
+    manifest = _load_manifest(root, violations)
+
+    # --- C++ constants <-> manifest, both ways -----------------------------
+    header = model.files.get(HEADER_REL)
+    consts: Dict[str, Tuple[int, int]] = {}  # camel name -> (value, line)
+    if header is not None:
+        for i, ln in enumerate(header.blanked_lines, 1):
+            for m in _CONST_RE.finditer(ln):
+                consts[m.group(1)] = (int(m.group(2)), i)
+    for name, tag in sorted(manifest.items()):
+        c = camel(name)
+        if c not in consts:
+            violations.append(Violation(
+                "wiretags", HEADER_REL, 0,
+                f"manifest tag {tag} ({name}) has no kMetaTag{c} "
+                f"constant in {HEADER_REL}"))
+        elif consts[c][0] != tag:
+            violations.append(Violation(
+                "wiretags", HEADER_REL, consts[c][1],
+                f"kMetaTag{c} = {consts[c][0]} disagrees with the "
+                f"manifest ({name} = {tag})"))
+    known_camels = {camel(n) for n in manifest}
+    for c, (val, line) in sorted(consts.items()):
+        if c not in known_camels:
+            violations.append(Violation(
+                "wiretags", HEADER_REL, line,
+                f"kMetaTag{c} = {val} is not registered in "
+                f"{MANIFEST_REL} (add `<tag> <name> <description>`)"))
+
+    # --- Python mirror <-> manifest, both ways -----------------------------
+    py_path = os.path.join(root, PY_REL)
+    if not os.path.exists(py_path):
+        violations.append(Violation(
+            "wiretags", PY_REL, 0,
+            f"Python wire-tag mirror missing ({PY_REL} must define "
+            f"<NAME> = <tag> for every manifest entry)"))
+    else:
+        with open(py_path, encoding="utf-8") as f:
+            text = f.read()
+        py_consts: Dict[str, int] = {}
+        for m in _PY_CONST_RE.finditer(text):
+            py_consts[m.group(1)] = int(m.group(2))
+        for name, tag in sorted(manifest.items()):
+            up = name.upper()
+            if up not in py_consts:
+                violations.append(Violation(
+                    "wiretags", PY_REL, 0,
+                    f"manifest tag {tag} ({name}) has no {up} constant "
+                    f"in the Python mirror"))
+            elif py_consts[up] != tag:
+                violations.append(Violation(
+                    "wiretags", PY_REL, 0,
+                    f"{up} = {py_consts[up]} disagrees with the "
+                    f"manifest ({name} = {tag})"))
+        known_upper = {n.upper() for n in manifest}
+        for up, val in sorted(py_consts.items()):
+            if up not in known_upper:
+                violations.append(Violation(
+                    "wiretags", PY_REL, 0,
+                    f"{up} = {val} in the Python mirror is not "
+                    f"registered in {MANIFEST_REL}"))
+
+    # --- no bare numeric tags at the framing seams -------------------------
+    rpccc = model.files.get(RPCCC_REL)
+    if rpccc is None:
+        return
+    decode_span = None
+    for d in model.defs_by_file.get(RPCCC_REL, []):
+        if d.name == "DecodeMeta":
+            decode_span = (d.body_start, d.end)
+    for i, ln in enumerate(rpccc.blanked_lines):
+        orig = rpccc.lines[i]
+        if _ESCAPE in orig or (i > 0 and _ESCAPE in rpccc.lines[i - 1]):
+            continue
+        for m in _TLV_CALL_RE.finditer(ln):
+            violations.append(Violation(
+                "wiretags", RPCCC_REL, i + 1,
+                f"bare numeric TLV tag {m.group(1)} at an encode seam: "
+                f"use the kMetaTag* constant (registry: "
+                f"{MANIFEST_REL}), or escape with {_ESCAPE} (reason)"))
+        if decode_span and decode_span[0] <= i <= decode_span[1]:
+            for m in _CASE_RE.finditer(ln):
+                violations.append(Violation(
+                    "wiretags", RPCCC_REL, i + 1,
+                    f"bare numeric case {m.group(1)} in DecodeMeta: use "
+                    f"the kMetaTag* constant (registry: "
+                    f"{MANIFEST_REL}), or escape with {_ESCAPE} "
+                    f"(reason)"))

@@ -1,11 +1,15 @@
 #!/usr/bin/env python
-"""Repo-local static analysis gate (ISSUE 6): machine-check the
+"""Repo-local static analysis gate (ISSUE 6, grown into the
+concurrency-contract analyzer in ISSUE 10): machine-check the
 concurrency/runtime conventions that reviewers used to eyeball.  Runs as
 a tier-1 pytest (tests/test_lint.py) and stand-alone:
 
     python tools/lint.py [--repo ROOT] [--reference ROOT]
+                         [--rule r1,r2,...] [--json]
 
-Rules:
+Line-level rules (this file) — see also tools/analyze/ for the
+multi-pass analyzer rules (lockorder, fiberblock, atomics, abi,
+wiretags; documented in tools/ANALYZE.md):
 
   flags        every TRPC_* env var read in C++ (getenv) is resolved once
                per process — the call sits in a `static` initializer or
@@ -55,20 +59,17 @@ point — conventions stay visible next to the code they govern.
 from __future__ import annotations
 
 import argparse
+import hashlib
+import json
 import os
 import re
 import sys
-from typing import Dict, List, NamedTuple, Optional, Set
+from typing import Dict, List, Optional, Set
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-class Violation(NamedTuple):
-    rule: str
-    path: str   # repo-relative
-    line: int   # 1-based; 0 = whole file
-    message: str
-
-    def __str__(self) -> str:
-        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+import analyze  # noqa: E402  (tools/analyze — the ISSUE-10 analyzer)
+from analyze.model import Violation  # noqa: E402,F401 — shared type
 
 
 # files scanned for C++ getenv caching (product code only: test drivers
@@ -428,16 +429,62 @@ def _check_metrics_manifest(root: str,
             f"exports it (renamed series must update the manifest)"))
 
 
+# rule registry: line-level rules live here, multi-pass rules in
+# tools/analyze/.  Every name is addressable via --rule.
+LINE_RULES = ("flags", "citations", "scenarios", "allocations",
+              "crossshard", "metrics")
+ALL_RULES = LINE_RULES + tuple(analyze.ANALYZER_RULES)
+
+
 def run_lint(repo_root: str,
-             reference_root: Optional[str] = None) -> List[Violation]:
+             reference_root: Optional[str] = None,
+             rules: Optional[List[str]] = None) -> List[Violation]:
+    picked = list(ALL_RULES) if rules is None else list(rules)
+    unknown = [r for r in picked if r not in ALL_RULES]
+    if unknown:
+        raise ValueError(f"unknown rule(s): {unknown} "
+                         f"(have: {sorted(ALL_RULES)})")
     violations: List[Violation] = []
-    _check_flags(repo_root, violations)
-    _check_citations(repo_root, reference_root, violations)
-    _check_scenarios(repo_root, violations)
-    _check_allocations(repo_root, violations)
-    _check_cross_shard(repo_root, violations)
-    _check_metrics_manifest(repo_root, violations)
+    if "flags" in picked:
+        _check_flags(repo_root, violations)
+    if "citations" in picked:
+        _check_citations(repo_root, reference_root, violations)
+    if "scenarios" in picked:
+        _check_scenarios(repo_root, violations)
+    if "allocations" in picked:
+        _check_allocations(repo_root, violations)
+    if "crossshard" in picked:
+        _check_cross_shard(repo_root, violations)
+    if "metrics" in picked:
+        _check_metrics_manifest(repo_root, violations)
+    analyzer = [r for r in picked if r in analyze.ANALYZER_RULES]
+    if analyzer:
+        violations.extend(analyze.run_rules(repo_root, analyzer))
     return violations
+
+
+def analyzer_version(repo_root: Optional[str] = None) -> str:
+    """Short content hash of the analyzer itself (this file +
+    tools/analyze/*.py + the manifests) — recorded by bench.py so every
+    BENCH_NOTES row is attributable to the exact analyzed tree."""
+    root = repo_root or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    h = hashlib.sha256()
+    tools = os.path.join(root, "tools")
+    paths = [os.path.join(tools, "lint.py")]
+    adir = os.path.join(tools, "analyze")
+    if os.path.isdir(adir):
+        paths += [os.path.join(adir, n) for n in sorted(os.listdir(adir))
+                  if n.endswith(".py")]
+    for man in ("flags_manifest.txt", "metrics_manifest.txt",
+                "wire_tags_manifest.txt"):
+        paths.append(os.path.join(tools, man))
+    for p in paths:
+        if os.path.exists(p):
+            h.update(os.path.basename(p).encode())
+            with open(p, "rb") as f:
+                h.update(f.read())
+    return h.hexdigest()[:12]
 
 
 def main() -> int:
@@ -448,8 +495,22 @@ def main() -> int:
     ap.add_argument("--reference",
                     default=os.environ.get("TRPC_REFERENCE_ROOT",
                                            "/root/reference"))
+    ap.add_argument("--rule", default=None,
+                    help="comma-separated rule subset (default: all of "
+                         + ",".join(ALL_RULES) + ")")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings on stdout")
     args = ap.parse_args()
-    violations = run_lint(args.repo, args.reference)
+    rules = args.rule.split(",") if args.rule else None
+    violations = run_lint(args.repo, args.reference, rules)
+    if args.json:
+        print(json.dumps({
+            "analyzer": analyzer_version(args.repo),
+            "rules": rules or list(ALL_RULES),
+            "count": len(violations),
+            "violations": [v._asdict() for v in violations],
+        }))
+        return 1 if violations else 0
     for v in violations:
         print(v)
     if violations:
