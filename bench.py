@@ -453,6 +453,13 @@ def main() -> int:
             }
             for k in range(int(L.trpc_shard_count()))
         },
+        # overload-control plane (ISSUE 11): bench-of-record runs with
+        # the plane OFF (rejects must stay 0 — a bench that shed load
+        # would report admitted-only throughput as headline QPS); the
+        # rpc_press --ramp cannon owns the overload numbers
+        "overload": "on" if bool(L.trpc_overload_active()) else "off",
+        "overload_admits": native_counter("native_overload_admits"),
+        "overload_rejects": native_counter("native_overload_rejects"),
         # payload-codec rail (ISSUE 8): bench-of-record runs none; the
         # --codec-ab harness flips TRPC_PAYLOAD_CODEC per subprocess arm
         "payload_codec": codec_names.get(int(L.trpc_payload_codec()), "?"),

@@ -361,6 +361,36 @@ def _declare(L: ctypes.CDLL) -> None:
     L.trpc_set_usercode_max_inflight.argtypes = [c.c_int64]
     L.trpc_set_usercode_max_inflight.restype = None
 
+    # overload-control plane (native/src/overload.h): reloadable master
+    # switch + gradient clamps, folded per-family reads for /status, the
+    # per-method max_concurrency table, and the deterministic test hooks
+    L.trpc_set_overload.argtypes = [c.c_int]
+    L.trpc_set_overload.restype = None
+    L.trpc_overload_active.argtypes = []
+    L.trpc_overload_active.restype = c.c_int
+    L.trpc_set_overload_min_concurrency.argtypes = [c.c_int]
+    L.trpc_set_overload_min_concurrency.restype = None
+    L.trpc_set_overload_max_concurrency.argtypes = [c.c_int]
+    L.trpc_set_overload_max_concurrency.restype = None
+    L.trpc_set_overload_window_ms.argtypes = [c.c_int]
+    L.trpc_set_overload_window_ms.restype = None
+    L.trpc_overload_limit.argtypes = [c.c_int]
+    L.trpc_overload_limit.restype = c.c_int64
+    L.trpc_overload_inflight.argtypes = [c.c_int]
+    L.trpc_overload_inflight.restype = c.c_int64
+    L.trpc_overload_rejects.argtypes = [c.c_int]
+    L.trpc_overload_rejects.restype = c.c_uint64
+    L.trpc_overload_admits.argtypes = [c.c_int]
+    L.trpc_overload_admits.restype = c.c_uint64
+    L.trpc_server_set_method_max_concurrency.argtypes = [
+        c.c_void_p, c.c_char_p, c.c_int64]
+    L.trpc_server_set_method_max_concurrency.restype = c.c_int
+    L.trpc_overload_test_feed.argtypes = [c.c_int, c.c_int, c.c_int64,
+                                          c.c_int, c.c_int64]
+    L.trpc_overload_test_feed.restype = None
+    L.trpc_overload_test_reset.argtypes = [c.c_int, c.c_int]
+    L.trpc_overload_test_reset.restype = None
+
     # client egress fast path: request corking + serialize-once fan-out
     L.trpc_set_client_cork.argtypes = [c.c_int]
     L.trpc_set_client_cork.restype = None

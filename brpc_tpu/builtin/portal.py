@@ -385,13 +385,18 @@ def install_builtin_services(server, dispatcher: HttpDispatcher) -> None:
         # `native_methods` = the families that never leave the native
         # core (inline echo, redis cache, client unary, ...) read from
         # the per-shard histograms — the fast path's latency story
-        from brpc_tpu.metrics.native import native_family_stats
+        # `overload` = the admission plane's per-family limit/inflight/
+        # reject block (overload.h) — enabled:false means the plane is
+        # inert and the numbers are the configured defaults
+        from brpc_tpu.metrics.native import (native_family_stats,
+                                             native_overload_stats)
         return HttpResponse.json({
             "version": VERSION,
             "uptime_s": round(time.time() - _START_TIME, 1),
             "requests": server.request_count(),
             "methods": server.method_stats(),
             "native_methods": native_family_stats(),
+            "overload": native_overload_stats(),
         })
 
     def _connections(req: HttpRequest) -> HttpResponse:

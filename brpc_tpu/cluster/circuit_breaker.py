@@ -62,16 +62,40 @@ class CircuitBreaker:
         self._isolated_until = 0.0
         self._isolation_s = self.opt.min_isolation_s
         self._last_isolation = 0.0
+        self._pressure = 0.0  # shed-rate EMA (soft ELIMIT feedback)
         self.isolated_times = 0
 
-    def on_call_end(self, latency_us: int, failed: bool) -> bool:
+    # EMA decay of the shed-pressure signal: ~32 calls of memory — fast
+    # enough to clear once a replica stops shedding, slow enough that
+    # the EWMA LB leg sees sustained pressure, not single rejects
+    PRESSURE_ALPHA = 1.0 / 32.0
+
+    def on_call_end(self, latency_us: int, failed: bool,
+                    shed: bool = False) -> bool:
         """Record one call (≙ OnCallEnd, circuit_breaker.h:38).
-        Returns False if the node just tripped into isolation."""
+        Returns False if the node just tripped into isolation.
+
+        `shed` marks a server-side ELIMIT (the overload plane rejected
+        before executing): SOFT feedback only — it feeds the pressure
+        EMA that weights the LB away from the saturated replica, but it
+        never counts toward the error windows, so shedding alone can
+        never trip isolation (a shedding server is alive and healthy;
+        isolating it would dogpile the survivors)."""
         with self._lock:
+            if shed:
+                self._pressure += self.PRESSURE_ALPHA * (1.0 - self._pressure)
+                return True
+            self._pressure += self.PRESSURE_ALPHA * (0.0 - self._pressure)
             ok = self._long.record(failed) and self._short.record(failed)
             if not ok:
                 self._isolate_locked()
             return ok
+
+    def pressure(self) -> float:
+        """EMA fraction of recent calls the node shed with ELIMIT
+        (0.0-1.0) — the breaker-fed signal the EWMA LB leg steers on."""
+        with self._lock:
+            return self._pressure
 
     def is_isolated(self) -> bool:
         with self._lock:

@@ -131,14 +131,33 @@ class ClusterChannel:
             compress, cancel_buf=getattr(cntl, "_call_id_buf", None))
         latency_us = (time.monotonic_ns() - t0) // 1000
         failed = code != 0
+        shed = code == errors.ELIMIT
+        # the client half of the overload survival loop (overload.h,
+        # ISSUE 11): a server-side ELIMIT means the replica shed BEFORE
+        # executing — (a) the LB leg treats it as a failure so the EWMA
+        # weights (`la`) steer new traffic away from the saturated
+        # replica, (b) the breaker records it as SOFT pressure that can
+        # never trip isolation by itself (a shedding node is alive —
+        # isolating it would dogpile the survivors), and (c) the
+        # excluded set makes THIS call's retry land on a different
+        # replica (≙ ExcludedServers), which is safe precisely because
+        # a shed request never executed.
         self.lb.feedback(node, latency_us, failed)
-        self._breaker(node).on_call_end(latency_us, failed)
+        self._breaker(node).on_call_end(latency_us,
+                                        failed and not shed, shed=shed)
         if failed:
             cntl.excluded_nodes.add(node)
         if code == errors.EFAILEDSOCKET:
             self._health.mark_broken(node)
         cntl.remote_side = str(node.endpoint)
         return code, text, data, att
+
+    def node_pressure(self):
+        """Per-node shed-rate EMA (the breaker-fed EWMA signal): the
+        health/LB view of which replicas are saturated right now."""
+        with self._lock:
+            items = list(self._breakers.items())
+        return {n: br.pressure() for n, br in items}
 
     def close(self):
         if self._closed:

@@ -100,6 +100,24 @@ def native_family_stats() -> Dict[str, dict]:
     return out
 
 
+def native_overload_stats() -> dict:
+    """The overload-control plane's /status block (overload.h, ISSUE
+    11): master-switch state plus the per-family limit / inflight /
+    rejects triple, folded across shards by the native read side.  Only
+    the server-ingress families are gated; the others report the inert
+    defaults."""
+    L = lib()
+    fams = {}
+    for f, name in enumerate(native_families()):
+        fams[name] = {
+            "limit": int(L.trpc_overload_limit(f)),
+            "inflight": int(L.trpc_overload_inflight(f)),
+            "admits": int(L.trpc_overload_admits(f)),
+            "rejects": int(L.trpc_overload_rejects(f)),
+        }
+    return {"enabled": bool(L.trpc_overload_active()), "families": fams}
+
+
 def install_native_metrics() -> None:
     """Expose every native counter as a PassiveStatus bvar (idempotent).
     Called from Server.start(); safe to call standalone."""

@@ -90,6 +90,16 @@ class RetryPolicy:
                  errors.EINTERNAL, errors.ESTOP}
 
     def do_retry(self, cntl: Controller) -> bool:
+        if cntl.error_code == errors.ELIMIT:
+            # the server SHED this request before executing it (the
+            # overload plane's inline fast-reject, overload.h) — a retry
+            # is at-most-once-safe even for non-idempotent methods, but
+            # only useful on a DIFFERENT replica (≙ ExcludedServers: the
+            # shedding node is excluded for this call's later attempts).
+            # Single-server channels don't retry ELIMIT: hammering the
+            # one saturated server is exactly what shedding exists to
+            # stop.
+            return getattr(cntl, "retry_elsewhere", False)
         return cntl.error_code in self.RETRIABLE
 
     def backoff_us(self, attempt: int) -> int:
@@ -450,6 +460,13 @@ class Channel:
         # published id; between attempts the flag stops the retry loop
         cntl._call_id_buf = ctypes.c_uint64(0)
 
+        # ELIMIT retry-elsewhere gate: a shed request may retry only
+        # when another replica exists to land on (cluster mode, >1
+        # resolved servers — the shedding node joins excluded_nodes)
+        cntl.retry_elsewhere = (
+            self._cluster is not None
+            and len(self._cluster.lb.servers()) > 1)
+
         try:
             attempt = 0
             while True:
@@ -465,6 +482,16 @@ class Channel:
                     mb, payload, attachment, remaining_us, backup_ms, cntl,
                     compress_type)
                 cntl.error_code, cntl.error_text = code, text
+                if code == errors.ELIMIT:
+                    # refresh the elsewhere gate against THIS call's
+                    # exclusions: once every replica has shed, the
+                    # cluster's all-excluded fallback would re-pick a
+                    # saturated node — stop retrying instead of
+                    # hammering servers that just told us to back off
+                    cntl.retry_elsewhere = (
+                        self._cluster is not None
+                        and any(n not in cntl.excluded_nodes
+                                for n in self._cluster.lb.servers()))
                 if code == 0:
                     cntl.response_attachment = att
                     cntl.latency_us = (time.monotonic_ns() - start) // 1000

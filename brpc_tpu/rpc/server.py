@@ -142,6 +142,57 @@ def _push_telemetry(value) -> bool:
     return True
 
 
+def _push_overload(value) -> bool:
+    lib().trpc_set_overload(1 if value else 0)
+    return True
+
+
+def _push_overload_min(value) -> bool:
+    if value < 1:
+        return False
+    lib().trpc_set_overload_min_concurrency(int(value))
+    return True
+
+
+def _push_overload_max(value) -> bool:
+    if value < 1:
+        return False
+    lib().trpc_set_overload_max_concurrency(int(value))
+    return True
+
+
+def _push_overload_window(value) -> bool:
+    if value < 1:
+        return False
+    lib().trpc_set_overload_window_ms(int(value))
+    return True
+
+
+flags.define_bool("overload_control",
+                  os.environ.get("TRPC_OVERLOAD", "") not in ("", "0"),
+                  "native overload-control plane (overload.h, ISSUE 11): "
+                  "per-(shard, method-family) gradient concurrency "
+                  "limiter with inline ELIMIT shedding on the parse "
+                  "fiber (no decode, no spawn — the reject rides the "
+                  "response cork).  Off (the default, TRPC_OVERLOAD "
+                  "unset) the plane is inert and behavior-identical to "
+                  "before (reloadable)", validator=_push_overload)
+flags.define_int32("overload_min_concurrency", 16,
+                   "floor the adaptive per-(shard,family) limit can "
+                   "never drop below — the working limit for µs-scale "
+                   "families whose gradient target sits under it "
+                   "(TRPC_OVERLOAD_MIN_CONCURRENCY; reloadable)",
+                   validator=_push_overload_min)
+flags.define_int32("overload_max_concurrency", 4096,
+                   "cap on the adaptive per-(shard,family) limit "
+                   "(TRPC_OVERLOAD_MAX_CONCURRENCY; reloadable)",
+                   validator=_push_overload_max)
+flags.define_int32("overload_window_ms", 100,
+                   "gradient sample-window length: one adaptation step "
+                   "folds per window (TRPC_OVERLOAD_WINDOW_MS; "
+                   "reloadable)", validator=_push_overload_window)
+
+
 flags.define_bool("telemetry",
                   os.environ.get("TRPC_TELEMETRY") != "0",
                   "native hot-path telemetry plane (metrics.h): per-shard "
@@ -199,6 +250,18 @@ class ServerOptions:
     # (pattern, cert_file, key_file); pattern is an exact hostname or a
     # one-label "*.domain" wildcard.  Unmatched names get the base cert.
     tls_sni: Optional[list] = None
+    # Python-side admission hook (cluster/limiter.py Constant/Auto/
+    # Timeout limiters, ≙ ServerOptions.method_max_concurrency taking a
+    # ConcurrencyLimiter): consulted per usercode dispatch; rejected
+    # requests answer ELIMIT.  The NATIVE overload plane (overload.h,
+    # the `overload_control` flag) sheds before requests ever reach
+    # Python — this hook is the slow-path override for custom policies.
+    limiter: Optional[object] = None
+    # Per-method max_concurrency overrides (≙ MaxConcurrencyOf(server,
+    # "Service.Method") = n): {"Service.Method": n} pushed natively at
+    # start() — beyond n queued+running requests of that method the
+    # parse fiber sheds with ELIMIT before decode/dispatch.
+    method_max_concurrency: Optional[Dict[str, int]] = None
 
 
 class _MethodStatus:
@@ -224,7 +287,9 @@ class Server:
         self._cb_keepalive = []
         self._started = False
         self._port = 0
-        self._limiter = None  # cluster.ConcurrencyLimiter, set via option
+        # cluster.ConcurrencyLimiter: ServerOptions.limiter or
+        # set_concurrency_limiter()
+        self._limiter = self.options.limiter
         # dump context built eagerly (cheap: opens no file until the
         # rpc_dump flag turns on) so usercode threads never race a lazy init
         self._dump = dump_mod.RpcDumpContext()
@@ -715,6 +780,23 @@ class Server:
             1 if flags.get_flag("enable_rpcz") else 0)
         lib().trpc_set_rpcz_budget(
             int(flags.get_flag("rpcz_max_samples_per_second")))
+        # overload-control plane (overload.h): resolved flag state lands
+        # in the native atomics before traffic; off = the plane is inert
+        lib().trpc_set_overload(
+            1 if flags.get_flag("overload_control") else 0)
+        lib().trpc_set_overload_min_concurrency(
+            int(flags.get_flag("overload_min_concurrency")))
+        lib().trpc_set_overload_max_concurrency(
+            int(flags.get_flag("overload_max_concurrency")))
+        lib().trpc_set_overload_window_ms(
+            int(flags.get_flag("overload_window_ms")))
+        for meth, cap in (self.options.method_max_concurrency or {}).items():
+            rc = lib().trpc_server_set_method_max_concurrency(
+                self._handle, meth.encode(), int(cap))
+            if rc != 0:
+                raise ValueError(
+                    f"method_max_concurrency[{meth!r}] rejected natively "
+                    f"(rc={rc}; is the service registered?)")
         if self.options.enable_builtin_services:
             from brpc_tpu.builtin import install_builtin_services
             install_builtin_services(self, self.http)

@@ -4,6 +4,15 @@ rpc_press_impl.{h,cpp}).
 
     python -m brpc_tpu.tools.rpc_press -s 127.0.0.1:8000 -m Echo.echo \
         -d 'hello' -q 10000 -c 8 -t 10
+
+Overload-control cannon (ISSUE 11): ``--ramp lo:hi:steps`` sweeps the
+offered concurrency across ``steps`` levels and reports, per step,
+admitted-vs-shed counts and ADMITTED-ONLY latency percentiles in the
+``--json`` line — the acceptance harness for the native overload plane
+(shed = server answered TRPC_ELIMIT without executing).  The ramp is
+open-loop per level: every caller thread keeps its next request queued
+regardless of how the server answered the last one, so offered load does
+not back off when the server sheds.
 """
 
 from __future__ import annotations
@@ -19,9 +28,17 @@ from typing import List, Optional
 class PressResult:
     calls: int = 0
     errors: int = 0
+    shed: int = 0   # server-side ELIMIT rejects (never executed)
     wall_s: float = 0.0
     qps: float = 0.0
+    # admitted-only latencies: a shed answer is the overload plane
+    # working, not a serving latency — mixing them in would let a fast
+    # reject path mask a collapsing admitted path
     latencies_us: List[int] = field(default_factory=list)
+
+    @property
+    def admitted(self) -> int:
+        return self.calls - self.errors - self.shed
 
     def percentile(self, p: float) -> float:
         if not self.latencies_us:
@@ -30,20 +47,21 @@ class PressResult:
         return s[min(len(s) - 1, int(p * len(s)))]
 
     def summary(self) -> str:
-        return (f"calls={self.calls} errors={self.errors} "
+        return (f"calls={self.calls} admitted={self.admitted} "
+                f"shed={self.shed} errors={self.errors} "
                 f"qps={self.qps:.0f} "
                 f"p50={self.percentile(.5):.0f}us "
                 f"p90={self.percentile(.9):.0f}us "
                 f"p99={self.percentile(.99):.0f}us "
                 f"p999={self.percentile(.999):.0f}us")
 
-    def to_json_line(self) -> str:
-        """One machine-readable JSON line (the overload-control harness
-        of ROADMAP item 4 diff-checks these across pressure levels)."""
-        import json
-        return json.dumps({
-            "metric": "rpc_press",
+    def step_dict(self, concurrency: int = 0) -> dict:
+        """One ramp step's machine-readable block (admitted-only
+        percentiles beside the admitted/shed split)."""
+        d = {
             "calls": self.calls,
+            "admitted": self.admitted,
+            "shed": self.shed,
             "errors": self.errors,
             "wall_s": round(self.wall_s, 3),
             "qps": round(self.qps, 1),
@@ -51,7 +69,16 @@ class PressResult:
             "p90_us": self.percentile(.9),
             "p99_us": self.percentile(.99),
             "p999_us": self.percentile(.999),
-        })
+        }
+        if concurrency:
+            d["concurrency"] = concurrency
+        return d
+
+    def to_json_line(self) -> str:
+        """One machine-readable JSON line (the overload-control harness
+        of ROADMAP item 2 diff-checks these across pressure levels)."""
+        import json
+        return json.dumps({"metric": "rpc_press", **self.step_dict()})
 
 
 def press(server: str, method: str, payload: bytes, qps: float = 0.0,
@@ -66,6 +93,7 @@ def press(server: str, method: str, payload: bytes, qps: float = 0.0,
     serialized request).  For HTTP/1.1, a method starting with "GET " /
     "POST " etc. is an HTTP target ("GET /health") driven through the
     framework's own client (≙ rpc_press's multi-protocol support)."""
+    from brpc_tpu.rpc import errors
     from brpc_tpu.rpc.channel import Channel, ChannelOptions
     from brpc_tpu.rpc.http_client import HttpChannel
 
@@ -122,7 +150,7 @@ def press(server: str, method: str, payload: bytes, qps: float = 0.0,
                 ch.call(method, payload, attachment)
 
             closer = ch.close
-        local_lat, local_calls, local_errs = [], 0, 0
+        local_lat, local_calls, local_errs, local_shed = [], 0, 0, 0
         next_at = time.monotonic()
         while not stop.is_set():
             if interval > 0:
@@ -135,6 +163,11 @@ def press(server: str, method: str, payload: bytes, qps: float = 0.0,
             try:
                 call_once()
                 local_lat.append((time.monotonic_ns() - t0) // 1000)
+            except errors.RpcError as e:
+                if e.code == errors.ELIMIT:
+                    local_shed += 1  # shed, never executed — not an error
+                else:
+                    local_errs += 1
             except Exception:
                 local_errs += 1
             local_calls += 1
@@ -142,6 +175,7 @@ def press(server: str, method: str, payload: bytes, qps: float = 0.0,
         with lock:
             res.calls += local_calls
             res.errors += local_errs
+            res.shed += local_shed
             res.latencies_us.extend(local_lat)
 
     threads = [threading.Thread(target=worker, daemon=True)
@@ -158,6 +192,41 @@ def press(server: str, method: str, payload: bytes, qps: float = 0.0,
     return res
 
 
+def parse_ramp(spec: str) -> List[int]:
+    """'lo:hi:steps' -> the concurrency level per step (inclusive,
+    linearly spaced, deduplicated ascending)."""
+    parts = spec.split(":")
+    if len(parts) != 3:
+        raise ValueError(f"--ramp wants lo:hi:steps, got {spec!r}")
+    lo, hi, steps = int(parts[0]), int(parts[1]), int(parts[2])
+    if lo < 1 or hi < lo or steps < 1:
+        raise ValueError(f"--ramp wants 1 <= lo <= hi, steps >= 1 "
+                         f"(got {spec!r})")
+    if steps == 1:
+        return [hi]
+    levels = []
+    for i in range(steps):
+        c = lo + round(i * (hi - lo) / (steps - 1))
+        if not levels or c > levels[-1]:
+            levels.append(c)
+    return levels
+
+
+def ramp(server: str, method: str, payload: bytes, spec: str,
+         step_s: float, qps: float = 0.0, attachment: bytes = b"",
+         timeout_ms: float = 1000.0, protocol: str = "trpc") -> List[dict]:
+    """The overload cannon: one open-loop press() per concurrency level,
+    each step_s long, reporting admitted/shed + admitted-only
+    percentiles per step."""
+    out = []
+    for level in parse_ramp(spec):
+        r = press(server, method, payload, qps=qps, concurrency=level,
+                  duration_s=step_s, attachment=attachment,
+                  timeout_ms=timeout_ms, protocol=protocol)
+        out.append(r.step_dict(concurrency=level))
+    return out
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(description="rpc_press load generator")
     ap.add_argument("-s", "--server", required=True, help="ip:port")
@@ -171,13 +240,34 @@ def main(argv: Optional[List[str]] = None) -> int:
                     choices=["trpc", "h2", "grpc"],
                     help="wire protocol (HTTP/1.1 via 'GET /path' methods)")
     ap.add_argument("-t", "--time", type=float, default=5.0,
-                    help="duration seconds")
+                    help="duration seconds (per step with --ramp)")
+    ap.add_argument("--ramp", metavar="lo:hi:steps",
+                    help="open-loop concurrency ramp: one -t second "
+                         "step per level; reports admitted-vs-shed and "
+                         "admitted-only p50/p99/p999 per step (the "
+                         "overload-control cannon)")
     ap.add_argument("--json", action="store_true",
                     help="print ONE JSON summary line (qps + "
-                         "p50/p90/p99/p999) instead of the text summary")
+                         "admitted/shed + p50/p90/p99/p999; with "
+                         "--ramp, a per-step array) instead of text")
     args = ap.parse_args(argv)
     payload = (open(args.file, "rb").read() if args.file
                else args.data.encode())
+    if args.ramp:
+        import json
+        steps = ramp(args.server, args.method, payload, args.ramp,
+                     args.time, qps=args.qps, protocol=args.protocol)
+        if args.json:
+            print(json.dumps({"metric": "rpc_press_ramp",
+                              "method": args.method, "steps": steps}))
+        else:
+            for st in steps:
+                print(f"c={st['concurrency']} qps={st['qps']:.0f} "
+                      f"admitted={st['admitted']} shed={st['shed']} "
+                      f"errors={st['errors']} p50={st['p50_us']:.0f}us "
+                      f"p99={st['p99_us']:.0f}us "
+                      f"p999={st['p999_us']:.0f}us")
+        return 0
     res = press(args.server, args.method, payload, args.qps,
                 args.concurrency, args.time, protocol=args.protocol)
     print(res.to_json_line() if args.json else res.summary())

@@ -15,6 +15,7 @@
 #include "http.h"
 #include "iobuf.h"
 #include "metrics.h"
+#include "overload.h"
 #include "profiler.h"
 #include "crc32c.h"
 #include "rpc.h"
@@ -169,6 +170,50 @@ uint64_t trpc_server_requests(void* s) { return server_requests((Server*)s); }
 void trpc_set_usercode_workers(int n) { set_usercode_workers(n); }
 void trpc_set_usercode_max_inflight(int64_t n) {
   set_usercode_max_inflight(n);
+}
+
+// --- overload-control plane (overload.h, ISSUE 11) --------------------------
+
+// Reloadable master switch + gradient knobs (TRPC_OVERLOAD_* seed the
+// defaults; the overload_* flags push through here).  Off = the plane
+// is inert: no admits, no charges — behavior-identical to before.
+void trpc_set_overload(int on) { set_overload(on); }
+int trpc_overload_active() { return overload_enabled() ? 1 : 0; }
+void trpc_set_overload_min_concurrency(int n) {
+  set_overload_min_concurrency(n);
+}
+void trpc_set_overload_max_concurrency(int n) {
+  set_overload_max_concurrency(n);
+}
+void trpc_set_overload_window_ms(int ms) { set_overload_window_ms(ms); }
+
+// Folded read side (/status's per-family limit/inflight/reject block).
+int64_t trpc_overload_limit(int family) { return overload_limit(family); }
+int64_t trpc_overload_inflight(int family) {
+  return overload_inflight(family);
+}
+uint64_t trpc_overload_rejects(int family) {
+  return overload_rejects(family);
+}
+uint64_t trpc_overload_admits(int family) {
+  return overload_admits(family);
+}
+
+// Per-method max_concurrency override (≙ MaxConcurrencyOf; pre-start).
+int trpc_server_set_method_max_concurrency(void* s, const char* method,
+                                           int64_t n) {
+  return server_set_method_max_concurrency((Server*)s, method, n);
+}
+
+// Deterministic gradient-math test hooks (tests/test_overload.py): feed
+// synthetic samples/clock, reset an agent — the adaptation becomes a
+// pure function of the fed sequence.
+void trpc_overload_test_feed(int family, int shard, int64_t lat_us,
+                             int count, int64_t now_ns) {
+  overload_test_feed(family, shard, lat_us, count, now_ns);
+}
+void trpc_overload_test_reset(int family, int shard) {
+  overload_test_reset(family, shard);
 }
 
 // Ingress fast path (run-to-completion dispatch + response corking):

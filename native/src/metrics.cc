@@ -7,6 +7,7 @@
 #include <mutex>
 
 #include "common.h"
+#include "overload.h"
 #include "sched_perturb.h"
 #include "shard.h"
 #include "tpu.h"
@@ -221,6 +222,26 @@ size_t telemetry_prom_dump(char* buf, size_t cap) {
   for (int f = 0; f < TF_FAMILIES; ++f) {
     emit("native_inflight{family=\"%s\"} %lld\n", kTelemetryFamilyNames[f],
          (long long)telemetry_inflight(f));
+  }
+  // overload-control plane (overload.h, ISSUE 11): per-family adaptive
+  // limit + live charges + sheds, folded across shards at read time.
+  // Only the server-ingress families are gated (inline_echo, hbm_echo,
+  // usercode); client families report the inert defaults.
+  emit("# TYPE native_overload_limit gauge\n");
+  for (int f = 0; f < TF_FAMILIES; ++f) {
+    emit("native_overload_limit{family=\"%s\"} %lld\n",
+         kTelemetryFamilyNames[f], (long long)overload_limit(f));
+  }
+  emit("# TYPE native_overload_inflight gauge\n");
+  for (int f = 0; f < TF_FAMILIES; ++f) {
+    emit("native_overload_inflight{family=\"%s\"} %lld\n",
+         kTelemetryFamilyNames[f], (long long)overload_inflight(f));
+  }
+  emit("# TYPE native_overload_rejects counter\n");
+  for (int f = 0; f < TF_FAMILIES; ++f) {
+    emit("native_overload_rejects{family=\"%s\"} %llu\n",
+         kTelemetryFamilyNames[f],
+         (unsigned long long)overload_rejects(f));
   }
   return off;
 }
@@ -550,7 +571,20 @@ size_t native_metrics_dump(char* buf, size_t cap) {
     putf("native_latency_%s_sum_us %lld\n",
          (long long)telemetry_sum_us(f));
     putf("native_inflight_%s %lld\n", (long long)telemetry_inflight(f));
+    // overload-control plane (overload.h, ISSUE 11): the per-family
+    // limit/inflight/reject triple /status surfaces — the proof the
+    // gradient limiter is bounding (or idling, when off)
+    putf("native_overload_limit_%s %lld\n", (long long)overload_limit(f));
+    putf("native_overload_inflight_%s %lld\n",
+         (long long)overload_inflight(f));
+    putf("native_overload_rejects_%s %lld\n",
+         (long long)overload_rejects(f));
   }
+  // overload-control plane admission totals (the per-family triple
+  // rides the family loop above)
+  put("native_overload_admits", (long long)overload_admits_total());
+  put("native_overload_rejects", (long long)overload_rejects_total());
+  put("native_overload_windows", (long long)overload_windows_total());
   put("native_sched_perturb_yields", relu(m.sched_perturb_yields));
   put("native_sched_perturb_steal_shuffles",
       relu(m.sched_perturb_steal_shuffles));

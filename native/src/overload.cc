@@ -1,0 +1,398 @@
+#include "overload.h"
+
+#include <stdlib.h>
+
+#include <algorithm>
+
+#include "common.h"
+#include "shard.h"
+
+namespace trpc {
+
+namespace {
+
+// Gradient constants (≙ the auto_concurrency_limiter shape,
+// policy/auto_concurrency_limiter.cpp: alpha headroom over the no-load
+// floor, EMA smoothing, periodic exploration that lowers the limit so
+// the floor can re-sample under reduced concurrency).  Values are ours.
+constexpr double kAlpha = 0.3;          // headroom over the no-load floor
+constexpr int kExploreEvery = 16;       // windows between floor re-samples
+constexpr uint64_t kMinWindowSamples = 64;  // don't fold starved windows
+
+struct alignas(64) OvAgent {
+  // hot half: one load + one fetch_add per admission
+  std::atomic<int64_t> limit{0};     // 0 = unadapted (default applies)
+  std::atomic<int64_t> inflight{0};  // live charges
+  std::atomic<uint64_t> admits{0};
+  std::atomic<uint64_t> rejects{0};
+  // sample window: relaxed adds on completion, folded by the claim
+  // winner when the window ages out
+  std::atomic<uint64_t> win_count{0};
+  std::atomic<uint64_t> win_lat_us{0};
+  std::atomic<int64_t> win_start_ns{0};
+  std::atomic<int> fold_claim{0};  // CAS try-lock: losers skip, never park
+  // gradient state — written only inside a successful claim
+  std::atomic<int64_t> min_lat_us_x16{0};  // EWMA no-load floor, µs × 16
+  std::atomic<int64_t> peak_qps{0};        // decayed peak throughput
+  std::atomic<uint64_t> windows{0};        // folds (explore every Nth)
+};
+
+// [shard][family] — per-shard agents, folded only at read time
+// (≙ bvar per-cpu agents; PR 7/9 discipline).  ~tiny: 8×6 cache lines.
+OvAgent g_agents[kMaxShards][TF_FAMILIES];
+
+// -1 = resolve TRPC_OVERLOAD on first use (flag-cached below; the
+// reloadable `overload_control` flag overrides through set_overload).
+// DEFAULT OFF: the plane unset is behavior-identical to the pre-ISSUE
+// runtime (the acceptance A/B baseline).
+std::atomic<int> g_overload{-1};
+std::atomic<int> g_min_c{-1};       // TRPC_OVERLOAD_MIN_CONCURRENCY
+std::atomic<int> g_max_c{-1};       // TRPC_OVERLOAD_MAX_CONCURRENCY
+std::atomic<int> g_window_ms{-1};   // TRPC_OVERLOAD_WINDOW_MS
+
+int env_int_once(const char* name, int dflt, int lo, int hi) {
+  // flag-cached: the ONE env read; the resolved value lives in the
+  // caller's atomic for the rest of the process (reload via /flags)
+  const char* e = getenv(name);
+  if (e == nullptr || e[0] == '\0') {
+    return dflt;
+  }
+  long v = strtol(e, nullptr, 10);
+  if (v < lo) {
+    v = lo;
+  }
+  if (v > hi) {
+    v = hi;
+  }
+  return (int)v;
+}
+
+int overload_resolve() {
+  // flag-cached: resolved once into g_overload (and the knob atomics);
+  // later reads take the atomic fast path above
+  const char* e = getenv("TRPC_OVERLOAD");
+  int on = (e != nullptr && e[0] != '\0' && e[0] != '0') ? 1 : 0;
+  int expected = -1;
+  g_overload.compare_exchange_strong(expected, on,
+                                     std::memory_order_acq_rel);
+  return g_overload.load(std::memory_order_acquire);
+}
+
+int knob(std::atomic<int>& a, const char* env, int dflt, int lo, int hi) {
+  int v = a.load(std::memory_order_acquire);
+  if (TRPC_UNLIKELY(v < 0)) {
+    int resolved = env_int_once(env, dflt, lo, hi);
+    int expected = -1;
+    a.compare_exchange_strong(expected, resolved,
+                              std::memory_order_acq_rel);
+    v = a.load(std::memory_order_acquire);
+  }
+  return v;
+}
+
+int min_concurrency() {
+  return knob(g_min_c, "TRPC_OVERLOAD_MIN_CONCURRENCY", 16, 1, 1 << 20);
+}
+
+int max_concurrency() {
+  return knob(g_max_c, "TRPC_OVERLOAD_MAX_CONCURRENCY", 4096, 1, 1 << 20);
+}
+
+int64_t window_ns() {
+  return (int64_t)knob(g_window_ms, "TRPC_OVERLOAD_WINDOW_MS", 100, 1,
+                       60 * 1000) * 1000000LL;
+}
+
+inline int clamp_fam(int family) {
+  return (family >= 0 && family < TF_FAMILIES) ? family : 0;
+}
+
+inline int clamp_shd(int shard) {
+  // off-worker callers fold into shard 0's agent (PR-9 convention)
+  return (shard >= 0 && shard < kMaxShards) ? shard : 0;
+}
+
+inline OvAgent& agent(int shard, int family) {
+  return g_agents[clamp_shd(shard)][clamp_fam(family)];
+}
+
+// The effective limit: an unadapted agent starts at 4× the floor —
+// conservative enough that an overload burst arriving before the first
+// window is still bounded, loose enough that the gradient's first
+// grow steps aren't fighting the initial value.  The stored limit is
+// clamped on EVERY read, not just at fold time: a hot-reloaded
+// min/max_concurrency must bind immediately — a quiet family (below
+// kMinWindowSamples per window) may never fold again, and its stale
+// adapted limit must not outrank the operator's new clamp.
+inline int64_t eff_limit(const OvAgent& a) {
+  int64_t lo = min_concurrency();
+  int64_t hi = max_concurrency();
+  int64_t v = a.limit.load(std::memory_order_relaxed);
+  if (v <= 0) {
+    v = lo * 4;  // unadapted default
+  }
+  return std::min(std::max(v, lo), hi);
+}
+
+// Fold the aged-out sample window and take one gradient step.  Runs on
+// whichever completion notices the window aged out; the CAS claim makes
+// losers skip (never park — this is reachable from parse fibers, so it
+// must not block; tools/analyze fiberblock rule).
+void maybe_fold(OvAgent& a, int64_t now_ns) {
+  int64_t start = a.win_start_ns.load(std::memory_order_relaxed);
+  if (start == 0 || now_ns - start < window_ns() ||
+      a.win_count.load(std::memory_order_relaxed) < kMinWindowSamples) {
+    return;
+  }
+  int expected = 0;
+  if (!a.fold_claim.compare_exchange_strong(expected, 1,
+                                            std::memory_order_acq_rel)) {
+    return;  // another completion is folding — skip, never wait
+  }
+  // re-check under the claim (a racing fold may have just reset it)
+  start = a.win_start_ns.load(std::memory_order_relaxed);
+  if (start != 0 && now_ns - start >= window_ns()) {
+    uint64_t cnt = a.win_count.exchange(0, std::memory_order_relaxed);
+    uint64_t sum = a.win_lat_us.exchange(0, std::memory_order_relaxed);
+    a.win_start_ns.store(now_ns, std::memory_order_relaxed);
+    if (cnt >= kMinWindowSamples) {
+      double avg = (double)sum / (double)cnt;
+      double dt_s = (double)(now_ns - start) / 1e9;
+      double qps = dt_s > 0 ? (double)cnt / dt_s : 0.0;
+      // no-load floor: fast down (a lower average IS the new floor),
+      // slow up (1/16 EMA — a sustained shift eventually re-bases, a
+      // transient spike barely moves it)
+      int64_t floor_x16 = a.min_lat_us_x16.load(std::memory_order_relaxed);
+      double floor_us = (double)floor_x16 / 16.0;
+      if (floor_x16 == 0 || avg < floor_us) {
+        floor_us = avg;
+      } else {
+        floor_us += (avg - floor_us) * (1.0 / 16.0);
+      }
+      a.min_lat_us_x16.store((int64_t)(floor_us * 16.0),
+                             std::memory_order_relaxed);
+      double peak = (double)a.peak_qps.load(std::memory_order_relaxed);
+      peak = std::max(peak * 0.98, qps);  // decayed peak throughput
+      a.peak_qps.store((int64_t)peak, std::memory_order_relaxed);
+      uint64_t w =
+          a.windows.fetch_add(1, std::memory_order_relaxed) + 1;
+      int64_t cur = eff_limit(a);
+      int64_t next;
+      if (w % (uint64_t)kExploreEvery == 0) {
+        // exploration: drop concurrency so the floor can re-sample at
+        // lighter load (an inflated floor otherwise locks the limit
+        // high forever)
+        next = cur * 3 / 4;
+      } else {
+        // the gradient: positive headroom below (2+alpha)×floor grows
+        // the limit toward peak-QPS × headroom (Little's law target);
+        // latency inflation past it shrinks toward the floor clamp
+        double target =
+            peak * ((2.0 + kAlpha) * floor_us - avg) / 1e6;
+        next = (int64_t)(0.5 * (double)cur +
+                         0.5 * std::max(target, 1.0));
+      }
+      int64_t lo = min_concurrency();
+      int64_t hi = max_concurrency();
+      a.limit.store(std::min(std::max(next, lo), hi),
+                    std::memory_order_relaxed);
+    }
+  }
+  a.fold_claim.store(0, std::memory_order_release);
+}
+
+void record_sample(OvAgent& a, int64_t lat_us, int64_t now_ns) {
+  if (lat_us < 0) {
+    lat_us = 0;  // coarse-clock arm stamps can sit slightly ahead
+  }
+  // first sample opens the window (CAS so concurrent openers agree)
+  if (a.win_start_ns.load(std::memory_order_relaxed) == 0) {
+    int64_t expected = 0;
+    a.win_start_ns.compare_exchange_strong(expected, now_ns,
+                                           std::memory_order_acq_rel);
+  }
+  a.win_count.fetch_add(1, std::memory_order_relaxed);
+  a.win_lat_us.fetch_add((uint64_t)lat_us, std::memory_order_relaxed);
+  maybe_fold(a, now_ns);
+}
+
+}  // namespace
+
+void set_overload(int on) {
+  g_overload.store(on != 0 ? 1 : 0, std::memory_order_release);
+}
+
+bool overload_enabled() {
+  int v = g_overload.load(std::memory_order_acquire);
+  if (TRPC_UNLIKELY(v < 0)) {
+    v = overload_resolve();
+  }
+  return v != 0;
+}
+
+void set_overload_min_concurrency(int n) {
+  g_min_c.store(n > 0 ? n : 1, std::memory_order_release);
+}
+
+void set_overload_max_concurrency(int n) {
+  g_max_c.store(n > 0 ? n : 1, std::memory_order_release);
+}
+
+void set_overload_window_ms(int ms) {
+  g_window_ms.store(ms > 0 ? ms : 1, std::memory_order_release);
+}
+
+OverloadGate::OverloadGate(int shard_)
+    : shard(shard_), on(overload_enabled()) {}
+
+OverloadGate::~OverloadGate() {
+  for (int f = 0; f < TF_FAMILIES; ++f) {
+    if (deferred[f] > 0) {
+      agent(shard, f).inflight.fetch_sub((int64_t)deferred[f],
+                                         std::memory_order_relaxed);
+    }
+  }
+}
+
+bool overload_admit(OverloadGate* g, int family, bool defer_release) {
+  OvAgent& a = agent(g->shard, family);
+  int64_t lim = eff_limit(a);
+  int64_t cur = a.inflight.fetch_add(1, std::memory_order_relaxed);
+  if (cur >= lim) {
+    a.inflight.fetch_sub(1, std::memory_order_relaxed);
+    a.rejects.fetch_add(1, std::memory_order_relaxed);
+    return false;
+  }
+  a.admits.fetch_add(1, std::memory_order_relaxed);
+  if (defer_release) {
+    g->deferred[clamp_fam(family)] += 1;
+  }
+  return true;
+}
+
+void overload_unadmit(OverloadGate* g, int family, bool defer_release) {
+  if (defer_release) {
+    uint32_t& d = g->deferred[clamp_fam(family)];
+    if (d > 0) {
+      d -= 1;  // the gate destructor will no longer release this charge
+    }
+  }
+  OvAgent& a = agent(g->shard, family);
+  a.inflight.fetch_sub(1, std::memory_order_relaxed);
+  // keep `admits` = requests actually dispatched (this one never was)
+  a.admits.fetch_sub(1, std::memory_order_relaxed);
+}
+
+void overload_on_complete(int family, int shard, int64_t lat_us,
+                          int64_t now_ns) {
+  OvAgent& a = agent(shard, family);
+  a.inflight.fetch_sub(1, std::memory_order_relaxed);
+  record_sample(a, lat_us, now_ns);
+}
+
+void overload_sample(int family, int shard, int64_t lat_us,
+                     int64_t now_ns) {
+  record_sample(agent(shard, family), lat_us, now_ns);
+}
+
+void overload_release(int family, int shard) {
+  agent(shard, family).inflight.fetch_sub(1, std::memory_order_relaxed);
+}
+
+void overload_note_shed(int family, int shard) {
+  agent(shard, family).rejects.fetch_add(1, std::memory_order_relaxed);
+}
+
+int64_t overload_limit(int family) {
+  int64_t v = 0;
+  int n = shard_count();
+  for (int k = 0; k < n && k < kMaxShards; ++k) {
+    v += eff_limit(agent(k, family));
+  }
+  return v;
+}
+
+int64_t overload_inflight(int family) {
+  int64_t v = 0;
+  int n = shard_count();
+  for (int k = 0; k < n && k < kMaxShards; ++k) {
+    v += agent(k, family).inflight.load(std::memory_order_relaxed);
+  }
+  return v;
+}
+
+uint64_t overload_rejects(int family) {
+  uint64_t v = 0;
+  int n = shard_count();
+  for (int k = 0; k < n && k < kMaxShards; ++k) {
+    v += agent(k, family).rejects.load(std::memory_order_relaxed);
+  }
+  return v;
+}
+
+uint64_t overload_admits(int family) {
+  uint64_t v = 0;
+  int n = shard_count();
+  for (int k = 0; k < n && k < kMaxShards; ++k) {
+    v += agent(k, family).admits.load(std::memory_order_relaxed);
+  }
+  return v;
+}
+
+uint64_t overload_admits_total() {
+  uint64_t v = 0;
+  for (int f = 0; f < TF_FAMILIES; ++f) {
+    v += overload_admits(f);
+  }
+  return v;
+}
+
+uint64_t overload_rejects_total() {
+  uint64_t v = 0;
+  for (int f = 0; f < TF_FAMILIES; ++f) {
+    v += overload_rejects(f);
+  }
+  return v;
+}
+
+uint64_t overload_windows_total() {
+  uint64_t v = 0;
+  for (int f = 0; f < TF_FAMILIES; ++f) {
+    for (int k = 0; k < kMaxShards; ++k) {
+      v += g_agents[k][f].windows.load(std::memory_order_relaxed);
+    }
+  }
+  return v;
+}
+
+void overload_test_feed(int family, int shard, int64_t lat_us, int count,
+                        int64_t now_ns) {
+  OvAgent& a = agent(shard, family);
+  for (int i = 0; i < count; ++i) {
+    if (a.win_start_ns.load(std::memory_order_relaxed) == 0) {
+      int64_t expected = 0;
+      a.win_start_ns.compare_exchange_strong(expected, now_ns,
+                                             std::memory_order_acq_rel);
+    }
+    a.win_count.fetch_add(1, std::memory_order_relaxed);
+    a.win_lat_us.fetch_add((uint64_t)(lat_us > 0 ? lat_us : 0),
+                           std::memory_order_relaxed);
+  }
+  maybe_fold(a, now_ns);
+}
+
+void overload_test_reset(int family, int shard) {
+  OvAgent& a = agent(shard, family);
+  a.limit.store(0, std::memory_order_relaxed);
+  a.inflight.store(0, std::memory_order_relaxed);
+  a.admits.store(0, std::memory_order_relaxed);
+  a.rejects.store(0, std::memory_order_relaxed);
+  a.win_count.store(0, std::memory_order_relaxed);
+  a.win_lat_us.store(0, std::memory_order_relaxed);
+  a.win_start_ns.store(0, std::memory_order_relaxed);
+  a.min_lat_us_x16.store(0, std::memory_order_relaxed);
+  a.peak_qps.store(0, std::memory_order_relaxed);
+  a.windows.store(0, std::memory_order_relaxed);
+}
+
+}  // namespace trpc

@@ -1,0 +1,128 @@
+// overload.h — the native overload-control plane (ISSUE 11; ROADMAP
+// item 2): per-shard, per-method-family admission with a gradient
+// auto-limiter and inline load shedding.
+//
+// Capability of the reference's ConcurrencyLimiter family
+// (≙ concurrency_limiter.h:29-44 + policy/auto_concurrency_limiter.cpp:
+// a per-method limit adapted from an EWMA'd no-load latency floor and a
+// peak-QPS estimate, periodically lowered to re-sample the floor) —
+// re-designed for THIS runtime's shape:
+//
+//   * State is per (shard, family): a parse fiber only ever touches its
+//     own shard's cache lines (≙ bvar per-cpu agents, PR 7/9 discipline)
+//     and each shard's limit adapts from its own completions.  Reads
+//     (/status, /vars, Prometheus) fold across shards.
+//   * The latency signal is the PR-9 queue-INCLUSIVE stamp (drain start
+//     for run-to-completion work, parse-loop arm for usercode) — the
+//     client-p50-vs-service-p50 split the histograms exposed is exactly
+//     what the gradient feeds on.
+//   * Shedding is INLINE on the parse fiber, BEFORE codec decode and
+//     before any fiber/usercode spawn: a rejected request costs one
+//     frame parse + one tiny ELIMIT frame packed onto the PR-3 response
+//     cork.  At 10x offered load the reject path is what keeps admitted
+//     p99 bounded — it must cost ~0.
+//
+// Two admission shapes share one limit per (shard, family):
+//   * run-to-completion families (inline echo): the charge is released
+//     when the DRAIN ends (OverloadGate destructor), so the limit bounds
+//     the pipeline depth one drain may admit — in-drain queueing is the
+//     dominant admitted-latency term for µs-scale handlers.  For those
+//     the gradient's target is usually below the floor, and
+//     min_concurrency IS the working limit (documented, not hidden).
+//   * in-flight families (HbmEcho DMA waits, usercode handlers): the
+//     charge is released at completion (respond / fiber exit), so the
+//     limit bounds queued+running work exactly like the reference's
+//     limiter.  This is where the gradient's dynamic range matters
+//     (ms-scale handlers, pool queueing).
+//
+// Off (TRPC_OVERLOAD unset/0) every function short-circuits: no admit
+// checks, no charges, no samples — behavior-identical to the pre-ISSUE
+// runtime.  All knobs reload through /flags (server.py validators push
+// through capi).
+#pragma once
+
+#include <atomic>
+#include <cstddef>
+#include <cstdint>
+
+#include "metrics.h"  // TelemetryFamily: the overload plane gates the
+                      // same families the PR-9 histograms observe
+
+namespace trpc {
+
+// Reloadable master switch (TRPC_OVERLOAD env seeds the default — OFF;
+// the `overload_control` flag pushes through capi).
+void set_overload(int on);
+bool overload_enabled();
+
+// Gradient knobs (TRPC_OVERLOAD_{MIN,MAX}_CONCURRENCY,
+// TRPC_OVERLOAD_WINDOW_MS seed the defaults; reloadable).  The limit is
+// clamped into [min, max] per shard; min is the floor the limit can
+// never adapt below (and the working limit for µs-scale families),
+// window_ms is the sample-window length one adaptation step folds.
+void set_overload_min_concurrency(int n);
+void set_overload_max_concurrency(int n);
+void set_overload_window_ms(int ms);
+
+// One drain's admission scope, constructed next to the InlineBudget in
+// ServerOnMessages.  `on` snapshots the master switch once per drain;
+// deferred charges (run-to-completion admits) release in the destructor
+// so a charge can never leak across the flag flipping mid-drain.
+struct OverloadGate {
+  int shard = 0;
+  bool on = false;
+  uint32_t deferred[TF_FAMILIES] = {0};
+  explicit OverloadGate(int shard_);
+  ~OverloadGate();
+};
+
+// Admission on the parse fiber (gate.on must be true).  Returns true =
+// admitted (the (shard,family) in-flight charge is taken; defer_release
+// parks the release on the gate destructor — the run-to-completion
+// shape), false = shed (the caller answers TRPC_ELIMIT on the cork; the
+// reject is counted).
+bool overload_admit(OverloadGate* g, int family, bool defer_release);
+
+// Undo an admit whose request failed BEFORE dispatch (e.g. a corrupt
+// codec body): releases the charge without feeding a sample.
+void overload_unadmit(OverloadGate* g, int family, bool defer_release);
+
+// Completion of a non-deferred admit: release the charge and feed one
+// queue-inclusive latency sample into the (shard,family) window.
+// now_ns = the CLOCK_MONOTONIC read the caller already has.
+void overload_on_complete(int family, int shard, int64_t lat_us,
+                          int64_t now_ns);
+// Sample without a release — deferred-admit completions (the gate owns
+// their release) still feed the gradient window.
+void overload_sample(int family, int shard, int64_t lat_us,
+                     int64_t now_ns);
+// Release without a sample — error paths that never produced a latency.
+void overload_release(int family, int shard);
+
+// Count a shed the admission plane did NOT decide (the per-method
+// max_concurrency cap, which works with the plane off too) into the
+// (shard,family) reject counter, so /status's reject block covers every
+// ELIMIT the parse fiber issued.
+void overload_note_shed(int family, int shard);
+
+// Read side, folded across shards (≙ bvar agent folds): limit = sum of
+// per-shard limits (total admission capacity), inflight = live charges,
+// rejects/admits = totals.  All valid whether the plane is on or off.
+int64_t overload_limit(int family);
+int64_t overload_inflight(int family);
+uint64_t overload_rejects(int family);
+uint64_t overload_admits(int family);
+uint64_t overload_admits_total();
+uint64_t overload_rejects_total();
+uint64_t overload_windows_total();  // adaptation windows folded
+
+// Deterministic test hook (tests/test_overload.py): record `count`
+// samples of lat_us into (shard,family) and run the window-close
+// attempt at the SYNTHETIC clock now_ns — the gradient math becomes a
+// pure function of the fed sequence (no sockets, no real clock).
+void overload_test_feed(int family, int shard, int64_t lat_us, int count,
+                        int64_t now_ns);
+// Test hook: reset one (shard,family) agent to boot state.
+void overload_test_reset(int family, int shard);
+
+}  // namespace trpc

@@ -30,6 +30,7 @@
 #include "http.h"
 #include "metrics.h"
 #include "object_pool.h"
+#include "overload.h"
 #include "redis.h"
 #include "sched_perturb.h"
 #include "shard.h"
@@ -409,6 +410,12 @@ struct CallCtx {
   // (HTTP/redis-python/thrift ride their own Python-side recorders)
   int shard = 0;
   int telemetry_family = -1;
+  // overload plane (overload.h): the family this request was admitted
+  // under (-1 = not charged — plane off, or admitted before an
+  // enable), consumed by respond()'s release+sample; method_inflight
+  // is the per-method max_concurrency gauge to release there too
+  int ov_family = -1;
+  std::atomic<int64_t>* method_inflight = nullptr;
   uint32_t slot = 0;
   std::atomic<uint32_t> version{1};
   // cancellation (≙ server side of Controller::StartCancel +
@@ -725,6 +732,13 @@ struct ServiceHandler {
   int kind = 0;  // 0 native echo, 1 usercode callback
   HandlerCb cb = nullptr;
   void* user = nullptr;
+  // per-method max_concurrency override (≙ MaxConcurrencyOf, the
+  // constant limiter beside the adaptive overload plane): inflight
+  // points into the GLOBAL leaked slot pool (AllocMethodInflight —
+  // respond() may run after server_destroy), charged at dispatch,
+  // released in respond().  0 = uncapped.
+  int64_t max_concurrency = 0;
+  std::atomic<int64_t>* method_inflight = nullptr;
 };
 
 // Native redis cache (server_enable_redis_cache): the GET/SET-class
@@ -1038,6 +1052,39 @@ void SendResponse(SocketId sock_id, uint64_t correlation_id,
   s->Dereference();
 }
 
+// Inline fast-reject (overload.h, ISSUE 11): the ELIMIT answer for a
+// shed request is packed straight onto the drain's response cork — no
+// codec decode, no fiber, no usercode spawn, one tiny frame riding the
+// same flush as the admitted batch.  Mirrors SendResponse's meta shape
+// (incl. the device-caps probe answer) minus everything a reject never
+// carries.
+void ShedOnCork(Socket* s, IOBuf* out, uint64_t corr) {
+  RpcMeta rmeta;
+  rmeta.correlation_id = corr;
+  rmeta.flags = 1;  // response
+  rmeta.error_code = TRPC_ELIMIT;
+  rmeta.error_text = "rejected by overload control";
+  if (s->advertise_device_caps.load(std::memory_order_acquire)) {
+    rmeta.device_caps = ServerDeviceCaps();
+    rmeta.plane_uid = tpu_plane_uid();
+  }
+  PackFrame(out, rmeta, IOBuf(), IOBuf());
+}
+
+// Method resolution with the "Service.Method" -> "Service" fallback —
+// ONE definition for the overload admission check and the dispatch
+// path, so shed routing can never diverge from dispatch routing.
+ServiceHandler* ResolveHandler(Server* srv, const std::string& method) {
+  ServiceHandler* sh = srv->services.find(method);
+  if (sh == nullptr) {
+    size_t dot = method.find('.');
+    if (dot != std::string::npos) {
+      sh = srv->services.find(method.substr(0, dot));
+    }
+  }
+  return sh;
+}
+
 // --- ingress fast-path executors -------------------------------------------
 
 // Hold the socket's response doorbell for one parse drain: every response
@@ -1067,9 +1114,13 @@ struct EchoFiberArg {
   uint8_t compress;
   uint8_t codec;  // request's payload codec, mirrored on the response
   // telemetry (metrics.h): parse-loop arm stamp + owning shard so the
-  // spawned-fallback arm lands in the SAME histogram family as inline
+  // spawned-fallback arm lands in the SAME histogram family as inline.
+  // armed when telemetry OR the overload plane wants the latency;
+  // telem/ov say which consumer(s) get it
   int64_t arm_ns = 0;
   int shard = 0;
+  int8_t telem = 0;
+  int8_t ov = 0;  // overload sample only — the charge released at drain end
   IOBuf payload;
   IOBuf attachment;
 };
@@ -1079,8 +1130,16 @@ void EchoFiber(void* p) {
   SendResponse(a->sock, a->corr, 0, nullptr, std::move(a->payload),
                std::move(a->attachment), 0, 0, a->compress, a->codec);
   if (a->arm_ns > 0) {
-    telemetry_record(TF_INLINE_ECHO, a->shard,
-                     (monotonic_ns() - a->arm_ns) / 1000);
+    int64_t now_ns = monotonic_ns();
+    int64_t lat_us = (now_ns - a->arm_ns) / 1000;
+    if (a->telem) {
+      telemetry_record(TF_INLINE_ECHO, a->shard, lat_us);
+    }
+    if (a->ov) {
+      // deferred-release family: the gate already returned the charge
+      // when the drain ended; the spawned arm still feeds the window
+      overload_sample(TF_INLINE_ECHO, a->shard, lat_us, now_ns);
+    }
   }
   a->payload.clear();
   a->attachment.clear();
@@ -1094,8 +1153,10 @@ struct HbmEchoArg {
   SocketId sock;
   uint64_t corr;
   uint8_t codec = 0;  // request's payload codec, mirrored on the response
-  int64_t arm_ns = 0;  // telemetry arm stamp (coarse, from the parse loop)
+  int64_t arm_ns = 0;  // arm stamp (coarse, from the parse loop)
   int shard = 0;
+  int8_t telem = 0;
+  int8_t ov = 0;  // in-flight family: release + sample at completion
   IOBuf payload;
   IOBuf attachment;
 };
@@ -1124,9 +1185,15 @@ void HbmEchoFiber(void* p) {
   SendResponse(a->sock, a->corr, err, etext, std::move(a->payload),
                std::move(resp_attach), 0, 0, 0, a->codec);
   if (a->arm_ns > 0) {
-    telemetry_record(TF_HBM_ECHO, a->shard,
-                     (monotonic_ns() - a->arm_ns) / 1000);
-    telemetry_inflight_add(TF_HBM_ECHO, a->shard, -1);
+    int64_t now_ns = monotonic_ns();
+    int64_t lat_us = (now_ns - a->arm_ns) / 1000;
+    if (a->telem) {
+      telemetry_record(TF_HBM_ECHO, a->shard, lat_us);
+      telemetry_inflight_add(TF_HBM_ECHO, a->shard, -1);
+    }
+    if (a->ov) {
+      overload_on_complete(TF_HBM_ECHO, a->shard, lat_us, now_ns);
+    }
   }
   a->payload.clear();
   a->attachment.clear();
@@ -1330,6 +1397,8 @@ void DispatchHttp(Socket* s, Server* srv, HttpRequest&& req) {
   ctx->trace_id = 0;  // pooled slot: a prior TRPC use must not leak ids
   ctx->span_id = 0;
   ctx->telemetry_family = -1;
+  ctx->ov_family = -1;  // pooled slot: no stale overload charge
+  ctx->method_inflight = nullptr;
   ctx->hcb = srv->http_cb;
   ctx->user = srv->http_user;
   UsercodePool::Instance().Submit(ctx);
@@ -1413,6 +1482,8 @@ void DispatchH2(Socket* s, Server* srv, H2Request&& req) {
   ctx->trace_id = 0;  // pooled slot: a prior TRPC use must not leak ids
   ctx->span_id = 0;
   ctx->telemetry_family = -1;
+  ctx->ov_family = -1;  // pooled slot: no stale overload charge
+  ctx->method_inflight = nullptr;
   ctx->hcb = srv->http_cb;
   ctx->user = srv->http_user;
   UsercodePool::Instance().Submit(ctx);
@@ -1473,6 +1544,11 @@ void ServerOnMessages(Socket* s) {
   int64_t drain_ns = CoarseClockRefresh();
   InlineBudget budget(fast, drain_ns);
   bool telem = telemetry_enabled();
+  // overload-control admission scope (overload.h): one master-switch
+  // snapshot per drain; run-to-completion charges release when this
+  // gate dies, so the per-(shard,family) limit bounds the pipeline
+  // depth one drain may admit
+  OverloadGate ovgate(s->shard);
   CorkScope cork_scope(s, fast);
   // connections that completed the h2 preface stay h2 for life (is_h2
   // gates the registry mutex off the non-h2 hot path)
@@ -1712,6 +1788,8 @@ void ServerOnMessages(Socket* s) {
         rctx->trace_id = 0;  // pooled slot: no stale trace ids
         rctx->span_id = 0;
         rctx->telemetry_family = -1;
+        rctx->ov_family = -1;  // pooled slot: no stale overload charge
+        rctx->method_inflight = nullptr;
         rctx->rcb = srv->redis_cb;
         rctx->user = srv->redis_user;
         // per-KEY execution ordering (see ConnState.redis_key_q): run
@@ -1815,6 +1893,8 @@ void ServerOnMessages(Socket* s) {
         tctx->trace_id = 0;  // pooled slot: no stale trace ids
         tctx->span_id = 0;
         tctx->telemetry_family = -1;
+        tctx->ov_family = -1;  // pooled slot: no stale overload charge
+        tctx->method_inflight = nullptr;
         tctx->rcb = srv->thrift_cb;
         tctx->user = srv->thrift_user;
         UsercodePool::Instance().Submit(tctx);
@@ -1924,6 +2004,8 @@ void ServerOnMessages(Socket* s) {
           uctx->trace_id = 0;  // pooled slot: no stale trace ids
           uctx->span_id = 0;
           uctx->telemetry_family = -1;
+          uctx->ov_family = -1;  // pooled slot: no stale overload charge
+          uctx->method_inflight = nullptr;
           uctx->rcb = (RedisHandlerCb)up.handler;
           uctx->user = up.user;
           UsercodePool::Instance().Submit(uctx);
@@ -2027,6 +2109,39 @@ void ServerOnMessages(Socket* s) {
         s->peer_plane_uid.store(meta.plane_uid, std::memory_order_release);
       }
     }
+    // Overload admission (overload.h, ISSUE 11): with the plane on,
+    // resolve the handler FIRST (the same flat-map find dispatch needs
+    // anyway) and admit/shed BEFORE the codec decode — a shed request
+    // costs one frame parse plus one ELIMIT frame on the cork: no
+    // decode, no fiber, no usercode spawn (the acceptance proof holds
+    // the decode/spawn counters flat across a shed flood).  Plane off:
+    // sh stays null here and the pre-ISSUE order runs untouched.
+    ServiceHandler* sh = nullptr;
+    int ov_fam = -1;
+    bool ov_deferred = false;
+    if (ovgate.on) {
+      sh = ResolveHandler(srv, meta.method);
+      if (sh != nullptr) {
+        ov_fam = sh->kind == 0   ? TF_INLINE_ECHO
+                 : sh->kind == 2 ? TF_HBM_ECHO
+                                 : TF_USERCODE;
+        // run-to-completion echo releases at drain end (the limit
+        // bounds the admitted pipeline depth — the dominant latency
+        // term for µs-scale handlers); HbmEcho/usercode release at
+        // completion (the limit bounds queued+running work, the
+        // reference limiter's shape)
+        ov_deferred = sh->kind == 0;
+        if (!overload_admit(&ovgate, ov_fam, ov_deferred)) {
+          // shed requests still count as requests (the per-method-cap
+          // and backlog ELIMIT paths count them too): request_count -
+          // overload_rejects stays one arithmetic whichever limiter
+          // fired
+          srv->nrequests.fetch_add(1, std::memory_order_relaxed);
+          ShedOnCork(s, &batched_out, meta.correlation_id);
+          continue;
+        }
+      }
+    }
     // Payload-codec rail (codec.h): decode ON THIS PARSE FIBER — the
     // socket's owning shard — so downstream dispatch (inline echo,
     // HbmEcho DMA, usercode) sees plain bytes and shard confinement
@@ -2039,6 +2154,10 @@ void ServerOnMessages(Socket* s) {
            codec_decode(meta.payload_codec, &payload) != 0) ||
           (meta.attach_codec != 0 &&
            codec_decode(meta.attach_codec, &attachment) != 0)) {
+        if (ov_fam >= 0) {
+          // admitted but never dispatched: return the charge unfed
+          overload_unadmit(&ovgate, ov_fam, ov_deferred);
+        }
         native_metrics().parse_errors.fetch_add(1,
                                                 std::memory_order_relaxed);
         SendResponse(s->id(), meta.correlation_id, TRPC_EREQUEST,
@@ -2047,13 +2166,8 @@ void ServerOnMessages(Socket* s) {
       }
     }
     srv->nrequests.fetch_add(1, std::memory_order_relaxed);
-    ServiceHandler* sh = srv->services.find(meta.method);
     if (sh == nullptr) {
-      // service-level fallback: "Service.Method" -> "Service"
-      size_t dot = meta.method.find('.');
-      if (dot != std::string::npos) {
-        sh = srv->services.find(meta.method.substr(0, dot));
-      }
+      sh = ResolveHandler(srv, meta.method);
     }
     if (sh == nullptr) {
       SendResponse(s->id(), meta.correlation_id, TRPC_ENOMETHOD,
@@ -2086,22 +2200,30 @@ void ServerOnMessages(Socket* s) {
           // re-encode with the request's codec, still on the parse fiber
           rmeta.payload_codec = codec_encode(req_codec, &payload);
           PackFrame(&batched_out, rmeta, std::move(payload), IOBuf());
-          if (telem) {
-            int64_t lat_us = (monotonic_ns() - drain_ns) / 1000;
-            telemetry_record(TF_HBM_ECHO, s->shard, lat_us);
-            if (rpcz_try_sample()) {
-              NativeSpan sp;
-              sp.trace_id = meta.trace_id != 0 ? meta.trace_id
-                                               : rpcz_next_id();
-              sp.span_id = rpcz_next_id();
-              sp.parent_span_id = meta.span_id;
-              sp.family = TF_HBM_ECHO;
-              sp.shard = s->shard;
-              sp.start_mono_ns = drain_ns;
-              sp.latency_us = lat_us;
-              trace_take_annotations(sp.annotations,
-                                     sizeof(sp.annotations));
-              rpcz_capture(sp);
+          if (telem || ov_fam >= 0) {
+            int64_t done_ns = monotonic_ns();
+            int64_t lat_us = (done_ns - drain_ns) / 1000;
+            if (ov_fam >= 0) {
+              // in-flight family, inline arm: work done — release +
+              // feed the gradient window right here
+              overload_on_complete(ov_fam, s->shard, lat_us, done_ns);
+            }
+            if (telem) {
+              telemetry_record(TF_HBM_ECHO, s->shard, lat_us);
+              if (rpcz_try_sample()) {
+                NativeSpan sp;
+                sp.trace_id = meta.trace_id != 0 ? meta.trace_id
+                                                 : rpcz_next_id();
+                sp.span_id = rpcz_next_id();
+                sp.parent_span_id = meta.span_id;
+                sp.family = TF_HBM_ECHO;
+                sp.shard = s->shard;
+                sp.start_mono_ns = drain_ns;
+                sp.latency_us = lat_us;
+                trace_take_annotations(sp.annotations,
+                                       sizeof(sp.annotations));
+                rpcz_capture(sp);
+              }
             }
           }
           continue;
@@ -2113,8 +2235,10 @@ void ServerOnMessages(Socket* s) {
       a->sock = s->id();
       a->corr = meta.correlation_id;
       a->codec = req_codec;
-      a->arm_ns = telem ? drain_ns : 0;
+      a->arm_ns = (telem || ov_fam >= 0) ? drain_ns : 0;
       a->shard = s->shard;
+      a->telem = telem ? 1 : 0;
+      a->ov = ov_fam >= 0 ? 1 : 0;  // release + sample in HbmEchoFiber
       a->payload = std::move(payload);
       a->attachment = std::move(attachment);
       if (telem) {
@@ -2124,8 +2248,13 @@ void ServerOnMessages(Socket* s) {
       }
       fiber_t f;
       if (fiber_start(&f, HbmEchoFiber, a) != 0) {
-        if (a->arm_ns > 0) {
+        if (a->telem) {
           telemetry_inflight_add(TF_HBM_ECHO, a->shard, -1);
+        }
+        if (a->ov) {
+          // never dispatched: return the charge unfed, keeping `admits`
+          // = requests actually dispatched (like the codec-error path)
+          overload_unadmit(&ovgate, TF_HBM_ECHO, false);
         }
         a->payload.clear();
         a->attachment.clear();
@@ -2166,25 +2295,36 @@ void ServerOnMessages(Socket* s) {
         }
         PackFrame(&batched_out, rmeta, std::move(payload),
                   std::move(attachment));
-        if (telem) {
+        if (telem || ov_fam >= 0) {
           // the histogram write /status and the overload gradient read:
-          // one clock syscall + two relaxed adds on this shard's agent
-          int64_t lat_us = (monotonic_ns() - drain_ns) / 1000;
-          telemetry_record(TF_INLINE_ECHO, s->shard, lat_us);
-          if (rpcz_try_sample()) {
-            // fast-path span: /rpcz finally sees inline-dispatched
-            // requests; inbound tags 7/8 parent it into the caller's tree
-            NativeSpan sp;
-            sp.trace_id = meta.trace_id != 0 ? meta.trace_id
-                                             : rpcz_next_id();
-            sp.span_id = rpcz_next_id();
-            sp.parent_span_id = meta.span_id;
-            sp.family = TF_INLINE_ECHO;
-            sp.shard = s->shard;
-            sp.start_mono_ns = drain_ns;
-            sp.latency_us = lat_us;
-            trace_take_annotations(sp.annotations, sizeof(sp.annotations));
-            rpcz_capture(sp);
+          // one clock syscall + a few relaxed adds on this shard's agent
+          int64_t done_ns = monotonic_ns();
+          int64_t lat_us = (done_ns - drain_ns) / 1000;
+          if (ov_fam >= 0) {
+            // deferred-release family: the gate returns the charge at
+            // drain end — here we only feed the queue-inclusive sample
+            // (the Kth pipelined request carries its in-drain wait)
+            overload_sample(ov_fam, s->shard, lat_us, done_ns);
+          }
+          if (telem) {
+            telemetry_record(TF_INLINE_ECHO, s->shard, lat_us);
+            if (rpcz_try_sample()) {
+              // fast-path span: /rpcz finally sees inline-dispatched
+              // requests; inbound tags 7/8 parent it into the caller's
+              // tree
+              NativeSpan sp;
+              sp.trace_id = meta.trace_id != 0 ? meta.trace_id
+                                               : rpcz_next_id();
+              sp.span_id = rpcz_next_id();
+              sp.parent_span_id = meta.span_id;
+              sp.family = TF_INLINE_ECHO;
+              sp.shard = s->shard;
+              sp.start_mono_ns = drain_ns;
+              sp.latency_us = lat_us;
+              trace_take_annotations(sp.annotations,
+                                     sizeof(sp.annotations));
+              rpcz_capture(sp);
+            }
           }
         }
       } else {
@@ -2198,8 +2338,10 @@ void ServerOnMessages(Socket* s) {
         a->corr = meta.correlation_id;
         a->compress = meta.compress_type;
         a->codec = req_codec;
-        a->arm_ns = telem ? drain_ns : 0;
+        a->arm_ns = (telem || ov_fam >= 0) ? drain_ns : 0;
         a->shard = s->shard;
+        a->telem = telem ? 1 : 0;
+        a->ov = ov_fam >= 0 ? 1 : 0;  // sample-only (gate owns the release)
         a->payload = std::move(payload);
         a->attachment = std::move(attachment);
         fiber_t f;
@@ -2211,9 +2353,35 @@ void ServerOnMessages(Socket* s) {
       if (!UsercodeAdmit()) {
         // flood of requests into a slow handler pool: reject instead of
         // queueing unboundedly (≙ ELIMIT from the concurrency limiter)
+        if (ov_fam >= 0) {
+          // the adaptive charge was taken pre-decode: return it unfed
+          overload_unadmit(&ovgate, ov_fam, false);
+        }
+        // the reject block covers EVERY ELIMIT this parse fiber issues
+        // (overload.h contract), backstop included
+        overload_note_shed(TF_USERCODE, s->shard);
         SendResponse(s->id(), meta.correlation_id, TRPC_ELIMIT,
                      "usercode backlog full", IOBuf(), IOBuf());
         continue;
+      }
+      if (h.max_concurrency > 0) {
+        // per-method max_concurrency override (≙ MaxConcurrencyOf): a
+        // constant cap beside the adaptive plane, charged here and
+        // released in respond() — the reject rides the cork like any
+        // shed (no ctx, no spawn)
+        int64_t mc = h.method_inflight->fetch_add(
+            1, std::memory_order_relaxed);
+        if (mc >= h.max_concurrency) {
+          h.method_inflight->fetch_sub(1, std::memory_order_relaxed);
+          if (ov_fam >= 0) {
+            overload_unadmit(&ovgate, ov_fam, false);
+          }
+          // the cap works with the plane off too: count the shed so
+          // /status's reject block covers every ELIMIT issued here
+          overload_note_shed(TF_USERCODE, s->shard);
+          ShedOnCork(s, &batched_out, meta.correlation_id);
+          continue;
+        }
       }
       CallCtx* ctx = nullptr;
       uint32_t slot = ResourcePool<CallCtx>::Get(&ctx);
@@ -2246,6 +2414,11 @@ void ServerOnMessages(Socket* s) {
       ctx->span_id = meta.span_id;
       ctx->shard = s->shard;
       ctx->telemetry_family = telem ? TF_USERCODE : -1;
+      // overload release + gradient sample happen in respond() with the
+      // queue-inclusive latency (arm_ns -> response handoff)
+      ctx->ov_family = ov_fam;
+      ctx->method_inflight =
+          h.max_concurrency > 0 ? h.method_inflight : nullptr;
       if (telem) {
         telemetry_inflight_add(TF_USERCODE, s->shard, 1);
       }
@@ -2375,6 +2548,41 @@ int server_add_service(Server* s, const char* name, int kind, HandlerCb cb,
   h.cb = cb;
   h.user = user;
   s->services.insert(name, h);
+  return 0;
+}
+
+// Per-method inflight gauges for max_concurrency overrides — GLOBAL and
+// leaked by design, NOT Server-owned: CallCtx carries a bare pointer
+// that respond() dereferences on a usercode-pool thread, and nothing in
+// server_destroy waits for in-flight handlers (they hold no socket
+// ref), so Server-owned storage would be a write-after-free when a
+// handler finishes after the destroy.  Bounded by registrations (one
+// slot per capped method per server lifetime); deque = stable
+// addresses.  The mutex guards pre-start registration only — never
+// touched by the parse loop or respond().
+std::mutex g_method_inflights_mu;  // lint:allow-blocking-bounded (pre-start registration only, one emplace under it)
+
+std::atomic<int64_t>* AllocMethodInflight() {
+  static std::deque<std::atomic<int64_t>>* slots =
+      new std::deque<std::atomic<int64_t>>();  // leaked on purpose
+  std::lock_guard lk(g_method_inflights_mu);
+  slots->emplace_back(0);
+  return &slots->back();
+}
+
+int server_set_method_max_concurrency(Server* s, const char* method,
+                                      int64_t n) {
+  if (s->running.load(std::memory_order_acquire)) {
+    return -EBUSY;  // the parse loop reads the handler table lock-free
+  }
+  ServiceHandler* h = s->services.find(method);
+  if (h == nullptr) {
+    return -ENOENT;  // register the service first
+  }
+  if (h->method_inflight == nullptr && n > 0) {
+    h->method_inflight = AllocMethodInflight();
+  }
+  h->max_concurrency = n > 0 ? n : 0;
   return 0;
 }
 
@@ -2898,14 +3106,26 @@ int respond(uint64_t token, int32_t error_code, const char* error_text,
                std::move(payload), std::move(attachment), accepted,
                accepted != 0 ? stream_window(accepted) : 0, compress_type,
                ctx->payload_codec);
-  if (ctx->telemetry_family >= 0) {
+  if (ctx->telemetry_family >= 0 || ctx->ov_family >= 0) {
     // queue-INCLUSIVE usercode latency: parse-loop arm stamp -> response
     // handed to the socket (the number /status could never show before —
-    // inline fast paths have their own families in the same histograms)
-    telemetry_record(ctx->telemetry_family, ctx->shard,
-                     (monotonic_ns() - ctx->arm_ns) / 1000);
-    telemetry_inflight_add(ctx->telemetry_family, ctx->shard, -1);
-    ctx->telemetry_family = -1;
+    // inline fast paths have their own families in the same histograms).
+    // One clock read feeds both the histogram and the overload gradient.
+    int64_t done_ns = monotonic_ns();
+    int64_t lat_us = (done_ns - ctx->arm_ns) / 1000;
+    if (ctx->telemetry_family >= 0) {
+      telemetry_record(ctx->telemetry_family, ctx->shard, lat_us);
+      telemetry_inflight_add(ctx->telemetry_family, ctx->shard, -1);
+      ctx->telemetry_family = -1;
+    }
+    if (ctx->ov_family >= 0) {
+      overload_on_complete(ctx->ov_family, ctx->shard, lat_us, done_ns);
+      ctx->ov_family = -1;
+    }
+  }
+  if (ctx->method_inflight != nullptr) {
+    ctx->method_inflight->fetch_sub(1, std::memory_order_relaxed);
+    ctx->method_inflight = nullptr;
   }
   if (ctx->cancel_registered) {
     // ordering matters: unregister BEFORE the version bump, so a racing

@@ -272,6 +272,16 @@ void channel_request_device_plane(Channel* c, int enable);
 // most recent completed call rode).
 int channel_transport_state(Channel* c);
 
+// Per-method max_concurrency override (≙ MaxConcurrencyOf(server,
+// method), server.h — the constant limiter beside the adaptive overload
+// plane in overload.h): beyond `n` queued+running requests of `method`,
+// the parse fiber answers TRPC_ELIMIT on the response cork without
+// decoding or spawning.  Pre-start only; n<=0 clears.  Applies to
+// usercode methods (kind 1) — native echo families ride the per-family
+// overload plane.  Returns 0 / -EBUSY (started) / -ENOENT (no method).
+int server_set_method_max_concurrency(Server* s, const char* method,
+                                      int64_t n);
+
 // size of the pthread pool running Python handlers (before first request)
 void set_usercode_workers(int n);
 // TRPC usercode in-flight cap (queued + running); beyond it requests get

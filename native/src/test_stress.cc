@@ -34,6 +34,7 @@
 #include "execution_queue.h"
 #include "metrics.h"
 #include "fiber.h"
+#include "overload.h"
 #include "shard.h"
 #include "fiber_sync.h"
 #include "iobuf.h"
@@ -2720,6 +2721,178 @@ static void test_telemetry_races() {
   printf("ok telemetry_races (forced-shards child rc=%d)\n", rc);
 }
 
+// Child body (TRPC_SHARDS=2): the ISSUE-11 overload plane ITSELF under
+// races — (a) the reloadable overload flags (master switch + min/max
+// concurrency + window) flipping under live traffic, incl. a
+// tight-limit arm that forces real sheds, (b) inline fast-rejects
+// packed onto both shards' corks racing admitted dispatch and the
+// drain-end deferred releases, (c) the usercode in-flight family (slow
+// handlers behind a per-method max_concurrency cap) releasing charges
+// in respond() on pool threads while parse fibers admit/shed, (d) the
+// CAS-claimed gradient window folds racing completions on both shards
+// plus concurrent /vars + Prometheus read folds, and (e) server restart
+// rounds tearing connections down under all of it — every charge must
+// balance back to zero once traffic stops.
+static void overload_slow_handler(uint64_t token, const char*,
+                                  const uint8_t* req, size_t req_len,
+                                  const uint8_t*, size_t, void*) {
+  usleep(50 + fast_rand() % 300);
+  respond(token, 0, nullptr, req, req_len, nullptr, 0, 0);
+}
+
+static void overload_child_body() {
+  CHECK_TRUE(shard_count() == 2);
+  fiber_runtime_init(4);
+  set_overload(1);
+  set_overload_min_concurrency(1);
+  set_overload_max_concurrency(64);
+  set_overload_window_ms(10);
+
+  Server* probe = server_create();
+  CHECK_TRUE(server_start(probe, "127.0.0.1", 0) == 0);
+  int port = server_port(probe);
+  server_destroy(probe);
+
+  std::atomic<bool> stop{false};
+  std::atomic<uint64_t> ok{0}, shed{0}, failed{0};
+  std::vector<std::thread> ts;
+
+  // (a) flag flipper: the master switch, the clamps (incl. a 1-2 tight
+  // arm that guarantees sheds) and the window length all cycle live
+  ts.emplace_back([&] {
+    int i = 0;
+    while (!stop.load(std::memory_order_acquire)) {
+      set_overload((i & 7) != 7 ? 1 : 0);  // mostly on, real off windows
+      set_overload_min_concurrency(1 + (i % 3));
+      set_overload_max_concurrency((i & 1) ? 2 : 64);
+      set_overload_window_ms(5 + (i % 3) * 15);
+      ++i;
+      usleep(800);
+    }
+    set_overload(1);
+    set_overload_min_concurrency(1);
+    set_overload_max_concurrency(64);
+    set_overload_window_ms(10);
+  });
+
+  // (b) echo hammers on single + pooled connections: admitted inline
+  // echoes and corked ELIMIT sheds interleave on both shards' drains
+  for (int t = 0; t < 3; ++t) {
+    ts.emplace_back([&, t] {
+      Channel* ch = channel_create("127.0.0.1", port);
+      channel_set_connection_type(ch, t % 2);
+      channel_set_connect_timeout(ch, 100 * 1000);
+      std::string payload(128, 'o');
+      CallResult res;
+      while (!stop.load(std::memory_order_acquire)) {
+        int rc = channel_call(ch, "Echo", (const uint8_t*)payload.data(),
+                              payload.size(), nullptr, 0, 300 * 1000,
+                              &res);
+        if (rc == 0) {
+          ok.fetch_add(1);
+        } else if (rc == TRPC_ELIMIT) {
+          shed.fetch_add(1);
+        } else {
+          failed.fetch_add(1);
+        }
+      }
+      channel_destroy(ch);
+    });
+  }
+
+  // (c) usercode callers against the capped Slow method: the in-flight
+  // family's respond()-side releases race the parse-fiber admits, and
+  // the per-method cap (2) sheds the excess on the cork
+  for (int t = 0; t < 2; ++t) {
+    ts.emplace_back([&] {
+      Channel* ch = channel_create("127.0.0.1", port);
+      channel_set_connect_timeout(ch, 100 * 1000);
+      std::string payload(64, 'u');
+      CallResult res;
+      while (!stop.load(std::memory_order_acquire)) {
+        int rc = channel_call(ch, "Slow", (const uint8_t*)payload.data(),
+                              payload.size(), nullptr, 0, 500 * 1000,
+                              &res);
+        if (rc == 0) {
+          ok.fetch_add(1);
+        } else if (rc == TRPC_ELIMIT) {
+          shed.fetch_add(1);
+        } else {
+          failed.fetch_add(1);
+        }
+      }
+      channel_destroy(ch);
+    });
+  }
+
+  // (d) reader: /vars + Prometheus dumps fold the per-shard agents
+  // (limits, inflight, rejects) while both shards write them
+  ts.emplace_back([&] {
+    std::vector<char> buf(256 * 1024);
+    while (!stop.load(std::memory_order_acquire)) {
+      native_metrics_dump(buf.data(), buf.size());
+      telemetry_prom_dump(buf.data(), buf.size());
+      for (int f = 0; f < TF_FAMILIES; ++f) {
+        (void)overload_limit(f);
+        (void)overload_inflight(f);
+        (void)overload_rejects(f);
+      }
+      usleep(1500);
+    }
+  });
+
+  // (e) restart rounds: teardown fails live connections mid-admission —
+  // deferred drain-end releases and respond()-side releases must both
+  // survive the socket dying under them
+  for (int round = 0; round < 4; ++round) {
+    Server* srv = server_create();
+    server_add_service(srv, "Echo", 0, nullptr, nullptr);
+    server_add_service(srv, "Slow", 1, overload_slow_handler, nullptr);
+    CHECK_TRUE(server_set_method_max_concurrency(srv, "Slow", 2) == 0);
+    if (server_start(srv, "127.0.0.1", port) != 0) {
+      server_destroy(srv);
+      usleep(50 * 1000);
+      continue;
+    }
+    usleep(700 * 1000);
+    server_destroy(srv);
+    usleep(50 * 1000);
+  }
+  stop.store(true, std::memory_order_release);
+  for (auto& th : ts) {
+    th.join();
+  }
+  CHECK_TRUE(ok.load() > 0);
+  CHECK_TRUE(shed.load() > 0);  // the tight-limit arm really shed
+  CHECK_TRUE(overload_admits_total() > 0);
+  CHECK_TRUE(overload_rejects_total() > 0);
+  // every charge balances once traffic stops: the usercode pool may
+  // still be draining respond()s, so wait bounded for the gauges
+  int64_t deadline = monotonic_us() + 10 * 1000 * 1000;
+  while (monotonic_us() < deadline &&
+         (overload_inflight(TF_INLINE_ECHO) != 0 ||
+          overload_inflight(TF_HBM_ECHO) != 0 ||
+          overload_inflight(TF_USERCODE) != 0)) {
+    usleep(2000);
+  }
+  CHECK_TRUE(overload_inflight(TF_INLINE_ECHO) == 0);
+  CHECK_TRUE(overload_inflight(TF_HBM_ECHO) == 0);
+  CHECK_TRUE(overload_inflight(TF_USERCODE) == 0);
+  printf("ok overload (child) ok=%llu shed=%llu failed=%llu "
+         "admits=%llu rejects=%llu windows=%llu\n",
+         (unsigned long long)ok.load(), (unsigned long long)shed.load(),
+         (unsigned long long)failed.load(),
+         (unsigned long long)overload_admits_total(),
+         (unsigned long long)overload_rejects_total(),
+         (unsigned long long)overload_windows_total());
+}
+
+static void test_overload_races() {
+  int rc = run_forced_shards_child("__overload_body", "2");
+  CHECK_TRUE(rc == 0);
+  printf("ok overload_races (forced-shards child rc=%d)\n", rc);
+}
+
 // --- scenario registry + driver ---------------------------------------------
 // The default (no-args) run IS the sanitized gate: tools/lint.py
 // enforces that every test_*_races function above appears in this table,
@@ -2756,6 +2929,7 @@ static const Scenario kScenarios[] = {
     {"shard_handoff_races", test_shard_handoff_races},
     {"reuseport_accept_races", test_reuseport_accept_races},
     {"telemetry_races", test_telemetry_races},
+    {"overload_races", test_overload_races},
 };
 constexpr int kNumScenarios = (int)(sizeof(kScenarios) / sizeof(kScenarios[0]));
 
@@ -2883,6 +3057,10 @@ int main(int argc, char** argv) {
   }
   if (argc > 1 && strcmp(argv[1], "__telemetry_body") == 0) {
     telemetry_child_body();
+    return g_failures == 0 ? 0 : 1;
+  }
+  if (argc > 1 && strcmp(argv[1], "__overload_body") == 0) {
+    overload_child_body();
     return g_failures == 0 ? 0 : 1;
   }
   if (argc > 1 && strcmp(argv[1], "--list") == 0) {

@@ -1,0 +1,432 @@
+"""Overload-control plane (ISSUE 11, native/src/overload.h).
+
+Three layers, reference-style (real loopback sockets, no mocks):
+
+* deterministic gradient math — the per-(shard,family) limit adapts
+  from synthetic sample windows driven through the capi test hook
+  (trpc_overload_test_feed passes the clock, so the adaptation is a
+  pure function of the fed sequence — no sockets, no real time);
+* live shedding — a tight limit against a real echo server answers the
+  excess with ELIMIT on the parse fiber, /status shows the per-family
+  limit/inflight/reject block, and decode/spawn counters prove the shed
+  path never dispatched;
+* the client survival loop — TRPC_ELIMIT retries on a DIFFERENT replica
+  (ExcludedServers), feeds the breaker softly (never isolates by
+  itself), and a non-idempotent method still executes at most once
+  under shed-and-retry.
+"""
+
+import ctypes
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from brpc_tpu._native import lib
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.channel import Channel, ChannelOptions
+from brpc_tpu.rpc.server import Server, ServerOptions
+
+# inert coordinates for the synthetic-feed tests: the fanout_group
+# family is never fed by server-side traffic, so its agent state is
+# fully owned by the test (shard 0 = the only folded shard here)
+FAM = 5   # TF_FANOUT_GROUP
+SHARD = 0
+MIN_C, MAX_C, WINDOW_MS = 16, 4096, 100
+INIT_LIMIT = 4 * MIN_C  # eff_limit() default before the first fold
+
+
+@pytest.fixture
+def overload_plane():
+    """Arm the plane with known knobs; restore the inert default (off)
+    afterwards so unrelated tests in this process see today's behavior."""
+    L = lib()
+    L.trpc_set_overload_min_concurrency(MIN_C)
+    L.trpc_set_overload_max_concurrency(MAX_C)
+    L.trpc_set_overload_window_ms(WINDOW_MS)
+    L.trpc_overload_test_reset(FAM, SHARD)
+    yield L
+    L.trpc_set_overload(0)
+    L.trpc_set_overload_min_concurrency(MIN_C)
+    L.trpc_set_overload_max_concurrency(MAX_C)
+    L.trpc_set_overload_window_ms(WINDOW_MS)
+    L.trpc_overload_test_reset(FAM, SHARD)
+
+
+def _fold(L, lat_us, count, t_open_ns, t_close_ns):
+    """One closed sample window: `count` samples of lat_us opened at
+    t_open, folded at t_close."""
+    L.trpc_overload_test_feed(FAM, SHARD, lat_us, count, t_open_ns)
+    L.trpc_overload_test_feed(FAM, SHARD, lat_us, 1, t_close_ns)
+
+
+# --- gradient math (deterministic, no sockets) ------------------------------
+
+def test_gradient_grows_on_headroom(overload_plane):
+    """High throughput at a stable no-load latency = headroom: the
+    limit must grow past its initial value toward the Little's-law
+    target (peak_qps x (1+alpha) x floor)."""
+    L = overload_plane
+    t = 1_000_000_000
+    assert L.trpc_overload_limit(FAM) == INIT_LIMIT
+    step = WINDOW_MS * 2 * 1_000_000
+    # ~100k qps at 1ms latency, window after window
+    for i in range(3):
+        _fold(L, 1000, 20_000, t + i * step, t + (i + 1) * step)
+    grown = L.trpc_overload_limit(FAM)
+    assert grown > INIT_LIMIT, f"limit {grown} never grew past {INIT_LIMIT}"
+
+
+def test_gradient_shrinks_on_latency_inflation(overload_plane):
+    """Latency inflating far past the learned floor = overload: the
+    limit must shrink from its grown value."""
+    L = overload_plane
+    t = 1_000_000_000
+    step = WINDOW_MS * 2 * 1_000_000
+    for i in range(3):
+        _fold(L, 1000, 20_000, t + i * step, t + (i + 1) * step)
+    grown = L.trpc_overload_limit(FAM)
+    assert grown > INIT_LIMIT
+    # same offered qps, latency x5 the floor: gradient goes negative
+    for i in range(3, 6):
+        _fold(L, 5000, 20_000, t + i * step, t + (i + 1) * step)
+    shrunk = L.trpc_overload_limit(FAM)
+    assert shrunk < grown, f"limit {shrunk} never shrank from {grown}"
+
+
+def test_gradient_floors_at_min_concurrency(overload_plane):
+    """Sustained inflation at low throughput decays the limit to the
+    min_concurrency floor and NEVER below it (the floor is the working
+    limit for families whose target sits under it)."""
+    L = overload_plane
+    t = 1_000_000_000
+    step = WINDOW_MS * 2 * 1_000_000
+    for i in range(2):
+        _fold(L, 1000, 20_000, t + i * step, t + (i + 1) * step)
+    # low qps (64 samples / window ~= 320/s), latency x50 the floor:
+    # the target stays negative while the floor EMA crawls, so the
+    # limit halves toward — and clamps at — min_concurrency
+    for i in range(2, 10):
+        _fold(L, 50_000, 64, t + i * step, t + (i + 1) * step)
+    assert L.trpc_overload_limit(FAM) == MIN_C
+
+
+def test_reloaded_clamps_bind_immediately(overload_plane):
+    """Hot-reloading overload_{min,max}_concurrency must bind on the
+    very next admission, without waiting for a window fold a quiet
+    family may never produce (the stored adapted limit is clamped on
+    every read)."""
+    L = overload_plane
+    t = 1_000_000_000
+    step = WINDOW_MS * 2 * 1_000_000
+    for i in range(3):
+        _fold(L, 1000, 20_000, t + i * step, t + (i + 1) * step)
+    grown = L.trpc_overload_limit(FAM)
+    assert grown > INIT_LIMIT
+    L.trpc_set_overload_max_concurrency(8)
+    assert L.trpc_overload_limit(FAM) == 8  # no fold needed
+    L.trpc_set_overload_max_concurrency(MAX_C)
+    L.trpc_set_overload_min_concurrency(grown + 100)
+    assert L.trpc_overload_limit(FAM) == grown + 100
+    L.trpc_set_overload_min_concurrency(MIN_C)
+    assert L.trpc_overload_limit(FAM) == grown  # adapted value intact
+
+
+def test_starved_window_never_folds(overload_plane):
+    """Below kMinWindowSamples the window must not fold: a traffic
+    trickle computing nonsense qps would wreck the learned state."""
+    L = overload_plane
+    t = 1_000_000_000
+    L.trpc_overload_test_feed(FAM, SHARD, 999_999, 10, t)
+    L.trpc_overload_test_feed(FAM, SHARD, 999_999, 10,
+                              t + 10 * WINDOW_MS * 1_000_000)
+    assert L.trpc_overload_limit(FAM) == INIT_LIMIT  # unadapted
+
+
+# --- live shedding on a loopback echo server --------------------------------
+
+def test_inline_shed_and_status_block(overload_plane):
+    """A tight limit against real pipelined echo load: the excess is
+    answered ELIMIT from the parse fiber (no decode, no spawn — the
+    usercode/codec counters stay flat), admitted calls still succeed,
+    /status shows the live per-family limit/reject block, and every
+    charge balances back to zero."""
+    L = overload_plane
+
+    def counters():
+        buf = ctypes.create_string_buffer(1 << 16)
+        n = L.trpc_native_metrics_dump(buf, len(buf))
+        return dict((k, int(v)) for k, _, v in
+                    (ln.partition(" ")
+                     for ln in buf.raw[:n].decode().splitlines()) if v)
+
+    s = Server()
+    s.add_echo_service()
+    port = s.start("127.0.0.1:0")
+    try:
+        L.trpc_set_overload(1)
+        L.trpc_set_overload_max_concurrency(1)  # everything beyond 1 sheds
+        before = counters()
+        ok = shed = other = 0
+        lock = threading.Lock()
+
+        def hammer():
+            nonlocal ok, shed, other
+            ch = Channel(f"127.0.0.1:{port}",
+                         ChannelOptions(max_retry=0, timeout_ms=5000))
+            l_ok = l_shed = l_other = 0
+            for _ in range(300):
+                try:
+                    ch.call("Echo", b"x" * 128)
+                    l_ok += 1
+                except errors.RpcError as e:
+                    if e.code == errors.ELIMIT:
+                        l_shed += 1
+                    else:
+                        l_other += 1
+            ch.close()
+            with lock:
+                ok += l_ok
+                shed += l_shed
+                other += l_other
+
+        ts = [threading.Thread(target=hammer) for _ in range(8)]
+        for th in ts:
+            th.start()
+        for th in ts:
+            th.join()
+        after = counters()
+        assert other == 0
+        assert ok > 0, "everything was shed — the limiter starved the server"
+        assert shed > 0, "nothing was shed at limit 1 under 8-way load"
+        d = lambda k: after.get(k, 0) - before.get(k, 0)  # noqa: E731
+        assert d("native_overload_rejects") == shed
+        assert d("native_overload_rejects_inline_echo") == shed
+        # ~0-cost proof: the shed path never decoded or spawned —
+        # usercode never saw these requests and no codec ran
+        assert d("native_usercode_submitted") == 0
+        assert d("native_codec_decodes") == 0
+        assert after["native_overload_inflight_inline_echo"] == 0
+        # /status surfaces the live block
+        st = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/status", timeout=10).read())
+        ov = st["overload"]
+        assert ov["enabled"] is True
+        fam = ov["families"]["inline_echo"]
+        assert fam["limit"] >= 1
+        assert fam["rejects"] >= shed  # counter is process-cumulative
+        assert fam["inflight"] == 0
+    finally:
+        s.destroy()
+
+
+def test_overload_off_is_inert(overload_plane):
+    """With TRPC_OVERLOAD unset (the default), the plane must not
+    admit, charge, or shed anything — behavior-identical to before."""
+    L = overload_plane
+    L.trpc_set_overload(0)
+
+    def totals():
+        buf = ctypes.create_string_buffer(1 << 16)
+        n = L.trpc_native_metrics_dump(buf, len(buf))
+        d = dict((k, int(v)) for k, _, v in
+                 (ln.partition(" ")
+                  for ln in buf.raw[:n].decode().splitlines()) if v)
+        return d["native_overload_admits"], d["native_overload_rejects"]
+
+    s = Server()
+    s.add_echo_service()
+    port = s.start("127.0.0.1:0")
+    try:
+        a0, r0 = totals()
+        ch = Channel(f"127.0.0.1:{port}", ChannelOptions(max_retry=0))
+        for _ in range(100):
+            ch.call("Echo", b"y" * 64)
+        ch.close()
+        a1, r1 = totals()
+        assert (a1 - a0, r1 - r0) == (0, 0)
+    finally:
+        s.destroy()
+
+
+# --- client survival loop: ELIMIT retries elsewhere, at most once -----------
+
+def test_shed_retries_on_different_replica_at_most_once(overload_plane):
+    """Satellite 1: TRPC_ELIMIT is retryable-on-a-different-replica
+    (ExcludedServers) and breaker-SOFT.  replica 1's only Work slot is
+    occupied (per-method max_concurrency=1), so every Work call the LB
+    lands there is shed and must complete on replica 2 — and because a
+    shed request never executed, the non-idempotent handler runs AT
+    MOST ONCE per call: the execution counters add up exactly."""
+    L = overload_plane
+    exec1 = exec2 = 0
+    blocker = threading.Event()
+    entered = threading.Event()
+
+    def work1(cntl, payload):
+        nonlocal exec1
+        exec1 += 1  # non-idempotent: every execution is observable
+        if payload == b"block":
+            entered.set()
+            blocker.wait(30)
+        return b"r1"
+
+    def work2(cntl, payload):
+        nonlocal exec2
+        exec2 += 1
+        return b"r2"
+
+    s1 = Server(ServerOptions(method_max_concurrency={"Work": 1}))
+    s1.add_service("Work", work1)
+    s2 = Server()
+    s2.add_service("Work", work2)
+    p1 = s1.start("127.0.0.1:0")
+    p2 = s2.start("127.0.0.1:0")
+    occupier_err = []
+
+    def occupy():
+        try:
+            ch = Channel(f"127.0.0.1:{p1}",
+                         ChannelOptions(max_retry=0, timeout_ms=30_000))
+            ch.call("Work", b"block")
+            ch.close()
+        except Exception as e:  # surfaced after join
+            occupier_err.append(e)
+
+    occ = threading.Thread(target=occupy)
+    occ.start()
+    try:
+        assert entered.wait(10), "occupier never reached the handler"
+        ch = Channel(f"list://127.0.0.1:{p1},127.0.0.1:{p2}",
+                     ChannelOptions(load_balancer="rr", max_retry=3,
+                                    timeout_ms=10_000))
+        n_calls = 10
+        for _ in range(n_calls):
+            # every call must succeed: a shed at replica 1 retries on
+            # replica 2 (the shedding node joins excluded_nodes)
+            assert ch.call("Work", b"x") == b"r2"
+        # at-most-once: replica 1 executed ONLY the occupier; every
+        # shed-and-retried call executed exactly once, on replica 2
+        assert exec1 == 1
+        assert exec2 == n_calls
+        # rr over 2 nodes: about half the first attempts landed on the
+        # saturated replica and were shed there (counted natively)
+        assert L.trpc_overload_rejects(3) > 0  # TF_USERCODE
+        # breaker-SOFT: the shed replica is pressured, never isolated
+        cluster = ch._cluster
+        pressures = cluster.node_pressure()
+        node1 = next(n for n in pressures
+                     if n.endpoint.port == p1)
+        assert pressures[node1] > 0.0
+        assert not cluster._breaker(node1).is_isolated(), \
+            "ELIMIT alone must never trip isolation"
+        ch.close()
+    finally:
+        blocker.set()
+        occ.join(timeout=30)
+        s1.destroy()
+        s2.destroy()
+    assert not occupier_err, occupier_err
+
+
+def test_all_replicas_shedding_stops_the_retry_loop(overload_plane):
+    """When EVERY replica has shed this call, the retry loop must stop
+    (fail ELIMIT) instead of burning the budget through the cluster's
+    all-excluded fallback — re-hammering saturated servers is exactly
+    what shedding exists to stop."""
+    blockers = []
+    entered = []
+
+    def make_handler():
+        blk, ent = threading.Event(), threading.Event()
+        blockers.append(blk)
+        entered.append(ent)
+
+        def work(cntl, payload):
+            if payload == b"block":
+                ent.set()
+                blk.wait(30)
+            return b"r"
+        return work
+
+    servers, ports, occupiers = [], [], []
+    try:
+        for _ in range(2):
+            s = Server(ServerOptions(method_max_concurrency={"Work": 1}))
+            s.add_service("Work", make_handler())
+            servers.append(s)
+            ports.append(s.start("127.0.0.1:0"))
+        for p in ports:  # occupy BOTH replicas' single Work slot
+            th = threading.Thread(target=lambda p=p: Channel(
+                f"127.0.0.1:{p}",
+                ChannelOptions(max_retry=0, timeout_ms=30_000)).call(
+                    "Work", b"block"))
+            th.start()
+            occupiers.append(th)
+        for ent in entered:
+            assert ent.wait(10)
+        from brpc_tpu.rpc.controller import Controller
+        ch = Channel(f"list://127.0.0.1:{ports[0]},127.0.0.1:{ports[1]}",
+                     ChannelOptions(load_balancer="rr", max_retry=5,
+                                    timeout_ms=10_000))
+        cntl = Controller()
+        with pytest.raises(errors.RpcError) as ei:
+            ch.call("Work", b"x", cntl=cntl)
+        assert ei.value.code == errors.ELIMIT
+        # one retry (the other replica), then the gate closes: both
+        # replicas are excluded, so attempts stop well under max_retry
+        assert cntl.retried_count <= 1, \
+            f"retry loop hammered saturated replicas " \
+            f"({cntl.retried_count} retries)"
+        ch.close()
+    finally:
+        for blk in blockers:
+            blk.set()
+        for th in occupiers:
+            th.join(timeout=30)
+        for s in servers:
+            s.destroy()
+
+
+def test_single_server_channel_does_not_retry_elimit(overload_plane):
+    """A single-server channel must NOT retry ELIMIT: there is no other
+    replica, and hammering the one saturated server is exactly what
+    shedding exists to stop."""
+    calls = 0
+    blocker = threading.Event()
+    entered = threading.Event()
+
+    def work(cntl, payload):
+        nonlocal calls
+        calls += 1
+        if payload == b"block":
+            entered.set()
+            blocker.wait(30)
+        return b"r"
+
+    s = Server(ServerOptions(method_max_concurrency={"Work": 1}))
+    s.add_service("Work", work)
+    port = s.start("127.0.0.1:0")
+    occ = threading.Thread(target=lambda: Channel(
+        f"127.0.0.1:{port}",
+        ChannelOptions(max_retry=0, timeout_ms=30_000)).call(
+            "Work", b"block"))
+    occ.start()
+    try:
+        assert entered.wait(10)
+        ch = Channel(f"127.0.0.1:{port}",
+                     ChannelOptions(max_retry=3, timeout_ms=10_000))
+        cntl = None
+        from brpc_tpu.rpc.controller import Controller
+        cntl = Controller()
+        with pytest.raises(errors.RpcError) as ei:
+            ch.call("Work", b"x", cntl=cntl)
+        assert ei.value.code == errors.ELIMIT
+        assert cntl.retried_count == 0, \
+            "single-server ELIMIT must fail fast, not retry in place"
+        ch.close()
+    finally:
+        blocker.set()
+        occ.join(timeout=30)
+        s.destroy()

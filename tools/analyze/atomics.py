@@ -33,6 +33,7 @@ from .model import Model, Violation
 
 GATED_FILES = (
     "native/src/metrics.h", "native/src/metrics.cc",
+    "native/src/overload.h", "native/src/overload.cc",
     "native/src/shard.h", "native/src/shard.cc",
     "native/src/socket.h", "native/src/socket.cc",
     "native/src/uring.h", "native/src/uring.cc",

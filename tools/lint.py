@@ -93,6 +93,13 @@ _HOT_REGIONS = {
     "native/src/metrics.cc": ["telemetry_record", "telemetry_inflight_add",
                               "rpcz_try_sample", "rpcz_capture",
                               "trace_annotate", "trace_set_current"],
+    # ISSUE 11: overload admission + gradient feeds run on the parse
+    # fibers (admit per request, window fold on a completion) — the shed
+    # path's ~0-cost claim dies the moment these allocate
+    "native/src/overload.cc": ["overload_admit", "overload_unadmit",
+                               "overload_on_complete", "overload_sample",
+                               "overload_release", "record_sample",
+                               "maybe_fold"],
 }
 
 # control-plane regions (foreign-thread callers): direct Socket mutation
