@@ -15,6 +15,11 @@ from brpc_tpu.models.transformer import (  # noqa: F401
     init,
     param_specs,
 )
+from brpc_tpu.models.decode import (  # noqa: F401
+    decode_step,
+    init_cache,
+    prefill,
+)
 from brpc_tpu.models.train import (  # noqa: F401
     TrainState,
     loss_fn,

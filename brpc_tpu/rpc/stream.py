@@ -81,6 +81,18 @@ class Stream:
         raise errors.RpcError(errors.EFAILEDSOCKET,
                               "stream connection failed")
 
+    def try_write(self, data: bytes) -> bool:
+        """Non-blocking write: queue one message if the peer's window has
+        room RIGHT NOW, else return False without waiting.  A per-step
+        producer (e.g. a decode loop fanning one token to N streams)
+        uses this to detect a slow consumer without stalling the whole
+        batch; stream failures still raise like write()."""
+        try:
+            self.write(data, timeout_s=0)
+            return True
+        except StreamTimeout:
+            return False
+
     def read(self, timeout_s: Optional[float] = None) -> Optional[bytes]:
         """Receive one message; None on clean EOF (peer closed)."""
         timeout_us = -1 if timeout_s is None else int(timeout_s * 1e6)

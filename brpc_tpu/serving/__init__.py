@@ -1,0 +1,33 @@
+"""serving/ — the LLM-serving flagship workload (ISSUE 14).
+
+The first workload that earns the runtime: continuous batching over a
+pjit decode loop, with the KV cache living on the device plane as
+budgeted blocks and every client a streaming RPC.
+
+Three layers, composed bottom-up:
+
+* :mod:`kv_cache` — the KV-cache block plane.  A sequence's prefill K/V
+  is chunked into fixed-size ``tpu_plane.DeviceBufPool`` blocks on the
+  prefill device and migrated to the decode device over the ``tpu_d2d``
+  local rail (host landing-zone rail with optional bf16/int8 codec when
+  the ends don't share a PJRT client — the PARITY ruling's fallback
+  shape).  Hard accounting: blocks free on finish/evict/cancel and
+  ``tpu_plane.stats()`` balances to zero after a drain.
+* :mod:`scheduler` — continuous batching.  Admission sheds with ELIMIT
+  against the block budget BEFORE any device work (the PR-11 overload
+  posture: shed, never queue, beyond budget); running sequences
+  admit/evict per decode step; preemption-by-eviction when the pool
+  runs dry mid-decode.
+* :mod:`engine` — the serving front-end.  A stream-RPC handler feeds
+  the scheduler; one decode-loop thread drives
+  ``models/decode.decode_step`` under pjit and fans one token per step
+  to each live stream; stream RST / RPC cancel / slow-consumer timeout
+  evict the sequence and free its blocks.
+
+``examples/llm_server.py`` is the end-to-end proof;
+``tools/rpc_press.py --stream`` is the load cannon.
+"""
+
+from brpc_tpu.serving.engine import ServingEngine  # noqa: F401
+from brpc_tpu.serving.kv_cache import KvBlockPlane  # noqa: F401
+from brpc_tpu.serving.scheduler import Scheduler, Sequence  # noqa: F401

@@ -13,6 +13,12 @@ admitted-vs-shed counts and ADMITTED-ONLY latency percentiles in the
 open-loop per level: every caller thread keeps its next request queued
 regardless of how the server answered the last one, so offered load does
 not back off when the server sheds.
+
+Token cannon (ISSUE 14): ``--stream`` opens ``-c`` open-loop concurrent
+streams against a serving method (one message = one token) and reports
+per-stream TTFT / inter-token-gap p50/p99/p999 — admitted-only, with
+ELIMIT handshakes counted as shed and mid-stream RSTs (eviction/
+preemption) as resets.  The LLM serving bench's client side.
 """
 
 from __future__ import annotations
@@ -79,6 +85,144 @@ class PressResult:
         of ROADMAP item 2 diff-checks these across pressure levels)."""
         import json
         return json.dumps({"metric": "rpc_press", **self.step_dict()})
+
+
+@dataclass
+class StreamPressResult:
+    """--stream token-cannon tallies.  TTFT / inter-token-gap
+    percentiles are ADMITTED-ONLY (streams that produced >= 1 token):
+    a shed handshake is the overload plane working, not a serving
+    latency."""
+    streams: int = 0      # create_stream attempts
+    completed: int = 0    # streams that reached clean EOF
+    shed: int = 0         # ELIMIT handshakes (never admitted)
+    resets: int = 0       # mid-stream RST (eviction/preemption surface)
+    errors: int = 0
+    tokens: int = 0
+    wall_s: float = 0.0
+    ttft_us: List[int] = field(default_factory=list)
+    gap_us: List[int] = field(default_factory=list)
+
+    @property
+    def tokens_per_s(self) -> float:
+        return self.tokens / self.wall_s if self.wall_s > 0 else 0.0
+
+    @staticmethod
+    def _pct(xs: List[int], p: float) -> float:
+        if not xs:
+            return 0.0
+        s = sorted(xs)
+        return s[min(len(s) - 1, int(p * len(s)))]
+
+    def summary(self) -> str:
+        return (f"streams={self.streams} completed={self.completed} "
+                f"shed={self.shed} resets={self.resets} "
+                f"errors={self.errors} tokens={self.tokens} "
+                f"tok/s={self.tokens_per_s:.0f} "
+                f"ttft_p50={self._pct(self.ttft_us, .5):.0f}us "
+                f"ttft_p99={self._pct(self.ttft_us, .99):.0f}us "
+                f"gap_p50={self._pct(self.gap_us, .5):.0f}us "
+                f"gap_p99={self._pct(self.gap_us, .99):.0f}us "
+                f"gap_p999={self._pct(self.gap_us, .999):.0f}us")
+
+    def to_json_line(self) -> str:
+        import json
+        return json.dumps({
+            "metric": "rpc_press_stream",
+            "streams": self.streams,
+            "completed": self.completed,
+            "shed": self.shed,
+            "resets": self.resets,
+            "errors": self.errors,
+            "tokens": self.tokens,
+            "wall_s": round(self.wall_s, 3),
+            "tokens_per_s": round(self.tokens_per_s, 1),
+            "ttft_p50_us": self._pct(self.ttft_us, .5),
+            "ttft_p99_us": self._pct(self.ttft_us, .99),
+            "ttft_p999_us": self._pct(self.ttft_us, .999),
+            "gap_p50_us": self._pct(self.gap_us, .5),
+            "gap_p99_us": self._pct(self.gap_us, .99),
+            "gap_p999_us": self._pct(self.gap_us, .999),
+        })
+
+
+def press_stream(server: str, method: str, payload: bytes,
+                 concurrency: int = 4, duration_s: float = 5.0,
+                 timeout_ms: float = 30000.0,
+                 read_timeout_s: float = 60.0) -> StreamPressResult:
+    """The serving bench's client side: `concurrency` open-loop workers
+    each repeatedly open a stream on `method` and drain tokens to EOF,
+    recording per-stream TTFT (handshake issue -> first token) and
+    inter-token gaps.  ELIMIT handshakes count as shed and the worker
+    immediately re-offers — offered load does not back off when the
+    server sheds (same open-loop posture as press())."""
+    from brpc_tpu.rpc import errors
+    from brpc_tpu.rpc.channel import Channel, ChannelOptions
+    from brpc_tpu.rpc.stream import StreamReset, StreamTimeout
+
+    res = StreamPressResult()
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def worker():
+        ch = Channel(server, ChannelOptions(timeout_ms=timeout_ms,
+                                            max_retry=0))
+        ttft, gaps = [], []
+        streams = completed = shed = resets = errs = tokens = 0
+        while not stop.is_set():
+            streams += 1
+            t0 = time.monotonic_ns()
+            try:
+                _, st = ch.create_stream(method, payload)
+            except errors.RpcError as e:
+                if e.code == errors.ELIMIT:
+                    shed += 1
+                else:
+                    errs += 1
+                continue
+            n, last = 0, 0
+            try:
+                while True:
+                    msg = st.read(timeout_s=read_timeout_s)
+                    if msg is None:
+                        completed += 1
+                        break
+                    now = time.monotonic_ns()
+                    if n == 0:
+                        ttft.append((now - t0) // 1000)
+                    else:
+                        gaps.append((now - last) // 1000)
+                    n, last = n + 1, now
+                    tokens += 1
+            except StreamReset:
+                resets += 1   # evicted/preempted mid-stream: shed surface
+            except StreamTimeout:
+                errs += 1
+            except Exception:
+                errs += 1
+            st.destroy()
+        ch.close()
+        with lock:
+            res.streams += streams
+            res.completed += completed
+            res.shed += shed
+            res.resets += resets
+            res.errors += errs
+            res.tokens += tokens
+            res.ttft_us.extend(ttft)
+            res.gap_us.extend(gaps)
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(concurrency)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    time.sleep(duration_s)
+    stop.set()
+    for t in threads:
+        t.join(timeout=read_timeout_s + timeout_ms / 1000 + 1)
+    res.wall_s = time.monotonic() - t0
+    return res
 
 
 def press(server: str, method: str, payload: bytes, qps: float = 0.0,
@@ -241,6 +385,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="wire protocol (HTTP/1.1 via 'GET /path' methods)")
     ap.add_argument("-t", "--time", type=float, default=5.0,
                     help="duration seconds (per step with --ramp)")
+    ap.add_argument("--stream", action="store_true",
+                    help="token-cannon mode: -c open-loop concurrent "
+                         "streams on -m, draining tokens to EOF; "
+                         "reports per-stream TTFT and inter-token-gap "
+                         "p50/p99/p999 (admitted-only) plus tokens/s")
+    ap.add_argument("--read-timeout", type=float, default=60.0,
+                    help="--stream per-read budget seconds")
     ap.add_argument("--ramp", metavar="lo:hi:steps",
                     help="open-loop concurrency ramp: one -t second "
                          "step per level; reports admitted-vs-shed and "
@@ -253,6 +404,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = ap.parse_args(argv)
     payload = (open(args.file, "rb").read() if args.file
                else args.data.encode())
+    if args.stream:
+        res = press_stream(args.server, args.method, payload,
+                           concurrency=args.concurrency,
+                           duration_s=args.time,
+                           read_timeout_s=args.read_timeout)
+        print(res.to_json_line() if args.json else res.summary())
+        return 1 if res.errors and not res.tokens else 0
     if args.ramp:
         import json
         steps = ramp(args.server, args.method, payload, args.ramp,

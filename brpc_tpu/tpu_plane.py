@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import ctypes
 import errno
+import threading
 from typing import Dict, Optional
 
 from brpc_tpu._native import lib
@@ -146,3 +147,151 @@ def d2d(buf: DeviceBuffer, device: int) -> DeviceBuffer:
     if nb == 0:
         raise IOError(f"d2d failed: {error()}")
     return DeviceBuffer(nb, len(buf))
+
+
+class PoolExhausted(Exception):
+    """alloc() against a pool whose block budget is spent — the caller
+    sheds or preempts; the pool NEVER queues (admission control happens
+    above the device plane, before any DMA is issued)."""
+
+
+class DeviceBufPool:
+    """Budgeted fixed-size-block allocator over the plane's DeviceBuffers
+    (≙ the reference's rdma/block_pool.cpp: a hard block budget with
+    every allocation charged against it, re-designed: blocks are HBM
+    DeviceBuffers and migration is a PJRT d2d hop instead of an ibverbs
+    MR hand-off).
+
+    Hard accounting: every `alloc()` charges one block until `free()`;
+    `migrate()` moves a block between devices without changing the
+    charge (the source is freed as soon as the copy is enqueued).
+    `assert_balanced()` proves nothing leaked — the serving plane calls
+    it after every drain and the suite calls it after every cancel leg.
+
+    Thread-safe: the ledger mutates under one lock; DMA waits happen
+    outside it."""
+
+    def __init__(self, block_bytes: int, max_blocks: int):
+        if block_bytes <= 0 or max_blocks <= 0:
+            raise ValueError("block_bytes and max_blocks must be positive")
+        self.block_bytes = block_bytes
+        self.max_blocks = max_blocks
+        self._lock = threading.Lock()
+        self._live: Dict[int, DeviceBuffer] = {}   # handle -> buffer
+        self._allocs = 0
+        self._frees = 0
+        self._migrations = 0
+        self._exhausted = 0
+
+    # -- ledger -------------------------------------------------------------
+
+    @property
+    def used_blocks(self) -> int:
+        with self._lock:
+            return len(self._live)
+
+    @property
+    def free_blocks(self) -> int:
+        with self._lock:
+            return self.max_blocks - len(self._live)
+
+    def pool_stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"block_bytes": self.block_bytes,
+                    "max_blocks": self.max_blocks,
+                    "used_blocks": len(self._live),
+                    "allocs": self._allocs, "frees": self._frees,
+                    "migrations": self._migrations,
+                    "exhausted": self._exhausted}
+
+    def assert_balanced(self) -> None:
+        """Every charged block was freed; raises with the leak count
+        otherwise (the serving accounting proof rides on this)."""
+        with self._lock:
+            leaked = len(self._live)
+        if leaked:
+            raise AssertionError(
+                f"DeviceBufPool leaked {leaked} block(s): "
+                f"allocs={self._allocs} frees={self._frees}")
+
+    # -- data path ----------------------------------------------------------
+
+    def alloc(self, data: bytes, device: int = 0) -> DeviceBuffer:
+        """Charge one block and DMA `data` (at most block_bytes, padded
+        to the block size so every block is pool-shaped) onto `device`.
+        Raises PoolExhausted when the budget is spent — callers shed
+        BEFORE this ever queues."""
+        if len(data) > self.block_bytes:
+            raise ValueError(
+                f"block payload {len(data)} > block_bytes "
+                f"{self.block_bytes}")
+        with self._lock:
+            if len(self._live) >= self.max_blocks:
+                self._exhausted += 1
+                raise PoolExhausted(
+                    f"block budget spent ({self.max_blocks} blocks)")
+            self._allocs += 1
+        pad = self.block_bytes - len(data)
+        try:
+            buf = h2d(data + b"\x00" * pad, device)
+        except Exception:
+            with self._lock:
+                self._allocs -= 1
+            raise
+        with self._lock:
+            self._live[buf.handle] = buf
+        return buf
+
+    def migrate(self, buf: DeviceBuffer, device: int) -> DeviceBuffer:
+        """Move a charged block to `device` over the d2d fabric; the
+        charge transfers to the new buffer and the source is freed.  On
+        d2d failure the source stays charged and valid."""
+        with self._lock:
+            if buf.handle not in self._live:
+                raise KeyError("migrate() of a buffer not in this pool")
+        nb = d2d(buf, device)
+        with self._lock:
+            del self._live[buf.handle]
+            self._live[nb.handle] = nb
+            self._migrations += 1
+        buf.free()
+        return nb
+
+    def adopt(self, buf: DeviceBuffer) -> DeviceBuffer:
+        """Charge an externally-created DeviceBuffer (e.g. a host-rail
+        re-upload) against this pool's budget.  Raises PoolExhausted
+        rather than over-committing; the buffer is NOT freed on refusal
+        (it was never ours)."""
+        with self._lock:
+            if len(self._live) >= self.max_blocks:
+                self._exhausted += 1
+                raise PoolExhausted(
+                    f"block budget spent ({self.max_blocks} blocks)")
+            self._allocs += 1
+            self._live[buf.handle] = buf
+        return buf
+
+    def release(self, buf: DeviceBuffer) -> None:
+        """Un-charge a block WITHOUT freeing the underlying buffer —
+        ownership leaves the pool (e.g. handed to stream.write_device,
+        which consumes the buffer on success)."""
+        with self._lock:
+            if self._live.pop(buf.handle, None) is not None:
+                self._frees += 1
+
+    def free(self, buf: DeviceBuffer) -> None:
+        """Return a block: idempotent, like DeviceBuffer.free itself."""
+        with self._lock:
+            if self._live.pop(buf.handle, None) is None:
+                return
+            self._frees += 1
+        buf.free()
+
+    def free_all(self) -> None:
+        """Drop every outstanding block (teardown path)."""
+        with self._lock:
+            live = list(self._live.values())
+            self._live.clear()
+            self._frees += len(live)
+        for b in live:
+            b.free()

@@ -333,6 +333,29 @@ void tls_emit_to_socket(void* arg, IOBuf&& enc) {
 }
 }  // namespace
 
+// A hard read error (ECONNRESET from a peer's RST, EPIPE, ...) can
+// surface MID-drain, after this pass already banked bytes: the append
+// helpers and ReadToBuf both report the banked bytes and swallow the
+// error, and the edge-triggered event that announced it was consumed by
+// this very read.  Nothing re-reports a sticky error condition, so the
+// socket would sit "healthy" with a dead fd until every caller's
+// deadline fires.  Re-arming an input event makes the NEXT pass observe
+// the error with an empty drain (total == 0) and fail the socket
+// promptly.  Called from the processing fiber itself: nevent >= 1
+// there, so this never spawns a second fiber — it just makes the
+// fiber's exit CAS fail and re-run the edge.
+void Socket::RearmInputEvent() { StartInputEvent(id()); }
+
+namespace {
+// errno left behind by a SHORT-BUT-POSITIVE append: the helpers return
+// the banked byte count on hard errors, so the error class only
+// survives in errno (reset to 0 before each call to kill staleness)
+bool swallowed_hard_errno() {
+  return errno != 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
+         errno != EINTR;
+}
+}  // namespace
+
 ssize_t Socket::ReadToBuf(bool* eof) {
   if (ring_feed != nullptr) {
     // io_uring mode: the ring thread already received the bytes into the
@@ -357,7 +380,11 @@ ssize_t Socket::ReadToBuf(bool* eof) {
         if (errno == EAGAIN || errno == EWOULDBLOCK) {
           break;
         }
-        return total > 0 ? total : -1;
+        if (total > 0) {
+          RearmInputEvent();  // deliver the banked records, fail next pass
+          return total;
+        }
+        return -1;
       }
       if (n == 0) {
         if (eof != nullptr) {
@@ -385,24 +412,40 @@ ssize_t Socket::ReadToBuf(bool* eof) {
       // aligned exactly to its start
       if (frame_attach_hint > read_buf.size()) {
         size_t head = frame_attach_hint - read_buf.size();
+        errno = 0;
         ssize_t n = read_buf.append_from_fd(fd, head, eof);
         if (n < 0) {
-          return total > 0 ? total : -1;
+          if (total > 0) {
+            RearmInputEvent();
+            return total;
+          }
+          return -1;
         }
         bytes_in.fetch_add((uint64_t)n, std::memory_order_relaxed);
         total += n;
         if ((size_t)n < head) {
+          if (swallowed_hard_errno()) {
+            RearmInputEvent();
+          }
           return total;  // EAGAIN or EOF
         }
       }
       size_t want = frame_bytes_hint - read_buf.size();
+      errno = 0;
       ssize_t n = read_buf.append_from_fd_big(fd, want, eof);
       if (n < 0) {
-        return total > 0 ? total : -1;
+        if (total > 0) {
+          RearmInputEvent();
+          return total;
+        }
+        return -1;
       }
       bytes_in.fetch_add((uint64_t)n, std::memory_order_relaxed);
       total += n;
       if ((size_t)n < want) {
+        if (swallowed_hard_errno()) {
+          RearmInputEvent();
+        }
         return total;  // EAGAIN or EOF: frame still incomplete
       }
       frame_bytes_hint = 0;
@@ -416,13 +459,21 @@ ssize_t Socket::ReadToBuf(bool* eof) {
     // unbounded drain — the original behavior.
     size_t cap = frame_hint_fn != nullptr ? (size_t)(16 * 1024)
                                           : (size_t)-1;
+    errno = 0;
     ssize_t n = read_buf.append_from_fd(fd, cap, eof);
     if (n < 0) {
-      return total > 0 ? total : -1;
+      if (total > 0) {
+        RearmInputEvent();
+        return total;
+      }
+      return -1;
     }
     bytes_in.fetch_add((uint64_t)n, std::memory_order_relaxed);
     total += n;
     if ((size_t)n < cap || (eof != nullptr && *eof)) {
+      if ((eof == nullptr || !*eof) && swallowed_hard_errno()) {
+        RearmInputEvent();
+      }
       return total;  // EAGAIN or EOF: fully drained
     }
     frame_hint_fn(this);
@@ -776,11 +827,12 @@ void Socket::RunKeepWrite(WriteRequest* req) {
         // arm EPOLLOUT and wait for writability (or failure)
         int32_t w = butex_value(s->epollout_butex)
                         .load(std::memory_order_acquire);
+        const bool ring_fed = (s->ring_feed != nullptr);
         EventDispatcher::Instance().RegisterEpollOut(s->id(), s->fd,
-                                                     s->shard);
+                                                     s->shard, ring_fed);
         butex_wait(s->epollout_butex, w, 1000 * 1000);
         EventDispatcher::Instance().UnregisterEpollOut(s->id(), s->fd,
-                                                       s->shard);
+                                                       s->shard, ring_fed);
         continue;
       }
       if (n < 0 && errno == EINTR) {
@@ -894,15 +946,37 @@ int EventDispatcher::RemoveConsumer(int fd, int shard) {
   return epoll_ctl(EpfdFor(fd, shard), EPOLL_CTL_DEL, fd, nullptr);
 }
 
-int EventDispatcher::RegisterEpollOut(SocketId id, int fd, int shard) {
+int EventDispatcher::RegisterEpollOut(SocketId id, int fd, int shard,
+                                      bool ring_fed) {
+  // A ring-fed socket never passes through AddConsumer, so a stalled
+  // write can be the process's first dispatcher touch — start lazily
+  // like AddConsumer does, or EpfdFor divides by nepfd_ == 0.
+  Start(g_event_dispatcher_num.load(std::memory_order_relaxed));
   epoll_event ev;
   memset(&ev, 0, sizeof(ev));
-  ev.events = EPOLLIN | EPOLLOUT | EPOLLET;
   ev.data.u64 = id;
+  if (ring_fed) {
+    // fd is not in the epoll set: ADD a writability-only watch.  No
+    // EPOLLIN — reads stay on the ring's staged feed.  The implicit
+    // ERR/HUP delivery maps to StartInputEvent, which for a ring-fed
+    // socket just drains the staged feed (a no-op when empty).
+    ev.events = EPOLLOUT | EPOLLET;
+    return epoll_ctl(EpfdFor(fd, shard), EPOLL_CTL_ADD, fd, &ev);
+  }
+  ev.events = EPOLLIN | EPOLLOUT | EPOLLET;
   return epoll_ctl(EpfdFor(fd, shard), EPOLL_CTL_MOD, fd, &ev);
 }
 
-int EventDispatcher::UnregisterEpollOut(SocketId id, int fd, int shard) {
+int EventDispatcher::UnregisterEpollOut(SocketId id, int fd, int shard,
+                                        bool ring_fed) {
+  if (nepfd_ == 0) {
+    return -1;
+  }
+  if (ring_fed) {
+    // drop the temporary EPOLLOUT watch entirely — the ring keeps
+    // feeding receives, epoll has no standing business with this fd
+    return epoll_ctl(EpfdFor(fd, shard), EPOLL_CTL_DEL, fd, nullptr);
+  }
   epoll_event ev;
   memset(&ev, 0, sizeof(ev));
   ev.events = EPOLLIN | EPOLLET;

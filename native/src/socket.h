@@ -176,6 +176,10 @@ class Socket {
 
   // Read until EAGAIN into read_buf.  Returns bytes read; sets *eof.
   ssize_t ReadToBuf(bool* eof);
+  // re-queue an input event for THIS socket: used when a hard read error
+  // was swallowed behind banked bytes (the ET edge that announced it is
+  // consumed), so the next pass observes the sticky error and fails fast
+  void RearmInputEvent();
 
  private:
   friend struct KeepWriteArg;
@@ -204,8 +208,13 @@ class EventDispatcher {
   // fd-hash mapping.  Add/Remove/Register must pass the same shard.
   int AddConsumer(SocketId id, int fd, int shard = -1);
   int RemoveConsumer(int fd, int shard = -1);
-  int RegisterEpollOut(SocketId id, int fd, int shard = -1);
-  int UnregisterEpollOut(SocketId id, int fd, int shard = -1);
+  // `ring_fed` = the socket's receives are fed by io_uring (it never went
+  // through AddConsumer): Register ADDs an EPOLLOUT-only watch and
+  // Unregister DELs it, instead of MODing a registration that isn't there.
+  int RegisterEpollOut(SocketId id, int fd, int shard = -1,
+                       bool ring_fed = false);
+  int UnregisterEpollOut(SocketId id, int fd, int shard = -1,
+                         bool ring_fed = false);
 
  private:
   EventDispatcher() = default;

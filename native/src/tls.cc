@@ -113,6 +113,18 @@ std::map<SSL_CTX*, SniMap*>& sni_maps() {
   static auto* m = new std::map<SSL_CTX*, SniMap*>();
   return *m;
 }
+// ctxs handed out by tls_*_ctx_create and not yet destroyed, guarded by
+// sni_mu.  SSL_new against a ctx being concurrently SSL_CTX_freed is UB
+// inside OpenSSL (it dups the ctx's cipher/CA stacks while free tears
+// them down — ASAN sees memcpy-param-overlap on the recycled blocks), so
+// tls_state_create checks membership and runs SSL_new under sni_mu, and
+// tls_ctx_destroy drops the base ref under the same lock: a create
+// either wins (SSL_new takes its own ctx ref, keeping it alive past the
+// destroy) or observes the ctx gone and reports mid-teardown.
+std::map<SSL_CTX*, int>& live_ctxs() {
+  static auto* m = new std::map<SSL_CTX*, int>();
+  return *m;
+}
 
 // hostnames are case-insensitive (RFC 6066 / DNS): compare lowercased
 bool sni_match(const std::string& pattern, const char* name) {
@@ -354,6 +366,10 @@ void* tls_server_ctx_create(const char* cert_file, const char* key_file,
   }
   // ALPN: gRPC clients (h2) refuse sessions without it
   s.SSL_CTX_set_alpn_select_cb(ctx, alpn_select_cb, nullptr);
+  {
+    std::lock_guard<std::mutex> lk(sni_mu());
+    live_ctxs()[ctx] = 1;
+  }
   return ctx;
 }
 
@@ -449,6 +465,10 @@ void* tls_client_ctx_create(int verify, const char* ca_file,
   } else {
     s.SSL_CTX_set_verify(ctx, kSSL_VERIFY_NONE, nullptr);
   }
+  {
+    std::lock_guard<std::mutex> lk(sni_mu());
+    live_ctxs()[ctx] = 1;
+  }
   return ctx;
 }
 
@@ -470,8 +490,13 @@ void tls_ctx_destroy(void* ctx) {
         it->second->entries.clear();
         it->second->entries.shrink_to_fit();
       }
+      // drop the base ref under the SAME lock as tls_state_create's
+      // SSL_new: the ctx's internal stacks must not be torn down while a
+      // racing create duplicates them.  In-flight SSLs keep their own
+      // ctx refs, so this free only releases the registry's handle.
+      live_ctxs().erase((SSL_CTX*)ctx);
+      ssl().SSL_CTX_free((SSL_CTX*)ctx);
     }
-    ssl().SSL_CTX_free((SSL_CTX*)ctx);
   }
 }
 
@@ -481,7 +506,19 @@ TlsState* tls_state_create(void* ctx, int role) {
   }
   Ssl& s = ssl();
   TlsState* st = new TlsState();
-  st->conn = s.SSL_new((SSL_CTX*)ctx);
+  {
+    // SSL_new under sni_mu, after a liveness check: a ctx the owner
+    // already destroyed is dangling, and one being destroyed RIGHT NOW
+    // would have its stacks freed out from under SSL_new's dup.  Either
+    // way the caller sees nullptr (mid-teardown; retry with a fresh ctx).
+    std::lock_guard<std::mutex> lk(sni_mu());
+    if (live_ctxs().find((SSL_CTX*)ctx) == live_ctxs().end()) {
+      set_tls_error("tls_state_create: ctx already destroyed");
+      delete st;
+      return nullptr;
+    }
+    st->conn = s.SSL_new((SSL_CTX*)ctx);
+  }
   st->rbio = s.BIO_new(s.BIO_s_mem());
   st->wbio = s.BIO_new(s.BIO_s_mem());
   if (st->conn == nullptr || st->rbio == nullptr || st->wbio == nullptr) {

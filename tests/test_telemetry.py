@@ -156,12 +156,20 @@ def test_two_process_rpcz_trace_tree():
 
             url = (f"http://127.0.0.1:{port}/rpcz?"
                    f"trace_id={client.trace_id:x}&max_scan=200")
-            remote = json.loads(
-                urllib.request.urlopen(url, timeout=10).read())
-            by_kind = {}
-            for s in remote:
-                by_kind.setdefault((s["kind"], s["method"]), []).append(s)
-            server_sp = by_kind.get(("server", "Hop"), [None])[0]
+            # the server records its span AFTER writing the response, so
+            # our query can win that race under load — poll briefly
+            deadline = time.monotonic() + 10
+            while True:
+                remote = json.loads(
+                    urllib.request.urlopen(url, timeout=10).read())
+                by_kind = {}
+                for s in remote:
+                    by_kind.setdefault((s["kind"], s["method"]),
+                                       []).append(s)
+                server_sp = by_kind.get(("server", "Hop"), [None])[0]
+                if server_sp is not None or time.monotonic() > deadline:
+                    break
+                time.sleep(0.05)
             assert server_sp is not None, remote
             # link 1: server span parents at OUR client span
             assert int(server_sp["parent_span_id"], 16) == client.span_id
