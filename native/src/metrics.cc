@@ -494,6 +494,7 @@ size_t native_metrics_dump(char* buf, size_t cap) {
   put("native_live_sockets", rel(m.live_sockets));
   put("native_sockets_created", relu(m.sockets_created));
   put("native_socket_failures", relu(m.socket_failures));
+  put("native_accept_backoffs", relu(m.accept_backoffs));
   put("native_sequencer_parked", rel(m.sequencer_parked));
   put("native_inline_dispatch_hits", relu(m.inline_dispatch_hits));
   put("native_inline_dispatch_fallbacks", relu(m.inline_dispatch_fallbacks));

@@ -36,6 +36,11 @@ struct NativeMetrics {
   std::atomic<uint64_t> sockets_created{0};
   std::atomic<uint64_t> socket_failures{0};
 
+  // accept path (rpc.cc OnNewConnections / uring.cc acceptor): fd/buffer
+  // exhaustion pauses — the accept loop parked on a backoff timer instead
+  // of hot-retrying EMFILE/ENFILE
+  std::atomic<uint64_t> accept_backoffs{0};
+
   // server-side pipelining sequencer (rpc.cc ConnState): responses inside
   // the sequencer — parked out-of-order OR queued for the drain owner.
   // Sustained growth means handlers complete far out of request order.

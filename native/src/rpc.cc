@@ -803,6 +803,9 @@ class Server {
     int fd = -1;
     SocketId sock = INVALID_SOCKET_ID;
     bool ring = false;  // accepts flow through the shard's io_uring engine
+    // EMFILE/ENFILE accept backoff (exponential, reset on success).  Only
+    // touched by the listener socket's single processing fiber.
+    int backoff_ms = 0;
   };
   std::deque<Listener> listeners;
   int port = 0;
@@ -2524,12 +2527,54 @@ void RingOnAccept(void* user, int fd) {
 
 void OnNewConnections(Socket* listen_s) {
   Server::Listener* l = (Server::Listener*)listen_s->user;
+  // consume a pending backoff re-kick: this drain IS the re-kick firing
+  // (or a racing real edge) — either way the timer's job is done
+  {
+    TimerTask* kt =
+        listen_s->kick_timer.exchange(nullptr, std::memory_order_acq_rel);
+    if (kt != nullptr) {
+      timer_cancel_and_free(kt);
+    }
+  }
   while (true) {
     int fd = accept4(listen_s->fd, nullptr, nullptr,
                      SOCK_NONBLOCK | SOCK_CLOEXEC);
     if (fd < 0) {
-      return;  // EAGAIN or error: either way, wait for the next edge
+      int err = errno;
+      if (err == EMFILE || err == ENFILE || err == ENOBUFS ||
+          err == ENOMEM) {
+        // fd/buffer exhaustion: the pending connection stays queued in the
+        // kernel and — with edge-triggered epoll — no new edge is
+        // guaranteed once fds free up.  Instead of hot-looping, park and
+        // re-kick ourselves off the timer plane with exponential backoff
+        // (≙ acceptor.cpp:253's EMFILE pause-before-retry).
+        l->backoff_ms =
+            l->backoff_ms > 0 ? std::min(l->backoff_ms * 2, 1000) : 10;
+        native_metrics().accept_backoffs.fetch_add(
+            1, std::memory_order_relaxed);
+        TimerTask* t =
+            timer_add(monotonic_us() + (int64_t)l->backoff_ms * 1000,
+                      socket_timer_kick, (void*)(uintptr_t)listen_s->id());
+        TimerTask* prev =
+            listen_s->kick_timer.exchange(t, std::memory_order_acq_rel);
+        if (prev != nullptr) {
+          timer_cancel_and_free(prev);  // shouldn't happen; be safe
+        }
+        if (listen_s->failed.load(std::memory_order_acquire)) {
+          // teardown raced the arm: SetFailed may have swept BEFORE our
+          // exchange published `t` — reclaim it ourselves (both sides
+          // exchange, so exactly one actor gets each pointer)
+          TimerTask* mine =
+              listen_s->kick_timer.exchange(nullptr,
+                                            std::memory_order_acq_rel);
+          if (mine != nullptr) {
+            timer_cancel_and_free(mine);
+          }
+        }
+      }
+      return;  // EAGAIN or error: wait for the next edge / timer kick
     }
+    l->backoff_ms = 0;
     ServerAdoptConnection(l->srv, fd, l->shard);
   }
 }

@@ -16,6 +16,7 @@
 #include "metrics.h"
 #include "sched_perturb.h"
 #include "shard.h"
+#include "timer_thread.h"
 #include "tls.h"
 #include "uring.h"
 #include "object_pool.h"
@@ -77,6 +78,15 @@ int Socket::Create(const SocketOptions& opts, SocketId* id_out) {
   s->frame_attach_hint = 0;
   s->tls = nullptr;
   s->tls_checked = false;
+  {
+    // a recycled slot cannot carry a pending kick (SetFailed sweeps it),
+    // but an exchange keeps even an impossible leftover from leaking
+    TimerTask* kt = s->kick_timer.exchange(nullptr,
+                                           std::memory_order_acq_rel);
+    if (kt != nullptr) {
+      timer_cancel_and_free(kt);
+    }
+  }
   native_metrics().sockets_created.fetch_add(1, std::memory_order_relaxed);
   native_metrics().live_sockets.fetch_add(1, std::memory_order_relaxed);
   if (s->epollout_butex == nullptr) {
@@ -299,6 +309,16 @@ void Socket::SetFailed(int err) {
   if (err == TRPC_EREQUEST) {
     // malformed input killed the connection (≙ per-socket parse errors)
     native_metrics().parse_errors.fetch_add(1, std::memory_order_relaxed);
+  }
+  // sweep the pending re-kick/idle timer: the exchange races the arming
+  // fiber for the one cancel_and_free (an arm that lands after this
+  // sweep re-checks `failed` and reclaims its own task).  A firing
+  // callback is waited out — it only flags + StartInputEvent, bounded µs.
+  {
+    TimerTask* kt = kick_timer.exchange(nullptr, std::memory_order_acq_rel);
+    if (kt != nullptr) {
+      timer_cancel_and_free(kt);
+    }
   }
   // flip version to odd FIRST: from here no new Address can take a ref,
   // so the count can only drain to zero once
@@ -1071,6 +1091,12 @@ size_t socket_dump_all(char* buf, size_t cap) {
     }
   }
   return off;
+}
+
+void socket_timer_kick(void* arg) {
+  // stale ids are fine: Address inside StartInputEvent's dispatch path
+  // rejects a recycled generation, making a late kick a no-op
+  Socket::StartInputEvent((SocketId)(uintptr_t)arg);
 }
 
 }  // namespace trpc

@@ -19,6 +19,7 @@
 namespace trpc {
 
 class Socket;
+struct TimerTask;  // timer_thread.h — pending re-kick/idle timer handle
 
 // (version << 32) | pool slot
 typedef uint64_t SocketId;
@@ -107,6 +108,11 @@ class Socket {
   // (after the last Address ref is gone — respond paths may touch it)
   void* parse_state = nullptr;
   void (*parse_state_free)(void*) = nullptr;
+  // Pending timer-plane kick (accept backoff/pacing re-kick on listeners,
+  // idle-kick heartbeat on connections).  Whoever exchange()s the pointer
+  // out owns the single timer_cancel_and_free: the processing fiber
+  // consumes it at the top of its drain, SetFailed sweeps it at teardown.
+  std::atomic<TimerTask*> kick_timer{nullptr};
   bool corked = false;  // see SocketOptions.corked
   // Parse-batch response corking (≙ the reference batching all responses
   // of one InputMessenger cut into a single Socket::Write): while
@@ -230,5 +236,9 @@ class EventDispatcher {
 // Diagnostic text dump of every live socket in the process (clients +
 // servers; ≙ builtin sockets_service.cpp).  Returns bytes written.
 size_t socket_dump_all(char* buf, size_t cap);
+
+// Timer-plane trampoline: StartInputEvent on the SocketId packed into
+// `arg`.  Safe on stale ids (Address catches the recycled generation).
+void socket_timer_kick(void* arg);
 
 }  // namespace trpc

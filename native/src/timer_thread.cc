@@ -23,6 +23,9 @@ struct TimerTask {
   int64_t run_time_us = 0;
   TimerFn fn = nullptr;
   void* arg = nullptr;
+  // detached (timer_add_oneshot): nobody holds a handle — the timer
+  // thread frees the task itself right after the callback returns
+  bool detached = false;
   std::atomic<int> state{TIMER_PENDING};
 };
 
@@ -42,11 +45,13 @@ class TimerThread {
     return *t;
   }
 
-  TimerTask* Add(int64_t abstime_us, TimerFn fn, void* arg) {
+  TimerTask* Add(int64_t abstime_us, TimerFn fn, void* arg,
+                 bool detached = false) {
     TimerTask* t = ObjectPool<TimerTask>::Get();
     t->run_time_us = abstime_us;
     t->fn = fn;
     t->arg = arg;
+    t->detached = detached;
     t->state.store(TIMER_PENDING, std::memory_order_relaxed);
     {
       std::lock_guard<std::mutex> lk(mu_);
@@ -83,7 +88,12 @@ class TimerThread {
                                            std::memory_order_acq_rel)) {
         lk.unlock();
         t->fn(t->arg);
-        t->state.store(TIMER_DONE, std::memory_order_release);
+        if (t->detached) {
+          // oneshot: no canceller will ever free this task
+          ObjectPool<TimerTask>::Return(t);
+        } else {
+          t->state.store(TIMER_DONE, std::memory_order_release);
+        }
         lk.lock();
       } else {
         // cancelled between peek and pop
@@ -110,6 +120,10 @@ class TimerThread {
 
 TimerTask* timer_add(int64_t abstime_us, TimerFn fn, void* arg) {
   return TimerThread::Instance().Add(abstime_us, fn, arg);
+}
+
+void timer_add_oneshot(int64_t abstime_us, TimerFn fn, void* arg) {
+  (void)TimerThread::Instance().Add(abstime_us, fn, arg, /*detached=*/true);
 }
 
 int timer_cancel_and_free(TimerTask* t) {

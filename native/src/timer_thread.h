@@ -26,6 +26,12 @@ TimerTask* timer_add(int64_t abstime_us, TimerFn fn, void* arg);
 // (or is done).  Always releases the caller's ownership of `t`.
 int timer_cancel_and_free(TimerTask* t);
 
+// Fire-and-forget arm: no handle comes back and no cancel exists — the
+// timer plane frees the task right after the callback runs.  For re-kick
+// style timers whose owner may be gone by fire time: fn must tolerate a
+// stale arg (id-based lookup, e.g. Socket::StartInputEvent).
+void timer_add_oneshot(int64_t abstime_us, TimerFn fn, void* arg);
+
 void timer_thread_start();  // idempotent
 
 }  // namespace trpc

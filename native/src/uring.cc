@@ -18,6 +18,7 @@
 #include <sys/syscall.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <deque>
 #include <mutex>
@@ -28,6 +29,7 @@
 #include "metrics.h"
 #include "sched_perturb.h"
 #include "shard.h"
+#include "timer_thread.h"
 
 // --- uapi compat -----------------------------------------------------------
 // The engine tracks io_uring uapi newer than some build hosts ship in
@@ -125,7 +127,9 @@ constexpr int kGatherIovs = 64;  // small refs coalesced per SENDMSG op
 struct SendBatch;
 
 struct PendingOp {
-  int kind;  // 0 accept, 1 recv, 2 cancel-recv, 3 remove-acceptor, 4 send
+  // 0 accept, 1 recv, 2 cancel-recv, 3 remove-acceptor, 4 send,
+  // 5 rearm-acceptor (multishot re-issue after an EMFILE backoff pause)
+  int kind;
   SocketId id = INVALID_SOCKET_ID;
   int fd = -1;
   void (*on_accept)(void*, int) = nullptr;
@@ -163,7 +167,18 @@ struct Acceptor {
   void (*on_accept)(void*, int);
   void* user;
   int fd;
+  // EMFILE/ENFILE backoff (exponential, reset on a successful accept).
+  // Only the engine thread touches it.
+  int backoff_ms = 0;
 };
+
+// Timer-plane trampoline for the acceptor backoff: re-issue the multishot
+// accept after the pause.  arg packs [shard:32][fd:32]; a listener removed
+// in the meantime is caught by the acceptors_ lookup in Drain().
+void RingRearmAcceptCb(void* arg) {
+  uint64_t packed = (uint64_t)(uintptr_t)arg;
+  uring_rearm_acceptor((int)(uint32_t)packed, (int)(packed >> 32));
+}
 
 class RingEngine {
  public:
@@ -689,6 +704,13 @@ class RingEngine {
         butex_value(sb->ticket->done)
             .fetch_add(1, std::memory_order_release);
         butex_wake_all(sb->ticket->done);
+      } else if (op.kind == 5) {
+        // rearm-acceptor after an EMFILE backoff pause; the acceptor may
+        // have been removed while the timer was pending — then this is a
+        // no-op (never re-arm a dead listener fd)
+        if (acceptors_.count(op.fd) != 0) {
+          ArmAccept(op.fd);
+        }
       } else {  // remove-acceptor: no accept callback may fire after this
         io_uring_sqe* sqe = GetSqe();
         sqe->opcode = IORING_OP_ASYNC_CANCEL;
@@ -978,11 +1000,28 @@ class RingEngine {
             if (cqe->res >= 0) {
               native_metrics().uring_accepts.fetch_add(
                   1, std::memory_order_relaxed);
+              it->second.backoff_ms = 0;
               it->second.on_accept(it->second.user, cqe->res);
             }
             if (!(cqe->flags & IORING_CQE_F_MORE)) {
               if (cqe->res >= 0) {
                 ArmAccept(lfd);  // kernel dropped multishot benignly
+              } else if (cqe->res == -EMFILE || cqe->res == -ENFILE ||
+                         cqe->res == -ENOBUFS || cqe->res == -ENOMEM) {
+                // fd/buffer exhaustion killed the multishot: erasing the
+                // acceptor here would deafen the listener FOREVER (the
+                // old bug) — keep it and re-arm off the timer plane with
+                // exponential backoff instead of hot-spinning completions
+                Acceptor& a = it->second;
+                a.backoff_ms =
+                    a.backoff_ms > 0 ? std::min(a.backoff_ms * 2, 1000) : 10;
+                native_metrics().accept_backoffs.fetch_add(
+                    1, std::memory_order_relaxed);
+                uint64_t packed =
+                    ((uint64_t)(uint32_t)shard_idx_ << 32) | (uint32_t)lfd;
+                timer_add_oneshot(
+                    monotonic_us() + (int64_t)a.backoff_ms * 1000,
+                    RingRearmAcceptCb, (void*)(uintptr_t)packed);
               } else {
                 // canceled or listener closed: re-arming a dead fd
                 // would spin -EBADF completions forever
@@ -1211,6 +1250,13 @@ void uring_cancel(SocketId id, int shard) {
   PendingOp op;
   op.kind = 2;
   op.id = id;
+  RingEngine::Shard(shard)->Add(op);
+}
+
+void uring_rearm_acceptor(int fd, int shard) {
+  PendingOp op;
+  op.kind = 5;
+  op.fd = fd;
   RingEngine::Shard(shard)->Add(op);
 }
 

@@ -87,6 +87,11 @@ void uring_cancel(SocketId id, int shard = 0);
 // to free its Server).
 void uring_remove_acceptor(int fd, int shard = 0);
 
+// Re-issue a listener's multishot accept after an EMFILE/ENFILE backoff
+// pause (posted by the backoff timer).  No-op if the acceptor was removed
+// while the timer was pending.
+void uring_rearm_acceptor(int fd, int shard = 0);
+
 // --- zero-copy egress rail -------------------------------------------------
 
 // Kernel speaks IORING_OP_SEND_ZC (probed via IORING_REGISTER_PROBE).

@@ -1,0 +1,122 @@
+"""Accept-path EMFILE/ENFILE backoff (ISSUE 16 satellite, rpc.cc
+OnNewConnections).
+
+The bug: fd exhaustion during accept used to return and wait for the
+next epoll edge — but the listener is edge-triggered, so connections
+already queued in the kernel backlog when EMFILE hit would NEVER be
+announced again.  The fix parks the accept loop on an exponential
+backoff timer (socket.h kick_timer) that re-kicks the listener's
+processing fiber, and counts each pause in native_accept_backoffs.
+
+Reference style (SURVEY §4): a real loopback server in a subprocess
+(RLIMIT_NOFILE games must not poison the pytest process), raw sockets,
+the native metrics dump for the counter proof.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(code: str, timeout: float = 180.0) -> str:
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    pre = ("import sys, os\n"
+           f"sys.path.insert(0, {REPO!r})\n"
+           "from brpc_tpu.rpc.server import Server\n")
+    r = subprocess.run([sys.executable, "-c", pre + code],
+                       capture_output=True, text=True, timeout=timeout,
+                       env=env, cwd=REPO)
+    assert r.returncode == 0, f"child failed:\n{r.stdout}\n{r.stderr}"
+    return r.stdout
+
+
+# Exhaust the process fd table with spare sockets, connect a burst of
+# clients (the TCP handshakes complete via the kernel backlog even though
+# accept4 is failing EMFILE), then free the fds.  Edge-triggered epoll
+# guarantees no new readiness edge for the already-queued connections —
+# only the backoff timer's re-kick can ever accept them.
+_EMFILE_CODE = r"""
+import errno, resource, socket, struct, time
+from brpc_tpu.metrics.native import read_native_metrics
+
+srv = Server(); srv.add_echo_service(); srv.start("127.0.0.1:0")
+
+
+def tlv(tag, data):
+    return bytes([tag]) + struct.pack("<I", len(data)) + data
+
+
+def echo(s, corr, payload):
+    meta = tlv(1, b"Echo.echo") + tlv(2, struct.pack("<Q", corr))
+    s.sendall(b"TRPC" + struct.pack(">II", len(meta), len(payload))
+              + meta + payload)
+    buf = b""
+    while True:
+        if len(buf) >= 12:
+            ml, bl = struct.unpack(">II", buf[4:12])
+            if len(buf) >= 12 + ml + bl:
+                break
+        chunk = s.recv(65536)
+        assert chunk, "peer closed early"
+        buf += chunk
+    assert buf[12 + ml:12 + ml + bl] == payload
+
+
+# prove the accept path healthy before the storm
+w = socket.create_connection(("127.0.0.1", srv.port), timeout=30)
+w.settimeout(30)
+echo(w, 1, b"warm")
+
+soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+resource.setrlimit(resource.RLIMIT_NOFILE, (min(soft, 256), hard))
+NCONN = 4
+# client sockets FIRST (their fds must exist before the table fills);
+# connect() later needs no new fd, so the storm can start at zero-free
+conns = [socket.socket() for _ in range(NCONN)]
+for c in conns:
+    c.settimeout(30)
+spares = []
+try:
+    while True:
+        try:
+            spares.append(socket.socket())
+        except OSError as e:
+            assert e.errno == errno.EMFILE, e
+            break
+    for c in conns:
+        c.connect(("127.0.0.1", srv.port))  # backlog handshake, no accept
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if read_native_metrics().get("native_accept_backoffs", 0) >= 1:
+            break
+        time.sleep(0.01)
+    m = read_native_metrics()
+    assert m.get("native_accept_backoffs", 0) >= 1, m
+finally:
+    for sp in spares:
+        sp.close()
+    resource.setrlimit(resource.RLIMIT_NOFILE, (soft, hard))
+
+# fds are back, but the queued connections produce no new epoll edge:
+# completing an echo on each one proves the timer re-kick resumed accept
+for i, c in enumerate(conns):
+    echo(c, 100 + i, b"storm-%d" % i)
+    c.close()
+w.close()
+print("BACKOFFS", read_native_metrics()["native_accept_backoffs"])
+srv.destroy()
+print("OK")
+"""
+
+
+class TestAcceptBackoff:
+    def test_emfile_backoff_rekicks_accept(self):
+        out = _run(_EMFILE_CODE)
+        assert "OK" in out
+        backoffs = [int(l.split()[1]) for l in out.splitlines()
+                    if l.startswith("BACKOFFS ")]
+        assert backoffs and backoffs[0] >= 1
