@@ -401,6 +401,16 @@ def _declare(L: ctypes.CDLL) -> None:
         c.c_char_p, c.c_size_t, c.c_int64, c.POINTER(c.c_void_p)]
     L.trpc_fanout_call.restype = c.c_int
 
+    # million-connection ingress: accept-storm pacing + memory diet
+    L.trpc_set_accept_rate.argtypes = [c.c_int]
+    L.trpc_set_accept_rate.restype = None
+    L.trpc_set_accept_burst.argtypes = [c.c_int]
+    L.trpc_set_accept_burst.restype = None
+    L.trpc_set_accept_max_pending.argtypes = [c.c_int]
+    L.trpc_set_accept_max_pending.restype = None
+    L.trpc_set_idle_kick_ms.argtypes = [c.c_int]
+    L.trpc_set_idle_kick_ms.restype = None
+
     # ingress fast path: run-to-completion dispatch + response corking
     L.trpc_set_inline_dispatch.argtypes = [c.c_int]
     L.trpc_set_inline_dispatch.restype = None

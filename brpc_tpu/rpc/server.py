@@ -137,6 +137,57 @@ flags.define_int32("inline_budget_us", 500,
                    validator=_push_inline_budget_us)
 
 
+def _push_accept_rate(value) -> bool:
+    if value < 0:
+        return False
+    lib().trpc_set_accept_rate(int(value))
+    return True
+
+
+def _push_accept_burst(value) -> bool:
+    if value < 1:
+        return False
+    lib().trpc_set_accept_burst(int(value))
+    return True
+
+
+def _push_accept_max_pending(value) -> bool:
+    if value < 0:
+        return False
+    lib().trpc_set_accept_max_pending(int(value))
+    return True
+
+
+def _push_idle_kick_ms(value) -> bool:
+    if value < 0:
+        return False
+    lib().trpc_set_idle_kick_ms(int(value))
+    return True
+
+
+flags.define_int32("accept_rate", _parse_boot_int("TRPC_ACCEPT_RATE", 0),
+                   "accept-storm pacing: accepts/sec token bucket per "
+                   "listener, 0 = unpaced (TRPC_ACCEPT_RATE; reloadable)",
+                   validator=_push_accept_rate)
+flags.define_int32("accept_burst", _parse_boot_int("TRPC_ACCEPT_BURST", 64),
+                   "accept-storm pacing: token-bucket burst — accepts "
+                   "one drain may take before the rate binds "
+                   "(TRPC_ACCEPT_BURST; reloadable)",
+                   validator=_push_accept_burst)
+flags.define_int32("accept_max_pending",
+                   _parse_boot_int("TRPC_ACCEPT_MAX_PENDING", 0),
+                   "cap on accepted connections that have not yet sent "
+                   "their first bytes; the listener parks at the cap and "
+                   "the first-bytes decrement re-kicks it, 0 = uncapped "
+                   "(TRPC_ACCEPT_MAX_PENDING; reloadable)",
+                   validator=_push_accept_max_pending)
+flags.define_int32("idle_kick_ms", _parse_boot_int("TRPC_IDLE_KICK_MS", 0),
+                   "per-connection memory diet heartbeat: every interval "
+                   "with no ingress, the connection's banked buffers "
+                   "shrink back to the heap, 0 = off (TRPC_IDLE_KICK_MS; "
+                   "reloadable)", validator=_push_idle_kick_ms)
+
+
 def _push_telemetry(value) -> bool:
     lib().trpc_set_telemetry(1 if value else 0)
     return True
@@ -790,6 +841,16 @@ class Server:
             int(flags.get_flag("overload_max_concurrency")))
         lib().trpc_set_overload_window_ms(
             int(flags.get_flag("overload_window_ms")))
+        # million-connection ingress (rpc.h/socket.h): accept pacing +
+        # pending-handshake cap + idle-connection memory diet
+        lib().trpc_set_accept_rate(
+            int(flags.get_flag("accept_rate")))
+        lib().trpc_set_accept_burst(
+            int(flags.get_flag("accept_burst")))
+        lib().trpc_set_accept_max_pending(
+            int(flags.get_flag("accept_max_pending")))
+        lib().trpc_set_idle_kick_ms(
+            int(flags.get_flag("idle_kick_ms")))
         for meth, cap in (self.options.method_max_concurrency or {}).items():
             rc = lib().trpc_server_set_method_max_concurrency(
                 self._handle, meth.encode(), int(cap))

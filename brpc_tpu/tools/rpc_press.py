@@ -19,6 +19,15 @@ streams against a serving method (one message = one token) and reports
 per-stream TTFT / inter-token-gap p50/p99/p999 — admitted-only, with
 ELIMIT handshakes counted as shed and mid-stream RSTs (eviction/
 preemption) as resets.  The LLM serving bench's client side.
+
+Connection cannon (ISSUE 16): ``--connections N --hot M`` holds N idle
+connections against the server while M hot callers keep echoing, through
+three legs — ramp (open the N), churn (steady close/reopen), reconnect
+storm (drop and re-dial every idle connection at once).  The ``--json``
+line reports hot-subset p50/p99/p999 PER LEG beside the open/failed/
+shed/reconnect counts: the acceptance harness for the million-connection
+ingress work (per-shard timer wheel + memory diet + accept pacing) —
+idle-connection bookkeeping must not bend the hot path's tail.
 """
 
 from __future__ import annotations
@@ -225,6 +234,215 @@ def press_stream(server: str, method: str, payload: bytes,
     return res
 
 
+@dataclass
+class ConnCannonResult:
+    """--connections tallies.  Hot-subset latencies are kept PER LEG so
+    the acceptance check can diff the storm leg's p99 against the ramp
+    leg's — a flat tail across reconnect storms is the point."""
+    connections: int = 0
+    hot: int = 0
+    opened: int = 0      # successful idle dials (initial + re-dials)
+    failed: int = 0      # dials refused / timed out
+    sheds: int = 0       # idle conns found dead at sweep time (server
+    #                      closed them unused: accept-shed / eviction)
+    reconnects: int = 0  # churn + storm re-dials
+    storms: int = 0
+    ramp_s: float = 0.0  # wall time to open the first N
+    wall_s: float = 0.0
+    calls: int = 0
+    errors: int = 0
+    leg_lat_us: dict = field(default_factory=dict)  # leg -> [us]
+
+    @staticmethod
+    def _pct(xs: List[int], p: float) -> float:
+        if not xs:
+            return 0.0
+        s = sorted(xs)
+        return s[min(len(s) - 1, int(p * len(s)))]
+
+    def leg_dicts(self) -> List[dict]:
+        out = []
+        for leg in ("ramp", "churn", "storm"):
+            xs = self.leg_lat_us.get(leg, [])
+            out.append({"leg": leg, "calls": len(xs),
+                        "p50_us": self._pct(xs, .5),
+                        "p99_us": self._pct(xs, .99),
+                        "p999_us": self._pct(xs, .999)})
+        return out
+
+    def summary(self) -> str:
+        lines = [f"connections={self.connections} hot={self.hot} "
+                 f"opened={self.opened} failed={self.failed} "
+                 f"sheds={self.sheds} reconnects={self.reconnects} "
+                 f"storms={self.storms} ramp_s={self.ramp_s:.2f} "
+                 f"calls={self.calls} errors={self.errors}"]
+        for d in self.leg_dicts():
+            lines.append(f"  {d['leg']}: calls={d['calls']} "
+                         f"p50={d['p50_us']:.0f}us "
+                         f"p99={d['p99_us']:.0f}us "
+                         f"p999={d['p999_us']:.0f}us")
+        return "\n".join(lines)
+
+    def to_json_line(self) -> str:
+        import json
+        return json.dumps({
+            "metric": "rpc_press_connections",
+            "connections": self.connections, "hot": self.hot,
+            "opened": self.opened, "failed": self.failed,
+            "sheds": self.sheds, "reconnects": self.reconnects,
+            "storms": self.storms, "ramp_s": round(self.ramp_s, 3),
+            "wall_s": round(self.wall_s, 3),
+            "calls": self.calls, "errors": self.errors,
+            "legs": self.leg_dicts(),
+        })
+
+
+def press_connections(server: str, method: str, payload: bytes,
+                      connections: int = 1000, hot: int = 4,
+                      duration_s: float = 5.0, churn_per_s: float = 50.0,
+                      storms: int = 1,
+                      timeout_ms: float = 5000.0) -> ConnCannonResult:
+    """The million-connection ingress harness: `connections` idle raw
+    sockets dialed and HELD (they never speak — first-byte-lazy parse
+    state, idle-kick diet, and the timer wheel all get exercised server
+    side), while `hot` caller threads echo continuously.  Legs:
+
+    - ramp:  dial the N idle connections as fast as the server admits,
+             then dwell `duration_s` under steady hot traffic.
+    - churn: close+re-dial `churn_per_s` random idle connections per
+             second for `duration_s`.
+    - storm: `storms` rounds of dropping EVERY idle connection at once
+             and re-dialing the full set (the accept-storm leg).
+
+    Hot-subset latencies are recorded under the leg active at call time.
+    A dial the server refuses (or a held connection found dead at sweep
+    time — the overload plane closing unused fds) counts toward
+    failed/sheds; the cannon re-dials and keeps going."""
+    import errno as _errno
+    import random
+    import socket as _socket
+
+    from brpc_tpu.rpc.channel import Channel, ChannelOptions
+
+    res = ConnCannonResult(connections=connections, hot=hot,
+                           storms=storms)
+    for leg in ("ramp", "churn", "storm"):
+        res.leg_lat_us[leg] = []
+    lock = threading.Lock()
+    stop = threading.Event()
+    leg_now = ["ramp"]  # single writer (main thread), racy read is fine
+
+    def hot_worker():
+        ch = Channel(server, ChannelOptions(timeout_ms=timeout_ms,
+                                            max_retry=0))
+        local: dict = {"ramp": [], "churn": [], "storm": []}
+        calls = errs = 0
+        while not stop.is_set():
+            leg = leg_now[0]
+            t0 = time.monotonic_ns()
+            try:
+                ch.call(method, payload)
+                local[leg].append((time.monotonic_ns() - t0) // 1000)
+            except Exception:
+                errs += 1
+            calls += 1
+        ch.close()
+        with lock:
+            res.calls += calls
+            res.errors += errs
+            for leg, xs in local.items():
+                res.leg_lat_us[leg].extend(xs)
+
+    host, _, port_s = server.rpartition(":")
+    addr = (host, int(port_s))
+
+    def dial() -> Optional[_socket.socket]:
+        try:
+            c = _socket.create_connection(addr, timeout=timeout_ms / 1000)
+            c.setblocking(False)
+            res.opened += 1
+            return c
+        except OSError:
+            res.failed += 1
+            return None
+
+    def sweep_dead(conns: List[_socket.socket]) -> List[_socket.socket]:
+        """Drop held connections the server has closed under us (accept
+        shed / idle eviction read as EOF or reset on a silent socket)."""
+        live = []
+        for c in conns:
+            try:
+                if c.recv(1) == b"":
+                    res.sheds += 1
+                    c.close()
+                    continue
+            except OSError as e:
+                if e.errno in (_errno.EAGAIN, _errno.EWOULDBLOCK):
+                    live.append(c)
+                    continue
+                res.sheds += 1
+                c.close()
+                continue
+            live.append(c)  # server spoke first (unexpected): keep it
+        return live
+
+    threads = [threading.Thread(target=hot_worker, daemon=True)
+               for _ in range(hot)]
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+
+    # --- ramp ---
+    idle: List[_socket.socket] = []
+    t0 = time.monotonic()
+    for _ in range(connections):
+        c = dial()
+        if c is not None:
+            idle.append(c)
+    res.ramp_s = time.monotonic() - t0
+    time.sleep(duration_s)
+
+    # --- churn ---
+    leg_now[0] = "churn"
+    interval = 1.0 / churn_per_s if churn_per_s > 0 else duration_s
+    t_end = time.monotonic() + duration_s
+    while time.monotonic() < t_end:
+        if idle:
+            k = random.randrange(len(idle))
+            idle[k].close()
+            c = dial()
+            if c is not None:
+                idle[k] = c
+                res.reconnects += 1
+            else:
+                idle.pop(k)
+        time.sleep(interval)
+
+    # --- reconnect storms ---
+    leg_now[0] = "storm"
+    for _ in range(storms):
+        idle = sweep_dead(idle)
+        want = len(idle)
+        for c in idle:
+            c.close()
+        idle = []
+        for _ in range(want):
+            c = dial()
+            if c is not None:
+                idle.append(c)
+                res.reconnects += 1
+        time.sleep(max(duration_s / max(storms, 1), 0.2))
+
+    stop.set()
+    for t in threads:
+        t.join(timeout=timeout_ms / 1000 + 1)
+    idle = sweep_dead(idle)
+    for c in idle:
+        c.close()
+    res.wall_s = time.monotonic() - t_start
+    return res
+
+
 def press(server: str, method: str, payload: bytes, qps: float = 0.0,
           concurrency: int = 4, duration_s: float = 5.0,
           attachment: bytes = b"",
@@ -392,6 +610,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "p50/p99/p999 (admitted-only) plus tokens/s")
     ap.add_argument("--read-timeout", type=float, default=60.0,
                     help="--stream per-read budget seconds")
+    ap.add_argument("--connections", type=int, default=0,
+                    help="connection-cannon mode: hold N idle "
+                         "connections through ramp/churn/reconnect-storm "
+                         "legs while --hot callers keep echoing; "
+                         "reports hot-subset p50/p99/p999 per leg")
+    ap.add_argument("--hot", type=int, default=4,
+                    help="--connections hot-subset caller threads")
+    ap.add_argument("--churn", type=float, default=50.0,
+                    help="--connections churn leg: idle close+re-dials "
+                         "per second")
+    ap.add_argument("--storms", type=int, default=1,
+                    help="--connections reconnect-storm rounds")
     ap.add_argument("--ramp", metavar="lo:hi:steps",
                     help="open-loop concurrency ramp: one -t second "
                          "step per level; reports admitted-vs-shed and "
@@ -404,6 +634,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = ap.parse_args(argv)
     payload = (open(args.file, "rb").read() if args.file
                else args.data.encode())
+    if args.connections > 0:
+        res = press_connections(args.server, args.method, payload,
+                                connections=args.connections,
+                                hot=args.hot, duration_s=args.time,
+                                churn_per_s=args.churn,
+                                storms=args.storms)
+        print(res.to_json_line() if args.json else res.summary())
+        return 1 if res.errors and not res.calls - res.errors else 0
     if args.stream:
         res = press_stream(args.server, args.method, payload,
                            concurrency=args.concurrency,

@@ -216,6 +216,18 @@ void trpc_overload_test_reset(int family, int shard) {
   overload_test_reset(family, shard);
 }
 
+// --- million-connection ingress (ISSUE 16) ---------------------------------
+
+// Accept-storm pacing knobs (TRPC_ACCEPT_* seed the defaults;
+// reloadable): per-listener accepts/sec bucket, burst, and the
+// accepted-but-silent connection cap.
+void trpc_set_accept_rate(int per_sec) { set_accept_rate(per_sec); }
+void trpc_set_accept_burst(int n) { set_accept_burst(n); }
+void trpc_set_accept_max_pending(int n) { set_accept_max_pending(n); }
+// Per-connection memory diet: idle heartbeat interval (TRPC_IDLE_KICK_MS
+// seeds the default; 0 = off; reloadable).
+void trpc_set_idle_kick_ms(int ms) { set_idle_kick_ms(ms); }
+
 // Ingress fast path (run-to-completion dispatch + response corking):
 // reloadable A/B switch (TRPC_INLINE_DISPATCH env var seeds the default)
 // and the per-drain inline budget.

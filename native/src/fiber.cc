@@ -196,9 +196,13 @@ struct TaskGroup {
 
 struct TaskControl {
   std::vector<TaskGroup*> groups;
-  std::vector<std::thread> workers;
   ParkingLot pl;
   std::atomic<bool> started{false};
+  // `started` elects the one initializer; `ready` publishes the
+  // POPULATED group table.  Lazy-init racers must wait on `ready`:
+  // returning while `groups` is still empty routes the caller's fiber
+  // through ready_to_run's `% groups.size()` — a division fault.
+  std::atomic<bool> ready{false};
   std::atomic<uint64_t> nfibers{0};
   std::atomic<uint64_t> nsteals{0};
   std::atomic<uint64_t> nparks{0};
@@ -523,6 +527,11 @@ void run_fiber(TaskGroup* g, fiber_t tid) {
   run_remained(g);
 }
 
+void* worker_entry(void* p) {
+  worker_main((TaskGroup*)p);
+  return nullptr;
+}
+
 void worker_main(TaskGroup* g) {
   char name[16];
   snprintf(name, sizeof(name), "trpc_w%d", g->index);
@@ -842,6 +851,15 @@ int butex_wake_all(Butex* b) { return butex_wake_some(b, INT32_MAX); }
 int fiber_runtime_init(int num_workers) {
   bool expected = false;
   if (!g_control.started.compare_exchange_strong(expected, true)) {
+    // Lost the election: the winner is mid-init.  Wait for the group
+    // table before returning — concurrent lazy-init callers (pthread
+    // clients racing their first fiber_start) would otherwise spawn
+    // into an empty table.  Bounded by the winner's init (µs), and the
+    // waiters are plain pthreads, never fibers.  lint:allow-blocking-
+    // bounded (one-shot init latch)
+    while (!g_control.ready.load(std::memory_order_acquire)) {
+      std::this_thread::yield();
+    }
     return 0;
   }
   // writes to peers that vanished mid-call must surface as EPIPE, not
@@ -868,16 +886,33 @@ int fiber_runtime_init(int num_workers) {
     g->index = i;
     g_control.groups.push_back(g);
   }
+  // publish BEFORE spawning workers: the table is complete, and racers
+  // parked on `ready` may now route fibers (workers pick them up as
+  // they come up)
+  g_control.ready.store(true, std::memory_order_release);
+  // raw pthread_create, not std::thread: a detached std::thread heap-
+  // allocates a _State_impl whose only reference is the started
+  // thread's stack — a worker the kernel never scheduled before
+  // process exit (1-core host under schedule perturbation) reads as a
+  // LeakSanitizer direct leak.  The pthread arg is the TaskGroup*,
+  // already reachable from the leaked control() table.
+  pthread_attr_t attr;
+  pthread_attr_init(&attr);
+  pthread_attr_setdetachstate(&attr, PTHREAD_CREATE_DETACHED);
   for (int i = 0; i < num_workers; ++i) {
-    g_control.workers.emplace_back(worker_main, g_control.groups[i]);
-    g_control.workers.back().detach();
+    pthread_t tid;
+    pthread_create(&tid, &attr, worker_entry, g_control.groups[i]);
   }
+  pthread_attr_destroy(&attr);
   return num_workers;
 }
 
 int fiber_runtime_workers() { return (int)g_control.groups.size(); }
 bool fiber_runtime_started() {
-  return g_control.started.load(std::memory_order_acquire);
+  // `ready`, not `started`: between the two, the group table is still
+  // empty — callers gating fiber spawns on this must either see the
+  // full table or fall into fiber_runtime_init's wait
+  return g_control.ready.load(std::memory_order_acquire);
 }
 
 namespace {

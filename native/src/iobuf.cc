@@ -106,6 +106,39 @@ void IOBuf::push_ref(const BlockRef& r) {
   length_ += r.length;
 }
 
+size_t IOBuf::shrink(size_t compact_max) {
+  if (length_ == 0) {
+    size_t freed = refs_.capacity() * sizeof(BlockRef);
+    if (freed == 0) {
+      return 0;
+    }
+    std::vector<BlockRef>().swap(refs_);
+    return freed;
+  }
+  if (length_ > compact_max) {
+    return 0;  // a real payload is parked here; leave it alone
+  }
+  size_t pinned = 0;
+  for (const auto& r : refs_) {
+    pinned += r.block->cap;
+  }
+  // only compact when the remainder pins meaningfully more capacity than
+  // it uses — re-homing 100 banked bytes out of an 8KB pooled block is
+  // the win; copying a block that is already right-sized is churn
+  if (pinned < length_ + sizeof(IOBlock) + 64) {
+    return 0;
+  }
+  IOBlock* b = IOBlock::New((uint32_t)length_);
+  copy_to(b->data, length_);
+  b->size = (uint32_t)length_;
+  size_t len = length_;
+  clear();  // unrefs the pinning blocks, zeroes length_
+  std::vector<BlockRef>().swap(refs_);  // release banked ref capacity too
+  BlockRef r{b, 0, (uint32_t)len};
+  push_ref(r);  // b's initial ref transfers to this buf
+  return pinned - len;
+}
+
 void IOBuf::append(const void* data, size_t n) {
   const char* p = (const char*)data;
   // Large appends get one dedicated right-sized block instead of a chain

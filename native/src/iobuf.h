@@ -112,6 +112,15 @@ class IOBuf {
   // written or -1 (errno set).
   ssize_t cut_into_fd(int fd, size_t max = (size_t)-1);
 
+  // Idle-connection memory diet (ISSUE 16): return banked capacity the
+  // buffer no longer needs.  Empty buffer -> the refs_ vector's heap
+  // allocation is released.  A small parked remainder (a partial frame
+  // head, <= compact_max bytes) pinning big pooled blocks is re-homed
+  // into ONE exact-size block so the 8KB blocks go back to the heap.
+  // Returns an estimate of the bytes released (block capacities freed +
+  // vector capacity; shared blocks may survive on their other refs).
+  size_t shrink(size_t compact_max = 4096);
+
   size_t block_count() const { return refs_.size(); }
   const BlockRef& ref_at(size_t i) const { return refs_[i]; }
   // Any single ref of at least n bytes?  (The egress rail's eligibility

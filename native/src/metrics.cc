@@ -495,6 +495,19 @@ size_t native_metrics_dump(char* buf, size_t cap) {
   put("native_sockets_created", relu(m.sockets_created));
   put("native_socket_failures", relu(m.socket_failures));
   put("native_accept_backoffs", relu(m.accept_backoffs));
+  put("native_accept_paced", relu(m.accept_paced));
+  put("native_accept_sheds", relu(m.accept_sheds));
+  put("native_accept_pending_handshakes", rel(m.accept_pending_handshakes));
+  put("native_conn_idle_kicks", relu(m.conn_idle_kicks));
+  put("native_conn_shrinks", relu(m.conn_shrinks));
+  put("native_conn_shrunk_bytes", relu(m.conn_shrunk_bytes));
+  put("native_conn_parse_states", rel(m.conn_parse_states));
+  put("native_timer_arms", relu(m.timer_arms));
+  put("native_timer_cancels", relu(m.timer_cancels));
+  put("native_timer_fires", relu(m.timer_fires));
+  put("native_timer_cascades", relu(m.timer_cascades));
+  put("native_timer_foreign_arms", relu(m.timer_foreign_arms));
+  put("native_timer_pending", rel(m.timer_pending));
   put("native_sequencer_parked", rel(m.sequencer_parked));
   put("native_inline_dispatch_hits", relu(m.inline_dispatch_hits));
   put("native_inline_dispatch_fallbacks", relu(m.inline_dispatch_fallbacks));

@@ -40,6 +40,37 @@ struct NativeMetrics {
   // exhaustion pauses — the accept loop parked on a backoff timer instead
   // of hot-retrying EMFILE/ENFILE
   std::atomic<uint64_t> accept_backoffs{0};
+  // accept-storm pacing (rpc.cc): loop parks because the token bucket ran
+  // dry or the pending-handshake cap filled (re-kicked off the timer
+  // plane / the first-bytes decrement)
+  std::atomic<uint64_t> accept_paced{0};
+  // connections refused at accept because the overload plane judged the
+  // owning shard saturated (connection-level shedding, ISSUE 16)
+  std::atomic<uint64_t> accept_sheds{0};
+  // accepted connections that have not yet delivered their first ingress
+  // bytes (gauge; the per-listener cap bounds these)
+  std::atomic<int64_t> accept_pending_handshakes{0};
+
+  // per-connection memory diet (socket.cc idle-kick + IOBuf::shrink):
+  // idle heartbeats that found no ingress since the last beat, shrinks
+  // that actually released memory, and the bytes they returned
+  std::atomic<uint64_t> conn_idle_kicks{0};
+  std::atomic<uint64_t> conn_shrinks{0};
+  std::atomic<uint64_t> conn_shrunk_bytes{0};
+  // materialized per-connection parser states (gauge): stays at 0 for
+  // idle-accepted connections — ConnState is first-byte-lazy (rpc.cc)
+  std::atomic<int64_t> conn_parse_states{0};
+
+  // timer plane (timer_thread.cc per-shard hierarchical wheels)
+  std::atomic<uint64_t> timer_arms{0};     // timer_add/_oneshot calls
+  std::atomic<uint64_t> timer_cancels{0};  // cancels that prevented a fire
+  std::atomic<uint64_t> timer_fires{0};    // callbacks actually run
+  std::atomic<uint64_t> timer_cascades{0}; // tasks relinked level-down
+  // arms that fell back to the global wheel (caller had no shard): the
+  // zero-cross-shard-contention proof reads this — fiber-side arms at
+  // TRPC_SHARDS>1 must not move it
+  std::atomic<uint64_t> timer_foreign_arms{0};
+  std::atomic<int64_t> timer_pending{0};   // linked timers (gauge)
 
   // server-side pipelining sequencer (rpc.cc ConnState): responses inside
   // the sequencer — parked out-of-order OR queued for the drain owner.

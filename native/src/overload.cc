@@ -303,6 +303,25 @@ void overload_note_shed(int family, int shard) {
   agent(shard, family).rejects.fetch_add(1, std::memory_order_relaxed);
 }
 
+bool overload_accept_admit(int shard) {
+  if (!overload_enabled()) {
+    return true;  // plane off: inert, zero atomics on the accept path
+  }
+  // the shard is saturated when its LIVE charges have consumed the whole
+  // adapted budget across families — new connections would only feed the
+  // per-request shed path; refusing them keeps the kernel backlog (and
+  // the peer's retry policy) as the queue instead of accept+ELIMIT churn
+  int s = clamp_shd(shard);
+  int64_t in_sum = 0;
+  int64_t lim_sum = 0;
+  for (int f = 0; f < TF_FAMILIES; ++f) {
+    const OvAgent& a = g_agents[s][f];
+    in_sum += a.inflight.load(std::memory_order_relaxed);
+    lim_sum += eff_limit(a);
+  }
+  return in_sum < lim_sum;
+}
+
 int64_t overload_limit(int family) {
   int64_t v = 0;
   int n = shard_count();

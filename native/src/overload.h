@@ -105,6 +105,15 @@ void overload_release(int family, int shard);
 // ELIMIT the parse fiber issued.
 void overload_note_shed(int family, int shard);
 
+// Connection-level admission at accept (ISSUE 16): should the listener
+// adopt a NEW connection onto `shard`?  True (always, zero atomics) with
+// the plane off — TRPC_OVERLOAD unset stays behavior-identical.  On, a
+// shard whose total live charges have reached its total adapted limit is
+// saturated: accepting would only grow the shed queue request-by-request,
+// so the connection itself is refused (the caller closes the fd and
+// counts native_accept_sheds).
+bool overload_accept_admit(int shard);
+
 // Read side, folded across shards (≙ bvar agent folds): limit = sum of
 // per-shard limits (total admission capacity), inflight = live charges,
 // rejects/admits = totals.  All valid whether the plane is on or off.

@@ -453,6 +453,44 @@ std::atomic<int> g_inline_dispatch{-1};
 std::atomic<int> g_inline_budget_reqs{512};
 std::atomic<int64_t> g_inline_budget_us{500};
 
+// --- accept-storm pacing (ISSUE 16) ----------------------------------------
+// -1 = consult TRPC_ACCEPT_{RATE,BURST,MAX_PENDING} on first use
+// (flag-cached; reloadable through set_accept_*).  rate 0 = token bucket
+// off, max_pending 0 = handshake cap off — the defaults keep the accept
+// loop behavior-identical to the pre-ISSUE runtime.
+std::atomic<int> g_accept_rate{-1};
+std::atomic<int> g_accept_burst{-1};
+std::atomic<int> g_accept_max_pending{-1};
+
+int accept_knob(std::atomic<int>& a, const char* env, int dflt) {
+  int v = a.load(std::memory_order_acquire);
+  if (TRPC_UNLIKELY(v < 0)) {
+    // flag-cached: the ONE env read (≙ overload.cc knob discipline)
+    const char* e = getenv(env);
+    int resolved = dflt;
+    if (e != nullptr && e[0] != '\0') {
+      long p = strtol(e, nullptr, 10);
+      resolved = (int)(p < 0 ? 0 : (p > 100000000 ? 100000000 : p));
+    }
+    int expected = -1;
+    a.compare_exchange_strong(expected, resolved,
+                              std::memory_order_acq_rel);
+    v = a.load(std::memory_order_acquire);
+  }
+  return v;
+}
+
+int accept_rate() {
+  return accept_knob(g_accept_rate, "TRPC_ACCEPT_RATE", 0);
+}
+int accept_burst() {
+  int v = accept_knob(g_accept_burst, "TRPC_ACCEPT_BURST", 64);
+  return v > 0 ? v : 1;
+}
+int accept_max_pending() {
+  return accept_knob(g_accept_max_pending, "TRPC_ACCEPT_MAX_PENDING", 0);
+}
+
 // --- client egress fast path (request corking) -----------------------------
 // -1 = consult TRPC_CLIENT_CORK on first use (the bench A/B switch);
 // set_client_cork overrides at runtime (reloadable flag).  While on,
@@ -806,6 +844,19 @@ class Server {
     // EMFILE/ENFILE accept backoff (exponential, reset on success).  Only
     // touched by the listener socket's single processing fiber.
     int backoff_ms = 0;
+    // Accept-storm pacing token bucket (TRPC_ACCEPT_RATE/BURST): plain
+    // fields — only the listener's single processing fiber touches them.
+    double tokens = 0.0;
+    int64_t last_refill_us = 0;
+    // Accepted connections that have not delivered their first ingress
+    // bytes (TRPC_ACCEPT_MAX_PENDING cap).  Decremented from connection
+    // parse fibers on OTHER shards, hence atomic; parked_on_pending is
+    // the park/decrement-kick latch — the accept loop sets it before
+    // parking at the cap, a releasing decrement consumes it and re-kicks
+    // the listener, so a release can never slip between the cap check
+    // and the park.
+    std::atomic<int64_t> pending_handshakes{0};
+    std::atomic<bool> parked_on_pending{false};
   };
   std::deque<Listener> listeners;
   int port = 0;
@@ -827,6 +878,7 @@ namespace {
 // request order through the sequencer below.
 void PaOnHeadersSent(uint64_t pa_token);  // defined with PaState below
 void PaAbort(uint64_t pa_token);         // idem — dead conn, wake writers
+void ReleaseHandshakeCharge(Socket* s);  // defined with the accept plane
 
 struct ConnState {
   HttpParseState http;  // chunked-body resume state
@@ -912,8 +964,17 @@ constexpr uint64_t kMaxPipelined = 64;  // per-connection in-flight cap
 
 ConnState* GetConnState(Socket* s) {
   if (s->parse_state == nullptr) {
+    // first-byte-lazy (per-connection memory diet, ISSUE 16): an
+    // accepted-but-silent connection never materializes parser state —
+    // the native_conn_parse_states gauge is the proof
     s->parse_state = new ConnState();
-    s->parse_state_free = [](void* p) { delete (ConnState*)p; };
+    s->parse_state_free = [](void* p) {
+      native_metrics().conn_parse_states.fetch_sub(
+          1, std::memory_order_relaxed);
+      delete (ConnState*)p;
+    };
+    native_metrics().conn_parse_states.fetch_add(1,
+                                                 std::memory_order_relaxed);
   }
   return (ConnState*)s->parse_state;
 }
@@ -1501,6 +1562,12 @@ void ServerOnMessages(Socket* s) {
   if (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
     s->SetFailed(errno);
     return;
+  }
+  if (s->handshake_charge.load(std::memory_order_relaxed) != nullptr &&
+      (n > 0 || !s->read_buf.empty())) {
+    // first ingress bytes: the connection spoke — release its pending-
+    // handshake charge (and re-kick a listener parked at the cap)
+    ReleaseHandshakeCharge(s);
   }
   if (!s->tls_checked && srv->tls_ctx != nullptr && s->tls == nullptr &&
       !s->read_buf.empty()) {
@@ -2444,6 +2511,26 @@ void ServerOnMessages(Socket* s) {
   }
 }
 
+// Release a connection's pending-handshake charge (the exchange makes
+// every path — first bytes, teardown, the adopt-vs-Stop race — release
+// exactly once).  A listener parked at the cap is re-kicked off the
+// latch: the decrement IS its wake signal, no polling.
+void ReleaseHandshakeCharge(Socket* s) {
+  Server::Listener* l = (Server::Listener*)s->handshake_charge.exchange(
+      nullptr, std::memory_order_acq_rel);
+  if (l == nullptr) {
+    return;
+  }
+  native_metrics().accept_pending_handshakes.fetch_sub(
+      1, std::memory_order_relaxed);
+  l->pending_handshakes.fetch_sub(1, std::memory_order_seq_cst);
+  if (l->parked_on_pending.exchange(false, std::memory_order_seq_cst)) {
+    // the listener saw the cap full and parked after latching: this
+    // release observed the latch, so it owns the decrement-kick
+    Socket::StartInputEvent(l->sock);
+  }
+}
+
 void ServerConnFailed(Socket* s) {
   // parse_state (ConnState) is NOT freed here: respond paths holding an
   // Address ref may still touch it; Socket::TryRecycle frees it via
@@ -2452,6 +2539,7 @@ void ServerConnFailed(Socket* s) {
   // connection, including ones that failed moments before destroy (their
   // fibers may still hold refs into Server).  Recycled ids are pruned at
   // accept time.
+  ReleaseHandshakeCharge(s);
   H2ConnDestroy(s->id());
   StreamsOnSocketFailed(s->id());
   // the peer can never receive these responses: implicit cancel
@@ -2466,8 +2554,8 @@ void ServerConnFailed(Socket* s) {
 // readiness plumbing differs (AddConsumer vs multishot RECV).
 // `listener_shard` pins the connection to the accepting listener's shard
 // (SO_REUSEPORT sharding); -1 = round-robin across shards.
-void ServerAdoptConnection(Server* srv, int fd, int listener_shard) {
-  fd_set_nodelay(fd);
+void ServerAdoptConnection(Server* srv, int fd, Server::Listener* l) {
+  int listener_shard = l != nullptr ? l->shard : -1;
   int shard = 0;
   if (shard_count() > 1) {
     // single-listener sharding (TRPC_REUSEPORT=0): adopted connections
@@ -2479,6 +2567,16 @@ void ServerAdoptConnection(Server* srv, int fd, int listener_shard) {
                 : (int)(adopt_rr.fetch_add(1, std::memory_order_relaxed) %
                         (uint64_t)shard_count());
   }
+  // Connection-level shedding (ISSUE 16): consult the PR-11 overload
+  // plane BEFORE paying for the Socket — a saturated shard refuses the
+  // connection outright instead of accepting it into per-request ELIMIT
+  // churn.  Inert (always-admit, zero atomics) with TRPC_OVERLOAD unset.
+  if (!overload_accept_admit(shard)) {
+    native_metrics().accept_sheds.fetch_add(1, std::memory_order_relaxed);
+    ::close(fd);
+    return;
+  }
+  fd_set_nodelay(fd);
   shard_counters(shard).accepts.fetch_add(1, std::memory_order_relaxed);
   SocketOptions opts;
   opts.fd = fd;
@@ -2487,10 +2585,29 @@ void ServerAdoptConnection(Server* srv, int fd, int listener_shard) {
   opts.user = srv;
   opts.on_failed = ServerConnFailed;
   opts.frame_hint_fn = ArmTrpcFrameHints;
+  opts.idle_kick = idle_kick_ms() > 0;  // per-connection memory diet
   SocketId id;
   if (Socket::Create(opts, &id) != 0) {
     ::close(fd);
     return;
+  }
+  if (l != nullptr && accept_max_pending() > 0) {
+    // pending-handshake charge: released by the connection's first
+    // ingress bytes (ServerOnMessages) or its teardown (ServerConnFailed)
+    Socket* cs = Socket::Address(id);
+    if (cs != nullptr) {
+      l->pending_handshakes.fetch_add(1, std::memory_order_seq_cst);
+      native_metrics().accept_pending_handshakes.fetch_add(
+          1, std::memory_order_relaxed);
+      cs->handshake_charge.store((void*)l, std::memory_order_release);
+      if (cs->failed.load(std::memory_order_acquire)) {
+        // a concurrent server Stop failed the socket before the charge
+        // was published: ServerConnFailed saw nullptr, so release it
+        // ourselves (the exchange inside makes this exactly-once)
+        ReleaseHandshakeCharge(cs);
+      }
+      cs->Dereference();
+    }
   }
   {
     std::lock_guard lk(srv->conns_mu);
@@ -2522,13 +2639,36 @@ void ServerAdoptConnection(Server* srv, int fd, int listener_shard) {
 
 void RingOnAccept(void* user, int fd) {
   Server::Listener* l = (Server::Listener*)user;
-  ServerAdoptConnection(l->srv, fd, l->shard);
+  ServerAdoptConnection(l->srv, fd, l);
+}
+
+// Park the listener on a timer-plane re-kick `delay_us` out (backoff and
+// pacing share this; ≙ acceptor.cpp:253's pause-before-retry shape).
+// The exchange dance mirrors the connection kick protocol: teardown may
+// sweep BEFORE our exchange published `t`, so re-check `failed` and
+// reclaim our own task — both sides exchange, exactly one actor gets
+// each pointer.
+void ArmListenerKick(Socket* listen_s, int64_t delay_us) {
+  TimerTask* t = timer_add(monotonic_us() + delay_us, socket_timer_kick,
+                           (void*)(uintptr_t)listen_s->id());
+  TimerTask* prev =
+      listen_s->kick_timer.exchange(t, std::memory_order_acq_rel);
+  if (prev != nullptr) {
+    timer_cancel_and_free(prev);  // shouldn't happen; be safe
+  }
+  if (listen_s->failed.load(std::memory_order_acquire)) {
+    TimerTask* mine =
+        listen_s->kick_timer.exchange(nullptr, std::memory_order_acq_rel);
+    if (mine != nullptr) {
+      timer_cancel_and_free(mine);
+    }
+  }
 }
 
 void OnNewConnections(Socket* listen_s) {
   Server::Listener* l = (Server::Listener*)listen_s->user;
-  // consume a pending backoff re-kick: this drain IS the re-kick firing
-  // (or a racing real edge) — either way the timer's job is done
+  // consume a pending backoff/pacing re-kick: this drain IS the re-kick
+  // firing (or a racing real edge) — either way the timer's job is done
   {
     TimerTask* kt =
         listen_s->kick_timer.exchange(nullptr, std::memory_order_acq_rel);
@@ -2536,7 +2676,50 @@ void OnNewConnections(Socket* listen_s) {
       timer_cancel_and_free(kt);
     }
   }
+  const int rate = accept_rate();
   while (true) {
+    // pending-handshake cap: accepted connections that have not spoken
+    // yet are the storm's working set — beyond the cap, park and let the
+    // first-bytes decrement re-kick us (latch below; a 50ms timer is the
+    // safety net, not the wake path)
+    const int max_pending = accept_max_pending();
+    if (max_pending > 0 &&
+        l->pending_handshakes.load(std::memory_order_seq_cst) >=
+            (int64_t)max_pending) {
+      l->parked_on_pending.store(true, std::memory_order_seq_cst);
+      if (l->pending_handshakes.load(std::memory_order_seq_cst) <
+          (int64_t)max_pending) {
+        // a release slipped in while latching: un-park and continue (if
+        // the releaser consumed the latch first, its kick just re-drains)
+        l->parked_on_pending.store(false, std::memory_order_seq_cst);
+        continue;
+      }
+      native_metrics().accept_paced.fetch_add(1, std::memory_order_relaxed);
+      ArmListenerKick(listen_s, 50 * 1000);
+      return;
+    }
+    if (rate > 0) {
+      // token bucket (plain fields: single processing fiber).  Refill
+      // from the elapsed wall time, cap at the burst, spend 1 per accept.
+      int64_t now = monotonic_us();
+      const double burst = (double)accept_burst();
+      if (l->last_refill_us == 0) {
+        l->tokens = burst;  // first accept after boot: full bucket
+      } else {
+        l->tokens = std::min(
+            burst, l->tokens + (double)(now - l->last_refill_us) *
+                                   (double)rate / 1e6);
+      }
+      l->last_refill_us = now;
+      if (l->tokens < 1.0) {
+        native_metrics().accept_paced.fetch_add(1,
+                                                std::memory_order_relaxed);
+        int64_t wait_us =
+            (int64_t)((1.0 - l->tokens) * 1e6 / (double)rate) + 1;
+        ArmListenerKick(listen_s, wait_us);
+        return;
+      }
+    }
     int fd = accept4(listen_s->fd, nullptr, nullptr,
                      SOCK_NONBLOCK | SOCK_CLOEXEC);
     if (fd < 0) {
@@ -2546,36 +2729,20 @@ void OnNewConnections(Socket* listen_s) {
         // fd/buffer exhaustion: the pending connection stays queued in the
         // kernel and — with edge-triggered epoll — no new edge is
         // guaranteed once fds free up.  Instead of hot-looping, park and
-        // re-kick ourselves off the timer plane with exponential backoff
-        // (≙ acceptor.cpp:253's EMFILE pause-before-retry).
+        // re-kick ourselves off the timer plane with exponential backoff.
         l->backoff_ms =
             l->backoff_ms > 0 ? std::min(l->backoff_ms * 2, 1000) : 10;
         native_metrics().accept_backoffs.fetch_add(
             1, std::memory_order_relaxed);
-        TimerTask* t =
-            timer_add(monotonic_us() + (int64_t)l->backoff_ms * 1000,
-                      socket_timer_kick, (void*)(uintptr_t)listen_s->id());
-        TimerTask* prev =
-            listen_s->kick_timer.exchange(t, std::memory_order_acq_rel);
-        if (prev != nullptr) {
-          timer_cancel_and_free(prev);  // shouldn't happen; be safe
-        }
-        if (listen_s->failed.load(std::memory_order_acquire)) {
-          // teardown raced the arm: SetFailed may have swept BEFORE our
-          // exchange published `t` — reclaim it ourselves (both sides
-          // exchange, so exactly one actor gets each pointer)
-          TimerTask* mine =
-              listen_s->kick_timer.exchange(nullptr,
-                                            std::memory_order_acq_rel);
-          if (mine != nullptr) {
-            timer_cancel_and_free(mine);
-          }
-        }
+        ArmListenerKick(listen_s, (int64_t)l->backoff_ms * 1000);
       }
       return;  // EAGAIN or error: wait for the next edge / timer kick
     }
     l->backoff_ms = 0;
-    ServerAdoptConnection(l->srv, fd, l->shard);
+    if (rate > 0) {
+      l->tokens -= 1.0;
+    }
+    ServerAdoptConnection(l->srv, fd, l);
   }
 }
 
@@ -2937,9 +3104,12 @@ int server_start(Server* s, const char* ip, int port) {
     s->port = 0;
     // unix sockets have no SO_REUSEPORT sharding: one listener; on a
     // sharded runtime the adopted connections round-robin (shard = -1)
-    s->listeners.push_back(Server::Listener{
-        s, shard_count() > 1 ? -1 : 0, fd, INVALID_SOCKET_ID, false});
+    // emplace + assign: the atomic members make Listener immovable
+    s->listeners.emplace_back();
     Server::Listener& l = s->listeners.back();
+    l.srv = s;
+    l.shard = shard_count() > 1 ? -1 : 0;
+    l.fd = fd;
     SocketOptions opts;
     opts.fd = fd;
     opts.shard = 0;
@@ -3011,9 +3181,12 @@ int server_start(Server* s, const char* ip, int port) {
     }
     // single listener on a sharded runtime: adopted conns round-robin
     int conn_shard = rp_shards ? k : (nshards > 1 ? -1 : 0);
-    s->listeners.push_back(
-        Server::Listener{s, conn_shard, fd, INVALID_SOCKET_ID, false});
+    // emplace + assign: the atomic members make Listener immovable
+    s->listeners.emplace_back();
     Server::Listener& l = s->listeners.back();
+    l.srv = s;
+    l.shard = conn_shard;
+    l.fd = fd;
     int lshard = rp_shards ? k : 0;  // the listen fd's own reactor
     SocketOptions opts;
     opts.fd = fd;
@@ -4638,6 +4811,19 @@ void set_usercode_max_inflight(int64_t n) {
 
 void set_inline_dispatch(int on) {
   g_inline_dispatch.store(on ? 1 : 0, std::memory_order_release);
+}
+
+void set_accept_rate(int per_sec) {
+  g_accept_rate.store(per_sec < 0 ? 0 : per_sec,
+                      std::memory_order_release);
+}
+
+void set_accept_burst(int n) {
+  g_accept_burst.store(n < 1 ? 1 : n, std::memory_order_release);
+}
+
+void set_accept_max_pending(int n) {
+  g_accept_max_pending.store(n < 0 ? 0 : n, std::memory_order_release);
 }
 
 bool inline_dispatch_enabled() {

@@ -306,6 +306,16 @@ bool inline_dispatch_enabled();
 void set_inline_budget_requests(int reqs);
 void set_inline_budget_us(int64_t us);
 
+// Accept-storm pacing (ISSUE 16; TRPC_ACCEPT_{RATE,BURST,MAX_PENDING}
+// seed the defaults, reloadable): accepts/sec token bucket per listener
+// (0 = unpaced), the bucket's burst size, and the cap on accepted
+// connections that have not yet delivered their first ingress bytes
+// (0 = uncapped).  A parked listener re-kicks off the timer plane (rate)
+// or the first-bytes decrement (cap).
+void set_accept_rate(int per_sec);
+void set_accept_burst(int n);
+void set_accept_max_pending(int n);
+
 // Coarse clock: one monotonic_ns() per parse drain, shared by budget
 // checks and request arm-times (≙ rpcz/LatencyRecorder arm stamps without
 // per-request clock syscalls in the hot loop).

@@ -5,6 +5,7 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <stdio.h>
+#include <stdlib.h>
 #include <string.h>
 #include <sys/epoll.h>
 #include <sys/socket.h>
@@ -78,6 +79,11 @@ int Socket::Create(const SocketOptions& opts, SocketId* id_out) {
   s->frame_attach_hint = 0;
   s->tls = nullptr;
   s->tls_checked = false;
+  s->idle_check.store(false, std::memory_order_relaxed);
+  s->idle_kick_enabled = opts.idle_kick;
+  s->idle_armed = false;
+  s->idle_seen_bytes_in = 0;
+  s->handshake_charge.store(nullptr, std::memory_order_relaxed);
   {
     // a recycled slot cannot carry a pending kick (SetFailed sweeps it),
     // but an exchange keeps even an impossible leftover from leaking
@@ -89,9 +95,8 @@ int Socket::Create(const SocketOptions& opts, SocketId* id_out) {
   }
   native_metrics().sockets_created.fetch_add(1, std::memory_order_relaxed);
   native_metrics().live_sockets.fetch_add(1, std::memory_order_relaxed);
-  if (s->epollout_butex == nullptr) {
-    s->epollout_butex = butex_create();
-  }
+  // epollout_butex stays nullptr — materialized by the first EAGAIN
+  // writer (memory diet: idle/read-only connections never pay for it)
   // version in the slab is even (fresh slab: 0; recycled: last+2);
   // set owner refcount to 1
   uint64_t v = s->versioned_ref.load(std::memory_order_relaxed);
@@ -201,6 +206,16 @@ void Socket::TryRecycle(uint32_t odd_ver) {
     fd = -1;
   }
   read_buf.clear();
+  read_buf.shrink();  // release banked ref capacity with the connection
+  {
+    // no waiter can hold the pointer here (waiters hold an Address ref,
+    // and refs are provably gone): return the butex to its pool so a
+    // long-lived slab of mostly-idle slots doesn't bank one per slot
+    Butex* eb = epollout_butex.exchange(nullptr, std::memory_order_acq_rel);
+    if (eb != nullptr) {
+      butex_destroy(eb);
+    }
+  }
   if (parse_state != nullptr && parse_state_free != nullptr) {
     // freed here — not in on_failed — because respond paths holding an
     // Address ref may still be using it; refs are provably gone now
@@ -326,8 +341,13 @@ void Socket::SetFailed(int err) {
   if (fd >= 0) {
     ::shutdown(fd, SHUT_RDWR);  // wake in-flight reads/writes
   }
-  butex_value(epollout_butex).fetch_add(1, std::memory_order_release);
-  butex_wake_all(epollout_butex);
+  {
+    Butex* eb = epollout_butex.load(std::memory_order_acquire);
+    if (eb != nullptr) {
+      butex_value(eb).fetch_add(1, std::memory_order_release);
+      butex_wake_all(eb);
+    }
+  }
   if (on_failed != nullptr) {
     on_failed(this);
   }
@@ -517,6 +537,11 @@ void Socket::ProcessEventFiber(void* arg) {
     }
     // seen was refreshed: new events arrived while processing
   }
+  // idle-kick heartbeat (memory diet): first drain opens it, a fired
+  // beat shrinks-and-rearms; plain traffic drains pay one relaxed load
+  if (s->idle_kick_enabled) {
+    s->MaybeIdleShrink();
+  }
   s->Dereference();
 }
 
@@ -546,8 +571,13 @@ void Socket::HandleEpollOut(SocketId id) {
   if (s == nullptr) {
     return;
   }
-  butex_value(s->epollout_butex).fetch_add(1, std::memory_order_release);
-  butex_wake_all(s->epollout_butex);
+  Butex* eb = s->epollout_butex.load(std::memory_order_acquire);
+  if (eb != nullptr) {
+    // nullptr = no writer ever blocked on writability: nobody to wake
+    // (EPOLLOUT watches are only armed by waiters, after they publish)
+    butex_value(eb).fetch_add(1, std::memory_order_release);
+    butex_wake_all(eb);
+  }
   s->Dereference();
 }
 
@@ -844,13 +874,15 @@ void Socket::RunKeepWrite(WriteRequest* req) {
         continue;
       }
       if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
-        // arm EPOLLOUT and wait for writability (or failure)
-        int32_t w = butex_value(s->epollout_butex)
-                        .load(std::memory_order_acquire);
+        // arm EPOLLOUT and wait for writability (or failure); the lazy
+        // butex is published BEFORE the EPOLLOUT registration, so the
+        // dispatcher's wake can't miss it
+        Butex* eb = s->EnsureEpolloutButex();
+        int32_t w = butex_value(eb).load(std::memory_order_acquire);
         const bool ring_fed = (s->ring_feed != nullptr);
         EventDispatcher::Instance().RegisterEpollOut(s->id(), s->fd,
                                                      s->shard, ring_fed);
-        butex_wait(s->epollout_butex, w, 1000 * 1000);
+        butex_wait(eb, w, 1000 * 1000);
         EventDispatcher::Instance().UnregisterEpollOut(s->id(), s->fd,
                                                        s->shard, ring_fed);
         continue;
@@ -1097,6 +1129,133 @@ void socket_timer_kick(void* arg) {
   // stale ids are fine: Address inside StartInputEvent's dispatch path
   // rejects a recycled generation, making a late kick a no-op
   Socket::StartInputEvent((SocketId)(uintptr_t)arg);
+}
+
+// ---------------------------------------------------------------------------
+// idle-kick heartbeat (per-connection memory diet, ISSUE 16)
+
+namespace {
+// -1 = resolve TRPC_IDLE_KICK_MS on first use (flag-cached; reloadable
+// through set_idle_kick_ms).  0 = heartbeat off (the default: behavior-
+// identical to the pre-ISSUE runtime).
+std::atomic<int> g_idle_kick_ms{-1};
+
+// idle beat fired (tick thread): flag the check and kick the processing
+// fiber; it does the actual shrink on its own shard (read_buf is fiber-
+// owned state).  Stale ids no-op exactly like socket_timer_kick.
+void socket_idle_kick(void* arg) {
+  SocketId id = (SocketId)(uintptr_t)arg;
+  Socket* s = Socket::Address(id);
+  if (s == nullptr) {
+    return;
+  }
+  s->idle_check.store(true, std::memory_order_release);
+  s->Dereference();
+  Socket::StartInputEvent(id);
+}
+}  // namespace
+
+int idle_kick_ms() {
+  int v = g_idle_kick_ms.load(std::memory_order_acquire);
+  if (TRPC_UNLIKELY(v < 0)) {
+    // flag-cached: the ONE env read (≙ overload.cc knob discipline)
+    const char* e = getenv("TRPC_IDLE_KICK_MS");
+    int resolved = 0;
+    if (e != nullptr && e[0] != '\0') {
+      long p = strtol(e, nullptr, 10);
+      resolved = (int)(p < 0 ? 0 : (p > 3600 * 1000 ? 3600 * 1000 : p));
+    }
+    int expected = -1;
+    g_idle_kick_ms.compare_exchange_strong(expected, resolved,
+                                           std::memory_order_acq_rel);
+    v = g_idle_kick_ms.load(std::memory_order_acquire);
+  }
+  return v;
+}
+
+void set_idle_kick_ms(int ms) {
+  if (ms < 0) {
+    ms = 0;
+  }
+  g_idle_kick_ms.store(ms, std::memory_order_release);
+}
+
+Butex* Socket::EnsureEpolloutButex() {
+  Butex* eb = epollout_butex.load(std::memory_order_acquire);
+  if (eb != nullptr) {
+    return eb;
+  }
+  Butex* fresh = butex_create();
+  Butex* expected = nullptr;
+  if (epollout_butex.compare_exchange_strong(expected, fresh,
+                                             std::memory_order_acq_rel)) {
+    return fresh;
+  }
+  butex_destroy(fresh);  // lost the install race: use the winner's
+  return expected;
+}
+
+void Socket::ArmIdleKick() {
+  int ms = idle_kick_ms();
+  if (ms <= 0 || failed.load(std::memory_order_acquire)) {
+    return;
+  }
+  // processing fiber only: the wheel arm routes to THIS shard's wheel
+  // (current_shard() == this->shard here), so heartbeat arm/cancel never
+  // contends another shard's lock — the per-shard-wheel design point
+  TimerTask* t = timer_add(monotonic_us() + (int64_t)ms * 1000,
+                           socket_idle_kick, (void*)(uintptr_t)id());
+  TimerTask* prev = kick_timer.exchange(t, std::memory_order_acq_rel);
+  if (prev != nullptr) {
+    timer_cancel_and_free(prev);
+  }
+  if (failed.load(std::memory_order_acquire)) {
+    // teardown raced the arm: SetFailed may have swept BEFORE our
+    // exchange published `t` — reclaim it ourselves (both sides
+    // exchange, so exactly one actor gets each pointer)
+    TimerTask* mine = kick_timer.exchange(nullptr, std::memory_order_acq_rel);
+    if (mine != nullptr) {
+      timer_cancel_and_free(mine);
+    }
+  }
+}
+
+void Socket::MaybeIdleShrink() {
+  if (!idle_kick_enabled || failed.load(std::memory_order_acquire)) {
+    return;
+  }
+  if (!idle_armed) {
+    // first drain on this connection: open the heartbeat (arming here —
+    // not at accept — keeps every arm on the connection's own shard)
+    idle_armed = true;
+    idle_seen_bytes_in = bytes_in.load(std::memory_order_relaxed);
+    ArmIdleKick();
+    return;
+  }
+  if (!idle_check.load(std::memory_order_acquire) ||
+      !idle_check.exchange(false, std::memory_order_acq_rel)) {
+    return;  // plain traffic drain: zero heartbeat work on the hot path
+  }
+  // the beat fired: its TimerTask is done — reclaim the handle (the
+  // exchange may instead catch a newer pending arm; cancel frees either)
+  TimerTask* t = kick_timer.exchange(nullptr, std::memory_order_acq_rel);
+  if (t != nullptr) {
+    timer_cancel_and_free(t);
+  }
+  uint64_t bi = bytes_in.load(std::memory_order_relaxed);
+  if (bi == idle_seen_bytes_in) {
+    // a full interval with no ingress: return banked memory.  read_buf
+    // is processing-fiber-owned, so the shrink needs no lock.
+    native_metrics().conn_idle_kicks.fetch_add(1, std::memory_order_relaxed);
+    size_t freed = read_buf.shrink();
+    if (freed > 0) {
+      native_metrics().conn_shrinks.fetch_add(1, std::memory_order_relaxed);
+      native_metrics().conn_shrunk_bytes.fetch_add(
+          (uint64_t)freed, std::memory_order_relaxed);
+    }
+  }
+  idle_seen_bytes_in = bi;
+  ArmIdleKick();
 }
 
 }  // namespace trpc

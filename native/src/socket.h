@@ -58,6 +58,12 @@ struct SocketOptions {
   // worker's shard, round-robin off-worker).  The socket's epoll/ring
   // registration and processing fibers all stay on this shard.
   int shard = -1;
+  // Enable the idle-kick heartbeat (TRPC_IDLE_KICK_MS): a periodic
+  // timer-plane beat, armed from the socket's own processing fiber, that
+  // shrinks banked per-connection memory when no ingress arrived during
+  // the interval.  Servers set this on accepted connections; listeners
+  // keep kick_timer for accept backoff/pacing instead.
+  bool idle_kick = false;
 };
 
 class Socket {
@@ -77,7 +83,14 @@ class Socket {
   void* user = nullptr;
   void (*on_failed)(Socket*) = nullptr;
   void (*frame_hint_fn)(Socket*) = nullptr;  // see SocketOptions
-  Butex* epollout_butex = nullptr;
+  // Lazily materialized by the FIRST writer that hits EAGAIN (per-
+  // connection memory diet, ISSUE 16): an idle or read-only connection
+  // never allocates it.  Wakers (HandleEpollOut/SetFailed) that load
+  // nullptr have nobody to wake — a waiter publishes the butex before
+  // registering for EPOLLOUT, and the waits carry timeouts that re-check
+  // `failed`, so the publish/wake race degrades to one bounded timeout,
+  // never a hang.  Freed (and re-nulled) at TryRecycle.
+  std::atomic<Butex*> epollout_butex{nullptr};
   // running statistics
   std::atomic<uint64_t> bytes_in{0};
   std::atomic<uint64_t> bytes_out{0};
@@ -113,6 +126,19 @@ class Socket {
   // out owns the single timer_cancel_and_free: the processing fiber
   // consumes it at the top of its drain, SetFailed sweeps it at teardown.
   std::atomic<TimerTask*> kick_timer{nullptr};
+  // Accept-plane pending-handshake charge (rpc.cc listener cap): points
+  // at the accepting listener until the first ingress bytes (or
+  // teardown) release it — whoever exchange()s the pointer out does the
+  // one decrement (mirrors the kick_timer ownership discipline).
+  std::atomic<void*> handshake_charge{nullptr};
+  // Idle-kick heartbeat state (SocketOptions.idle_kick).  idle_check is
+  // set by the fired timer callback (tick thread) and consumed by the
+  // processing fiber; the rest is touched ONLY by the processing fiber
+  // (the nevent protocol guarantees a single one per socket).
+  std::atomic<bool> idle_check{false};
+  bool idle_kick_enabled = false;
+  bool idle_armed = false;
+  uint64_t idle_seen_bytes_in = 0;
   bool corked = false;  // see SocketOptions.corked
   // Parse-batch response corking (≙ the reference batching all responses
   // of one InputMessenger cut into a single Socket::Write): while
@@ -189,6 +215,14 @@ class Socket {
 
  private:
   friend struct KeepWriteArg;
+  // CAS-install the lazy epollout butex (EAGAIN writers only).
+  Butex* EnsureEpolloutButex();
+  // Arm/re-arm the idle-kick heartbeat; processing fiber only, so the
+  // wheel arm is always shard-confined (zero foreign-wheel routing).
+  void ArmIdleKick();
+  // Consume a fired idle beat: shrink banked memory if no ingress
+  // arrived since the last beat, then re-arm.  Processing fiber only.
+  void MaybeIdleShrink();
   static void ProcessEventFiber(void* arg);
   static void KeepWriteFiber(void* arg);
   void RunKeepWrite(WriteRequest* req);  // drain loop (fiber or inline)
@@ -240,5 +274,10 @@ size_t socket_dump_all(char* buf, size_t cap);
 // Timer-plane trampoline: StartInputEvent on the SocketId packed into
 // `arg`.  Safe on stale ids (Address catches the recycled generation).
 void socket_timer_kick(void* arg);
+
+// Idle-kick heartbeat interval in ms (TRPC_IDLE_KICK_MS, 0 = off,
+// flag-cached; reloadable through trpc_set_idle_kick_ms).
+int idle_kick_ms();
+void set_idle_kick_ms(int ms);
 
 }  // namespace trpc

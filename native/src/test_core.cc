@@ -13,6 +13,7 @@
 #include "fiber.h"
 #include "flat_map.h"
 #include "iobuf.h"
+#include "overload.h"
 #include "rpc.h"
 #include "snappy.h"
 #include "timer_thread.h"
@@ -71,6 +72,28 @@ static void test_iobuf() {
   CHECK_TRUE(g2.to_string() == std::string(64, 'u'));
   g2.clear();
   CHECK_TRUE(deleted.load() == 1);
+
+  // memory diet: shrink() on a drained buffer releases the ref vector's
+  // banked capacity; on a small remainder pinning big blocks it re-homes
+  // the bytes into one exact-size block
+  IOBuf h;
+  h.append(big.data(), big.size());
+  IOBuf sink;
+  h.cutn(&sink, big.size());
+  CHECK_TRUE(h.size() == 0);
+  CHECK_TRUE(h.shrink() > 0);   // refs_ capacity returned to the heap
+  CHECK_TRUE(h.shrink() == 0);  // idempotent: nothing left to give back
+  h.append(big.data(), big.size());
+  h.pop_front(big.size() - 10);  // 10 bytes pinning a block chain
+  size_t freed = h.shrink();
+  CHECK_TRUE(freed > 0);
+  CHECK_TRUE(h.size() == 10);
+  CHECK_TRUE(h.to_string() == std::string(10, 'x'));
+  // above compact_max: shrink refuses (copying big payloads isn't a diet)
+  IOBuf k;
+  k.append(big.data(), big.size());
+  CHECK_TRUE(k.shrink() == 0);
+  CHECK_TRUE(k.to_string() == big);
   printf("iobuf ok\n");
 }
 
@@ -428,6 +451,150 @@ static void test_worker_hooks() {
   printf("ok worker_hooks polls=%d\n", polls.load());
 }
 
+// --- timer wheel (timer_thread.cc, ISSUE 16) -------------------------------
+// Unit legs for the per-shard hierarchical wheel: never-early firing,
+// cascade correctness across bucket boundaries (the 64-tick L0 horizon),
+// far-future arms (high levels + the beyond-horizon clamp), and the
+// add/cancel ownership protocol in every reachable state.
+
+struct TimerProbe {
+  std::atomic<int> fired{0};
+  std::atomic<int64_t> fire_time_us{0};
+  int64_t armed_for_us = 0;
+};
+
+static void timer_probe_cb(void* arg) {
+  TimerProbe* p = (TimerProbe*)arg;
+  p->fire_time_us.store(monotonic_us(), std::memory_order_release);
+  p->fired.fetch_add(1, std::memory_order_acq_rel);
+}
+
+static void test_timer_wheel() {
+  // never-early + cross-boundary cascades: deadlines straddling the L0
+  // horizon (64 ticks ~ 65ms) force L1 linking and a cascade back down
+  constexpr int kN = 6;
+  const int64_t delays_ms[kN] = {5, 30, 70, 130, 200, 300};
+  TimerProbe probes[kN];
+  TimerTask* tasks[kN];
+  int64_t t0 = monotonic_us();
+  for (int i = 0; i < kN; ++i) {
+    probes[i].armed_for_us = t0 + delays_ms[i] * 1000;
+    tasks[i] = timer_add(probes[i].armed_for_us, timer_probe_cb, &probes[i]);
+  }
+  for (int i = 0; i < kN; ++i) {
+    while (probes[i].fired.load(std::memory_order_acquire) == 0) {
+      usleep(1000);
+    }
+    int64_t ft = probes[i].fire_time_us.load(std::memory_order_acquire);
+    CHECK_TRUE(ft >= probes[i].armed_for_us);  // NEVER early
+    CHECK_TRUE(ft < probes[i].armed_for_us + 500 * 1000);  // not absurdly late
+    // cancel-after-fire: ownership protocol — the pair releases the task
+    // and reports "ran" (0)
+    CHECK_TRUE(timer_cancel_and_free(tasks[i]) == 0);
+    CHECK_TRUE(probes[i].fired.load(std::memory_order_acquire) == 1);
+  }
+  // monotone order for well-separated deadlines
+  for (int i = 1; i < kN; ++i) {
+    CHECK_TRUE(probes[i].fire_time_us.load(std::memory_order_acquire) >=
+               probes[i - 1].fire_time_us.load(std::memory_order_acquire));
+  }
+
+  // cancel-before-fire prevents the callback (returns 1), including
+  // far-future arms that live in the top levels / beyond-horizon clamp
+  TimerProbe far[3];
+  int64_t now = monotonic_us();
+  TimerTask* f0 = timer_add(now + 10 * 1000 * 1000, timer_probe_cb, &far[0]);
+  TimerTask* f1 =
+      timer_add(now + 3600LL * 1000 * 1000, timer_probe_cb, &far[1]);
+  TimerTask* f2 =
+      timer_add(now + 48LL * 3600 * 1000 * 1000, timer_probe_cb, &far[2]);
+  usleep(20 * 1000);  // let ticks run: far timers must NOT fire
+  CHECK_TRUE(timer_cancel_and_free(f0) == 1);
+  CHECK_TRUE(timer_cancel_and_free(f1) == 1);
+  CHECK_TRUE(timer_cancel_and_free(f2) == 1);
+  usleep(20 * 1000);
+  for (int i = 0; i < 3; ++i) {
+    CHECK_TRUE(far[i].fired.load(std::memory_order_acquire) == 0);
+  }
+
+  // bulk arm/cancel: O(1) add + eager-unlink cancel across every level
+  constexpr int kBulk = 4096;
+  static TimerProbe bulk_probe;
+  std::vector<TimerTask*> bulk(kBulk);
+  now = monotonic_us();
+  for (int i = 0; i < kBulk; ++i) {
+    // spread 100ms..~7min: L1 through L3
+    bulk[i] = timer_add(now + (100 + (int64_t)i * 100) * 1000,
+                        timer_probe_cb, &bulk_probe);
+  }
+  for (int i = 0; i < kBulk; ++i) {
+    CHECK_TRUE(timer_cancel_and_free(bulk[i]) == 1);
+  }
+  usleep(10 * 1000);
+  CHECK_TRUE(bulk_probe.fired.load(std::memory_order_acquire) == 0);
+
+  // detached oneshot: fires and frees itself, no cancel exists
+  static TimerProbe oneshot;
+  timer_add_oneshot(monotonic_us() + 5 * 1000, timer_probe_cb, &oneshot);
+  while (oneshot.fired.load(std::memory_order_acquire) == 0) {
+    usleep(1000);
+  }
+
+  // shard-wheel leg: arms from a fiber land on the worker's shard wheel
+  // (wheel index != global fallback) and obey the same protocol
+  static std::atomic<int> fiber_done{0};
+  fiber_t fb;
+  fiber_start(&fb, [](void*) {
+    static TimerProbe p;
+    int64_t a = monotonic_us() + 10 * 1000;
+    p.armed_for_us = a;
+    TimerTask* t = timer_add(a, timer_probe_cb, &p);
+    while (p.fired.load(std::memory_order_acquire) == 0) {
+      fiber_usleep(1000);
+    }
+    CHECK_TRUE(p.fire_time_us.load(std::memory_order_acquire) >= a);
+    CHECK_TRUE(timer_cancel_and_free(t) == 0);
+    fiber_done.fetch_add(1, std::memory_order_release);
+  }, nullptr);
+  fiber_join(fb);
+  CHECK_TRUE(fiber_done.load(std::memory_order_acquire) == 1);
+  printf("timer wheel ok\n");
+}
+
+static void test_overload_accept_admit() {
+  // plane off: inert — always admits, no agent state consulted
+  set_overload(0);
+  CHECK_TRUE(overload_accept_admit(0));
+  set_overload(1);
+  for (int f = 0; f < TF_FAMILIES; ++f) {
+    overload_test_reset(f, 0);
+  }
+  CHECK_TRUE(overload_accept_admit(0));  // idle shard: far under budget
+  // saturate shard 0 with real admission charges in every family until
+  // each hits its effective limit — the accept gate must then refuse
+  OverloadGate g(0);
+  int charged[TF_FAMILIES] = {0};
+  for (int f = 0; f < TF_FAMILIES; ++f) {
+    while (overload_admit(&g, f, false)) {
+      ++charged[f];
+    }
+  }
+  CHECK_TRUE(!overload_accept_admit(0));
+  // one released charge re-opens the door (strict < comparison)
+  overload_release(0, 0);
+  charged[0] -= 1;
+  CHECK_TRUE(overload_accept_admit(0));
+  for (int f = 0; f < TF_FAMILIES; ++f) {
+    for (int i = 0; i < charged[f]; ++i) {
+      overload_release(f, 0);
+    }
+    overload_test_reset(f, 0);
+  }
+  set_overload(0);
+  CHECK_TRUE(overload_accept_admit(0));
+  printf("overload accept admit ok\n");
+}
+
 int main() {
   test_flat_map();
   test_snappy_roundtrip();
@@ -437,6 +604,8 @@ int main() {
   test_iobuf();
   test_fibers_basic();
   test_butex_timeout();
+  test_timer_wheel();
+  test_overload_accept_admit();
   test_fiber_sleep();
   test_butex_pingpong();
   test_pthread_butex();

@@ -42,7 +42,9 @@
 #include "h2.h"
 #include "heap_profiler.h"
 #include "sched_perturb.h"
+#include "socket.h"
 #include "stream.h"
+#include "timer_thread.h"
 #include "tls.h"
 #include "tpu.h"
 #include "uring.h"
@@ -2893,6 +2895,265 @@ static void test_overload_races() {
   printf("ok overload_races (forced-shards child rc=%d)\n", rc);
 }
 
+// --- timer wheel races (ISSUE 16, timer_thread.cc) --------------------------
+// Forced TRPC_SHARDS=2 child: arm/cancel storms racing the tick thread,
+// the Socket::kick_timer exchange-ownership protocol racing SetFailed
+// teardown (keepalive fire vs socket death), and shard-confined vs
+// foreign-thread adds proven by the wheel-routing counters.
+
+static std::atomic<uint64_t> g_tw_cb_runs{0};
+
+static void tw_count_cb(void* p) {
+  (void)p;
+  g_tw_cb_runs.fetch_add(1, std::memory_order_relaxed);
+}
+
+static void tw_noop_edge(Socket* s) { (void)s; }
+
+struct TwArmArg {
+  std::atomic<uint64_t>* done;
+};
+
+static void tw_shard_arm_body(void* p) {
+  TwArmArg* a = (TwArmArg*)p;
+  // arm on the worker's shard wheel, cancel immediately: the eager-unlink
+  // path under the shard's own lock (zero foreign-wheel routing)
+  TimerTask* t = timer_add(monotonic_us() + 50 * 1000, tw_count_cb, nullptr);
+  timer_cancel_and_free(t);
+  a->done->fetch_add(1, std::memory_order_release);
+}
+
+static void timer_wheel_child_body() {
+  CHECK_TRUE(shard_count() == 2);
+  fiber_runtime_init(4);
+
+  // 1) wheel-routing counter proof, run in isolation BEFORE the storms so
+  //    the deltas are exact: shard-fiber arms never touch the foreign
+  //    (global fallback) wheel; pthread arms always do
+  {
+    NativeMetrics& m = native_metrics();
+    uint64_t arms0 = m.timer_arms.load(std::memory_order_acquire);
+    uint64_t foreign0 = m.timer_foreign_arms.load(std::memory_order_acquire);
+    constexpr uint64_t kFiberArms = 200;
+    constexpr uint64_t kThreadArms = 100;
+    std::atomic<uint64_t> done{0};
+    TwArmArg arg{&done};
+    for (uint64_t i = 0; i < kFiberArms; ++i) {
+      fiber_t f;
+      CHECK_TRUE(fiber_start_shard((int)(i % 2), &f, tw_shard_arm_body,
+                                   &arg) == 0);
+    }
+    int64_t deadline = monotonic_us() + 10 * 1000 * 1000;
+    while (done.load(std::memory_order_acquire) < kFiberArms &&
+           monotonic_us() < deadline) {
+      usleep(1000);
+    }
+    CHECK_TRUE(done.load(std::memory_order_acquire) == kFiberArms);
+    for (uint64_t i = 0; i < kThreadArms; ++i) {
+      TimerTask* t =
+          timer_add(monotonic_us() + 60 * 1000, tw_count_cb, nullptr);
+      timer_cancel_and_free(t);
+    }
+    uint64_t arms_d =
+        m.timer_arms.load(std::memory_order_acquire) - arms0;
+    uint64_t foreign_d =
+        m.timer_foreign_arms.load(std::memory_order_acquire) - foreign0;
+    CHECK_TRUE(arms_d == kFiberArms + kThreadArms);
+    CHECK_TRUE(foreign_d == kThreadArms);  // fiber arms: zero foreign hops
+  }
+
+  // 2) arm/cancel storm racing the tick thread: every task gets exactly
+  //    one cancel_and_free; afterwards fires + prevented == arms exactly
+  //    (the ownership ledger balances whatever the race outcomes were)
+  {
+    g_tw_cb_runs.store(0, std::memory_order_release);
+    std::atomic<uint64_t> armed{0}, prevented{0};
+    std::atomic<bool> stop{false};
+    std::vector<std::thread> ts;
+    for (int t = 0; t < 6; ++t) {
+      ts.emplace_back([&] {
+        while (!stop.load(std::memory_order_acquire)) {
+          TimerTask* task = timer_add(
+              monotonic_us() + (int64_t)(fast_rand() % 5000),
+              tw_count_cb, nullptr);
+          armed.fetch_add(1, std::memory_order_relaxed);
+          if (fast_rand() % 2 == 0) {
+            usleep(fast_rand() % 3000);
+          }
+          if (timer_cancel_and_free(task) == 1) {
+            prevented.fetch_add(1, std::memory_order_relaxed);
+          }
+        }
+      });
+    }
+    usleep(2 * 1000 * 1000);
+    stop.store(true, std::memory_order_release);
+    for (auto& th : ts) {
+      th.join();
+    }
+    CHECK_TRUE(armed.load() > 0);
+    CHECK_TRUE(prevented.load() > 0);  // both outcomes actually raced
+    CHECK_TRUE(g_tw_cb_runs.load(std::memory_order_acquire) > 0);
+    CHECK_TRUE(g_tw_cb_runs.load(std::memory_order_acquire) +
+                   prevented.load() ==
+               armed.load());
+  }
+
+  // 3) detached oneshot storm: every fire frees its own task (ASAN owns
+  //    the leak check); fibers and pthreads interleave with the cancels
+  //    of leg 2's surviving pattern
+  {
+    g_tw_cb_runs.store(0, std::memory_order_release);
+    constexpr uint64_t kOneshots = 2000;
+    for (uint64_t i = 0; i < kOneshots; ++i) {
+      timer_add_oneshot(monotonic_us() + (int64_t)(fast_rand() % 3000),
+                        tw_count_cb, nullptr);
+    }
+    int64_t deadline = monotonic_us() + 10 * 1000 * 1000;
+    while (g_tw_cb_runs.load(std::memory_order_acquire) < kOneshots &&
+           monotonic_us() < deadline) {
+      usleep(1000);
+    }
+    CHECK_TRUE(g_tw_cb_runs.load(std::memory_order_acquire) == kOneshots);
+  }
+
+  // 4) socket teardown racing keepalive fire: the kick_timer exchange
+  //    protocol — armer threads re-arm socket_timer_kick on live sockets
+  //    while a reaper fails them through the sanctioned mailbox path; the
+  //    arm-then-check-failed reclaim and the SetFailed sweep must leave
+  //    every TimerTask freed exactly once (ASAN verdict) and every id
+  //    recyclable
+  {
+    constexpr int kSocks = 48;
+    SocketId ids[kSocks];
+    int peer_fds[kSocks];
+    for (int i = 0; i < kSocks; ++i) {
+      int sv[2];
+      CHECK_TRUE(socketpair(AF_UNIX, SOCK_STREAM, 0, sv) == 0);
+      SocketOptions opts;
+      opts.fd = sv[0];
+      opts.edge_fn = tw_noop_edge;
+      peer_fds[i] = sv[1];
+      CHECK_TRUE(Socket::Create(opts, &ids[i]) == 0);
+    }
+    std::atomic<bool> stop{false};
+    std::vector<std::thread> armers;
+    for (int t = 0; t < 4; ++t) {
+      armers.emplace_back([&] {
+        while (!stop.load(std::memory_order_acquire)) {
+          SocketId id = ids[fast_rand() % kSocks];
+          Socket* s = Socket::Address(id);
+          if (s == nullptr) {
+            continue;
+          }
+          TimerTask* t2 =
+              timer_add(monotonic_us() + (int64_t)(fast_rand() % 2000),
+                        socket_timer_kick, (void*)(uintptr_t)id);
+          TimerTask* prev =
+              s->kick_timer.exchange(t2, std::memory_order_acq_rel);
+          if (prev != nullptr) {
+            timer_cancel_and_free(prev);
+          }
+          if (s->failed.load(std::memory_order_acquire)) {
+            TimerTask* mine =
+                s->kick_timer.exchange(nullptr, std::memory_order_acq_rel);
+            if (mine != nullptr) {
+              timer_cancel_and_free(mine);
+            }
+          }
+          s->Dereference();
+        }
+      });
+    }
+    std::thread reaper([&] {
+      for (int round = 0; round < kSocks; ++round) {
+        usleep(fast_rand() % 20000);
+        shard_post_socket_failed(ids[round], ECONNRESET);
+      }
+    });
+    reaper.join();
+    usleep(100 * 1000);
+    stop.store(true, std::memory_order_release);
+    for (auto& th : armers) {
+      th.join();
+    }
+    for (int i = 0; i < kSocks; ++i) {
+      // the mailbox post is async: insist every socket actually dies,
+      // then joins out (sweep freed any parked kick)
+      int64_t deadline = monotonic_us() + 10 * 1000 * 1000;
+      while (!Socket::IsRecycled(ids[i]) && monotonic_us() < deadline) {
+        usleep(1000);
+      }
+      CHECK_TRUE(Socket::IsRecycled(ids[i]));
+      close(peer_fds[i]);
+    }
+  }
+  printf("timer_wheel child ok cb_runs=%llu\n",
+         (unsigned long long)g_tw_cb_runs.load());
+}
+
+static void test_timer_wheel_races() {
+  int rc = run_forced_shards_child("__timer_wheel_body", "2");
+  CHECK_TRUE(rc == 0);
+  printf("ok timer_wheel_races (forced-shards child rc=%d)\n", rc);
+}
+
+// Lazy fiber-runtime init racing first spawns from many pthreads
+// (ISSUE 16 connection cannon exposed it): `started` used to flip
+// before the group table was built, so a CAS-losing racer returned
+// early and routed its fiber through ready_to_run's `% groups.size()`
+// with an EMPTY table — a division fault.  The child process never
+// calls fiber_runtime_init explicitly; every thread races the lazy
+// path on its first fiber_start.
+static std::atomic<uint64_t> g_lazy_ran{0};
+
+static void lazy_count_task(void* p) {
+  (void)p;
+  g_lazy_ran.fetch_add(1, std::memory_order_relaxed);
+}
+
+static void lazy_init_child_body() {
+  constexpr int kThreads = 16;
+  constexpr int kSpawns = 8;
+  std::atomic<int> go{0};
+  std::vector<std::thread> ts;
+  ts.reserve(kThreads);
+  for (int i = 0; i < kThreads; ++i) {
+    ts.emplace_back([&go]() {
+      while (go.load(std::memory_order_acquire) == 0) {
+        // spin: all threads must hit the uninitialized runtime together
+      }
+      for (int k = 0; k < kSpawns; ++k) {
+        fiber_t f;
+        CHECK_TRUE(fiber_start(&f, lazy_count_task, nullptr) == 0);
+        fiber_join(f);
+      }
+    });
+  }
+  go.store(1, std::memory_order_release);
+  for (auto& t : ts) {
+    t.join();
+  }
+  CHECK_TRUE(g_lazy_ran.load(std::memory_order_relaxed) ==
+             (uint64_t)kThreads * kSpawns);
+  printf("lazy_init child ok ran=%llu\n",
+         (unsigned long long)g_lazy_ran.load());
+}
+
+static void test_lazy_init_races() {
+  // the race window is the winner's table build — one re-exec'd child
+  // per round keeps re-rolling it, alternating sharded/unsharded
+  for (int round = 0; round < 24; ++round) {
+    int rc = run_forced_shards_child("__lazy_init_body",
+                                     (round & 1) ? "2" : "1");
+    CHECK_TRUE(rc == 0);
+    if (rc != 0) {
+      break;
+    }
+  }
+  printf("ok lazy_init_races (24 fresh-process rounds)\n");
+}
+
 // --- scenario registry + driver ---------------------------------------------
 // The default (no-args) run IS the sanitized gate: tools/lint.py
 // enforces that every test_*_races function above appears in this table,
@@ -2930,6 +3191,8 @@ static const Scenario kScenarios[] = {
     {"reuseport_accept_races", test_reuseport_accept_races},
     {"telemetry_races", test_telemetry_races},
     {"overload_races", test_overload_races},
+    {"timer_wheel_races", test_timer_wheel_races},
+    {"lazy_init_races", test_lazy_init_races},
 };
 constexpr int kNumScenarios = (int)(sizeof(kScenarios) / sizeof(kScenarios[0]));
 
@@ -3061,6 +3324,14 @@ int main(int argc, char** argv) {
   }
   if (argc > 1 && strcmp(argv[1], "__overload_body") == 0) {
     overload_child_body();
+    return g_failures == 0 ? 0 : 1;
+  }
+  if (argc > 1 && strcmp(argv[1], "__timer_wheel_body") == 0) {
+    timer_wheel_child_body();
+    return g_failures == 0 ? 0 : 1;
+  }
+  if (argc > 1 && strcmp(argv[1], "__lazy_init_body") == 0) {
+    lazy_init_child_body();
     return g_failures == 0 ? 0 : 1;
   }
   if (argc > 1 && strcmp(argv[1], "--list") == 0) {

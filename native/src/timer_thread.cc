@@ -1,14 +1,41 @@
+// Per-shard hierarchical timer wheel (ISSUE 16 tentpole; ≙ the reference
+// bthread/timer_thread.cpp hashing timers into buckets so schedule() is
+// O(1) — this build goes one step further and gives every shard its OWN
+// wheel, so arm/cancel on a parse fiber only ever contends its shard's
+// lock with the single tick thread, never with another shard's fibers).
+//
+// Layout: kMaxShards+1 wheels — wheel k serves shard k's fibers, the
+// last wheel is the global fallback for foreign threads (control plane,
+// ring engines, Python callers).  Each wheel is a classic 4-level
+// hierarchy of 64 slots at a 1.024ms tick (shift arithmetic): L0 spans
+// ~65ms, L1 ~4.2s, L2 ~4.5min, L3 ~4.8h; farther deadlines park one L3
+// revolution out and re-cascade with their true due tick.  Slots are
+// intrusive doubly-linked lists: add, cancel (eager unlink) and the
+// per-tick splice are all O(1).
+//
+// One tick pthread drives every wheel.  It parks on a CV while no timer
+// is linked anywhere (an idle process makes zero wakeups); an empty
+// wheel fast-forwards its current tick instead of replaying the idle
+// gap.  Due ticks round UP so a callback never runs before its
+// abstime_us (tests/test_native.py pins the butex-timeout floor).
+//
+// Ownership protocol (unchanged from the heap build): every timer_add
+// pairs with exactly one timer_cancel_and_free.  Cancel of a LINKED task
+// unlinks and frees it immediately; a task already spliced for firing is
+// CAS-flipped PENDING->CANCELLED and the tick thread frees it; a RUNNING
+// callback is spin-waited out.  Detached (timer_add_oneshot) tasks are
+// freed by the tick thread right after the callback.
 #include "timer_thread.h"
 
 #include <pthread.h>
 
 #include <condition_variable>
 #include <mutex>
-#include <queue>
 #include <thread>
-#include <vector>
 
+#include "metrics.h"
 #include "object_pool.h"
+#include "shard.h"
 
 namespace trpc {
 
@@ -21,127 +48,322 @@ enum TimerState : int {
 
 struct TimerTask {
   int64_t run_time_us = 0;
+  uint64_t due_tick = 0;  // absolute tick, ceil-rounded (never fires early)
   TimerFn fn = nullptr;
   void* arg = nullptr;
-  // detached (timer_add_oneshot): nobody holds a handle — the timer
+  // detached (timer_add_oneshot): nobody holds a handle — the tick
   // thread frees the task itself right after the callback returns
   bool detached = false;
+  // in a wheel slot right now; guarded by the owning wheel's mu (cancel
+  // decides unlink-vs-CAS under that lock)
+  bool linked = false;
+  uint8_t wheel = 0;  // owning wheel index, written once before publish
+  TimerTask* next = nullptr;  // intrusive slot list, guarded by wheel mu
+  TimerTask* prev = nullptr;
+  TimerTask** slot = nullptr;  // current slot head (cascades update it)
   std::atomic<int> state{TIMER_PENDING};
 };
 
 namespace {
 
-struct Later {
-  bool operator()(const TimerTask* a, const TimerTask* b) const {
-    return a->run_time_us > b->run_time_us;
-  }
+constexpr int kTickShift = 10;                  // 2^10 us = 1.024ms tick
+constexpr int64_t kTickUs = 1 << kTickShift;
+constexpr int kSlotBits = 6;
+constexpr int kSlots = 1 << kSlotBits;          // 64 slots per level
+constexpr int kLevels = 4;
+constexpr uint64_t kMaxDelta = 1ULL << (kSlotBits * kLevels);  // 2^24 ticks
+
+struct Wheel {
+  // lint:allow-blocking-bounded (every critical section is O(1) pointer
+  // splices — link/unlink/slot swap — or a bounded cascade relink; only
+  // this shard's fibers and the single tick thread ever take it)
+  std::mutex mu;
+  TimerTask* slots[kLevels][kSlots] = {};
+  uint64_t current_tick = 0;  // guarded by mu
+  uint64_t pending = 0;       // linked tasks, guarded by mu
 };
 
-class TimerThread {
+class TimerPlane {
  public:
-  static TimerThread& Instance() {
-    // leaked on purpose: the detached timer thread uses mu_/cv_ forever
-    static TimerThread* t = new TimerThread();
-    return *t;
+  static TimerPlane& Instance() {
+    // leaked on purpose: the detached tick thread uses the wheels forever
+    static TimerPlane* p = new TimerPlane();
+    return *p;
   }
 
-  TimerTask* Add(int64_t abstime_us, TimerFn fn, void* arg,
-                 bool detached = false) {
+  TimerTask* Add(int64_t abstime_us, TimerFn fn, void* arg, bool detached) {
     TimerTask* t = ObjectPool<TimerTask>::Get();
     t->run_time_us = abstime_us;
     t->fn = fn;
     t->arg = arg;
     t->detached = detached;
+    t->linked = false;
+    t->next = nullptr;
+    t->prev = nullptr;
+    t->slot = nullptr;
     t->state.store(TIMER_PENDING, std::memory_order_relaxed);
+    int shard = current_shard();
+    int widx = (shard >= 0 && shard < shard_count()) ? shard : kMaxShards;
+    t->wheel = (uint8_t)widx;
+    NativeMetrics& m = native_metrics();
+    m.timer_arms.fetch_add(1, std::memory_order_relaxed);
+    if (widx == kMaxShards) {
+      m.timer_foreign_arms.fetch_add(1, std::memory_order_relaxed);
+    }
+    int64_t now = monotonic_us();
+    // ceil: the task lands in the first tick whose wall time >= abstime
+    t->due_tick = abstime_us > base_us_
+                      ? (uint64_t)(abstime_us - base_us_ + kTickUs - 1) >>
+                            kTickShift
+                      : 0;
+    Wheel& w = wheels_[widx];
     {
-      std::lock_guard<std::mutex> lk(mu_);
-      heap_.push(t);
-      if (heap_.top() == t) {
-        cv_.notify_one();  // new earliest deadline
+      std::lock_guard<std::mutex> lk(w.mu);
+      if (w.pending == 0) {
+        // empty wheel: no slot holds work, so the tick thread may be
+        // arbitrarily behind here — fast-forward instead of letting it
+        // replay the idle gap tick by tick
+        uint64_t tgt = TargetTick(now);
+        if (tgt > w.current_tick) {
+          w.current_tick = tgt;
+        }
       }
+      LinkLocked(w, t);
+      w.pending++;
+    }
+    m.timer_pending.fetch_add(1, std::memory_order_relaxed);
+    if (linked_total_.fetch_add(1, std::memory_order_acq_rel) == 0) {
+      std::lock_guard<std::mutex> lk(park_mu_);
+      park_cv_.notify_one();
     }
     return t;
   }
 
-  void Run() {
-    std::unique_lock<std::mutex> lk(mu_);
-    while (true) {
-      if (heap_.empty()) {
-        cv_.wait(lk);
-        continue;
-      }
-      TimerTask* t = heap_.top();
-      int st = t->state.load(std::memory_order_acquire);
-      if (st == TIMER_CANCELLED) {
-        heap_.pop();
+  int CancelAndFree(TimerTask* t) {
+    NativeMetrics& m = native_metrics();
+    Wheel& w = wheels_[t->wheel];
+    {
+      std::lock_guard<std::mutex> lk(w.mu);
+      if (t->linked) {
+        UnlinkLocked(w, t);
+        w.pending--;
+        linked_total_.fetch_sub(1, std::memory_order_acq_rel);
+        m.timer_pending.fetch_sub(1, std::memory_order_relaxed);
+        m.timer_cancels.fetch_add(1, std::memory_order_relaxed);
         ObjectPool<TimerTask>::Return(t);
-        continue;
+        return 1;  // prevented, eagerly freed
       }
-      int64_t now = monotonic_us();
-      if (t->run_time_us > now) {
-        cv_.wait_for(lk, std::chrono::microseconds(t->run_time_us - now));
-        continue;
+    }
+    int expected = TIMER_PENDING;
+    if (t->state.compare_exchange_strong(expected, TIMER_CANCELLED,
+                                         std::memory_order_acq_rel)) {
+      // spliced for firing but not yet run: the tick thread observes
+      // CANCELLED instead of running it, and frees the task
+      m.timer_cancels.fetch_add(1, std::memory_order_relaxed);
+      return 1;
+    }
+    // fired (or firing): wait out the callback, then free.
+    while (t->state.load(std::memory_order_acquire) == TIMER_RUNNING) {
+#if defined(__x86_64__)
+      __builtin_ia32_pause();
+#endif
+    }
+    ObjectPool<TimerTask>::Return(t);
+    return 0;
+  }
+
+  void Run() {
+    pthread_setname_np(pthread_self(), "trpc_timer");
+    while (true) {
+      if (linked_total_.load(std::memory_order_acquire) == 0) {
+        std::unique_lock<std::mutex> lk(park_mu_);
+        park_cv_.wait(lk, [this] {
+          return linked_total_.load(std::memory_order_acquire) != 0;
+        });
       }
-      heap_.pop();
+      SleepToNextTick();
+      uint64_t target = TargetTick(monotonic_us());
+      for (int i = 0; i <= kMaxShards; ++i) {
+        Wheel& w = wheels_[i];
+        TimerTask* expired = nullptr;  // singly-chained via ->next
+        {
+          std::lock_guard<std::mutex> lk(w.mu);
+          if (w.pending == 0) {
+            if (target > w.current_tick) {
+              w.current_tick = target;
+            }
+          } else {
+            while (w.current_tick < target) {
+              AdvanceLocked(w, &expired);
+              if (w.pending == 0) {
+                // drained mid-catch-up: skip the empty remainder
+                w.current_tick = target;
+                break;
+              }
+            }
+          }
+        }
+        RunExpired(expired);
+      }
+    }
+  }
+
+ private:
+  TimerPlane() : base_us_(monotonic_us()) {
+    std::thread th([this] { Run(); });
+    th.detach();
+  }
+
+  uint64_t TargetTick(int64_t now_us) const {
+    return now_us > base_us_ ? (uint64_t)(now_us - base_us_) >> kTickShift
+                             : 0;
+  }
+
+  void SleepToNextTick() {
+    int64_t now = monotonic_us();
+    int64_t into = (now - base_us_) & (kTickUs - 1);
+    std::this_thread::sleep_for(std::chrono::microseconds(kTickUs - into));
+  }
+
+  // Link t into the slot its due_tick selects, relative to the wheel's
+  // current position (≙ timer_thread.cpp bucketing; hierarchy per the
+  // classic hashed-and-hierarchical timing wheels scheme).
+  void LinkLocked(Wheel& w, TimerTask* t) {
+    if (t->due_tick <= w.current_tick) {
+      t->due_tick = w.current_tick + 1;  // already due: next tick
+    }
+    uint64_t delta = t->due_tick - w.current_tick;
+    int level = 0;
+    while (level < kLevels - 1 &&
+           delta >= (1ULL << (kSlotBits * (level + 1)))) {
+      ++level;
+    }
+    uint64_t idx;
+    if (delta >= kMaxDelta) {
+      // beyond the horizon: park one full top-level revolution out and
+      // re-cascade later with the true due_tick
+      idx = ((w.current_tick + kMaxDelta - 1) >> (kSlotBits * (kLevels - 1)))
+            & (kSlots - 1);
+    } else {
+      idx = (t->due_tick >> (kSlotBits * level)) & (kSlots - 1);
+    }
+    TimerTask*& head = w.slots[level][idx];
+    t->prev = nullptr;
+    t->next = head;
+    t->slot = &head;  // stable: slot arrays never move
+    if (head != nullptr) {
+      head->prev = t;
+    }
+    head = t;
+    t->linked = true;
+  }
+
+  void UnlinkLocked(Wheel& w, TimerTask* t) {
+    (void)w;  // lock witness: caller holds w.mu
+    if (t->prev != nullptr) {
+      t->prev->next = t->next;
+    } else {
+      *t->slot = t->next;  // head of its slot
+    }
+    if (t->next != nullptr) {
+      t->next->prev = t->prev;
+    }
+    t->next = nullptr;
+    t->prev = nullptr;
+    t->slot = nullptr;
+    t->linked = false;
+  }
+
+  void AdvanceLocked(Wheel& w, TimerTask** expired) {
+    w.current_tick++;
+    uint64_t ct = w.current_tick;
+    if ((ct & (kSlots - 1)) == 0) {
+      CascadeLocked(w, 1, (ct >> kSlotBits) & (kSlots - 1));
+      if (((ct >> kSlotBits) & (kSlots - 1)) == 0) {
+        CascadeLocked(w, 2, (ct >> (2 * kSlotBits)) & (kSlots - 1));
+        if (((ct >> (2 * kSlotBits)) & (kSlots - 1)) == 0) {
+          CascadeLocked(w, 3, (ct >> (3 * kSlotBits)) & (kSlots - 1));
+        }
+      }
+    }
+    // splice the due slot: O(1) — the list head moves to the expired
+    // chain wholesale
+    TimerTask* t = w.slots[0][ct & (kSlots - 1)];
+    w.slots[0][ct & (kSlots - 1)] = nullptr;
+    NativeMetrics& m = native_metrics();
+    while (t != nullptr) {
+      TimerTask* nx = t->next;
+      t->linked = false;
+      t->prev = nullptr;
+      t->next = *expired;
+      *expired = t;
+      w.pending--;
+      linked_total_.fetch_sub(1, std::memory_order_acq_rel);
+      m.timer_pending.fetch_sub(1, std::memory_order_relaxed);
+      t = nx;
+    }
+  }
+
+  // Re-distribute a higher-level slot into the levels below it (runs
+  // under the wheel lock; no callbacks here).
+  void CascadeLocked(Wheel& w, int level, uint64_t idx) {
+    TimerTask* t = w.slots[level][idx];
+    w.slots[level][idx] = nullptr;
+    NativeMetrics& m = native_metrics();
+    while (t != nullptr) {
+      TimerTask* nx = t->next;
+      t->prev = nullptr;
+      t->next = nullptr;
+      LinkLocked(w, t);
+      m.timer_cascades.fetch_add(1, std::memory_order_relaxed);
+      t = nx;
+    }
+  }
+
+  void RunExpired(TimerTask* t) {
+    NativeMetrics& m = native_metrics();
+    while (t != nullptr) {
+      TimerTask* nx = t->next;
       int expected = TIMER_PENDING;
       if (t->state.compare_exchange_strong(expected, TIMER_RUNNING,
                                            std::memory_order_acq_rel)) {
-        lk.unlock();
         t->fn(t->arg);
+        m.timer_fires.fetch_add(1, std::memory_order_relaxed);
         if (t->detached) {
           // oneshot: no canceller will ever free this task
           ObjectPool<TimerTask>::Return(t);
         } else {
           t->state.store(TIMER_DONE, std::memory_order_release);
         }
-        lk.lock();
       } else {
-        // cancelled between peek and pop
+        // cancelled between splice and fire: ours to free
         ObjectPool<TimerTask>::Return(t);
       }
+      t = nx;
     }
   }
 
- private:
-  TimerThread() {
-    std::thread th([this] {
-      pthread_setname_np(pthread_self(), "trpc_timer");
-      Run();
-    });
-    th.detach();
-  }
-
-  std::mutex mu_;
-  std::condition_variable cv_;
-  std::priority_queue<TimerTask*, std::vector<TimerTask*>, Later> heap_;
+  Wheel wheels_[kMaxShards + 1];
+  std::mutex park_mu_;
+  std::condition_variable park_cv_;
+  std::atomic<int64_t> linked_total_{0};
+  const int64_t base_us_;
 };
 
 }  // namespace
 
 TimerTask* timer_add(int64_t abstime_us, TimerFn fn, void* arg) {
-  return TimerThread::Instance().Add(abstime_us, fn, arg);
+  return TimerPlane::Instance().Add(abstime_us, fn, arg, /*detached=*/false);
 }
 
 void timer_add_oneshot(int64_t abstime_us, TimerFn fn, void* arg) {
-  (void)TimerThread::Instance().Add(abstime_us, fn, arg, /*detached=*/true);
+  (void)TimerPlane::Instance().Add(abstime_us, fn, arg, /*detached=*/true);
 }
 
 int timer_cancel_and_free(TimerTask* t) {
-  int expected = TIMER_PENDING;
-  if (t->state.compare_exchange_strong(expected, TIMER_CANCELLED,
-                                       std::memory_order_acq_rel)) {
-    return 1;  // timer thread frees it on lazy pop
-  }
-  // fired (or firing): wait out the callback, then free.
-  while (t->state.load(std::memory_order_acquire) == TIMER_RUNNING) {
-#if defined(__x86_64__)
-    __builtin_ia32_pause();
-#endif
-  }
-  ObjectPool<TimerTask>::Return(t);
-  return 0;
+  return TimerPlane::Instance().CancelAndFree(t);
 }
 
-void timer_thread_start() { (void)TimerThread::Instance(); }
+void timer_thread_start() { (void)TimerPlane::Instance(); }
 
 }  // namespace trpc

@@ -1,7 +1,10 @@
-// timer_thread.h — dedicated timer pthread driving all RPC timeouts and
-// timed waits (capability of the reference bthread/timer_thread.h:53; the
-// reference uses O(1) hashed buckets, this build starts with a binary heap —
-// the schedule/unschedule rate is bounded by in-flight RPCs).
+// timer_thread.h — the timer plane driving all RPC timeouts, timed waits
+// and connection keepalive (capability of the reference
+// bthread/timer_thread.h:53's O(1) hashed buckets).  Implementation: one
+// hierarchical timer wheel PER SHARD plus a global fallback wheel for
+// foreign threads — arm/cancel on a shard's parse fiber never contends
+// another shard's lock, and a tick is O(1) regardless of how many idle
+// connections hold keepalive timers (see timer_thread.cc).
 //
 // Ownership protocol: every timer_add() must be paired with exactly one
 // timer_cancel_and_free(), even after the timer fired.  CANCELLED-while-

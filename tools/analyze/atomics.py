@@ -36,6 +36,7 @@ GATED_FILES = (
     "native/src/overload.h", "native/src/overload.cc",
     "native/src/shard.h", "native/src/shard.cc",
     "native/src/socket.h", "native/src/socket.cc",
+    "native/src/timer_thread.h", "native/src/timer_thread.cc",
     "native/src/uring.h", "native/src/uring.cc",
 )
 

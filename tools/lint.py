@@ -93,6 +93,12 @@ _HOT_REGIONS = {
     "native/src/metrics.cc": ["telemetry_record", "telemetry_inflight_add",
                               "rpcz_try_sample", "rpcz_capture",
                               "trace_annotate", "trace_set_current"],
+    # ISSUE 16: the timer wheel's arm/cancel run on parse fibers (every
+    # RPC deadline, every idle-kick) and the tick/cascade/fire loop runs
+    # every ~1ms — TimerTask recycling must stay on the ObjectPool
+    "native/src/timer_thread.cc": ["Add", "CancelAndFree", "LinkLocked",
+                                   "UnlinkLocked", "AdvanceLocked",
+                                   "CascadeLocked", "RunExpired"],
     # ISSUE 11: overload admission + gradient feeds run on the parse
     # fibers (admit per request, window fold on a completion) — the shed
     # path's ~0-cost claim dies the moment these allocate
@@ -282,7 +288,13 @@ def _check_scenarios(root: str, violations: List[Violation]) -> None:
 def _function_body(lines: List[str], name: str):
     """(start, end) 0-based line span of `name`'s definition, by brace
     matching from the definition line; None when not found."""
-    sig = re.compile(r"^[A-Za-z_][\w:<>,*&\s]*\b" + re.escape(name) +
+    # indented definitions (class members) are admitted; statement lines
+    # that merely CALL the function can't match — a leading keyword is
+    # excluded and a direct call's first token is consumed by the
+    # return-type class before \b can anchor the name
+    sig = re.compile(r"^\s*(?!return\b|else\b|if\b|while\b|for\b|do\b|"
+                     r"switch\b|case\b)"
+                     r"[A-Za-z_][\w:<>,*&\s]*\b" + re.escape(name) +
                      r"\s*\(")
     for i, line in enumerate(lines):
         if not sig.match(line):
