@@ -574,6 +574,12 @@ def main() -> int:
         "overload": "on" if bool(L.trpc_overload_active()) else "off",
         "overload_admits": native_counter("native_overload_admits"),
         "overload_rejects": native_counter("native_overload_rejects"),
+        # flight recorder (ISSUE 17): bench-of-record runs capture OFF
+        # (samples/drops must stay 0 — capture overhead belongs to the
+        # BENCH_NOTES "Traffic capture" A/B, not the headline QPS)
+        "capture": "on" if bool(L.trpc_dump_active()) else "off",
+        "capture_samples": native_counter("native_dump_captured"),
+        "capture_drops": native_counter("native_dump_dropped"),
         # payload-codec rail (ISSUE 8): bench-of-record runs none; the
         # --codec-ab harness flips TRPC_PAYLOAD_CODEC per subprocess arm
         "payload_codec": codec_names.get(int(L.trpc_payload_codec()), "?"),

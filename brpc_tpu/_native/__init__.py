@@ -308,6 +308,13 @@ def _declare(L: ctypes.CDLL) -> None:
         c.c_void_p, c.c_char_p, c.c_char_p, c.c_size_t, c.c_char_p,
         c.c_size_t, c.c_int64, c.c_int, c.POINTER(c.c_void_p)]
     L.trpc_channel_call_compressed.restype = c.c_int
+    # replay rail (native/src/dump.h): wire-form bytes from a captured
+    # sample, codec tags 16/17 stamped verbatim, encode skipped
+    L.trpc_channel_call_raw.argtypes = [
+        c.c_void_p, c.c_char_p, c.c_char_p, c.c_size_t, c.c_char_p,
+        c.c_size_t, c.c_int64, c.c_int, c.c_int, c.c_int,
+        c.POINTER(c.c_void_p)]
+    L.trpc_channel_call_raw.restype = c.c_int
     L.trpc_result_compress.argtypes = [c.c_void_p]
     L.trpc_result_compress.restype = c.c_int
     L.trpc_result_error_code.argtypes = [c.c_void_p]
@@ -509,6 +516,16 @@ def _declare(L: ctypes.CDLL) -> None:
     L.trpc_set_rpcz_budget.restype = None
     L.trpc_rpcz_drain.argtypes = [c.c_char_p, c.c_size_t]
     L.trpc_rpcz_drain.restype = c.c_size_t
+    # native flight recorder (native/src/dump.h): wire-form traffic
+    # capture on the fast paths + length-prefixed v2 sample drain
+    L.trpc_set_dump.argtypes = [c.c_int]
+    L.trpc_set_dump.restype = None
+    L.trpc_dump_active.argtypes = []
+    L.trpc_dump_active.restype = c.c_int
+    L.trpc_set_dump_budget.argtypes = [c.c_int64]
+    L.trpc_set_dump_budget.restype = None
+    L.trpc_dump_drain.argtypes = [c.c_char_p, c.c_size_t]
+    L.trpc_dump_drain.restype = c.c_size_t
     L.trpc_trace_set_current.argtypes = [c.c_uint64, c.c_uint64, c.c_int]
     L.trpc_trace_set_current.restype = None
     L.trpc_trace_current.argtypes = [c.POINTER(c.c_uint64),

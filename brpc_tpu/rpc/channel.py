@@ -171,6 +171,22 @@ class _NativeCall:
                 timeout_us, ctypes.byref(result))
         return _unpack_result(L, rc, result)
 
+    def call_raw(self, method: bytes, payload: bytes, attachment: bytes,
+                 timeout_us: int, compress: int, payload_codec: int,
+                 attach_codec: int) -> Tuple[int, str, bytes, bytes]:
+        """Replay rail (native/src/dump.h): payload/attachment are
+        WIRE-form bytes from a captured sample — the native layer skips
+        its codec encode and stamps the captured tag-16/17 ids verbatim,
+        so the frame leaving here is byte-identical to the captured one."""
+        L = lib()
+        result = ctypes.c_void_p()
+        rc = L.trpc_channel_call_raw(
+            self.handle, method, payload, len(payload),
+            attachment if attachment else None, len(attachment),
+            timeout_us, compress, payload_codec, attach_codec,
+            ctypes.byref(result))
+        return _unpack_result(L, rc, result)
+
 
 def native_fanout(subs: Sequence["SubChannel"], method: bytes,
                   payload: bytes, attachment: bytes, timeout_us: int
@@ -284,6 +300,25 @@ class SubChannel:
             return self._native.call(method, payload, attachment,
                                      timeout_us, stream_handle, compress,
                                      cancel_buf)
+        finally:
+            with self._lock:
+                self._inflight -= 1
+                if self._inflight == 0:
+                    self._drained.notify_all()
+
+    def call_raw_once(self, method: bytes, payload: bytes,
+                      attachment: bytes, timeout_us: int, compress: int,
+                      payload_codec: int, attach_codec: int):
+        """One byte-for-byte replay attempt (wire-form bytes from a
+        captured sample, codec/compress tags stamped verbatim)."""
+        with self._lock:
+            if self._closed:
+                return (errors.EFAILEDSOCKET, "channel closed", b"", b"")
+            self._inflight += 1
+        try:
+            return self._native.call_raw(method, payload, attachment,
+                                         timeout_us, compress,
+                                         payload_codec, attach_codec)
         finally:
             with self._lock:
                 self._inflight -= 1
@@ -606,6 +641,32 @@ class Channel:
                 if left <= 0:
                     return (errors.ERPCTIMEDOUT, "", b"", b"")
                 cond.wait(left)
+
+    def call_raw(self, method: str, payload: bytes = b"",
+                 attachment: bytes = b"",
+                 timeout_ms: Optional[float] = None,
+                 compress_type: int = 0, payload_codec: int = 0,
+                 attach_codec: int = 0) -> bytes:
+        """Byte-for-byte replay call (tools/rpc_replay): payload and
+        attachment are WIRE-form bytes from a captured sample; the
+        captured codec ids (meta tags 16/17) and compress type (tag 6)
+        are stamped verbatim and the client-side encode is skipped.
+        Single-server channels only, no retries — the replay cannon
+        measures offered load, sheds included.  Raises RpcError on
+        failure; returns the response payload."""
+        if self._sub is None:
+            raise errors.RpcError(
+                errors.EINTERNAL,
+                "call_raw requires a single-server channel")
+        if timeout_ms is None:
+            timeout_ms = self.options.timeout_ms
+        self._maybe_refresh_credential()
+        code, text, data, _att = self._sub.call_raw_once(
+            method.encode(), payload, attachment, int(timeout_ms * 1000),
+            compress_type, payload_codec, attach_codec)
+        if code != 0:
+            raise errors.RpcError(code, text)
+        return data
 
     # -- streaming (≙ StreamCreate + CallMethod handshake, stream.cpp:773) --
 

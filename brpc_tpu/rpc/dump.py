@@ -5,15 +5,34 @@ RpcDumpContext rpc_dump.cpp:68,150; read back by SampleIterator rpc_dump.h:81
 and replayed by tools/rpc_replay).
 
 Enable with the ``rpc_dump`` flag; sampled inbound requests are serialized
-(method, payload, attachment, compress type) into recordio files under
+(method, payload, attachment, compress/codec meta) into recordio files under
 ``rpc_dump_dir``, rotated by size.  ``SampleIterator`` yields them back for
 tools.rpc_replay.
+
+Two capture paths feed ONE record schema, but each request lands in
+the segments exactly ONCE:
+
+- Native path (canonical): the C++ flight recorder (native/src/dump.h)
+  samples wire-form frames on the parse fibers — everything inbound,
+  including the fast paths (inline echo, HbmEcho, redis-cache,
+  stream/token frames) Python never sees — and ``drain_native()``
+  pumps them through the same rotating writer.
+- Python path (fallback): ``RpcDumpContext.sample()`` on the usercode
+  dispatch, taken only while the native recorder is NOT armed
+  (``trpc_dump_active() == 0``); the parse-fiber seam already captured
+  the same frame otherwise, and sampling twice would double the
+  segments — a doubled segment replays 2x the incident's traffic.
+
+Records carry a leading schema-version byte (``0x02``); version-1 records
+(no version byte, no meta) still deserialize, so old segments replay.
 """
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
+import struct
 import threading
 import time
 from dataclasses import dataclass
@@ -21,25 +40,69 @@ from typing import Iterator, Optional
 
 from brpc_tpu.utils import flags, recordio
 
-flags.define_bool("rpc_dump", False, "sample inbound requests to disk")
+SCHEMA_V2 = 0x02
+
+
+def _push_dump(value) -> bool:
+    """Flag validator doubling as the native push: the C++ flight
+    recorder (native/src/dump.h) samples fast-path wire frames only
+    while the native half of the switch is on.  Turning the flag on
+    also arms the drain pump, so a runtime toggle (e.g. via /flags on
+    a live server) never captures into rings nobody empties."""
+    from brpc_tpu._native import lib
+    lib().trpc_set_dump(1 if value else 0)
+    if value:
+        ensure_native_drain()
+    return True
+
+
+def _push_dump_budget(value) -> bool:
+    if value < 0:
+        return False
+    from brpc_tpu._native import lib
+    lib().trpc_set_dump_budget(int(value))
+    return True
+
+
+flags.define_bool("rpc_dump",
+                  os.environ.get("TRPC_DUMP", "") not in ("", "0"),
+                  "sample inbound requests to disk (TRPC_DUMP seeds the "
+                  "boot default; the native capture rings follow this "
+                  "switch through the validator)",
+                  validator=_push_dump)
 flags.define_string("rpc_dump_dir", "./rpc_dump",
                     "directory of rpc_dump sample files")
 flags.define_int32("rpc_dump_max_requests_in_one_file", 1000,
                    "rotate after this many samples per file")
 flags.define_int32("rpc_dump_max_files", 32,
                    "keep at most this many rotated files")
-flags.define_int32("rpc_dump_max_samples_per_second", 1024,
-                   "sampling budget (≙ collector speed limit)")
+flags.define_int32("rpc_dump_max_samples_per_second",
+                   int(os.environ.get("TRPC_DUMP_BUDGET", "") or 1024),
+                   "sampling budget (≙ collector speed limit); shared "
+                   "by the Python path and the native capture rings "
+                   "(TRPC_DUMP_BUDGET seeds the boot default)",
+                   validator=_push_dump_budget)
 
 
 @dataclass
 class SampledRequest:
-    """One captured inbound request (≙ SampledRequest, rpc_dump.h:50)."""
+    """One captured inbound request (≙ SampledRequest, rpc_dump.h:50).
+
+    ``payload``/``attachment`` hold the WIRE form: still codec-encoded
+    (``payload_codec``/``attach_codec``, meta tags 16/17) and/or
+    compressed (``compress_type``, tag 6) — replay re-sends the exact
+    bytes, stamping the captured tags verbatim."""
     method: str
     payload: bytes
     attachment: bytes = b""
     compress_type: int = 0
     timestamp: float = 0.0
+    trace_id: int = 0
+    span_id: int = 0
+    payload_codec: int = 0
+    attach_codec: int = 0
+    stream_id: int = 0
+    stream_frame_type: int = 0  # 0 = unary request
 
     def serialize(self) -> bytes:
         head = json.dumps({
@@ -48,12 +111,24 @@ class SampledRequest:
             "timestamp": self.timestamp,
             "payload_len": len(self.payload),
             "attachment_len": len(self.attachment),
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "payload_codec": self.payload_codec,
+            "attach_codec": self.attach_codec,
+            "stream_id": self.stream_id,
+            "stream_frame_type": self.stream_frame_type,
         }).encode()
-        return b"%d\n%s%s%s" % (len(head), head, self.payload,
-                                self.attachment)
+        return b"%c%d\n%s%s%s" % (SCHEMA_V2, len(head), head,
+                                  self.payload, self.attachment)
 
     @staticmethod
     def deserialize(blob: bytes) -> "SampledRequest":
+        # version sniff: v2 leads with 0x02; v1 led straight with the
+        # ASCII head-length digits — old segments keep deserializing
+        if blob[:1] == bytes([SCHEMA_V2]):
+            blob = blob[1:]
+        elif not blob[:1].isdigit():
+            raise ValueError("unknown sample schema version")
         nl = blob.index(b"\n")
         head_len = int(blob[:nl])
         head = json.loads(blob[nl + 1:nl + 1 + head_len])
@@ -64,7 +139,19 @@ class SampledRequest:
             payload=rest[:pl],
             attachment=rest[pl:pl + head["attachment_len"]],
             compress_type=head["compress_type"],
-            timestamp=head["timestamp"])
+            timestamp=head["timestamp"],
+            trace_id=head.get("trace_id", 0),
+            span_id=head.get("span_id", 0),
+            payload_codec=head.get("payload_codec", 0),
+            attach_codec=head.get("attach_codec", 0),
+            stream_id=head.get("stream_id", 0),
+            stream_frame_type=head.get("stream_frame_type", 0))
+
+
+# Per-process writer discriminator: two contexts rotating in the same
+# second (e.g. the server's Python-path context and the native-drain
+# context) must never open the SAME segment file.
+_ctx_ids = itertools.count()
 
 
 class RpcDumpContext:
@@ -80,6 +167,7 @@ class RpcDumpContext:
         self._writer: Optional[recordio.RecordWriter] = None
         self._in_file = 0
         self._seq = 0
+        self._tag = "%x-%d" % (os.getpid(), next(_ctx_ids))
         self._budget = PerSecondBudget("rpc_dump_max_samples_per_second")
 
     def _try_sample(self) -> bool:
@@ -94,7 +182,8 @@ class RpcDumpContext:
             self._writer.close()
         os.makedirs(self._dir, exist_ok=True)
         path = os.path.join(
-            self._dir, f"requests.{int(time.time())}.{self._seq:06d}")
+            self._dir,
+            f"requests.{int(time.time())}.{self._tag}.{self._seq:06d}")
         self._seq += 1
         self._writer = recordio.RecordWriter(path)
         self._in_file = 0
@@ -108,6 +197,14 @@ class RpcDumpContext:
             except OSError:
                 pass
 
+    def _write_locked(self, blob: bytes) -> None:
+        if (self._writer is None or self._in_file >=
+                int(flags.get_flag("rpc_dump_max_requests_in_one_file"))):
+            self._rotate()
+        self._writer.write(blob)
+        self._writer.flush()
+        self._in_file += 1
+
     def sample(self, req: SampledRequest) -> bool:
         """Called on the server hot path; cheap no-op unless enabled and
         under budget."""
@@ -116,14 +213,15 @@ class RpcDumpContext:
         with self._lock:
             if not self._try_sample():
                 return False
-            if (self._writer is None or self._in_file >=
-                    int(flags.get_flag("rpc_dump_max_requests_in_one_file"))):
-                self._rotate()
             req.timestamp = time.time()
-            self._writer.write(req.serialize())
-            self._writer.flush()
-            self._in_file += 1
+            self._write_locked(req.serialize())
             return True
+
+    def write_blob(self, blob: bytes) -> None:
+        """Write one already-serialized sample record (the native drain
+        path: budget + meta were applied at capture time in C++)."""
+        with self._lock:
+            self._write_locked(blob)
 
     def close(self) -> None:
         with self._lock:
@@ -151,3 +249,82 @@ class SampleIterator:
                     yield SampledRequest.deserialize(blob)
                 except (ValueError, KeyError, IndexError):
                     continue  # skip corrupt sample
+
+
+# --- native capture drain ---------------------------------------------------
+# The C++ rings (native/src/dump.cc) hold sampled wire frames already
+# serialized at drain time into the v2 record schema; this side only
+# splits the length-prefixed batch and appends through the rotating
+# writer.  One module-level context so concurrent drains share one
+# segment sequence (its filename tag keeps it apart from any
+# server-owned Python-path context).
+
+_native_ctx: Optional[RpcDumpContext] = None
+_native_lock = threading.Lock()
+_drain_lock = threading.Lock()
+_pump_started = False
+
+
+def _native_context() -> RpcDumpContext:
+    global _native_ctx
+    with _native_lock:
+        if _native_ctx is None:
+            _native_ctx = RpcDumpContext()
+        return _native_ctx
+
+
+def drain_native() -> int:
+    """Move natively captured frames (dump.cc rings) into recordio
+    segments under rpc_dump_dir (returns how many).  Runs at human /
+    pump frequency; the native side is lock-free for its writers."""
+    try:
+        import ctypes
+        from brpc_tpu._native import lib
+    except Exception:
+        return 0  # native core unavailable (exotic import contexts)
+    ctx = _native_context()
+    moved = 0
+    with _drain_lock:
+        buf = ctypes.create_string_buffer(1 << 20)
+        while True:
+            n = lib().trpc_dump_drain(buf, len(buf))
+            if n == 0:
+                break  # rings drained (a buffer-full stop returns > 0)
+            raw = buf.raw[:n]
+            off = 0
+            while off + 4 <= len(raw):
+                (blen,) = struct.unpack_from("<I", raw, off)
+                off += 4
+                if off + blen > len(raw):
+                    break  # torn batch tail: impossible by construction
+                ctx.write_blob(raw[off:off + blen])
+                off += blen
+                moved += 1
+    return moved
+
+
+def ensure_native_drain() -> None:
+    """Start the background pump flushing the native capture rings to
+    disk (idempotent; daemon thread).  Servers call this when rpc_dump
+    turns on — without a pump the 64-slot rings would just lap."""
+    global _pump_started
+    with _native_lock:
+        if _pump_started:
+            return
+        _pump_started = True
+
+    def _pump() -> None:
+        while True:
+            time.sleep(0.25)
+            try:
+                # only pump while the FLAG holds the recorder on: a
+                # harness arming the native switch directly (tests, the
+                # stress child) drains by hand, and a background steal
+                # between its captures and its own drain would race it
+                if flags.get_flag("rpc_dump"):
+                    drain_native()
+            except Exception:
+                return  # interpreter teardown
+
+    threading.Thread(target=_pump, name="rpc-dump-drain",
+                     daemon=True).start()

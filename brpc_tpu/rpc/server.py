@@ -618,14 +618,22 @@ class Server:
                 req = ctypes.string_at(req_p, req_len) if req_len else b""
                 cntl.request_compress_type = max(
                     L.trpc_token_compress(token), 0)
-                if flags.get_flag("rpc_dump"):
+                if (flags.get_flag("rpc_dump")
+                        and not L.trpc_dump_active()):
                     # sample the wire-form request (pre-decompression,
-                    # ≙ rpc_dump capturing what arrived, rpc_dump.cpp)
+                    # ≙ rpc_dump capturing what arrived, rpc_dump.cpp) —
+                    # same v2 record schema the native capture plane
+                    # emits, so segments from either path interchange.
+                    # Fallback only: when the native flight recorder is
+                    # armed it already captured this frame at the parse
+                    # fiber (pre-admission wire form) — sampling here
+                    # too would double every record in the segments.
                     limiter_box._dump.sample(dump_mod.SampledRequest(
                         method=cntl.method, payload=req,
                         attachment=ctypes.string_at(att_p, att_len)
                         if att_len else b"",
-                        compress_type=cntl.request_compress_type))
+                        compress_type=cntl.request_compress_type,
+                        trace_id=cntl.trace_id, span_id=cntl.span_id))
                 if cntl.request_compress_type:
                     try:
                         req = compress_mod.decompress(
@@ -831,6 +839,15 @@ class Server:
             1 if flags.get_flag("enable_rpcz") else 0)
         lib().trpc_set_rpcz_budget(
             int(flags.get_flag("rpcz_max_samples_per_second")))
+        # flight recorder (dump.h): the native capture rings follow the
+        # resolved rpc_dump flags, and the drain pump starts so sampled
+        # fast-path frames reach the recordio segments
+        lib().trpc_set_dump(
+            1 if flags.get_flag("rpc_dump") else 0)
+        lib().trpc_set_dump_budget(
+            int(flags.get_flag("rpc_dump_max_samples_per_second")))
+        if flags.get_flag("rpc_dump"):
+            dump_mod.ensure_native_drain()
         # overload-control plane (overload.h): resolved flag state lands
         # in the native atomics before traffic; off = the plane is inert
         lib().trpc_set_overload(

@@ -9,6 +9,7 @@
 #include <vector>
 
 #include "codec.h"
+#include "dump.h"
 #include "fiber.h"
 #include "fiber_sync.h"
 #include "h2.h"
@@ -742,6 +743,24 @@ int trpc_channel_call_stream(void* c, const char* method, const uint8_t* req,
   return rc;
 }
 
+// Replay rail (dump.h): req/attach are WIRE-form bytes from a captured
+// sample — the payload-codec encode is skipped and tags 16/17 carry
+// payload_codec/attach_codec verbatim, so the replayed frame is
+// byte-identical to the one the flight recorder captured.
+int trpc_channel_call_raw(void* c, const char* method, const uint8_t* req,
+                          size_t req_len, const uint8_t* attach,
+                          size_t attach_len, int64_t timeout_us,
+                          int compress_type, int payload_codec,
+                          int attach_codec, void** result) {
+  CallResult* r = new CallResult();
+  int rc = channel_call((Channel*)c, method, req, req_len, attach,
+                        attach_len, timeout_us, r, 0,
+                        (uint8_t)compress_type, nullptr,
+                        (payload_codec & 0xff) | ((attach_codec & 0xff) << 8));
+  *result = r;
+  return rc;
+}
+
 // --- streaming RPC (stream.h) ----------------------------------------------
 
 uint64_t trpc_stream_create(uint64_t window_bytes) {
@@ -834,6 +853,18 @@ void trpc_set_rpcz_budget(int64_t per_second) {
 // Drain captured spans as tab-separated lines (consumed; they surface
 // exactly once, through the Python Collector into span.py's store).
 size_t trpc_rpcz_drain(char* buf, size_t cap) { return rpcz_drain(buf, cap); }
+
+// Native flight recorder (dump.h): wire-form traffic capture on the
+// fast paths.  The Python rpc_dump flag drives the switch; the budget
+// mirrors rpc_dump_max_samples_per_second (collector-style rate limit).
+void trpc_set_dump(int on) { dump_set_enabled(on); }
+int trpc_dump_active() { return dump_native_enabled() ? 1 : 0; }
+void trpc_set_dump_budget(int64_t per_second) {
+  dump_set_budget(per_second);
+}
+// Drain captured frames as length-prefixed v2 sample blobs (consumed;
+// they surface exactly once, through dump.py's drain into recordio).
+size_t trpc_dump_drain(char* buf, size_t cap) { return dump_drain(buf, cap); }
 
 // Cross-hop trace context of the calling thread (fiber-local parent):
 // trace_set_current(0,0,0) clears; python_owned=1 marks "the Python

@@ -1,0 +1,319 @@
+// dump.cc — native flight recorder (≙ the reference rpc_dump.cpp:68-150:
+// RpcDumpContext sampling throttled by bvar::Collector, serialized into
+// rotated recordio segments).  This is the fast-path half our PR-3 inline
+// dispatch made necessary: echo / HbmEcho / redis-cache / stream frames
+// never reach Python, so brpc_tpu/rpc/dump.py cannot see them.  Capture
+// runs on the parse fiber through the PR-9 span-ring discipline
+// (metrics.cc rpcz_capture): per-shard seqlock'd rings, claim-before-
+// write, counted drops.  The rings differ from SpanRing in ONE way: a
+// DumpRecord holds IOBuf chains, which are not memcpy-safe under the
+// plain read-retry seqlock — so the DRAIN side also claims slots
+// (even -> odd CAS) before touching a record, and releases them back to
+// even.  Writers and the drain therefore never co-touch a record; a
+// failed claim on either side is a counted drop (writer) or a skip
+// (drain), never a torn IOBuf.
+#include "dump.h"
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <mutex>
+
+#include "common.h"
+#include "metrics.h"
+#include "shard.h"
+
+namespace trpc {
+
+namespace {
+
+constexpr int kDumpRingSlots = 64;  // per shard; drained at read time
+
+// One captured wire-form frame.  Payload/attachment are IOBuf block-ref
+// shares of the inbound bytes — capture copies pointers, never bytes.
+struct DumpRecord {
+  char method[64] = {};
+  uint32_t method_len = 0;
+  uint64_t trace_id = 0;
+  uint64_t span_id = 0;
+  uint64_t correlation_id = 0;
+  uint64_t stream_id = 0;
+  int64_t wall_us = 0;  // CLOCK_REALTIME at capture (Python-schema time)
+  uint8_t compress_type = 0;
+  uint8_t payload_codec = 0;
+  uint8_t attach_codec = 0;
+  uint8_t stream_frame_type = 0;
+  // 1 = holds an unconsumed capture.  A capture whose claim FAILED still
+  // advanced head, so the drain visits that index and finds whatever the
+  // slot last held — without this flag it would re-emit an
+  // already-consumed record (stale meta, empty payload).
+  uint8_t live = 0;
+  int32_t shard = 0;
+  IOBuf payload;
+  IOBuf attachment;
+};
+
+struct DumpSlot {
+  // seqlock: odd = a writer OR the drain is inside (both sides claim)
+  std::atomic<uint32_t> seq{0};
+  DumpRecord rec;
+};
+
+struct DumpRing {
+  std::atomic<uint64_t> head{0};  // next slot index to claim (mod slots)
+  uint64_t tail = 0;              // consumed watermark (under drain_mu)
+  std::mutex drain_mu;
+  DumpSlot slots[kDumpRingSlots];
+};
+
+DumpRing g_dump_rings[kMaxShards];
+
+// -1 = resolve TRPC_DUMP on first use (flag-cached; the Python rpc_dump
+// flag validator overrides through trpc_set_dump)
+std::atomic<int> g_dump{-1};
+// -1 = resolve TRPC_DUMP_BUDGET on first use (flag-cached; the Python
+// rpc_dump_max_samples_per_second validator overrides)
+std::atomic<int64_t> g_dump_budget{-1};
+// token bucket refilled per ~second (monotonic_ns >> 30 ≈ 1.07s epochs;
+// the same collector-style pacing as rpcz_try_sample — ≙ the ONE
+// bvar::Collector throttling both rpcz spans and rpc_dump samples)
+std::atomic<int64_t> g_dump_epoch{-1};
+std::atomic<int64_t> g_dump_left{0};
+
+int dump_resolve() {
+  // flag-cached: the ONE env read; the resolved value lives in g_dump
+  const char* e = getenv("TRPC_DUMP");
+  int on = (e != nullptr && e[0] != '\0' && e[0] != '0') ? 1 : 0;
+  int expected = -1;
+  g_dump.compare_exchange_strong(expected, on, std::memory_order_acq_rel);
+  return g_dump.load(std::memory_order_acquire);
+}
+
+int64_t dump_budget_resolve() {
+  // flag-cached: the ONE env read; the resolved value lives in
+  // g_dump_budget (default matches rpc_dump_max_samples_per_second)
+  const char* e = getenv("TRPC_DUMP_BUDGET");
+  int64_t per_second = 1024;
+  if (e != nullptr && e[0] != '\0') {
+    long v = strtol(e, nullptr, 10);
+    per_second = v > 0 ? (int64_t)v : 0;
+  }
+  int64_t expected = -1;
+  g_dump_budget.compare_exchange_strong(expected, per_second,
+                                        std::memory_order_acq_rel);
+  return g_dump_budget.load(std::memory_order_acquire);
+}
+
+inline int dump_clamp_shard(int shard) {
+  return shard >= 0 && shard < kMaxShards ? shard : 0;
+}
+
+inline int64_t wall_us_now() {
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return (int64_t)ts.tv_sec * 1000000 + ts.tv_nsec / 1000;
+}
+
+}  // namespace
+
+void dump_set_enabled(int on) {
+  g_dump.store(on != 0 ? 1 : 0, std::memory_order_release);
+}
+
+bool dump_native_enabled() {
+  int v = g_dump.load(std::memory_order_acquire);
+  if (TRPC_UNLIKELY(v < 0)) {
+    v = dump_resolve();
+  }
+  return v != 0;
+}
+
+void dump_set_budget(int64_t per_second) {
+  g_dump_budget.store(per_second > 0 ? per_second : 0,
+                      std::memory_order_release);
+}
+
+bool dump_try_sample() {
+  if (!dump_native_enabled()) {
+    return false;
+  }
+  int64_t budget = g_dump_budget.load(std::memory_order_acquire);
+  if (TRPC_UNLIKELY(budget < 0)) {
+    budget = dump_budget_resolve();
+  }
+  int64_t epoch = monotonic_ns() >> 30;
+  int64_t seen = g_dump_epoch.load(std::memory_order_acquire);
+  if (seen != epoch &&
+      g_dump_epoch.compare_exchange_strong(seen, epoch,
+                                           std::memory_order_acq_rel)) {
+    // refill winner: losers draw from whatever remains of the old epoch
+    // for one race window — collector semantics, not an exact meter
+    g_dump_left.store(budget, std::memory_order_release);
+  }
+  return g_dump_left.fetch_sub(1, std::memory_order_acq_rel) > 0;
+}
+
+void dump_capture(const DumpMeta& m, const IOBuf& payload,
+                  const IOBuf& attachment) {
+  int shard = dump_clamp_shard(m.shard);
+  DumpRing& ring = g_dump_rings[shard];
+  NativeMetrics& nm = native_metrics();
+  uint64_t idx = ring.head.fetch_add(1, std::memory_order_acq_rel);
+  DumpSlot& slot = ring.slots[idx % kDumpRingSlots];
+  // CLAIM the slot (even -> odd CAS) before writing: captures come from
+  // arbitrary parse fibers, the drain claims slots too, and the ring can
+  // lap a stalled tenant.  A failed claim means someone is inside the
+  // slot: this sample is DROPPED (counted), never co-written — an IOBuf
+  // co-write would corrupt block refcounts, not just tear bytes.
+  uint32_t seq = slot.seq.load(std::memory_order_acquire);
+  if ((seq & 1u) != 0 ||
+      !slot.seq.compare_exchange_strong(seq, seq + 1,
+                                        std::memory_order_acq_rel)) {
+    nm.dump_dropped.fetch_add(1, std::memory_order_relaxed);
+    return;
+  }
+  DumpRecord& r = slot.rec;
+  r.method_len = m.method_len < sizeof(r.method) ? (uint32_t)m.method_len
+                                                 : (uint32_t)sizeof(r.method);
+  for (uint32_t i = 0; i < r.method_len; ++i) {
+    // sanitized at capture so the drain can embed it in a JSON head
+    // without escaping: quotes/backslashes/control chars -> '_'
+    char c = m.method[i];
+    r.method[i] = (c == '"' || c == '\\' || (unsigned char)c < 0x20)
+                      ? '_'
+                      : c;
+  }
+  r.trace_id = m.trace_id;
+  r.span_id = m.span_id;
+  r.correlation_id = m.correlation_id;
+  r.stream_id = m.stream_id;
+  r.wall_us = wall_us_now();
+  r.compress_type = m.compress_type;
+  r.payload_codec = m.payload_codec;
+  r.attach_codec = m.attach_codec;
+  r.stream_frame_type = m.stream_frame_type;
+  r.live = 1;
+  r.shard = shard;
+  // block-ref shares: the wire bytes are never copied or flattened here
+  r.payload = payload;
+  r.attachment = attachment;
+  slot.seq.fetch_add(1, std::memory_order_release);  // even: stable
+  nm.dump_captured.fetch_add(1, std::memory_order_relaxed);
+}
+
+size_t dump_drain(char* buf, size_t cap) {
+  size_t off = 0;
+  NativeMetrics& nm = native_metrics();
+  for (int k = 0; k < kMaxShards; ++k) {
+    DumpRing& ring = g_dump_rings[k];
+    std::lock_guard<std::mutex> lk(ring.drain_mu);
+    uint64_t head = ring.head.load(std::memory_order_acquire);
+    uint64_t from = ring.tail;
+    if (head - from > (uint64_t)kDumpRingSlots) {
+      // ring lapped the drain: the overwritten records are gone (their
+      // IOBuf refs were released by the overwriting capture's assign)
+      uint64_t lost = head - from - kDumpRingSlots;
+      nm.dump_dropped.fetch_add(lost, std::memory_order_relaxed);
+      from = head - kDumpRingSlots;
+    }
+    for (uint64_t i = from; i < head; ++i) {
+      DumpSlot& slot = ring.slots[i % kDumpRingSlots];
+      // CLAIM before reading — a DumpRecord holds IOBufs, so the
+      // read-retry trick SpanRing's drain uses would race refcounts.
+      uint32_t s0 = slot.seq.load(std::memory_order_acquire);
+      if ((s0 & 1u) != 0 ||
+          !slot.seq.compare_exchange_strong(s0, s0 + 1,
+                                            std::memory_order_acq_rel)) {
+        // a writer is mid-slot (the ring lapped us during the walk):
+        // skip it — counted as dropped, never emitted half-written
+        nm.dump_dropped.fetch_add(1, std::memory_order_relaxed);
+        continue;
+      }
+      DumpRecord& r = slot.rec;
+      if (r.live == 0) {
+        // this index's capture lost its claim (already counted dropped);
+        // the slot holds a consumed record — nothing to emit
+        slot.seq.fetch_add(1, std::memory_order_release);
+        continue;
+      }
+      // v2 sample head, shared schema with brpc_tpu/rpc/dump.py —
+      // method was sanitized at capture, every other field is numeric,
+      // so plain snprintf emits valid JSON
+      char head_buf[512];
+      int head_len = snprintf(
+          head_buf, sizeof(head_buf),
+          "{\"method\": \"%.*s\", \"compress_type\": %u, "
+          "\"timestamp\": %lld.%06lld, \"payload_len\": %zu, "
+          "\"attachment_len\": %zu, \"trace_id\": %llu, "
+          "\"span_id\": %llu, \"payload_codec\": %u, "
+          "\"attach_codec\": %u, \"stream_id\": %llu, "
+          "\"stream_frame_type\": %u}",
+          (int)r.method_len, r.method, (unsigned)r.compress_type,
+          (long long)(r.wall_us / 1000000),
+          (long long)(r.wall_us % 1000000), r.payload.size(),
+          r.attachment.size(), (unsigned long long)r.trace_id,
+          (unsigned long long)r.span_id, (unsigned)r.payload_codec,
+          (unsigned)r.attach_codec, (unsigned long long)r.stream_id,
+          (unsigned)r.stream_frame_type);
+      char pfx_buf[16];
+      int pfx_len = snprintf(pfx_buf, sizeof(pfx_buf), "%d\n", head_len);
+      size_t blob_len = 1 + (size_t)pfx_len + (size_t)head_len +
+                        r.payload.size() + r.attachment.size();
+      size_t total = 4 + blob_len;
+      if (off + total > cap) {
+        if (off == 0 && total > cap) {
+          // one record larger than the whole drain buffer can never be
+          // emitted: drop it so the drain does not stall forever
+          r.payload.clear();
+          r.attachment.clear();
+          r.live = 0;
+          nm.dump_dropped.fetch_add(1, std::memory_order_relaxed);
+          slot.seq.fetch_add(1, std::memory_order_release);
+          continue;
+        }
+        // out of buffer: release the claim with the record INTACT
+        // (seq advances to even, content untouched) so it surfaces on
+        // the next drain
+        slot.seq.fetch_add(1, std::memory_order_release);
+        ring.tail = i;
+        return off;
+      }
+      // u32 LE length prefix, then the v2 blob
+      buf[off] = (char)(blob_len & 0xff);
+      buf[off + 1] = (char)((blob_len >> 8) & 0xff);
+      buf[off + 2] = (char)((blob_len >> 16) & 0xff);
+      buf[off + 3] = (char)((blob_len >> 24) & 0xff);
+      off += 4;
+      buf[off++] = (char)0x02;  // schema-version byte
+      memcpy(buf + off, pfx_buf, (size_t)pfx_len);
+      off += (size_t)pfx_len;
+      memcpy(buf + off, head_buf, (size_t)head_len);
+      off += (size_t)head_len;
+      off += r.payload.copy_to(buf + off, r.payload.size());
+      off += r.attachment.copy_to(buf + off, r.attachment.size());
+      // consume: drop the block refs before releasing the slot
+      r.payload.clear();
+      r.attachment.clear();
+      r.live = 0;
+      slot.seq.fetch_add(1, std::memory_order_release);
+      nm.dump_drained.fetch_add(1, std::memory_order_relaxed);
+    }
+    ring.tail = head;
+  }
+  return off;
+}
+
+uint64_t dump_captured_total() {
+  return native_metrics().dump_captured.load(std::memory_order_relaxed);
+}
+
+uint64_t dump_dropped_total() {
+  return native_metrics().dump_dropped.load(std::memory_order_relaxed);
+}
+
+uint64_t dump_drained_total() {
+  return native_metrics().dump_drained.load(std::memory_order_relaxed);
+}
+
+}  // namespace trpc

@@ -1,0 +1,73 @@
+// dump.h — native flight recorder: sampled wire-form traffic capture for
+// the fast paths Python never sees (≙ the reference rpc_dump.{h,cpp}:
+// SampledRequest throttled by the bvar Collector, written to recordio —
+// here the capture side runs on the parse fibers through the PR-9
+// span-ring discipline, and the Python drain writes the SAME versioned
+// record schema brpc_tpu/rpc/dump.py produces, so native- and
+// Python-captured segments are interchangeable to SampleIterator and
+// tools/rpc_replay).
+//
+// Write side: per-shard seqlock'd rings, claim-before-write (a failed
+// claim is a counted drop, never a co-write), payload/attachment shared
+// as refcounted IOBuf block refs — no flatten, no byte copy on the hot
+// path.  Drain side (trpc_dump_drain, human/collector frequency):
+// consumes records, serializing each into one length-prefixed v2 sample
+// blob the Python side writes through the PR-7 recordio rotation.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+#include "iobuf.h"
+
+namespace trpc {
+
+// Reloadable master switch (TRPC_DUMP env seeds the default; the Python
+// rpc_dump flag validator pushes through capi) plus the collector-style
+// per-second sampling budget shared across shards (the same epoch-bucket
+// pacing discipline as rpcz_try_sample — ≙ bvar::Collector's speed
+// limit throttling rpc_dump, rpc_dump.cpp:69).
+void dump_set_enabled(int on);
+bool dump_native_enabled();
+void dump_set_budget(int64_t per_second);
+// One budget token (false = disabled or over budget this second).
+bool dump_try_sample();
+
+// Wire-form meta of one sampled inbound frame — exactly the TLV fields
+// the replay cannon needs to reproduce the frame byte-for-byte (method
+// tag 1, trace/span tags 7/8, compress tag 6, codec tags 16/17, stream
+// tags 10/11).  `method` is NOT retained past the dump_capture call.
+struct DumpMeta {
+  const char* method = nullptr;
+  size_t method_len = 0;
+  uint64_t trace_id = 0;
+  uint64_t span_id = 0;
+  uint64_t correlation_id = 0;
+  uint64_t stream_id = 0;
+  uint8_t compress_type = 0;
+  uint8_t payload_codec = 0;
+  uint8_t attach_codec = 0;
+  uint8_t stream_frame_type = 0;  // 0 = unary request
+  int shard = 0;
+};
+
+// Publish one sampled frame into the capturing shard's ring.  The
+// payload/attachment IOBuf chains are shared (block-ref copies); the
+// bytes are the WIRE form — still codec-encoded / compressed — so a
+// replayed frame is byte-identical to what arrived.
+void dump_capture(const DumpMeta& m, const IOBuf& payload,
+                  const IOBuf& attachment);
+
+// Drain every shard's ring, consuming records.  Each record serializes
+// as: u32 blob_len (LE) | blob, where blob is the shared v2 sample
+// schema (brpc_tpu/rpc/dump.py SampledRequest):
+//   0x02 | "<head_len>\n" | JSON head | payload bytes | attachment bytes
+// Stops early when buf fills; the rest surfaces on the next drain.
+size_t dump_drain(char* buf, size_t cap);
+
+// Rollup counters (also in native_metrics_dump as native_dump_*).
+uint64_t dump_captured_total();
+uint64_t dump_dropped_total();
+uint64_t dump_drained_total();
+
+}  // namespace trpc

@@ -557,6 +557,9 @@ size_t native_metrics_dump(char* buf, size_t cap) {
   put("native_uring_zc_pool_in_use", rel(m.uring_zc_pool_in_use));
   put("native_rpcz_spans_sampled", relu(m.rpcz_spans_sampled));
   put("native_rpcz_spans_dropped", relu(m.rpcz_spans_dropped));
+  put("native_dump_captured", relu(m.dump_captured));
+  put("native_dump_dropped", relu(m.dump_dropped));
+  put("native_dump_drained", relu(m.dump_drained));
   // hot-path telemetry plane: per-family latency percentiles (derived
   // from the per-shard log-bucket histograms at read time), counts and
   // inflight gauges — what /status, /vars and the periodic bvar dump see

@@ -179,6 +179,14 @@ struct NativeMetrics {
   std::atomic<uint64_t> rpcz_spans_sampled{0};
   std::atomic<uint64_t> rpcz_spans_dropped{0};
 
+  // native traffic capture (dump.cc rings): captured = wire frames that
+  // landed in a shard ring; dropped = frames lost to claim contention,
+  // ring laps, or a record bigger than the drain buffer; drained =
+  // frames consumed by trpc_dump_drain into the Python recordio writer.
+  std::atomic<uint64_t> dump_captured{0};
+  std::atomic<uint64_t> dump_dropped{0};
+  std::atomic<uint64_t> dump_drained{0};
+
   // schedule perturbation (sched_perturb.cc, TRPC_SCHED_SEED): yields =
   // injected pauses/spins/budget truncations at instrumented seams;
   // steal_shuffles = seeded steal-victim + placement-detour draws;

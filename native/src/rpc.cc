@@ -26,6 +26,7 @@
 #include <vector>
 
 #include "codec.h"
+#include "dump.h"
 #include "h2.h"
 #include "http.h"
 #include "metrics.h"
@@ -1753,6 +1754,19 @@ void ServerOnMessages(Socket* s) {
           continue;
         }
         srv->nrequests.fetch_add(1, std::memory_order_relaxed);
+        if (TRPC_UNLIKELY(dump_native_enabled()) && dump_try_sample()) {
+          // Flight-recorder seam for the RESP port: the sampled record
+          // carries the packed argv blob (redis.h PackRedisArgs — the
+          // exact framing the redis handler callback receives), method
+          // "REDIS" so rpc_view/rpc_replay can tell it from TRPC frames.
+          IOBuf rpay;
+          rpay.append(PackRedisArgs(argv));
+          DumpMeta dm;
+          dm.method = "REDIS";
+          dm.method_len = 5;
+          dm.shard = s->shard;
+          dump_capture(dm, rpay, IOBuf());
+        }
         if (srv->redis_store != nullptr && RedisCacheHandles(argv)) {
           // native-cache command: run to completion on this parse fiber
           // under the budget, or on a spawned fiber past it — either way
@@ -2140,6 +2154,29 @@ void ServerOnMessages(Socket* s) {
       // stranger can't cancel another client's call by guessing ids.
       CancelInflight(s->id(), meta.correlation_id);
       continue;
+    }
+    if (TRPC_UNLIKELY(dump_native_enabled()) && dump_try_sample()) {
+      // Flight-recorder seam (dump.h, ≙ the reference sampling inbound
+      // requests in the InputMessenger's process path, rpc_dump.cpp:150):
+      // capture the WIRE form — before overload admission (a shed is
+      // offered load the replay cannon must reproduce) and before the
+      // codec decode (tag-16/17 bytes stay encoded, so a replayed frame
+      // is byte-identical).  Stream/token frames are sampled here too,
+      // pre-splice, with their frame type; the IOBufs are block-ref
+      // shares — no flatten, no byte copy on this parse fiber.
+      DumpMeta dm;
+      dm.method = meta.method.data();
+      dm.method_len = meta.method.size();
+      dm.trace_id = meta.trace_id;
+      dm.span_id = meta.span_id;
+      dm.correlation_id = meta.correlation_id;
+      dm.stream_id = meta.stream_id;
+      dm.compress_type = meta.compress_type;
+      dm.payload_codec = meta.payload_codec;
+      dm.attach_codec = meta.attach_codec;
+      dm.stream_frame_type = meta.stream_frame_type;
+      dm.shard = s->shard;
+      dump_capture(dm, payload, attachment);
     }
     if (meta.stream_frame_type != STREAM_FRAME_NONE) {
       if (srv->has_auth && !s->authed.load(std::memory_order_acquire)) {
@@ -4962,7 +4999,7 @@ void channel_destroy(Channel* c) {
 int channel_call(Channel* c, const char* method, const uint8_t* req,
                  size_t req_len, const uint8_t* attach, size_t attach_len,
                  int64_t timeout_us, CallResult* out, uint64_t stream,
-                 uint8_t compress, uint64_t* call_id_out) {
+                 uint8_t compress, uint64_t* call_id_out, int raw_codecs) {
   int rc = 0;
   Socket* s = AcquireConn(c, &rc);
   if (s == nullptr) {
@@ -5046,7 +5083,14 @@ int channel_call(Channel* c, const char* method, const uint8_t* req,
   // Skipped when the caller already compressed (compress tag 6): the
   // two rails are orthogonal and double-encoding helps neither.
   uint8_t want_codec = compress == 0 ? (uint8_t)payload_codec() : 0;
-  if (want_codec != 0) {
+  if (raw_codecs >= 0) {
+    // replay rail (dump.h): the caller hands over WIRE-form bytes from a
+    // captured sample — stamp the captured tag-16/17 ids verbatim and
+    // skip the encode, so the replayed frame is byte-identical to the
+    // one the flight recorder saw.
+    meta.payload_codec = (uint8_t)(raw_codecs & 0xff);
+    meta.attach_codec = (uint8_t)((raw_codecs >> 8) & 0xff);
+  } else if (want_codec != 0) {
     meta.payload_codec = codec_encode(want_codec, &payload);
     meta.attach_codec = codec_encode(want_codec, &attachment);
   }

@@ -395,10 +395,15 @@ int http_client_call(Channel* c, const char* method, const char* target,
 // the request is written, so another thread can call_cancel() it while
 // this thread is still blocked (≙ Controller::call_id + StartCancel,
 // controller.h:631,843).
+// `raw_codecs` (replay rail, dump.h): >= 0 means req/attach are already
+// WIRE-form bytes from a captured sample — the payload-codec encode is
+// skipped and tags 16/17 are stamped verbatim from (raw_codecs & 0xff,
+// raw_codecs >> 8), so the replayed frame is byte-identical.
 int channel_call(Channel* c, const char* method, const uint8_t* req,
                  size_t req_len, const uint8_t* attach, size_t attach_len,
                  int64_t timeout_us, CallResult* out, uint64_t stream = 0,
-                 uint8_t compress = 0, uint64_t* call_id_out = nullptr);
+                 uint8_t compress = 0, uint64_t* call_id_out = nullptr,
+                 int raw_codecs = -1);
 
 // Cancel an in-flight call from any thread: the blocked caller returns
 // TRPC_ECANCELED immediately, the correlation slot is claimed safely

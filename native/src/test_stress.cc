@@ -31,6 +31,7 @@
 
 #include "codec.h"
 #include "common.h"
+#include "dump.h"
 #include "execution_queue.h"
 #include "metrics.h"
 #include "fiber.h"
@@ -3154,6 +3155,216 @@ static void test_lazy_init_races() {
   printf("ok lazy_init_races (24 fresh-process rounds)\n");
 }
 
+// Child body (TRPC_SHARDS=2): the ISSUE-17 flight recorder under races —
+// (a) the reloadable dump flags (master switch + sampling budget)
+// flipping under live traffic on both shards, (b) parse-fiber captures
+// claiming ring slots while the drain claims the same slots (the
+// IOBuf-bearing seqlock variant: both sides CAS even->odd, a failed
+// claim is a counted drop, never a co-write), (c) ring laps when the
+// drain stalls behind a tiny buffer, incl. the oversize-record drop and
+// the buffer-full release-intact paths, (d) the raw-codecs replay rail
+// stamping wire codec ids verbatim — a bogus id must fail the CALL, not
+// the connection, and (e) server restart rounds tearing connections down
+// while their frames sit block-ref-shared in the rings.  Every emitted
+// blob must be a well-formed v2 sample; captured/drained/dropped must
+// reconcile once traffic stops and the rings drain dry.
+static size_t dump_scan_blobs(const char* buf, size_t n,
+                              uint64_t* bad_out) {
+  // walk `u32 LE len | 0x02 "<head_len>\n" {json} payload attach` blobs,
+  // returning how many parsed clean and counting malformed ones
+  size_t cnt = 0, off = 0;
+  while (off + 4 <= n) {
+    uint32_t len = (uint32_t)(uint8_t)buf[off] |
+                   ((uint32_t)(uint8_t)buf[off + 1] << 8) |
+                   ((uint32_t)(uint8_t)buf[off + 2] << 16) |
+                   ((uint32_t)(uint8_t)buf[off + 3] << 24);
+    off += 4;
+    if (len == 0 || off + len > n) {
+      *bad_out += 1;
+      break;
+    }
+    const char* blob = buf + off;
+    bool ok_blob = blob[0] == 0x02;
+    if (ok_blob) {
+      long head_len = 0;
+      size_t i = 1;
+      while (i < len && blob[i] >= '0' && blob[i] <= '9') {
+        head_len = head_len * 10 + (blob[i] - '0');
+        ++i;
+      }
+      ok_blob = i < len && blob[i] == '\n' && head_len > 0 &&
+                i + 1 + (size_t)head_len <= len &&
+                blob[i + 1] == '{' && blob[i + (size_t)head_len] == '}';
+    }
+    if (ok_blob) {
+      ++cnt;
+    } else {
+      *bad_out += 1;
+    }
+    off += len;
+  }
+  return cnt;
+}
+
+static void dump_child_body() {
+  CHECK_TRUE(shard_count() == 2);
+  fiber_runtime_init(4);
+  dump_set_enabled(1);
+  dump_set_budget(1 << 20);
+
+  Server* probe = server_create();
+  CHECK_TRUE(server_start(probe, "127.0.0.1", 0) == 0);
+  int port = server_port(probe);
+  server_destroy(probe);
+
+  std::atomic<bool> stop{false};
+  std::atomic<uint64_t> ok{0}, failed{0}, raw_ok{0}, raw_bogus_fail{0};
+  std::atomic<uint64_t> blobs{0}, bad_blobs{0};
+  std::vector<std::thread> ts;
+
+  // (a) flag flipper: switch + budget cycle under traffic, restored to
+  // fully-on before the final asserts
+  ts.emplace_back([&] {
+    int i = 0;
+    while (!stop.load(std::memory_order_acquire)) {
+      dump_set_enabled(i & 1);
+      dump_set_budget((i & 7) == 0 ? 0 : (1 << 18));
+      ++i;
+      usleep(900);
+    }
+    dump_set_enabled(1);
+    dump_set_budget(1 << 20);
+  });
+
+  // (b) unary hammers with trace context: tags 7/8 ride each frame into
+  // the capture's trace_id/span_id head fields
+  for (int t = 0; t < 3; ++t) {
+    ts.emplace_back([&, t] {
+      Channel* ch = channel_create("127.0.0.1", port);
+      channel_set_connection_type(ch, t % 2);
+      channel_set_connect_timeout(ch, 100 * 1000);
+      std::string payload(256, 'd');
+      CallResult res;
+      uint64_t i = 0;
+      while (!stop.load(std::memory_order_acquire)) {
+        trace_set_current(0x7000u + (uint64_t)t, 0x8000u + (++i), 0);
+        if (channel_call(ch, "Echo", (const uint8_t*)payload.data(),
+                         payload.size(), nullptr, 0, 300 * 1000,
+                         &res) == 0) {
+          ok.fetch_add(1);
+        } else {
+          failed.fetch_add(1);
+        }
+      }
+      trace_set_current(0, 0, 0);
+      channel_destroy(ch);
+    });
+  }
+
+  // (c) raw-codecs replay rail: codec ids stamped verbatim.  id 0 is a
+  // plain frame (must echo fine); a bogus id must fail the CALL, never
+  // kill the connection — the next plain raw call on the SAME channel
+  // proves it stayed up
+  ts.emplace_back([&] {
+    Channel* ch = channel_create("127.0.0.1", port);
+    channel_set_connect_timeout(ch, 100 * 1000);
+    std::string payload(128, 'r');
+    CallResult res;
+    uint64_t i = 0;
+    while (!stop.load(std::memory_order_acquire)) {
+      int raw = ((++i & 3u) == 0) ? 0x0009 : 0;  // sometimes bogus
+      int rc = channel_call(ch, "Echo", (const uint8_t*)payload.data(),
+                            payload.size(), nullptr, 0, 300 * 1000, &res,
+                            0, 0, nullptr, raw);
+      if (raw == 0 && rc == 0) {
+        raw_ok.fetch_add(1);
+      } else if (raw != 0 && rc != 0) {
+        raw_bogus_fail.fetch_add(1);
+      }
+    }
+    channel_destroy(ch);
+  });
+
+  // (d) drain: alternates a roomy buffer with one too small for even a
+  // single record (oversize-drop path) and one that fits a couple
+  // (buffer-full release-intact path); every byte that comes out must
+  // parse as well-formed v2 blobs
+  ts.emplace_back([&] {
+    std::vector<char> buf(256 * 1024);
+    uint64_t round = 0;
+    while (!stop.load(std::memory_order_acquire)) {
+      size_t cap = buf.size();
+      if ((++round & 7u) == 0) {
+        cap = 300;  // smaller than one 256B-payload record
+      } else if ((round & 7u) == 1) {
+        cap = 1024;  // a couple of records, then buffer-full
+      }
+      size_t n = dump_drain(buf.data(), cap);
+      uint64_t bad = 0;
+      blobs.fetch_add(dump_scan_blobs(buf.data(), n, &bad));
+      bad_blobs.fetch_add(bad);
+      usleep(1500);
+    }
+  });
+
+  // (e) restart rounds: connections die while their wire bytes sit
+  // block-ref-shared in the rings (the refs must keep the blocks alive)
+  for (int round = 0; round < 4; ++round) {
+    Server* srv = server_create();
+    server_add_service(srv, "Echo", 0, nullptr, nullptr);
+    if (server_start(srv, "127.0.0.1", port) != 0) {
+      server_destroy(srv);
+      usleep(50 * 1000);
+      continue;
+    }
+    usleep(700 * 1000);
+    server_destroy(srv);
+    usleep(50 * 1000);
+  }
+  stop.store(true, std::memory_order_release);
+  for (auto& th : ts) {
+    th.join();
+  }
+  // flipper restored full-on; drain the rings dry so the accounting
+  // below is settled
+  {
+    std::vector<char> buf(256 * 1024);
+    size_t n;
+    while ((n = dump_drain(buf.data(), buf.size())) > 0) {
+      uint64_t bad = 0;
+      blobs.fetch_add(dump_scan_blobs(buf.data(), n, &bad));
+      bad_blobs.fetch_add(bad);
+    }
+  }
+  uint64_t captured = dump_captured_total();
+  uint64_t dropped = dump_dropped_total();
+  uint64_t drained = dump_drained_total();
+  CHECK_TRUE(ok.load() > 0);
+  CHECK_TRUE(raw_ok.load() > 0);
+  CHECK_TRUE(raw_bogus_fail.load() > 0);
+  CHECK_TRUE(captured > 0);
+  CHECK_TRUE(drained > 0);
+  CHECK_TRUE(blobs.load() == drained);
+  CHECK_TRUE(bad_blobs.load() == 0);
+  // rings are dry: every captured record was either emitted or counted
+  // out (claim contention, laps, oversize-vs-cap)
+  CHECK_TRUE(drained <= captured);
+  CHECK_TRUE(captured <= drained + dropped);
+  printf("ok dump (child) ok=%llu failed=%llu raw_ok=%llu bogus=%llu "
+         "captured=%llu drained=%llu dropped=%llu blobs=%llu\n",
+         (unsigned long long)ok.load(), (unsigned long long)failed.load(),
+         (unsigned long long)raw_ok.load(),
+         (unsigned long long)raw_bogus_fail.load(),
+         (unsigned long long)captured, (unsigned long long)drained,
+         (unsigned long long)dropped, (unsigned long long)blobs.load());
+}
+
+static void test_dump_races() {
+  int rc = run_forced_shards_child("__dump_body", "2");
+  CHECK_TRUE(rc == 0);
+  printf("ok dump_races (forced-shards child rc=%d)\n", rc);
+}
+
 // --- scenario registry + driver ---------------------------------------------
 // The default (no-args) run IS the sanitized gate: tools/lint.py
 // enforces that every test_*_races function above appears in this table,
@@ -3193,6 +3404,7 @@ static const Scenario kScenarios[] = {
     {"overload_races", test_overload_races},
     {"timer_wheel_races", test_timer_wheel_races},
     {"lazy_init_races", test_lazy_init_races},
+    {"dump_races", test_dump_races},
 };
 constexpr int kNumScenarios = (int)(sizeof(kScenarios) / sizeof(kScenarios[0]));
 
@@ -3332,6 +3544,10 @@ int main(int argc, char** argv) {
   }
   if (argc > 1 && strcmp(argv[1], "__lazy_init_body") == 0) {
     lazy_init_child_body();
+    return g_failures == 0 ? 0 : 1;
+  }
+  if (argc > 1 && strcmp(argv[1], "__dump_body") == 0) {
+    dump_child_body();
     return g_failures == 0 ? 0 : 1;
   }
   if (argc > 1 && strcmp(argv[1], "--list") == 0) {

@@ -99,6 +99,11 @@ _HOT_REGIONS = {
     "native/src/timer_thread.cc": ["Add", "CancelAndFree", "LinkLocked",
                                    "UnlinkLocked", "AdvanceLocked",
                                    "CascadeLocked", "RunExpired"],
+    # ISSUE 17: flight-recorder capture runs on the parse fibers (one
+    # claim + IOBuf block-ref share per sampled request) — the sampled
+    # path must stay allocation-free; only the drain (Python-thread
+    # side) may touch the heap
+    "native/src/dump.cc": ["dump_try_sample", "dump_capture"],
     # ISSUE 11: overload admission + gradient feeds run on the parse
     # fibers (admit per request, window fold on a completion) — the shed
     # path's ~0-cost claim dies the moment these allocate
