@@ -429,6 +429,19 @@ def _declare(L: ctypes.CDLL) -> None:
     L.trpc_set_inline_budget_us.restype = None
     L.trpc_token_arm_ns.argtypes = [c.c_uint64]
     L.trpc_token_arm_ns.restype = c.c_int64
+
+    # deadline-budget propagation (ISSUE 19)
+    L.trpc_set_deadline_propagate.argtypes = [c.c_int]
+    L.trpc_set_deadline_propagate.restype = None
+    L.trpc_deadline_propagate_active.argtypes = []
+    L.trpc_deadline_propagate_active.restype = c.c_int
+    L.trpc_set_deadline_reserve_us.argtypes = [c.c_int64]
+    L.trpc_set_deadline_reserve_us.restype = None
+    L.trpc_deadline_reserve_us.argtypes = []
+    L.trpc_deadline_reserve_us.restype = c.c_int64
+    L.trpc_token_deadline_left_us.argtypes = [c.c_uint64,
+                                              c.POINTER(c.c_int64)]
+    L.trpc_token_deadline_left_us.restype = c.c_int
     L.trpc_server_enable_redis_cache.argtypes = [c.c_void_p]
     L.trpc_server_enable_redis_cache.restype = c.c_int
     L.trpc_server_http_cache_put.argtypes = [c.c_void_p, c.c_char_p,

@@ -107,7 +107,8 @@ class ClusterChannel:
 
     def call_once(self, method: bytes, payload: bytes, attachment: bytes,
                   timeout_us: int, cntl, stream_handle: int = 0,
-                  compress: int = 0) -> Tuple[int, str, bytes, bytes]:
+                  compress: int = 0,
+                  cancel_buf=None) -> Tuple[int, str, bytes, bytes]:
         # breaker-isolated nodes + nodes that already failed THIS call's
         # earlier attempts (≙ ExcludedServers): without the latter, sticky
         # LBs (c_md5) would re-pick the same dead node on every retry
@@ -126,9 +127,11 @@ class ClusterChannel:
                 return (errors.ENOSERVICE, "no servers resolved", b"", b"")
         sub = self._sub(node)
         t0 = time.monotonic_ns()
+        if cancel_buf is None:  # hedged attempts pass their own cell
+            cancel_buf = getattr(cntl, "_call_id_buf", None)
         code, text, data, att = sub.call_once(
             method, payload, attachment, timeout_us, stream_handle,
-            compress, cancel_buf=getattr(cntl, "_call_id_buf", None))
+            compress, cancel_buf=cancel_buf)
         latency_us = (time.monotonic_ns() - t0) // 1000
         failed = code != 0
         shed = code == errors.ELIMIT
@@ -143,8 +146,13 @@ class ClusterChannel:
         # replica (≙ ExcludedServers), which is safe precisely because
         # a shed request never executed.
         self.lb.feedback(node, latency_us, failed)
-        self._breaker(node).on_call_end(latency_us,
-                                        failed and not shed, shed=shed)
+        br = self._breaker(node)
+        br.on_call_end(latency_us, failed and not shed, shed=shed)
+        # pressure-steered LB (ISSUE 19): push the breaker's shed-rate
+        # EMA into the LB after EVERY attempt, so `la`/`wrr` bleed
+        # traffic off a slow-but-alive replica while its breaker is
+        # still closed (soft steering before hard isolation).
+        self.lb.set_pressure(node, br.pressure())
         if failed:
             cntl.excluded_nodes.add(node)
         if code == errors.EFAILEDSOCKET:

@@ -1,13 +1,23 @@
 """Health checking — periodic probe of isolated/failed nodes, revive on
 success (≙ details/health_check.cpp:146-241 HealthCheckTask: periodic
 reconnect probe + optional app-level RPC check via health_check_path).
+
+Probe pacing (ISSUE 19): each probe is jittered ±25% around its due time
+so a mesh of clients that lost the same leaf at the same instant does not
+re-probe it in lockstep, and a node that STAYS dead backs off
+exponentially (interval × 2^fails, capped) — a long-dead leaf costs a
+trickle of SYNs instead of a steady drumbeat (≙ the reference's
+HealthCheckTask rescheduling at health_check_interval_s, plus the
+defer-with-backoff idiom of its reconnect path).
 """
 
 from __future__ import annotations
 
+import random
 import socket as pysocket
 import threading
 import time
+from dataclasses import dataclass
 from typing import Callable, Dict, Optional
 
 from brpc_tpu.cluster.naming import ServerNode
@@ -25,26 +35,49 @@ def tcp_probe(node: ServerNode, timeout_s: float = 0.5) -> bool:
         return False
 
 
+@dataclass
+class _BrokenState:
+    since: float        # when the node was first marked broken
+    fails: int = 0      # consecutive failed probes (drives backoff)
+    next_due: float = 0.0  # monotonic time of the next probe
+
+
 class HealthChecker:
     """Watches broken nodes, revives them via on_revive when the probe
     passes.  `rpc_probe` (≙ health_check_path) upgrades the TCP probe to an
     application-level call."""
 
+    # ±25% uniform jitter applied to every scheduling decision
+    JITTER = 0.25
+
     def __init__(self, interval_s: float = 0.2,
                  probe: Callable[[ServerNode], bool] = tcp_probe,
-                 on_revive: Optional[Callable[[ServerNode], None]] = None):
+                 on_revive: Optional[Callable[[ServerNode], None]] = None,
+                 max_backoff_s: Optional[float] = None):
         self.interval_s = interval_s
+        # backoff ceiling: a dead node is probed at least this often
+        self.max_backoff_s = (max_backoff_s if max_backoff_s is not None
+                              else interval_s * 16)
         self.probe = probe
         self.on_revive = on_revive
-        self._broken: Dict[ServerNode, float] = {}  # node -> since
+        self._broken: Dict[ServerNode, _BrokenState] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._rng = random.Random()
+
+    def _jittered(self, base_s: float) -> float:
+        return base_s * (1.0 - self.JITTER
+                         + 2.0 * self.JITTER * self._rng.random())
 
     def mark_broken(self, node: ServerNode) -> None:
         with self._lock:
             if node not in self._broken:
-                self._broken[node] = time.monotonic()
+                now = time.monotonic()
+                # first probe after one jittered interval (not instantly:
+                # the breaker just saw the failure, give the node a beat)
+                self._broken[node] = _BrokenState(
+                    since=now, next_due=now + self._jittered(self.interval_s))
             self._ensure_thread_locked()
 
     def discard(self, node: ServerNode) -> None:
@@ -54,6 +87,11 @@ class HealthChecker:
     def broken_nodes(self):
         with self._lock:
             return list(self._broken)
+
+    def probe_backlog(self):
+        """Diagnostic view: node -> consecutive failed probes."""
+        with self._lock:
+            return {n: st.fails for n, st in self._broken.items()}
 
     def stop(self) -> None:
         self._stop.set()
@@ -66,18 +104,35 @@ class HealthChecker:
             self._thread.start()
 
     def _run(self) -> None:
-        while not self._stop.wait(self.interval_s):
+        # tick faster than interval_s so jittered due-times are honored
+        # with reasonable resolution; each node still probes only when
+        # its own (jittered, backed-off) due time arrives
+        tick = max(self.interval_s / 4.0, 0.01)
+        while not self._stop.wait(tick):
+            now = time.monotonic()
             with self._lock:
-                nodes = list(self._broken)
-            if not nodes:
-                return  # exit when idle; restarted on next mark_broken
-            for node in nodes:
+                if not self._broken:
+                    return  # exit when idle; restarted on next mark_broken
+                due = [n for n, st in self._broken.items()
+                       if st.next_due <= now]
+            for node in due:
                 if self.probe(node):
                     with self._lock:
-                        since = self._broken.pop(node, None)
-                    if since is not None:
+                        st = self._broken.pop(node, None)
+                    if st is not None:
                         log.LOG(log.LOG_INFO,
-                                "health check revived %s after %.1fs",
-                                node, time.monotonic() - since)
+                                "health check revived %s after %.1fs "
+                                "(%d failed probes)",
+                                node, time.monotonic() - st.since, st.fails)
                         if self.on_revive is not None:
                             self.on_revive(node)
+                else:
+                    with self._lock:
+                        st = self._broken.get(node)
+                        if st is not None:
+                            st.fails += 1
+                            backoff = min(
+                                self.interval_s * (2.0 ** st.fails),
+                                self.max_backoff_s)
+                            st.next_due = (time.monotonic()
+                                           + self._jittered(backoff))

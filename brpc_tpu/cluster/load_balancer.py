@@ -79,6 +79,14 @@ class LoadBalancer:
                  failed: bool) -> None:
         """≙ LoadBalancer::Feedback — only LA uses it by default."""
 
+    def set_pressure(self, node: ServerNode, pressure: float) -> None:
+        """Per-node soft-pressure hint (ISSUE 19): the circuit breaker's
+        shed-rate EMA (0.0-1.0, CircuitBreaker.pressure()) pushed after
+        every attempt so a slow-but-alive replica bleeds traffic BEFORE
+        its breaker trips.  Pressure-aware LBs (la, wrr) override;
+        default is a no-op so sticky/hashing LBs keep their placement
+        contract."""
+
     # subclass hooks -------------------------------------------------------
     def _pick(self, lst, request_code, excluded) -> Optional[ServerNode]:
         raise NotImplementedError
@@ -117,11 +125,21 @@ class WeightedRoundRobinLB(LoadBalancer):
     total (≙ policy/weighted_round_robin_load_balancer.cpp semantics)."""
 
     name = "wrr"
+    # pressure scaling resolution: static weights ride ×100 so the
+    # (1 - pressure) scale keeps fractional precision in the smooth-WRR
+    # integer arithmetic; a fully-pressured node keeps a trickle (never
+    # drops to zero — its shed/latency signal must keep refreshing)
+    PRESSURE_SCALE = 100
 
     def __init__(self):
         super().__init__()
         self._cw: Dict[ServerNode, int] = {}
+        self._pressure: Dict[ServerNode, float] = {}
         self._lock = threading.Lock()
+
+    def set_pressure(self, node: ServerNode, pressure: float) -> None:
+        with self._lock:
+            self._pressure[node] = min(max(pressure, 0.0), 1.0)
 
     def _pick(self, lst, request_code, excluded):
         with self._lock:
@@ -130,7 +148,9 @@ class WeightedRoundRobinLB(LoadBalancer):
             for n in lst:
                 if n in excluded:
                     continue
-                w = max(n.weight, 1)
+                p = min(self._pressure.get(n, 0.0), 0.99)
+                w = max(int(max(n.weight, 1)
+                            * self.PRESSURE_SCALE * (1.0 - p)), 1)
                 total += w
                 self._cw[n] = self._cw.get(n, 0) + w
                 if best is None or self._cw[n] > self._cw[best]:
@@ -143,6 +163,8 @@ class WeightedRoundRobinLB(LoadBalancer):
         with self._lock:
             live = set(self.servers())
             self._cw = {n: w for n, w in self._cw.items() if n in live}
+            self._pressure = {n: p for n, p in self._pressure.items()
+                              if n in live}
 
 
 class RandomizedLB(LoadBalancer):
@@ -278,18 +300,28 @@ class _NodeStat:
     latency_ema_us: float = 1000.0
     inflight: int = 0
     errors: int = 0
+    pressure: float = 0.0  # breaker shed-rate EMA (ISSUE 19)
 
 
 class LocalityAwareLB(LoadBalancer):
-    """Weight ∝ 1 / (latency_ema * (inflight + 1)); feedback-driven."""
+    """Weight ∝ 1 / (latency_ema * (inflight + 1) * (1 + k·pressure));
+    feedback-driven, with the breaker's shed-rate EMA as a third leg
+    (ISSUE 19) so a replica that sheds (or crawls behind a saturated
+    NIC) bleeds traffic before its latency EMA fully catches up."""
 
     name = "la"
     DECAY = 0.85
+    PRESSURE_K = 8.0  # pressure 1.0 → node costs 9× its unpressured self
 
     def __init__(self):
         super().__init__()
         self._stats: Dict[ServerNode, _NodeStat] = {}
         self._lock = threading.Lock()
+
+    def set_pressure(self, node: ServerNode, pressure: float) -> None:
+        with self._lock:
+            st = self._stats.setdefault(node, _NodeStat())
+            st.pressure = min(max(pressure, 0.0), 1.0)
 
     def _pick(self, lst, request_code, excluded):
         cand = [n for n in lst if n not in excluded]
@@ -300,7 +332,9 @@ class LocalityAwareLB(LoadBalancer):
             for n in cand:
                 st = self._stats.setdefault(n, _NodeStat())
                 weights.append(1.0 / (max(st.latency_ema_us, 1.0)
-                                      * (st.inflight + 1)))
+                                      * (st.inflight + 1)
+                                      * (1.0 + self.PRESSURE_K
+                                         * st.pressure)))
             chosen = random.choices(cand, weights)[0]
             self._stats[chosen].inflight += 1
             return chosen

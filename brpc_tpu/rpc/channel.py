@@ -23,7 +23,7 @@ from brpc_tpu.metrics import bvar
 from brpc_tpu.rpc import codec as _codec  # noqa: F401 — registers the
 # payload_codec / codec_min_bytes flags (native/src/codec.h rail)
 from brpc_tpu.rpc import errors
-from brpc_tpu.rpc.controller import Controller
+from brpc_tpu.rpc.controller import Controller, inherited_deadline_ns
 from brpc_tpu.utils import flags
 from brpc_tpu.utils import logging as log
 from brpc_tpu.utils.endpoint import EndPoint, str2endpoint
@@ -351,6 +351,7 @@ class Channel:
     """
 
     _latency = None  # class-wide client latency recorder, lazily exposed
+    _hedge_canceled = None  # losing hedge attempts canceled (ISSUE 19)
 
     def __init__(self, address: str,
                  options: Optional[ChannelOptions] = None, **kw):
@@ -406,6 +407,8 @@ class Channel:
         if Channel._latency is None:
             Channel._latency = bvar.LatencyRecorder()
             Channel._latency.expose("rpc_client")
+            Channel._hedge_canceled = bvar.Adder(
+                "rpc_client_hedge_canceled")
         self._fallback_warned = False
 
     def _maybe_refresh_credential(self) -> None:
@@ -443,8 +446,26 @@ class Channel:
         # effective knobs: Controller overrides, else ChannelOptions —
         # computed into locals so a reused Controller keeps None = inherit
         if timeout_ms is None:
-            timeout_ms = (cntl.timeout_ms if cntl.timeout_ms is not None
-                          else self.options.timeout_ms)
+            if cntl.timeout_ms is not None:
+                timeout_ms = cntl.timeout_ms
+            else:
+                timeout_ms = self.options.timeout_ms
+                # deadline-budget inheritance (ISSUE 19, ≙ the reference
+                # shrinking the baidu_std meta timeout_ms hop by hop): a
+                # call with NO explicit timeout made from inside a server
+                # handler defaults to the caller's remaining budget minus
+                # the per-hop reserve (TRPC_DEADLINE_RESERVE_US), so a
+                # mesh's tail work is bounded by the root's deadline
+                # instead of each tier's full ChannelOptions.timeout_ms.
+                # Explicit timeouts (Controller or per-call) still win.
+                inh = inherited_deadline_ns()
+                if inh is not None:
+                    left_ms = ((inh - time.monotonic_ns()) / 1e6
+                               - lib().trpc_deadline_reserve_us() / 1e3)
+                    if left_ms < 1.0:
+                        left_ms = 1.0  # let the server-side shed decide
+                    if left_ms < timeout_ms:
+                        timeout_ms = left_ms
         self._maybe_refresh_credential()
         mb = method.encode()
         start = time.monotonic_ns()
@@ -584,41 +605,55 @@ class Channel:
     def _call_attempt(self, method: bytes, payload: bytes, attachment: bytes,
                       timeout_us: int, backup_ms: Optional[float],
                       cntl: Controller, compress: int = 0):
+        hedged = backup_ms is not None and timeout_us > backup_ms * 1000
         if self._cluster is not None:
-            return self._cluster.call_once(method, payload, attachment,
-                                           timeout_us, cntl,
-                                           compress=compress)
-        cancel_buf = getattr(cntl, "_call_id_buf", None)
-        if backup_ms is None or timeout_us <= backup_ms * 1000:
-            return self._sub.call_once(method, payload, attachment,
-                                       timeout_us, compress=compress,
-                                       cancel_buf=cancel_buf)
-        return self._backup_race(self._sub, method, payload, attachment,
-                                 timeout_us, backup_ms, cntl, compress,
-                                 cancel_buf)
+            # cluster hedging (ISSUE 19): the backup attempt goes back
+            # through the LB, so it statistically lands on a DIFFERENT
+            # replica than the straggling primary — the mixer-tier
+            # "hedged scatter" leg of the churn story
+            def call_fn(budget_us, buf):
+                return self._cluster.call_once(
+                    method, payload, attachment, budget_us, cntl,
+                    compress=compress, cancel_buf=buf)
+        else:
+            def call_fn(budget_us, buf):
+                return self._sub.call_once(
+                    method, payload, attachment, budget_us,
+                    compress=compress, cancel_buf=buf)
+        if not hedged:
+            return call_fn(timeout_us, getattr(cntl, "_call_id_buf", None))
+        return self._backup_race(call_fn, timeout_us, backup_ms, cntl)
 
     @staticmethod
-    def _backup_race(sub: SubChannel, method: bytes, payload: bytes,
-                     attachment: bytes, timeout_us: int, backup_ms: float,
-                     cntl: Controller, compress: int = 0, cancel_buf=None):
+    def _backup_race(call_fn, timeout_us: int, backup_ms: float,
+                     cntl: Controller):
         """Backup request (≙ reference channel.cpp:551-560,
         controller.cpp:601-634): if no response within backup_ms, race a
-        second attempt; first success wins."""
-        result = []
+        second attempt; first success wins — and CANCELS the loser
+        (≙ the reference's CallId cancel of the superseded attempt) so
+        its server-side work stops instead of running to completion on a
+        node that no longer has a waiter.  Canceled-loser count rides
+        the rpc_client_hedge_canceled bvar."""
+        result = []  # (attempt_idx, (code, text, data, att))
         cond = threading.Condition()
         deadline = time.monotonic() + timeout_us / 1e6  # from attempt start
+        # per-attempt cancel cells: the winner needs the LOSER's call id,
+        # so the two attempts cannot share one buffer.  External
+        # start_cancel still claims whichever armed last via
+        # cntl._call_id_buf (same window the shared cell gave it).
+        bufs = [ctypes.c_uint64(0), ctypes.c_uint64(0)]
+        done = [False, False]
 
-        def attempt(budget_us):
-            # both racing attempts publish into the same cell: a cancel
-            # claims whichever armed last; the flag stops the retry loop
-            r = sub.call_once(method, payload, attachment, budget_us,
-                              compress=compress, cancel_buf=cancel_buf)
+        def attempt(idx, budget_us):
+            cntl._call_id_buf = bufs[idx]
+            r = call_fn(budget_us, bufs[idx])
             with cond:
-                result.append(r)
+                done[idx] = True
+                result.append((idx, r))
                 cond.notify_all()
 
         t1 = threading.Thread(
-            target=attempt, args=(timeout_us,), daemon=True)
+            target=attempt, args=(0, timeout_us), daemon=True)
         t1.start()
         with cond:
             cond.wait(backup_ms / 1000.0)
@@ -627,16 +662,27 @@ class Channel:
         if cntl.backup_fired:
             remaining = timeout_us - int(backup_ms * 1000)
             t2 = threading.Thread(
-                target=attempt, args=(remaining,), daemon=True)
+                target=attempt, args=(1, remaining), daemon=True)
             t2.start()
+
+        def cancel_loser(winner_idx):
+            loser = 1 - winner_idx
+            if not cntl.backup_fired or done[loser]:
+                return
+            call_id = bufs[loser].value
+            if call_id:
+                lib().trpc_call_cancel(call_id)
+                Channel._hedge_canceled.add(1)
+
         with cond:
             while True:
-                for r in result:
+                for idx, r in result:
                     if r[0] == 0:
+                        cancel_loser(idx)
                         return r
                 expected = 2 if cntl.backup_fired else 1
                 if len(result) >= expected:
-                    return result[0]
+                    return result[0][1]
                 left = deadline - time.monotonic()
                 if left <= 0:
                     return (errors.ERPCTIMEDOUT, "", b"", b"")

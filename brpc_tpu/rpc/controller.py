@@ -4,7 +4,29 @@ client side; method/peer/attachment context on the server side)."""
 
 from __future__ import annotations
 
+import threading
 from typing import Optional
+
+# Inherited-deadline context (ISSUE 19, ≙ the reference propagating the
+# caller's remaining timeout in the baidu_std meta): the server
+# dispatcher anchors the inbound tag-18 budget here as an ABSOLUTE
+# monotonic-ns deadline for the handler's thread; Channel.call reads it
+# to default a downstream call's timeout to the inherited remainder
+# minus the per-hop reserve.  Thread-local because handlers own their
+# usercode pthread for the callback's duration (the same contract the
+# native TraceCtx rides).
+_deadline_tls = threading.local()
+
+
+def set_inherited_deadline_ns(abs_ns: Optional[int]) -> None:
+    """Install (or clear, with None) the calling thread's inherited
+    absolute deadline (time.monotonic_ns scale)."""
+    _deadline_tls.abs_ns = abs_ns
+
+
+def inherited_deadline_ns() -> Optional[int]:
+    """The calling thread's inherited absolute deadline, or None."""
+    return getattr(_deadline_tls, "abs_ns", None)
 
 
 class Controller:
@@ -45,6 +67,12 @@ class Controller:
         # 0/0 when the caller sent no trace context
         self.trace_id: int = 0
         self.span_id: int = 0
+        # deadline-budget ingress (meta tag 18, ISSUE 19): the request's
+        # remaining budget in µs as of dispatch, populated by the server
+        # dispatcher via trpc_token_deadline_left_us; None when the
+        # caller sent no budget.  May be <= 0 (already spent) — the
+        # native layer normally sheds those before the handler runs.
+        self.deadline_left_us: Optional[int] = None
         # populated after a call
         self.latency_us: int = 0
         self.retried_count: int = 0

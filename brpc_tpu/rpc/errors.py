@@ -18,6 +18,7 @@ ELIMIT = 2005
 ESTREAMUNACCEPTED = 2006
 ECANCELED = 2007
 EAUTH = 2008
+EDEADLINE = 2009
 
 _TEXT = {
     OK: "OK",
@@ -35,6 +36,7 @@ _TEXT = {
     ESTREAMUNACCEPTED: "server did not accept the stream",
     ECANCELED: "the rpc was canceled by the caller",
     EAUTH: "authentication failed",
+    EDEADLINE: "deadline budget exhausted before dispatch",
 }
 
 

@@ -23,6 +23,7 @@ from brpc_tpu.rpc import codec as codec_mod
 from brpc_tpu.rpc import compress as compress_mod
 from brpc_tpu.rpc import dump as dump_mod
 from brpc_tpu.rpc import errors, span
+from brpc_tpu.rpc import controller as controller_mod
 from brpc_tpu.rpc.controller import Controller
 from brpc_tpu.rpc.http import (HttpDispatcher, HttpRequest, pack_headers,
                                parse_headers_blob)
@@ -242,6 +243,39 @@ flags.define_int32("overload_window_ms", 100,
                    "gradient sample-window length: one adaptation step "
                    "folds per window (TRPC_OVERLOAD_WINDOW_MS; "
                    "reloadable)", validator=_push_overload_window)
+
+
+def _push_deadline_propagate(value) -> bool:
+    lib().trpc_set_deadline_propagate(1 if value else 0)
+    return True
+
+
+def _push_deadline_reserve_us(value) -> bool:
+    if value < 0:
+        return False
+    lib().trpc_set_deadline_reserve_us(int(value))
+    return True
+
+
+flags.define_bool("deadline_propagate",
+                  os.environ.get("TRPC_DEADLINE_PROPAGATE", "")
+                  not in ("", "0"),
+                  "deadline-budget propagation (rpc.h, ISSUE 19): client "
+                  "calls stamp their remaining budget into meta tag 18, "
+                  "servers shed requests whose budget is already spent "
+                  "(EDEADLINE on the parse fiber / at usercode dequeue) "
+                  "and handlers' downstream calls default to the "
+                  "inherited remainder minus deadline_reserve_us.  Off "
+                  "(the default, TRPC_DEADLINE_PROPAGATE unset) the wire "
+                  "is byte-identical to before (reloadable)",
+                  validator=_push_deadline_propagate)
+flags.define_int32("deadline_reserve_us",
+                   _parse_boot_int("TRPC_DEADLINE_RESERVE_US", 2000),
+                   "per-hop reserve subtracted when a handler's "
+                   "downstream call inherits the remaining budget — the "
+                   "slack this tier keeps for its own respond path "
+                   "(TRPC_DEADLINE_RESERVE_US; reloadable)",
+                   validator=_push_deadline_reserve_us)
 
 
 flags.define_bool("telemetry",
@@ -589,6 +623,17 @@ class Server:
             if L.trpc_token_trace(token, ctypes.byref(tid),
                                   ctypes.byref(sid)) == 0:
                 cntl.trace_id, cntl.span_id = tid.value, sid.value
+            # deadline-budget ingress (meta tag 18, ISSUE 19): surface
+            # the live remaining budget on the Controller and anchor the
+            # thread's inherited absolute deadline — downstream calls
+            # this handler makes default to the remainder minus the
+            # per-hop reserve (Channel.call reads it back)
+            dl = ctypes.c_int64(0)
+            if L.trpc_token_deadline_left_us(token,
+                                             ctypes.byref(dl)) == 1:
+                cntl.deadline_left_us = dl.value
+                controller_mod.set_inherited_deadline_ns(
+                    t0 + dl.value * 1000)
             sp = None
             try:
                 authn = limiter_box.options.authenticator
@@ -698,6 +743,7 @@ class Server:
                                None, 0, None, 0)
                 status.errors.add(1)
             finally:
+                controller_mod.set_inherited_deadline_ns(None)
                 span.set_current(None)
                 span.finish_span(sp, cntl.error_code)
                 if limiter is not None:

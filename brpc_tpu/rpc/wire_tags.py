@@ -29,3 +29,4 @@ DEVICE_CAPS = 14
 PLANE_UID = 15
 PAYLOAD_CODEC = 16
 ATTACH_CODEC = 17
+DEADLINE_LEFT_US = 18

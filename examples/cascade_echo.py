@@ -1,20 +1,40 @@
 """Cascading RPC: a middle server whose handler calls a downstream server
-(≙ example/cascade_echo — latency composes, portals show both hops)."""
+(≙ example/cascade_echo — latency composes, portals show both hops).
+
+With deadline-budget propagation on (ISSUE 19), each tier also sees how
+much of the ROOT caller's budget it inherited: the root stamps its
+timeout as meta tag 18, the middle tier's downstream call (made with no
+explicit timeout) defaults to the inherited remainder minus the per-hop
+reserve (TRPC_DEADLINE_RESERVE_US), so the budget visibly SHRINKS hop by
+hop instead of every tier re-arming its own full timeout.
+"""
 import _bootstrap  # noqa: F401
 
 from brpc_tpu.rpc.channel import Channel
 from brpc_tpu.rpc.server import Server
+from brpc_tpu.utils import flags
 
 
 def main():
+    flags.set_flag("deadline_propagate", True)
+
     backend = Server()
-    backend.add_service("Deep", lambda cntl, req: b"deep(" + req + b")")
+
+    def deep(cntl, req):
+        print(f"  backend inherited deadline_left_us={cntl.deadline_left_us}")
+        return b"deep(" + req + b")"
+
+    backend.add_service("Deep", deep)
     backend.start("127.0.0.1:0")
 
     middle = Server()
     down = Channel(f"127.0.0.1:{backend.port}")
 
     def relay(cntl, req):
+        print(f"  middle  inherited deadline_left_us={cntl.deadline_left_us}")
+        # no explicit timeout: the downstream attempt's budget defaults
+        # to the inherited remainder minus the per-hop reserve, so the
+        # backend prints a strictly smaller number than this tier saw
         inner = down.call("Deep", req)  # handler issues its own RPC
         return b"relay(" + inner + b")"
 
@@ -22,7 +42,8 @@ def main():
     middle.start("127.0.0.1:0")
 
     ch = Channel(f"127.0.0.1:{middle.port}")
-    print("cascaded:", ch.call("Relay", b"x"))
+    print("root sends timeout_ms=500 (the whole cascade's budget)")
+    print("cascaded:", ch.call("Relay", b"x", timeout_ms=500))
     ch.close()
     down.close()
     middle.destroy()

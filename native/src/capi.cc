@@ -244,6 +244,26 @@ void trpc_set_inline_budget_us(int64_t us) { set_inline_budget_us(us); }
 // LatencyRecorder arm stamp, queue-inclusive; 0 for stale tokens.
 int64_t trpc_token_arm_ns(uint64_t token) { return token_arm_ns(token); }
 
+// --- deadline-budget propagation (ISSUE 19) --------------------------------
+
+// Reloadable master switch + per-hop reserve (TRPC_DEADLINE_PROPAGATE /
+// TRPC_DEADLINE_RESERVE_US seed the defaults; the deadline_* flags push
+// through here).  Off = no tag-18 stamp, no expired-budget sheds —
+// byte-identical to the pre-ISSUE wire.
+void trpc_set_deadline_propagate(int on) { set_deadline_propagate(on); }
+int trpc_deadline_propagate_active() {
+  return deadline_propagate_enabled() ? 1 : 0;
+}
+void trpc_set_deadline_reserve_us(int64_t us) {
+  set_deadline_reserve_us(us);
+}
+int64_t trpc_deadline_reserve_us() { return deadline_reserve_us(); }
+// Live remaining budget of a pending usercode request: 1 = *left_us set
+// (may be <= 0), 0 = the request carried no budget, -1 = stale token.
+int trpc_token_deadline_left_us(uint64_t token, int64_t* left_us) {
+  return token_deadline_left_us(token, left_us);
+}
+
 // Native redis cache + cached-response HTTP builtins (pre-start only).
 int trpc_server_enable_redis_cache(void* s) {
   return server_enable_redis_cache((Server*)s);

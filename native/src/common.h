@@ -47,6 +47,8 @@ enum ErrorCode {
   TRPC_ESTREAMUNACCEPTED = 2006,  // handshake RPC ok but no StreamAccept
   TRPC_ECANCELED = 2007,      // caller canceled the call (≙ brpc ECANCELED)
   TRPC_EAUTH = 2008,          // credential verify failed (≙ brpc ERPCAUTH)
+  TRPC_EDEADLINE = 2009,      // propagated deadline budget already spent
+                              // before dispatch (ISSUE 19)
 };
 
 // xorshift per-thread fast random (≙ butil fast_rand).

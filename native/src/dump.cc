@@ -50,6 +50,10 @@ struct DumpRecord {
   // already-consumed record (stale meta, empty payload).
   uint8_t live = 0;
   int32_t shard = 0;
+  // ring index that produced this record (written under the slot
+  // claim): the capture-side strand check must only self-reclaim its
+  // OWN record, never one a lapping capture put in the slot since
+  uint64_t owner_idx = 0;
   IOBuf payload;
   IOBuf attachment;
 };
@@ -62,7 +66,14 @@ struct DumpSlot {
 
 struct DumpRing {
   std::atomic<uint64_t> head{0};  // next slot index to claim (mod slots)
-  uint64_t tail = 0;              // consumed watermark (under drain_mu)
+  // Consumed watermark.  Advanced only under drain_mu, but READ by
+  // dump_capture's post-publish strand check: a capture that allocated
+  // its index and then lost the race to claim its slot before a drain
+  // walked that index would otherwise publish a record no future walk
+  // revisits (counted captured, never drained nor dropped).  The drain
+  // publishes tail=i+1 BEFORE each claim attempt so a capture seeing
+  // tail > idx after publishing knows to self-reclaim.
+  std::atomic<uint64_t> tail{0};
   std::mutex drain_mu;
   DumpSlot slots[kDumpRingSlots];
 };
@@ -195,11 +206,35 @@ void dump_capture(const DumpMeta& m, const IOBuf& payload,
   r.stream_frame_type = m.stream_frame_type;
   r.live = 1;
   r.shard = shard;
+  r.owner_idx = idx;
   // block-ref shares: the wire bytes are never copied or flattened here
   r.payload = payload;
   r.attachment = attachment;
   slot.seq.fetch_add(1, std::memory_order_release);  // even: stable
   nm.dump_captured.fetch_add(1, std::memory_order_relaxed);
+  if (TRPC_UNLIKELY(ring.tail.load(std::memory_order_acquire) > idx)) {
+    // A drain walked index idx between our head allocation and the
+    // claim above (the claim's acquire orders this load after it, and
+    // the drain stores tail=i+1 before every claim attempt, so the
+    // strand is always observed): no future walk revisits idx, the
+    // record would sit live-in-slot with the books short by one.
+    // Reclaim our own slot and count the sample dropped.  Racing
+    // lappers/drains can inflate dropped by one here — safe, the
+    // reconciliation contract is one-sided (captured <= drained +
+    // dropped).
+    uint32_t s2 = slot.seq.load(std::memory_order_acquire);
+    if ((s2 & 1u) == 0 &&
+        slot.seq.compare_exchange_strong(s2, s2 + 1,
+                                         std::memory_order_acq_rel)) {
+      if (r.live && r.owner_idx == idx) {
+        r.payload.clear();
+        r.attachment.clear();
+        r.live = 0;
+      }
+      slot.seq.fetch_add(1, std::memory_order_release);
+    }
+    nm.dump_dropped.fetch_add(1, std::memory_order_relaxed);
+  }
 }
 
 size_t dump_drain(char* buf, size_t cap) {
@@ -209,7 +244,7 @@ size_t dump_drain(char* buf, size_t cap) {
     DumpRing& ring = g_dump_rings[k];
     std::lock_guard<std::mutex> lk(ring.drain_mu);
     uint64_t head = ring.head.load(std::memory_order_acquire);
-    uint64_t from = ring.tail;
+    uint64_t from = ring.tail.load(std::memory_order_relaxed);
     if (head - from > (uint64_t)kDumpRingSlots) {
       // ring lapped the drain: the overwritten records are gone (their
       // IOBuf refs were released by the overwriting capture's assign)
@@ -219,6 +254,11 @@ size_t dump_drain(char* buf, size_t cap) {
     }
     for (uint64_t i = from; i < head; ++i) {
       DumpSlot& slot = ring.slots[i % kDumpRingSlots];
+      // tail advances BEFORE the claim attempt: a capture that claims
+      // this slot after we pass it must observe tail > idx when it
+      // publishes, so it self-reclaims instead of stranding the record
+      // (see DumpRing::tail).
+      ring.tail.store(i + 1, std::memory_order_release);
       // CLAIM before reading — a DumpRecord holds IOBufs, so the
       // read-retry trick SpanRing's drain uses would race refcounts.
       uint32_t s0 = slot.seq.load(std::memory_order_acquire);
@@ -274,9 +314,11 @@ size_t dump_drain(char* buf, size_t cap) {
         }
         // out of buffer: release the claim with the record INTACT
         // (seq advances to even, content untouched) so it surfaces on
-        // the next drain
+        // the next drain — rewind tail so the next walk revisits it (a
+        // capture that glimpsed tail=i+1 in the window self-drops its
+        // own record: rare, counted, collector semantics)
         slot.seq.fetch_add(1, std::memory_order_release);
-        ring.tail = i;
+        ring.tail.store(i, std::memory_order_release);
         return off;
       }
       // u32 LE length prefix, then the v2 blob
@@ -299,7 +341,7 @@ size_t dump_drain(char* buf, size_t cap) {
       slot.seq.fetch_add(1, std::memory_order_release);
       nm.dump_drained.fetch_add(1, std::memory_order_relaxed);
     }
-    ring.tail = head;
+    ring.tail.store(head, std::memory_order_release);
   }
   return off;
 }

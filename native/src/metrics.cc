@@ -115,6 +115,24 @@ const char* telemetry_family_name(int family) {
   return kTelemetryFamilyNames[clamp_family(family)];
 }
 
+// Deadline-budget drop split (ISSUE 19): one cell per family, written
+// only on the (rare) shed path — a plain relaxed add, no shard fold
+// needed at this frequency.
+static std::atomic<uint64_t> g_deadline_drops_family[TF_FAMILIES];
+
+void deadline_drop_note(int family) {
+  native_metrics().deadline_drops.fetch_add(1, std::memory_order_relaxed);
+  if (family >= 0 && family < TF_FAMILIES) {
+    g_deadline_drops_family[family].fetch_add(1,
+                                              std::memory_order_relaxed);
+  }
+}
+
+uint64_t deadline_drops_by_family(int family) {
+  return g_deadline_drops_family[clamp_family(family)].load(
+      std::memory_order_relaxed);
+}
+
 void telemetry_record(int family, int shard, int64_t lat_us) {
   if (lat_us < 0) {
     lat_us = 0;  // coarse-clock arm stamps can sit slightly in the future
@@ -560,6 +578,8 @@ size_t native_metrics_dump(char* buf, size_t cap) {
   put("native_dump_captured", relu(m.dump_captured));
   put("native_dump_dropped", relu(m.dump_dropped));
   put("native_dump_drained", relu(m.dump_drained));
+  put("native_deadline_drops", relu(m.deadline_drops));
+  put("native_deadline_queue_drops", relu(m.deadline_queue_drops));
   // hot-path telemetry plane: per-family latency percentiles (derived
   // from the per-shard log-bucket histograms at read time), counts and
   // inflight gauges — what /status, /vars and the periodic bvar dump see
@@ -596,6 +616,10 @@ size_t native_metrics_dump(char* buf, size_t cap) {
          (long long)overload_inflight(f));
     putf("native_overload_rejects_%s %lld\n",
          (long long)overload_rejects(f));
+    // deadline-budget plane (ISSUE 19): which family's traffic is being
+    // shed as already-expired — the chaos proof reads the leaf's split
+    putf("native_deadline_drops_%s %lld\n",
+         (long long)deadline_drops_by_family(f));
   }
   // overload-control plane admission totals (the per-family triple
   // rides the family loop above)

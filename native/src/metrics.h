@@ -187,6 +187,16 @@ struct NativeMetrics {
   std::atomic<uint64_t> dump_dropped{0};
   std::atomic<uint64_t> dump_drained{0};
 
+  // deadline-budget propagation (ISSUE 19, rpc.cc tag-18 plane):
+  // deadline_drops = requests shed on the parse fiber because their
+  // propagated budget was already spent (EDEADLINE on the cork — no
+  // decode, no fiber, no usercode spawn; the per-family split is
+  // deadline_drop_note below).  deadline_queue_drops = usercode requests
+  // whose budget expired while queued for a worker: answered EDEADLINE
+  // at dequeue, the handler never ran.
+  std::atomic<uint64_t> deadline_drops{0};
+  std::atomic<uint64_t> deadline_queue_drops{0};
+
   // schedule perturbation (sched_perturb.cc, TRPC_SCHED_SEED): yields =
   // injected pauses/spins/budget truncations at instrumented seams;
   // steal_shuffles = seeded steal-victim + placement-detour draws;
@@ -235,6 +245,11 @@ void set_telemetry(int on);
 bool telemetry_enabled();
 
 const char* telemetry_family_name(int family);
+// Deadline-budget drop accounting (ISSUE 19): one parse-fiber shed of a
+// budget-spent request — bumps the native_deadline_drops total plus the
+// family's split row (family < 0 = handler unresolved: total only).
+void deadline_drop_note(int family);
+uint64_t deadline_drops_by_family(int family);
 // One histogram write: relaxed atomic adds on the shard's agent (negative
 // shard / off-worker callers fold into shard 0's agent).
 void telemetry_record(int family, int shard, int64_t lat_us);

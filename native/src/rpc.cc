@@ -141,6 +141,9 @@ void EncodeMeta(const RpcMeta& m, MetaWriter* w) {
   if (m.attach_codec != 0) {
     w->tlv_u8(kMetaTagAttachCodec, m.attach_codec);
   }
+  if (m.deadline_left_us != 0) {
+    w->tlv_u64(kMetaTagDeadlineLeftUs, m.deadline_left_us);
+  }
 }
 
 bool DecodeMeta(const char* p, size_t n, RpcMeta* m) {
@@ -199,6 +202,9 @@ bool DecodeMeta(const char* p, size_t n, RpcMeta* m) {
         break;
       case kMetaTagAttachCodec:
         if (len == 1) m->attach_codec = (uint8_t)v[0];
+        break;
+      case kMetaTagDeadlineLeftUs:
+        if (len == 8) memcpy(&m->deadline_left_us, v, 8);
         break;
       default: break;  // forward compatibility: skip unknown tags
     }
@@ -406,6 +412,14 @@ struct CallCtx {
   // downstream channel_call inherits the hop (metrics.h trace plane)
   uint64_t trace_id = 0;
   uint64_t span_id = 0;
+  // deadline-budget plane (ISSUE 19): remaining budget (µs) AT ARM TIME
+  // — the inbound tag-18 value minus the ingress wait; -1 = the request
+  // carried no budget.  Live remainder = this minus (now - arm_ns).
+  // Surfaced via token_deadline_left_us; checked at usercode dequeue
+  // (expired ⇒ EDEADLINE, the handler never runs).  Every TRPC dispatch
+  // writes it; the HTTP/redis/thrift paths never read it (the dequeue
+  // check is guarded by the is_* flags), so a recycled value can't leak.
+  int64_t deadline_left_us = -1;
   // telemetry (metrics.h): owning shard for the per-shard histogram
   // agents; telemetry_family < 0 = this request is not histogrammed
   // (HTTP/redis-python/thrift ride their own Python-side recorders)
@@ -453,6 +467,18 @@ std::atomic<int> g_inline_dispatch{-1};
 // deep pipeline cannot starve the other sockets' parse fibers.
 std::atomic<int> g_inline_budget_reqs{512};
 std::atomic<int64_t> g_inline_budget_us{500};
+
+// --- deadline-budget propagation (ISSUE 19) --------------------------------
+// -1 = consult TRPC_DEADLINE_PROPAGATE on first use (flag-cached; default
+// OFF — the tag-18 stamp and the expired-budget sheds are opt-in, so an
+// unset mesh stays byte-identical to the pre-ISSUE wire).  Reloadable via
+// set_deadline_propagate (the deadline_propagate flag).
+std::atomic<int> g_deadline_propagate{-1};
+// Per-hop reserve (µs) the Python layer subtracts when a handler's
+// downstream call inherits the remaining budget.  -1 = consult
+// TRPC_DEADLINE_RESERVE_US on first use; reloadable.
+std::atomic<int64_t> g_deadline_reserve_us{-1};
+constexpr int64_t kDeadlineReserveDefaultUs = 2000;
 
 // --- accept-storm pacing (ISSUE 16) ----------------------------------------
 // -1 = consult TRPC_ACCEPT_{RATE,BURST,MAX_PENDING} on first use
@@ -725,6 +751,26 @@ class UsercodePool {
         if (q_ns > 0) {
           nm.usercode_queue_ns_total.fetch_add((uint64_t)q_ns,
                                                std::memory_order_relaxed);
+        }
+      }
+      // deadline dequeue check (ISSUE 19): the budget this request
+      // carried ran out while it waited for a worker — answer EDEADLINE
+      // without running the handler.  respond() balances the overload/
+      // telemetry/method-cap/cancel bookkeeping exactly like a handler
+      // completion, so every charge taken at dispatch releases here too.
+      // TRPC-only (the is_* guards): HTTP/redis/thrift ctxs never stamp
+      // the field, so a recycled value must not be read for them.
+      if (!ctx->is_http && !ctx->is_redis && !ctx->is_thrift &&
+          !ctx->is_user_proto && ctx->deadline_left_us >= 0 &&
+          deadline_propagate_enabled()) {
+        int64_t waited_us = (monotonic_ns() - ctx->arm_ns) / 1000;
+        if (waited_us >= ctx->deadline_left_us) {
+          nm.deadline_queue_drops.fetch_add(1, std::memory_order_relaxed);
+          respond(ctx->token(), TRPC_EDEADLINE,
+                  "deadline budget exhausted", nullptr, 0, nullptr, 0, 0);
+          nm.usercode_running.fetch_sub(1, std::memory_order_relaxed);
+          lk.lock();
+          continue;
         }
       }
       // fiber-local-parent ingress (metrics.h trace plane): the handler
@@ -1117,18 +1163,21 @@ void SendResponse(SocketId sock_id, uint64_t correlation_id,
   s->Dereference();
 }
 
-// Inline fast-reject (overload.h, ISSUE 11): the ELIMIT answer for a
+// Inline fast-reject (overload.h, ISSUE 11): the reject answer for a
 // shed request is packed straight onto the drain's response cork — no
 // codec decode, no fiber, no usercode spawn, one tiny frame riding the
 // same flush as the admitted batch.  Mirrors SendResponse's meta shape
 // (incl. the device-caps probe answer) minus everything a reject never
-// carries.
-void ShedOnCork(Socket* s, IOBuf* out, uint64_t corr) {
+// carries.  Defaults answer ELIMIT (the overload plane); the deadline
+// plane (ISSUE 19) rides the same rail with EDEADLINE.
+void ShedOnCork(Socket* s, IOBuf* out, uint64_t corr,
+                int32_t error_code = TRPC_ELIMIT,
+                const char* error_text = "rejected by overload control") {
   RpcMeta rmeta;
   rmeta.correlation_id = corr;
   rmeta.flags = 1;  // response
-  rmeta.error_code = TRPC_ELIMIT;
-  rmeta.error_text = "rejected by overload control";
+  rmeta.error_code = error_code;
+  rmeta.error_text = error_text;
   if (s->advertise_device_caps.load(std::memory_order_acquire)) {
     rmeta.device_caps = ServerDeviceCaps();
     rmeta.plane_uid = tpu_plane_uid();
@@ -1614,6 +1663,16 @@ void ServerOnMessages(Socket* s) {
   // (ROADMAP item 4) needs, at one clock read per completion
   int64_t drain_ns = CoarseClockRefresh();
   InlineBudget budget(fast, drain_ns);
+  // Deadline-budget ingress anchor (ISSUE 19): frames parsed this drain
+  // waited (drain_ns - ingress_arm_ns) since their first bytes landed —
+  // 0 for bytes that arrived just now, a real wait for frames that sat
+  // buffered while earlier drains were busy.  The anchor re-stamps at
+  // drain end (leftover partial frames count their wait from here), so
+  // the shed is conservative: never early, exactly like the timer plane.
+  if (s->read_arm_ns == 0 && !s->read_buf.empty()) {
+    s->read_arm_ns = drain_ns;
+  }
+  int64_t ingress_arm_ns = s->read_arm_ns != 0 ? s->read_arm_ns : drain_ns;
   bool telem = telemetry_enabled();
   // overload-control admission scope (overload.h): one master-switch
   // snapshot per drain; run-to-completion charges release when this
@@ -2216,6 +2275,26 @@ void ServerOnMessages(Socket* s) {
         s->peer_plane_uid.store(meta.plane_uid, std::memory_order_release);
       }
     }
+    // Deadline-budget fast-drop (ISSUE 19, tag 18): the propagated
+    // budget this request carried was spent while it sat in read_buf —
+    // the caller has already given up, so executing it is pure waste.
+    // The EDEADLINE answer rides the PR-11 ShedOnCork rail BEFORE the
+    // overload charge, the codec decode and any fiber/usercode spawn.
+    // Tag absent or TRPC_DEADLINE_PROPAGATE off: nothing here runs.
+    if (meta.deadline_left_us != 0 && deadline_propagate_enabled()) {
+      int64_t waited_us = (drain_ns - ingress_arm_ns) / 1000;
+      if (waited_us > 0 && (uint64_t)waited_us >= meta.deadline_left_us) {
+        ServiceHandler* dsh = ResolveHandler(srv, meta.method);
+        deadline_drop_note(dsh == nullptr ? -1
+                           : dsh->kind == 0 ? TF_INLINE_ECHO
+                           : dsh->kind == 2 ? TF_HBM_ECHO
+                                            : TF_USERCODE);
+        srv->nrequests.fetch_add(1, std::memory_order_relaxed);
+        ShedOnCork(s, &batched_out, meta.correlation_id, TRPC_EDEADLINE,
+                   "deadline budget exhausted");
+        continue;
+      }
+    }
     // Overload admission (overload.h, ISSUE 11): with the plane on,
     // resolve the handler FIRST (the same flat-map find dispatch needs
     // anyway) and admit/shed BEFORE the codec decode — a shed request
@@ -2519,6 +2598,16 @@ void ServerOnMessages(Socket* s) {
       // handler thread's TraceCtx so downstream calls inherit the hop
       ctx->trace_id = meta.trace_id;
       ctx->span_id = meta.span_id;
+      // deadline-budget ingress (tag 18, decoded unconditionally so a
+      // mesh can flip tiers on one at a time): remaining-at-arm =
+      // inbound budget minus the wait this frame already served in
+      // read_buf (ingress_arm_ns); the dequeue check and the Controller
+      // surface (token_deadline_left_us) both anchor at arm_ns
+      ctx->deadline_left_us =
+          meta.deadline_left_us != 0
+              ? (int64_t)meta.deadline_left_us -
+                    (drain_ns - ingress_arm_ns) / 1000
+              : -1;
       ctx->shard = s->shard;
       ctx->telemetry_family = telem ? TF_USERCODE : -1;
       // overload release + gradient sample happen in respond() with the
@@ -2542,6 +2631,9 @@ void ServerOnMessages(Socket* s) {
       UsercodePool::Instance().Submit(ctx);
     }
   }
+  // Re-anchor the deadline ingress stamp: whatever read_buf still holds
+  // (a partial frame) counts its wait from this drain forward.
+  s->read_arm_ns = s->read_buf.empty() ? 0 : drain_ns;
   flush();
   if (eof) {
     s->SetFailed(ECONNRESET);
@@ -4891,6 +4983,42 @@ bool client_cork_enabled() {
   return v != 0;
 }
 
+void set_deadline_propagate(int on) {
+  g_deadline_propagate.store(on ? 1 : 0, std::memory_order_release);
+}
+
+bool deadline_propagate_enabled() {
+  int v = g_deadline_propagate.load(std::memory_order_acquire);
+  if (v < 0) {
+    // first use: TRPC_DEADLINE_PROPAGATE seeds the default (flag-cached:
+    // resolved once; default off — inert unless the mesh opts in)
+    const char* e = getenv("TRPC_DEADLINE_PROPAGATE");
+    v = (e != nullptr && e[0] != '\0' && !(e[0] == '0' && e[1] == '\0'))
+            ? 1
+            : 0;
+    g_deadline_propagate.store(v, std::memory_order_release);
+  }
+  return v != 0;
+}
+
+void set_deadline_reserve_us(int64_t us) {
+  g_deadline_reserve_us.store(us < 0 ? 0 : us, std::memory_order_release);
+}
+
+int64_t deadline_reserve_us() {
+  int64_t v = g_deadline_reserve_us.load(std::memory_order_acquire);
+  if (v < 0) {
+    // flag-cached: resolved once into g_deadline_reserve_us
+    const char* e = getenv("TRPC_DEADLINE_RESERVE_US");
+    v = e != nullptr ? atoll(e) : kDeadlineReserveDefaultUs;
+    if (v < 0) {
+      v = 0;
+    }
+    g_deadline_reserve_us.store(v, std::memory_order_release);
+  }
+  return v;
+}
+
 void set_inline_budget_requests(int reqs) {
   g_inline_budget_reqs.store(reqs > 0 ? reqs : 1,
                              std::memory_order_relaxed);
@@ -4929,6 +5057,25 @@ int token_trace(uint64_t token, uint64_t* trace_id, uint64_t* span_id) {
     *span_id = ctx->span_id;
   }
   return 0;
+}
+
+int token_deadline_left_us(uint64_t token, int64_t* left_us) {
+  CallCtx* ctx = ResourcePool<CallCtx>::Address((uint32_t)token);
+  if (ctx == nullptr ||
+      ctx->version.load(std::memory_order_acquire) !=
+          (uint32_t)(token >> 32)) {
+    return -1;
+  }
+  if (ctx->deadline_left_us < 0) {
+    return 0;  // the request carried no tag-18 budget
+  }
+  if (left_us != nullptr) {
+    // live remainder (may be <= 0: already spent) — the handler's
+    // downstream calls size their timeouts off this
+    *left_us =
+        ctx->deadline_left_us - (monotonic_ns() - ctx->arm_ns) / 1000;
+  }
+  return 1;
 }
 
 void channel_set_connection_type(Channel* c, int t) {
@@ -5058,6 +5205,13 @@ int channel_call(Channel* c, const char* method, const uint8_t* req,
   // zero ids mean no tags, byte-identical to the pre-telemetry wire
   meta.trace_id = capture ? nsp.trace_id : tc.trace_id;
   meta.span_id = capture ? nsp.span_id : tc.span_id;
+  if (timeout_us > 0 && deadline_propagate_enabled()) {
+    // deadline-budget propagation (tag 18, ISSUE 19): the completion
+    // wait starts right after the write, so this attempt's remaining
+    // budget AT SEND TIME is the whole timeout — each retry/backup
+    // attempt re-enters here with its own shrunken timeout_us
+    meta.deadline_left_us = (uint64_t)timeout_us;
+  }
   {
     std::lock_guard lk(c->auth_mu);  // vs live credential rotation
     meta.auth = c->auth;
@@ -5298,6 +5452,14 @@ int channel_fanout_call(Channel** chans, int n, const char* method,
       t.join();
     }
   }
+  // deadline-budget propagation (tag 18, ISSUE 19): cold dials above may
+  // have eaten into the group budget — every member carries the SAME
+  // remaining-at-pack figure (one clock read, the group is one hop)
+  uint64_t group_deadline_left_us = 0;
+  if (timeout_us > 0 && deadline_propagate_enabled()) {
+    int64_t left = deadline >= 0 ? deadline - monotonic_us() : timeout_us;
+    group_deadline_left_us = (uint64_t)(left > 1 ? left : 1);
+  }
   for (int i = 0; i < n; ++i) {
     CallResult* out = outs[i];
     Sub& sb = subs[(size_t)i];
@@ -5320,6 +5482,7 @@ int channel_fanout_call(Channel** chans, int n, const char* method,
     // so each downstream server span parents at the group span
     meta.trace_id = capture ? gsp.trace_id : tc.trace_id;
     meta.span_id = capture ? gsp.span_id : tc.span_id;
+    meta.deadline_left_us = group_deadline_left_us;
     {
       std::lock_guard lk(chans[i]->auth_mu);  // vs credential rotation
       meta.auth = chans[i]->auth;

@@ -60,6 +60,7 @@ enum : uint8_t {
   kMetaTagPlaneUid = 15,
   kMetaTagPayloadCodec = 16,
   kMetaTagAttachCodec = 17,
+  kMetaTagDeadlineLeftUs = 18,
 };
 
 struct RpcMeta {
@@ -94,6 +95,13 @@ struct RpcMeta {
   // no host landing (≙ RDMA only posting from registered blocks when the
   // peer rides the same fabric).
   uint64_t plane_uid = 0;
+  // tag 18 — deadline-budget propagation (ISSUE 19, ≙ the reference
+  // carrying the caller's remaining timeout in the baidu_std meta,
+  // baidu_rpc_meta.proto timeout_ms): the sender's remaining budget in
+  // µs AT SEND TIME, a relative duration (no cross-process clock).  Each
+  // tier re-stamps its own shrunken remainder.  0 = absent (tag omitted
+  // — propagation off is byte-identical on the wire).
+  uint64_t deadline_left_us = 0;
 
   bool is_response() const { return flags & 1; }
 };
@@ -331,6 +339,30 @@ int64_t token_arm_ns(uint64_t token);
 // downstream channel_call inherits the context into its own tags.
 // Returns 0, or -1 for a stale token (*trace_id/*span_id then untouched).
 int token_trace(uint64_t token, uint64_t* trace_id, uint64_t* span_id);
+
+// --- deadline-budget propagation (ISSUE 19) --------------------------------
+
+// Master switch (TRPC_DEADLINE_PROPAGATE env seeds the default, off;
+// reloadable through the deadline_propagate flag).  On: channel_call /
+// channel_fanout_call stamp the attempt's remaining budget into meta tag
+// 18 and the server sheds requests whose budget is already spent.  Off:
+// no tag is emitted and no shed fires — byte-identical to the pre-ISSUE
+// wire (tag-18 DECODE stays unconditional: inbound budgets still surface
+// on the Controller so a mesh can flip tiers on one at a time).
+void set_deadline_propagate(int on);
+bool deadline_propagate_enabled();
+// Per-hop reserve subtracted by the PYTHON layer when a handler's
+// downstream call defaults to the inherited remaining budget
+// (TRPC_DEADLINE_RESERVE_US; reloadable).  Held native-side so every
+// process in a mesh shares one reload rail.
+void set_deadline_reserve_us(int64_t us);
+int64_t deadline_reserve_us();
+
+// Remaining deadline budget of a pending usercode request: computed live
+// as (inbound budget at parse) - (time since parse).  Returns 1 with
+// *left_us set (may be <= 0: already spent), 0 when the request carried
+// no tag-18 budget, -1 for a stale token.
+int token_deadline_left_us(uint64_t token, int64_t* left_us);
 
 // Native redis cache: GET/SET/DEL/EXISTS/PING execute against an
 // in-memory native store — inline on the parse fiber when the fast path

@@ -167,6 +167,13 @@ class Socket {
   // device-DMA source.  Only touched by the socket's processing fiber.
   size_t frame_bytes_hint = 0;
   size_t frame_attach_hint = 0;
+  // Deadline-budget ingress anchor (ISSUE 19, rpc.cc tag-18 plane): the
+  // coarse drain stamp when read_buf last went empty→non-empty.  Frames
+  // parsed in a LATER drain have waited (drain_ns - read_arm_ns) on this
+  // host; the parse fiber sheds the ones whose propagated budget that
+  // wait already spent.  0 = buffer empty.  Only touched by the socket's
+  // processing fiber (the nevent protocol guarantees a single one).
+  int64_t read_arm_ns = 0;
 
   static int Create(const SocketOptions& opts, SocketId* id_out);
   // +1 ref; nullptr if the id is stale.

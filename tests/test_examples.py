@@ -110,7 +110,7 @@ def test_param_server_allreduce_codec_leg():
         r = subprocess.run(
             [sys.executable, "param_server_allreduce.py", "--codec",
              "int8"], cwd=_EXAMPLES_DIR, env=env, capture_output=True,
-            text=True, timeout=300)
+            text=True, timeout=780)  # ~165s alone; suite load can triple it
     except subprocess.TimeoutExpired:
         if not _jax_initializable():
             pytest.skip("jax cannot initialize on this host right now "

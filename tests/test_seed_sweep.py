@@ -154,6 +154,35 @@ def test_seed_sweep_overload_races(flavor):
 
 
 @pytest.mark.slow
+@pytest.mark.parametrize("flavor", ["thread", "address"])
+def test_seed_sweep_deadline_races(flavor):
+    """ISSUE 19 leg: >= 32 seeds over the deadline-budget scenario with
+    TRPC_SHARDS=2 forced — seeded interleavings drive tag-18 budget
+    stamping racing reloadable flag flips, the parse-fiber shed vs the
+    usercode dequeue drop vs normal responds, the read_arm_ns ingress
+    anchor across both reactors' drains, and server teardown under
+    queued tiny-budget work."""
+    if os.environ.get("BRPC_TPU_SKIP_SANITIZERS"):
+        pytest.skip("sanitizer runs disabled by env")
+    exe = _build(flavor)
+    seeds = int(os.environ.get("BRPC_TPU_SEED_SWEEP_SEEDS", "32"))
+    base = int(os.environ.get("BRPC_TPU_SEED_SWEEP_BASE", "1"))
+    env = dict(os.environ)
+    env["TRPC_SHARDS"] = "2"
+    out = subprocess.run(
+        [exe, "--sweep", str(seeds), str(base), "deadline_races"],
+        capture_output=True, text=True,
+        timeout=int(os.environ.get("BRPC_TPU_SEED_SWEEP_TIMEOUT", "5400")),
+        env=env)
+    hits = [int(m) for m in re.findall(r"SWEEP HIT seed=(\d+)", out.stdout)]
+    assert out.returncode == 0 and not hits, (
+        f"deadline sweep found schedule-dependent failures (seeds "
+        f"{hits}); replay: TRPC_SHARDS=2 TRPC_SCHED_SEED=<seed> {exe} "
+        f"deadline_races\n{out.stdout[-3000:]}")
+    assert f"sweep done: 0/{seeds}" in out.stdout, out.stdout[-2000:]
+
+
+@pytest.mark.slow
 def test_ubsan_gate():
     """ISSUE 10 UBSan rail: the FULL kScenarios gate table under
     -fsanitize=undefined -fno-sanitize-recover=all (any UB aborts the

@@ -1,0 +1,622 @@
+#!/usr/bin/env python3
+"""mesh_cluster — churn-proof scatter-gather mesh chaos harness (ISSUE 19).
+
+Builds a real multi-process mesh — this process (the root cannon) →
+N mixer processes → M leaf processes — and drives scripted churn legs
+through it while an open-loop press measures admitted-only latency and
+success rate at the root (≙ the reference's multi-server example topology
+example/cascade_echo + the rpc_press posture of tools/rpc_press).
+
+Topology plumbing:
+  - leaves announce their ports through files; membership rides
+    file:// naming (cluster/naming.py FileNamingService, 0.5s poll), so
+    the naming-flap leg is literally rewriting the file mid-flight.
+  - mixers scatter each root request to ``--fanout`` leaves through a
+    pressure-steered ``la`` cluster channel with ``backup_request_ms``
+    hedging, and forward the root's inherited deadline budget (meta tag
+    18) minus the per-hop reserve on every sub-call.
+  - leaves run the overload plane (TRPC_OVERLOAD=1) so a saturated leaf
+    sheds ELIMIT — the breaker's pressure EMA then bleeds its LB share
+    — and the deadline plane (TRPC_DEADLINE_PROPAGATE=1) so work whose
+    budget died in a queue is DROPPED (native_deadline_* counters), not
+    executed.
+
+Churn legs (each: press ``--leg-s`` seconds, chaos injected mid-burst):
+  baseline        no chaos — the reference numbers.
+  leaf_kill       SIGKILL one leaf mid-burst, then a second "recovered"
+                  press after the health-check interval: its success
+                  rate is the acceptance number (>= 99%).
+  slow_leaf       inject --slow-delay-ms into one leaf (alive, slow):
+                  its share of echoes must bleed below fair share while
+                  expired queue work shows up as deadline drops.
+  naming_flap     remove one leaf from the naming file mid-burst,
+                  re-add it before the leg ends.
+  mixer_partition SIGSTOP one mixer (partition, not crash) mid-burst,
+                  SIGCONT before the leg ends.
+
+Output: one ``--json`` line —
+  {"metric": "mesh_cluster", "topology": {...}, "legs": [
+      {"leg": ..., "root": {calls/admitted/shed/errors/success_rate/
+                            p50_us/p99_us/p999_us},
+       "leaves": {addr: {"echoes": n, "share": f,
+                         "deadline_drops": n, "deadline_queue_drops": n}},
+       "deadline_drops_total": n, ...}, ...]}
+"""
+
+from __future__ import annotations
+
+import argparse
+import ctypes  # noqa: F401  (ctypes types ride through brpc_tpu)
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+# the mesh's env contract: deadline propagation + overload shedding on
+# in every tier (children inherit; the root process sets them BEFORE
+# importing brpc_tpu so the native flag caches resolve to "on")
+_MESH_ENV = {
+    "TRPC_DEADLINE_PROPAGATE": "1",
+    "TRPC_OVERLOAD": "1",
+    "JAX_PLATFORMS": "cpu",
+}
+
+
+# --------------------------------------------------------------------------
+# child roles
+
+
+def _announce(path: str, port: int) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(str(port))
+    os.replace(tmp, path)
+
+
+def run_leaf(args) -> int:
+    from brpc_tpu.metrics.native import read_native_metrics
+    from brpc_tpu.rpc.server import Server
+
+    state = {"delay_us": int(args.slow_delay_ms * 1000), "echoes": 0}
+    lock = threading.Lock()
+
+    def echo(cntl, req):
+        d = state["delay_us"]
+        if d:
+            time.sleep(d / 1e6)
+        with lock:
+            state["echoes"] += 1
+        return req
+
+    def set_delay(cntl, req):
+        state["delay_us"] = int(req or b"0")
+        return b"ok"
+
+    def stats(cntl, req):
+        nm = read_native_metrics()
+        with lock:
+            echoes = state["echoes"]
+        return json.dumps({
+            "echoes": echoes,
+            "deadline_drops": nm.get("native_deadline_drops", 0),
+            "deadline_queue_drops": nm.get("native_deadline_queue_drops", 0),
+            "overload_rejects": nm.get("native_overload_rejects", 0),
+        }).encode()
+
+    srv = Server()
+    srv.add_service("Echo.echo", echo)
+    srv.add_service("Control.set_delay", set_delay)
+    srv.add_service("Control.stats", stats)
+    srv.start("127.0.0.1:0")
+    _announce(args.announce, srv.port)
+    signal.pause()  # killed by the harness
+    return 0
+
+
+def run_mixer(args) -> int:
+    from concurrent.futures import ThreadPoolExecutor
+
+    from brpc_tpu.metrics.native import read_native_metrics
+    from brpc_tpu.rpc import controller as controller_mod
+    from brpc_tpu.rpc import errors
+    from brpc_tpu.rpc.channel import Channel, ChannelOptions
+    from brpc_tpu.rpc.server import Server
+
+    down = Channel(f"file://{args.leaves}", ChannelOptions(
+        timeout_ms=args.timeout_ms,
+        max_retry=2,
+        load_balancer="la",
+        backup_request_ms=args.backup_ms))
+    pool = ThreadPoolExecutor(max_workers=8)
+    reserve_ms = 2.0
+    tallies = {"scatters": 0, "sub_calls": 0, "sub_errors": 0,
+               "sub_shed": 0}
+    lock = threading.Lock()
+
+    def sub_call(req, timeout_ms):
+        try:
+            down.call("Echo.echo", req, timeout_ms=timeout_ms)
+            return 0
+        except errors.RpcError as e:
+            return e.code
+
+    def scatter(cntl, req):
+        # forward the root's shrinking budget: sub-calls run on pool
+        # threads, so the handler thread's inherited deadline must be
+        # converted to an explicit per-sub timeout here (thread-local
+        # context does not follow the executor)
+        inh = controller_mod.inherited_deadline_ns()
+        timeout_ms = None
+        if inh is not None:
+            timeout_ms = max(
+                (inh - time.monotonic_ns()) / 1e6 - reserve_ms, 1.0)
+        futs = [pool.submit(sub_call, req, timeout_ms)
+                for _ in range(args.fanout)]
+        codes = [f.result() for f in futs]
+        with lock:
+            tallies["scatters"] += 1
+            tallies["sub_calls"] += len(codes)
+            tallies["sub_errors"] += sum(
+                1 for c in codes if c not in (0, errors.ELIMIT))
+            tallies["sub_shed"] += sum(
+                1 for c in codes if c == errors.ELIMIT)
+        bad = [c for c in codes if c != 0]
+        if bad:
+            cntl.set_failed(bad[0], f"{len(bad)}/{len(codes)} subs failed")
+            return b""
+        return req
+
+    def stats(cntl, req):
+        nm = read_native_metrics()
+        with lock:
+            out = dict(tallies)
+        out["deadline_drops"] = nm.get("native_deadline_drops", 0)
+        out["deadline_queue_drops"] = nm.get(
+            "native_deadline_queue_drops", 0)
+        return json.dumps(out).encode()
+
+    srv = Server()
+    srv.add_service("Mix.scatter", scatter)
+    srv.add_service("Control.stats", stats)
+    srv.start("127.0.0.1:0")
+    _announce(args.announce, srv.port)
+    signal.pause()
+    return 0
+
+
+# --------------------------------------------------------------------------
+# the harness (root) side
+
+
+class _Proc:
+    def __init__(self, role: str, popen: subprocess.Popen,
+                 announce: str, port: int, idx: int):
+        self.role = role
+        self.popen = popen
+        self.announce = announce
+        self.port = port
+        self.idx = idx
+
+    @property
+    def addr(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+
+class Mesh:
+    """Spawns and wires root → mixers → leaves; owns the naming files."""
+
+    def __init__(self, mixers: int, leaves: int, fanout: int,
+                 timeout_ms: float, backup_ms: float, workdir: str):
+        self.workdir = workdir
+        self.fanout = fanout
+        self.timeout_ms = timeout_ms
+        self.backup_ms = backup_ms
+        self.leaves_file = os.path.join(workdir, "leaves.list")
+        self.mixers_file = os.path.join(workdir, "mixers.list")
+        self.leaves: List[_Proc] = []
+        self.mixers: List[_Proc] = []
+        self._env = dict(os.environ, **_MESH_ENV)
+        for i in range(leaves):
+            self.leaves.append(self._spawn_leaf(i))
+        self._write_members(self.leaves_file, self.leaves)
+        for i in range(mixers):
+            self.mixers.append(self._spawn_mixer(i))
+        self._write_members(self.mixers_file, self.mixers)
+
+    def _spawn(self, role: str, idx: int, extra: List[str]) -> _Proc:
+        announce = os.path.join(self.workdir, f"{role}{idx}.port")
+        if os.path.exists(announce):
+            os.unlink(announce)
+        logf = open(os.path.join(self.workdir, f"{role}{idx}.log"), "ab")
+        p = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--role", role,
+             "--announce", announce] + extra,
+            stdout=logf, stderr=subprocess.STDOUT, env=self._env,
+            cwd=REPO_ROOT)
+        logf.close()
+        deadline = time.monotonic() + 30.0
+        while not os.path.exists(announce):
+            if p.poll() is not None:
+                raise RuntimeError(f"{role}{idx} died during startup "
+                                   f"(see {role}{idx}.log)")
+            if time.monotonic() > deadline:
+                p.kill()
+                raise RuntimeError(f"{role}{idx} startup timed out")
+            time.sleep(0.02)
+        with open(announce) as f:
+            port = int(f.read().strip())
+        return _Proc(role, p, announce, port, idx)
+
+    def _spawn_leaf(self, idx: int) -> _Proc:
+        return self._spawn("leaf", idx, [])
+
+    def _spawn_mixer(self, idx: int) -> _Proc:
+        return self._spawn("mixer", idx, [
+            "--leaves", self.leaves_file,
+            "--fanout", str(self.fanout),
+            "--timeout-ms", str(self.timeout_ms),
+            "--backup-ms", str(self.backup_ms)])
+
+    def _write_members(self, path: str, procs: List[_Proc],
+                       skip: Optional[_Proc] = None) -> None:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            for pr in procs:
+                if pr is skip:
+                    continue
+                f.write(f"{pr.addr}\n")
+        os.replace(tmp, path)
+
+    def respawn_leaf(self, pr: _Proc) -> _Proc:
+        fresh = self._spawn_leaf(pr.idx)
+        self.leaves[self.leaves.index(pr)] = fresh
+        self._write_members(self.leaves_file, self.leaves)
+        return fresh
+
+    def leaf_stats(self) -> Dict[str, dict]:
+        from brpc_tpu.rpc import errors
+        from brpc_tpu.rpc.channel import Channel, ChannelOptions
+        out: Dict[str, dict] = {}
+        for pr in self.leaves:
+            if pr.popen.poll() is not None:
+                continue
+            ch = Channel(pr.addr, ChannelOptions(timeout_ms=2000,
+                                                 max_retry=0))
+            try:
+                out[pr.addr] = json.loads(ch.call("Control.stats", b""))
+            except errors.RpcError:
+                pass
+            finally:
+                ch.close()
+        return out
+
+    def mixer_stats(self) -> Dict[str, dict]:
+        from brpc_tpu.rpc import errors
+        from brpc_tpu.rpc.channel import Channel, ChannelOptions
+        out: Dict[str, dict] = {}
+        for pr in self.mixers:
+            if pr.popen.poll() is not None:
+                continue
+            ch = Channel(pr.addr, ChannelOptions(timeout_ms=2000,
+                                                 max_retry=0))
+            try:
+                out[pr.addr] = json.loads(ch.call("Control.stats", b""))
+            except errors.RpcError:
+                pass
+            finally:
+                ch.close()
+        return out
+
+    def set_leaf_delay(self, pr: _Proc, delay_ms: float) -> None:
+        from brpc_tpu.rpc.channel import Channel, ChannelOptions
+        ch = Channel(pr.addr, ChannelOptions(timeout_ms=2000, max_retry=0))
+        try:
+            ch.call("Control.set_delay", str(int(delay_ms * 1000)).encode())
+        finally:
+            ch.close()
+
+    def shutdown(self) -> None:
+        for pr in self.mixers + self.leaves:
+            if pr.popen.poll() is None:
+                try:  # a SIGSTOPped mixer must be CONTed before TERM
+                    pr.popen.send_signal(signal.SIGCONT)
+                except OSError:
+                    pass
+                pr.popen.terminate()
+        for pr in self.mixers + self.leaves:
+            try:
+                pr.popen.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pr.popen.kill()
+
+
+def _press_root(mesh: Mesh, duration_s: float, concurrency: int,
+                timeout_ms: float, chaos=None, chaos_at_s: float = 0.0):
+    """Open-loop root press through the mixer tier; `chaos` (if given)
+    fires once, mid-burst, chaos_at_s into the leg — on a side thread so
+    offered load never pauses."""
+    from brpc_tpu.rpc import errors
+    from brpc_tpu.rpc.channel import Channel, ChannelOptions
+    from brpc_tpu.tools.rpc_press import PressResult
+
+    res = PressResult()
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def worker():
+        ch = Channel(f"file://{mesh.mixers_file}", ChannelOptions(
+            timeout_ms=timeout_ms, max_retry=2, load_balancer="la"))
+        lat, calls, errs, shed = [], 0, 0, 0
+        while not stop.is_set():
+            t0 = time.monotonic_ns()
+            try:
+                ch.call("Mix.scatter", b"mesh")
+                lat.append((time.monotonic_ns() - t0) // 1000)
+            except errors.RpcError as e:
+                if e.code == errors.ELIMIT:
+                    shed += 1
+                else:
+                    errs += 1
+            except Exception:
+                errs += 1
+            calls += 1
+        ch.close()
+        with lock:
+            res.calls += calls
+            res.errors += errs
+            res.shed += shed
+            res.latencies_us.extend(lat)
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(concurrency)]
+    timer = None
+    if chaos is not None:
+        timer = threading.Timer(chaos_at_s, chaos)
+        timer.daemon = True
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    if timer is not None:
+        timer.start()
+    time.sleep(duration_s)
+    stop.set()
+    for t in threads:
+        t.join(timeout=timeout_ms / 1000 + 2)
+    if timer is not None:
+        timer.cancel()
+    res.wall_s = time.monotonic() - t0
+    res.qps = res.calls / res.wall_s if res.wall_s > 0 else 0.0
+    return res
+
+
+def _root_dict(res) -> dict:
+    d = res.step_dict()
+    d["success_rate"] = (round(res.admitted / res.calls, 4)
+                         if res.calls else 0.0)
+    return d
+
+
+def _leaf_deltas(before: Dict[str, dict],
+                 after: Dict[str, dict]) -> Dict[str, dict]:
+    out: Dict[str, dict] = {}
+    total = 0
+    for addr, a in after.items():
+        b = before.get(addr, {})
+        echoes = a.get("echoes", 0) - b.get("echoes", 0)
+        total += max(echoes, 0)
+        out[addr] = {
+            "echoes": echoes,
+            "deadline_drops": (a.get("deadline_drops", 0)
+                               - b.get("deadline_drops", 0)),
+            "deadline_queue_drops": (a.get("deadline_queue_drops", 0)
+                                     - b.get("deadline_queue_drops", 0)),
+            "overload_rejects": (a.get("overload_rejects", 0)
+                                 - b.get("overload_rejects", 0)),
+        }
+    for d in out.values():
+        d["share"] = round(d["echoes"] / total, 4) if total else 0.0
+    return out
+
+
+def run_harness(args) -> int:
+    workdir = args.workdir or tempfile.mkdtemp(prefix="mesh_cluster_")
+    os.makedirs(workdir, exist_ok=True)
+    mesh = Mesh(args.mixers, args.leaves, args.fanout,
+                args.timeout_ms, args.backup_ms, workdir)
+    legs = []
+    ok = True
+    want = [s.strip() for s in args.legs.split(",") if s.strip()]
+    try:
+        def run_leg(name, chaos=None, chaos_at=0.0, extra=None,
+                    timeout_ms=None):
+            before = mesh.leaf_stats()
+            res = _press_root(mesh, args.leg_s, args.concurrency,
+                              timeout_ms or args.timeout_ms, chaos=chaos,
+                              chaos_at_s=chaos_at)
+            leaves = _leaf_deltas(before, mesh.leaf_stats())
+            leg = {"leg": name, "root": _root_dict(res), "leaves": leaves,
+                   "deadline_drops_total": sum(
+                       d["deadline_drops"] + d["deadline_queue_drops"]
+                       for d in leaves.values())}
+            if extra:
+                leg.update(extra)
+            legs.append(leg)
+            return leg
+
+        if "baseline" in want:
+            run_leg("baseline")
+
+        if "leaf_kill" in want:
+            victim = mesh.leaves[0]
+
+            def kill():
+                victim.popen.kill()
+
+            run_leg("leaf_kill", chaos=kill,
+                    chaos_at=args.leg_s * 0.3,
+                    extra={"killed": victim.addr})
+            # settle one health-check interval, then the acceptance
+            # press: success AFTER revival-or-steer-away must be >= 99%
+            time.sleep(args.settle_s)
+            leg = run_leg("leaf_kill_recovered")
+            if leg["root"]["success_rate"] < args.min_success:
+                ok = False
+            mesh.respawn_leaf(victim)
+            time.sleep(1.0)  # naming poll picks the respawn up
+
+        if "slow_leaf" in want:
+            slow = mesh.leaves[-1]
+            mesh.set_leaf_delay(slow, args.slow_delay_ms)
+            leg = run_leg("slow_leaf", extra={"slow": slow.addr})
+            mesh.set_leaf_delay(slow, 0.0)
+            fair = 1.0 / len(mesh.leaves)
+            leg["slow_share"] = leg["leaves"].get(
+                slow.addr, {}).get("share", 0.0)
+            leg["fair_share"] = round(fair, 4)
+            # the steering claim: the slow-but-alive leaf bled traffic
+            if leg["slow_share"] >= fair:
+                ok = False
+
+        if "naming_flap" in want:
+            flapped = mesh.leaves[-1]
+
+            def flap_out():
+                mesh._write_members(mesh.leaves_file, mesh.leaves,
+                                    skip=flapped)
+                t = threading.Timer(args.leg_s * 0.3, lambda:
+                                    mesh._write_members(mesh.leaves_file,
+                                                        mesh.leaves))
+                t.daemon = True
+                t.start()
+
+            leg = run_leg("naming_flap", chaos=flap_out,
+                          chaos_at=args.leg_s * 0.2,
+                          extra={"flapped": flapped.addr})
+            if leg["root"]["success_rate"] < args.min_success:
+                ok = False
+
+        if "expired_budget" in want:
+            # the drop-proof leg: EVERY leaf turns slow, so steering has
+            # nowhere to bleed to and open-loop pressure stacks queues —
+            # requests whose inherited budget dies while queued must be
+            # DROPPED by the leaf (native_deadline_* counters), never
+            # executed.  Root success is expected to crater here; the
+            # acceptance signal is deadline_drops_total > 0.
+            # self-contained pressure coordinates: the handler delay must
+            # dwarf the per-call budget so queued subs outlive it on the
+            # leaves' (4-thread) usercode pools at ANY topology scale
+            exp_budget_ms = min(args.timeout_ms, 100.0)
+            exp_delay_ms = max(args.slow_delay_ms, 80.0)
+            for pr in mesh.leaves:
+                mesh.set_leaf_delay(pr, exp_delay_ms)
+            leg = run_leg("expired_budget", timeout_ms=exp_budget_ms)
+            for pr in mesh.leaves:
+                mesh.set_leaf_delay(pr, 0.0)
+            if leg["deadline_drops_total"] <= 0:
+                ok = False
+
+        if "mixer_partition" in want and len(mesh.mixers) > 1:
+            part = mesh.mixers[-1]
+
+            def partition():
+                part.popen.send_signal(signal.SIGSTOP)
+                t = threading.Timer(
+                    args.leg_s * 0.4,
+                    lambda: part.popen.send_signal(signal.SIGCONT))
+                t.daemon = True
+                t.start()
+
+            run_leg("mixer_partition", chaos=partition,
+                    chaos_at=args.leg_s * 0.2,
+                    extra={"partitioned": part.addr})
+
+        mixer_stats = mesh.mixer_stats()
+    finally:
+        mesh.shutdown()
+
+    drops_total = sum(leg["deadline_drops_total"] for leg in legs)
+    doc = {
+        "metric": "mesh_cluster",
+        "topology": {"mixers": args.mixers, "leaves": args.leaves,
+                     "fanout": args.fanout,
+                     "timeout_ms": args.timeout_ms,
+                     "backup_ms": args.backup_ms},
+        "legs": legs,
+        "mixers": mixer_stats,
+        "deadline_drops_total": drops_total,
+        "ok": ok,
+    }
+    if args.json:
+        print(json.dumps(doc))
+    else:
+        for leg in legs:
+            r = leg["root"]
+            print(f"{leg['leg']:>20}: calls={r['calls']} "
+                  f"success={r['success_rate']:.3f} shed={r['shed']} "
+                  f"errors={r['errors']} p50={r['p50_us']:.0f}us "
+                  f"p99={r['p99_us']:.0f}us p999={r['p999_us']:.0f}us "
+                  f"deadline_drops={leg['deadline_drops_total']}")
+        print(f"total deadline drops: {drops_total}  ok={ok}")
+    return 0 if ok else 1
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description="scatter-gather mesh chaos "
+                                             "harness (ISSUE 19)")
+    ap.add_argument("--role", choices=["harness", "leaf", "mixer"],
+                    default="harness")
+    # child-role plumbing
+    ap.add_argument("--announce", help="file to write the bound port to")
+    ap.add_argument("--leaves", help="mixer: leaf naming file path")
+    ap.add_argument("--slow-delay-ms", type=float, default=25.0,
+                    help="slow-leaf leg injected handler delay "
+                         "(leaf boot default is 0; set via Control)")
+    # harness knobs
+    ap.add_argument("--mixers", type=int, default=2)
+    ap.add_argument("--n-leaves", dest="leaves_n", type=int, default=4)
+    ap.add_argument("--fanout", type=int, default=2,
+                    help="leaf sub-calls per root request")
+    ap.add_argument("--concurrency", type=int, default=8,
+                    help="root open-loop caller threads")
+    ap.add_argument("--leg-s", type=float, default=3.0,
+                    help="press duration per churn leg")
+    ap.add_argument("--settle-s", type=float, default=1.0,
+                    help="post-kill settle before the recovered press "
+                         "(>= the 0.2s health-check interval)")
+    ap.add_argument("--timeout-ms", type=float, default=300.0,
+                    help="root deadline budget per call (propagated)")
+    ap.add_argument("--backup-ms", type=float, default=30.0,
+                    help="mixer-tier hedge trigger")
+    ap.add_argument("--min-success", type=float, default=0.99)
+    ap.add_argument("--legs", default="baseline,leaf_kill,slow_leaf,"
+                                      "naming_flap,expired_budget,"
+                                      "mixer_partition")
+    ap.add_argument("--workdir", help="scratch dir (default: mkdtemp)")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.role == "leaf":
+        args.slow_delay_ms = 0.0  # leaves boot fast; Control flips them
+        return run_leaf(args)
+    if args.role == "mixer":
+        args.timeout_ms = args.timeout_ms
+        args.backup_ms = args.backup_ms
+        return run_mixer(args)
+    # env contract must be set before brpc_tpu loads native flag caches
+    for k, v in _MESH_ENV.items():
+        os.environ.setdefault(k, v)
+    args.leaves = args.leaves_n
+    return run_harness(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
